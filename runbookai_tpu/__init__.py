@@ -1,0 +1,20 @@
+"""runbookai_tpu — TPU-native AI SRE agent framework.
+
+A ground-up rebuild of RunbookAI (reference: an all-TypeScript Node CLI that
+delegates all model execution to hosted LLM HTTP APIs) as a TPU-native stack:
+
+- ``runbookai_tpu.models`` / ``ops`` / ``engine``: in-tree JAX/XLA inference
+  (Llama-3 family, paged KV cache, continuous batching, Pallas kernels).
+- ``runbookai_tpu.parallel``: device mesh, shardings, XLA collectives over ICI.
+- ``runbookai_tpu.agent``: the two reasoning paths (free-form tool loop and the
+  structured investigation state machine).
+- ``runbookai_tpu.knowledge``: SQLite FTS5 + on-device vector search with a JAX
+  bge-base encoder.
+- ``runbookai_tpu.tools`` / ``skills`` / ``evalsuite`` / ``cli``: the product
+  surface around the model.
+
+Heavy imports (jax, transformers) are deferred: importing this package is cheap
+so that CLI startup and model-less tests stay fast.
+"""
+
+__version__ = "0.1.0"

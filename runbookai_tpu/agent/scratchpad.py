@@ -1,0 +1,247 @@
+"""Scratchpad: per-session JSONL audit trail + tiered tool-result storage.
+
+Parity target: reference ``src/agent/scratchpad.ts`` — JSONL under
+``.runbook/scratchpad/`` (:84-137), tiered full→compact→cleared storage of tool
+results with drill-down by ``result_id`` (:327), graceful per-tool call limits
+that warn but never block (:173), similar-query detection, tiered context
+build (:382) and compaction-plan application (:271). The JSONL trail is
+load-bearing for the product's auditability claim and is kept verbatim.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+from runbookai_tpu.agent.types import ToolCall
+
+# Storage tiers for tool results.
+TIER_FULL = "full"
+TIER_COMPACT = "compact"
+TIER_CLEARED = "cleared"
+
+# Default graceful limits per tool (reference scratchpad.ts:33-47 spirit:
+# generous defaults; limits warn, never block).
+DEFAULT_TOOL_CALL_LIMIT = 15
+
+
+def _json_default(obj: Any) -> Any:
+    if hasattr(obj, "__dict__"):
+        return obj.__dict__
+    return str(obj)
+
+
+@dataclass
+class ToolResultEntry:
+    result_id: str
+    tool: str
+    args: dict[str, Any]
+    tier: str = TIER_FULL
+    full: Any = None
+    compact: Optional[dict[str, Any]] = None  # summary/highlights/itemCount/...
+    error: Optional[str] = None
+    duration_ms: float = 0.0
+    ts: float = field(default_factory=time.time)
+
+    def context_text(self) -> str:
+        """Render for the prompt according to the current tier."""
+        header = f"[{self.result_id}] {self.tool}({json.dumps(self.args, default=_json_default)})"
+        if self.error:
+            return f"{header} -> ERROR: {self.error}"
+        if self.tier == TIER_CLEARED:
+            return (
+                f"{header} -> (result cleared to save context; "
+                f"use get_full_result with result_id={self.result_id!r} to retrieve)"
+            )
+        if self.tier == TIER_COMPACT and self.compact is not None:
+            summary = self.compact.get("summary", "")
+            highlights = self.compact.get("highlights") or []
+            parts = [f"{header} -> {summary}"]
+            for h in highlights[:5]:
+                parts.append(f"  - {h}")
+            parts.append(f"  (compacted; drill down via get_full_result {self.result_id})")
+            return "\n".join(parts)
+        return f"{header} ->\n{json.dumps(self.full, indent=2, default=_json_default)[:8000]}"
+
+
+class Scratchpad:
+    """Append-only session log + in-memory tiered tool-result store."""
+
+    def __init__(
+        self,
+        session_id: Optional[str] = None,
+        root: str | Path = ".runbook/scratchpad",
+        tool_limits: Optional[dict[str, int]] = None,
+        default_limit: int = DEFAULT_TOOL_CALL_LIMIT,
+        persist: bool = True,
+    ):
+        self.session_id = session_id or f"session-{uuid.uuid4().hex[:10]}"
+        self.root = Path(root)
+        self.persist = persist
+        self.path = self.root / f"{self.session_id}.jsonl"
+        self.tool_limits = tool_limits or {}
+        self.default_limit = default_limit
+        self.entries: list[dict[str, Any]] = []
+        self.results: dict[str, ToolResultEntry] = {}
+        self._result_order: list[str] = []
+        self._tool_counts: dict[str, int] = {}
+        self._call_signatures: list[str] = []
+        if self.persist:
+            self.root.mkdir(parents=True, exist_ok=True)
+        self.append("init", {"session_id": self.session_id})
+
+    # ----------------------------------------------------------------- JSONL
+
+    def append(self, kind: str, data: dict[str, Any]) -> None:
+        entry = {"kind": kind, "ts": time.time(), **data}
+        self.entries.append(entry)
+        if self.persist:
+            with self.path.open("a") as f:
+                f.write(json.dumps(entry, default=_json_default) + "\n")
+
+    def append_thinking(self, text: str) -> None:
+        self.append("thinking", {"text": text})
+
+    # ------------------------------------------------------------ tool calls
+
+    @staticmethod
+    def call_signature(call: ToolCall) -> str:
+        return f"{call.name}:{json.dumps(call.args, sort_keys=True, default=_json_default)}"
+
+    def record_call_signature(self, call: ToolCall) -> int:
+        """Track exact-repeat calls; returns how many times this signature has
+        now been seen (agent loop warns at >2 — reference agent.ts:529-548)."""
+        sig = self.call_signature(call)
+        self._call_signatures.append(sig)
+        return self._call_signatures.count(sig)
+
+    def can_call_tool(self, tool: str) -> tuple[bool, Optional[str]]:
+        """Graceful limit check: always allows, returns a warning string once
+        the per-tool limit is exceeded (reference scratchpad.ts:173)."""
+        limit = self.tool_limits.get(tool, self.default_limit)
+        count = self._tool_counts.get(tool, 0)
+        if count >= limit:
+            return True, (
+                f"Tool {tool!r} has been called {count} times (soft limit {limit}). "
+                "Consider concluding with the evidence gathered."
+            )
+        return True, None
+
+    def append_tool_result(
+        self,
+        call: ToolCall,
+        result: Any = None,
+        error: Optional[str] = None,
+        duration_ms: float = 0.0,
+        compact: Optional[dict[str, Any]] = None,
+    ) -> ToolResultEntry:
+        self._tool_counts[call.name] = self._tool_counts.get(call.name, 0) + 1
+        result_id = f"r{len(self._result_order) + 1}"
+        entry = ToolResultEntry(
+            result_id=result_id,
+            tool=call.name,
+            args=call.args,
+            full=result,
+            compact=compact,
+            error=error,
+            duration_ms=duration_ms,
+        )
+        self.results[result_id] = entry
+        self._result_order.append(result_id)
+        self.append(
+            "tool_result",
+            {
+                "result_id": result_id,
+                "tool": call.name,
+                "args": call.args,
+                "error": error,
+                "duration_ms": duration_ms,
+                # Persist the full result in the audit trail even when the
+                # in-context tier later degrades — the JSONL is the audit log.
+                "result": result,
+            },
+        )
+        return entry
+
+    # ------------------------------------------------------------- drilldown
+
+    def get_result_by_id(self, result_id: str) -> Optional[ToolResultEntry]:
+        return self.results.get(result_id)
+
+    def list_results(self) -> list[dict[str, Any]]:
+        return [
+            {
+                "result_id": r.result_id,
+                "tool": r.tool,
+                "tier": r.tier,
+                "error": r.error,
+                "summary": (r.compact or {}).get("summary"),
+            }
+            for r in (self.results[rid] for rid in self._result_order)
+        ]
+
+    # ------------------------------------------------------------ compaction
+
+    def clear_oldest_tool_results(self, keep_last: int = 5) -> int:
+        """Degrade oldest results to cleared, keeping the newest K full."""
+        cleared = 0
+        for rid in self._result_order[:-keep_last] if keep_last else self._result_order:
+            entry = self.results[rid]
+            if entry.tier != TIER_CLEARED:
+                entry.tier = TIER_CLEARED
+                cleared += 1
+        return cleared
+
+    def apply_compaction_plan(self, plan: dict[str, str]) -> None:
+        """Apply {result_id: tier} from the ContextCompactor
+        (reference scratchpad.ts:271)."""
+        for rid, tier in plan.items():
+            entry = self.results.get(rid)
+            if entry and tier in (TIER_FULL, TIER_COMPACT, TIER_CLEARED):
+                entry.tier = tier
+        self.append("compaction", {"plan": plan})
+
+    # --------------------------------------------------------------- context
+
+    def build_tiered_context(self, max_chars: Optional[int] = None) -> str:
+        """Render all tool results for the iteration prompt, honoring tiers
+        (reference scratchpad.ts:382)."""
+        blocks = [self.results[rid].context_text() for rid in self._result_order]
+        text = "\n\n".join(blocks)
+        if max_chars is not None and len(text) > max_chars:
+            text = text[-max_chars:]
+        return text
+
+    def get_tool_usage_status(self) -> dict[str, dict[str, int]]:
+        return {
+            tool: {"count": count, "limit": self.tool_limits.get(tool, self.default_limit)}
+            for tool, count in sorted(self._tool_counts.items())
+        }
+
+    @classmethod
+    def load(cls, session_id: str, root: str | Path = ".runbook/scratchpad") -> "Scratchpad":
+        """Rehydrate a scratchpad from its JSONL (replayable audit log)."""
+        pad = cls(session_id=session_id, root=root, persist=False)
+        path = Path(root) / f"{session_id}.jsonl"
+        if path.is_file():
+            for line in path.read_text().splitlines():
+                try:
+                    entry = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if entry.get("kind") == "tool_result":
+                    call = ToolCall(
+                        id="replay", name=entry["tool"], args=entry.get("args") or {}
+                    )
+                    pad.append_tool_result(
+                        call,
+                        result=entry.get("result"),
+                        error=entry.get("error"),
+                        duration_ms=entry.get("duration_ms", 0.0),
+                    )
+        pad.persist = False
+        return pad

@@ -1,0 +1,151 @@
+"""Core agent contracts: tools, tool calls, events, retrieved knowledge.
+
+Parity target: reference ``src/agent/types.ts`` (AgentEvent union :6-140,
+Tool/ToolCall :174-201, scratchpad entry types :203-263, RetrievedKnowledge
+:281). Re-expressed as Python dataclasses; tool ``execute`` is async because the
+TPU build overlaps tool I/O with device decode steps (asyncio host program).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Awaitable, Callable, Optional
+
+
+class RiskLevel(str, Enum):
+    """Operation risk classes (reference ``src/agent/safety.ts:38-82``)."""
+
+    READ = "read"
+    LOW = "low"
+    HIGH = "high"
+    CRITICAL = "critical"
+
+
+@dataclass
+class Tool:
+    """A callable tool the agent may invoke.
+
+    Mirrors the reference tool interface ``{name, description, parameters,
+    execute(args)}`` (``src/agent/types.ts:174-190``) plus the category and
+    risk metadata the registry/safety layers need.
+    """
+
+    name: str
+    description: str
+    parameters: dict[str, Any]  # JSON schema for the arguments object
+    execute: Callable[[dict[str, Any]], Awaitable[Any]]
+    category: str = "general"
+    risk: RiskLevel = RiskLevel.READ
+    # Graceful per-session call limit (warn, never block — reference
+    # scratchpad.ts:173 design principle).
+    call_limit: Optional[int] = None
+
+    def schema(self) -> dict[str, Any]:
+        """The provider-facing tool schema (name/description/parameters)."""
+        return {
+            "name": self.name,
+            "description": self.description,
+            "parameters": self.parameters,
+        }
+
+
+@dataclass
+class ToolCall:
+    """A model-requested tool invocation (``src/agent/types.ts:192-201``)."""
+
+    id: str
+    name: str
+    args: dict[str, Any]
+
+    @staticmethod
+    def new(name: str, args: dict[str, Any]) -> "ToolCall":
+        return ToolCall(id=f"call_{uuid.uuid4().hex[:12]}", name=name, args=args)
+
+
+@dataclass
+class ToolResult:
+    """Result of executing one tool call."""
+
+    call: ToolCall
+    result: Any = None
+    error: Optional[str] = None
+    duration_ms: float = 0.0
+    cached: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+@dataclass
+class LLMMessage:
+    """One chat message. ``role`` in {system,user,assistant,tool}."""
+
+    role: str
+    content: str
+    tool_calls: list[ToolCall] = field(default_factory=list)
+    tool_call_id: Optional[str] = None  # set when role == "tool"
+    name: Optional[str] = None
+
+
+@dataclass
+class LLMResponse:
+    """What ``LLMClient.chat`` returns (reference ``src/agent/agent.ts:167-181``)."""
+
+    content: str
+    tool_calls: list[ToolCall] = field(default_factory=list)
+    thinking: Optional[str] = None
+    usage: dict[str, int] = field(default_factory=dict)  # prompt/completion tokens
+
+
+@dataclass
+class AgentEvent:
+    """Event streamed from the agent loops to UIs.
+
+    The reference models this as a ~20-variant discriminated union
+    (``src/agent/types.ts:6-140``). We use a single dataclass with a ``kind``
+    discriminator and a payload dict — renderers switch on ``kind``.
+
+    Kinds used by the free-form loop: ``start``, ``knowledge_retrieved``,
+    ``iteration``, ``thinking``, ``tool_call``, ``tool_result``, ``warning``,
+    ``phase``, ``answer``, ``error``, ``done``.
+    Kinds used by the structured path: ``phase_change``, ``hypothesis_created``,
+    ``hypothesis_updated``, ``evidence``, ``conclusion``, ``remediation_step``.
+    """
+
+    kind: str
+    data: dict[str, Any] = field(default_factory=dict)
+    ts: float = field(default_factory=time.time)
+
+
+@dataclass
+class KnowledgeResult:
+    """One retrieved knowledge chunk surfaced to the agent."""
+
+    doc_id: str
+    title: str
+    knowledge_type: str
+    content: str
+    score: float = 0.0
+    services: list[str] = field(default_factory=list)
+    source: str = ""
+
+
+@dataclass
+class RetrievedKnowledge:
+    """Grouped retrieval results (reference ``src/agent/types.ts:281``)."""
+
+    runbooks: list[KnowledgeResult] = field(default_factory=list)
+    postmortems: list[KnowledgeResult] = field(default_factory=list)
+    known_issues: list[KnowledgeResult] = field(default_factory=list)
+    architecture: list[KnowledgeResult] = field(default_factory=list)
+
+    def all(self) -> list[KnowledgeResult]:
+        return [*self.runbooks, *self.postmortems, *self.known_issues, *self.architecture]
+
+    @property
+    def empty(self) -> bool:
+        return not (self.runbooks or self.postmortems or self.known_issues or self.architecture)

@@ -1,0 +1,111 @@
+"""LLM client seam — the boundary the TPU inference backend plugs into.
+
+Parity target: reference ``src/model/llm.ts`` — ``LLMClient.chat(system, user,
+tools) -> {content, toolCalls, thinking}`` (``src/agent/agent.ts:167-181``) plus
+the orchestrator's simpler ``complete(prompt) -> str``
+(``src/agent/investigation-orchestrator.ts:59-61``) and an optional streaming
+variant. Where the reference fans out to 13 hosted HTTP providers via pi-ai,
+this build's primary provider is ``jax-tpu``: the in-tree JAX engine
+(:mod:`runbookai_tpu.engine`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, AsyncIterator, Optional, Protocol, runtime_checkable
+
+from runbookai_tpu.agent.types import LLMResponse, ToolCall
+
+
+@runtime_checkable
+class LLMClient(Protocol):
+    """The seam every reasoning path talks through."""
+
+    async def chat(
+        self,
+        system_prompt: str,
+        user_prompt: str,
+        tools: Optional[list[dict[str, Any]]] = None,
+    ) -> LLMResponse: ...
+
+    async def complete(self, prompt: str) -> str: ...
+
+    def chat_stream(
+        self,
+        system_prompt: str,
+        user_prompt: str,
+        tools: Optional[list[dict[str, Any]]] = None,
+    ) -> AsyncIterator[dict[str, Any]]: ...
+
+
+class BaseLLMClient:
+    """Default implementations of the derived methods."""
+
+    async def chat(self, system_prompt, user_prompt, tools=None) -> LLMResponse:
+        raise NotImplementedError
+
+    async def complete(self, prompt: str) -> str:
+        """Plain completion used by the structured orchestrator."""
+        resp = await self.chat("", prompt, tools=None)
+        return resp.content
+
+    async def chat_stream(self, system_prompt, user_prompt, tools=None):
+        """Fallback streaming: chunk a non-streaming response (reference
+        ``src/model/llm.ts:152-203`` does the same)."""
+        resp = await self.chat(system_prompt, user_prompt, tools)
+        text = resp.content
+        step = 64
+        for i in range(0, len(text), step):
+            yield {"type": "text", "delta": text[i : i + step]}
+        for call in resp.tool_calls:
+            yield {"type": "tool_call", "call": call}
+        yield {"type": "done", "response": resp}
+
+
+class MockLLMClient(BaseLLMClient):
+    """Queue of canned responses for tests (reference ``src/model/llm.ts:280-298``).
+
+    ``queue`` entries may be ``LLMResponse`` or plain strings. When the queue
+    empties, returns ``default`` (an empty-content response) instead of raising,
+    so loops terminate deterministically.
+    """
+
+    def __init__(self, responses: Optional[list[LLMResponse | str]] = None):
+        self.queue: list[LLMResponse] = [
+            r if isinstance(r, LLMResponse) else LLMResponse(content=r)
+            for r in (responses or [])
+        ]
+        self.calls: list[dict[str, Any]] = []  # recorded for assertions
+
+    def enqueue(self, *responses: LLMResponse | str) -> None:
+        for r in responses:
+            self.queue.append(r if isinstance(r, LLMResponse) else LLMResponse(content=r))
+
+    async def chat(self, system_prompt, user_prompt, tools=None) -> LLMResponse:
+        self.calls.append(
+            {"system": system_prompt, "user": user_prompt, "tools": tools}
+        )
+        await asyncio.sleep(0)  # yield, as a real engine would
+        if self.queue:
+            return self.queue.pop(0)
+        return LLMResponse(content="")
+
+
+def create_llm_client(config: Any) -> BaseLLMClient:
+    """Factory keyed on ``config.llm.provider`` (reference ``llm.ts:59``).
+
+    ``jax-tpu`` builds the in-tree engine-backed client; ``mock`` returns a
+    :class:`MockLLMClient` (used by the demo/offline paths and tests).
+    """
+    llm_cfg = getattr(config, "llm", config)
+    provider = getattr(llm_cfg, "provider", "mock")
+    if provider == "mock":
+        return MockLLMClient()
+    if provider == "jax-tpu":
+        from runbookai_tpu.model.jax_tpu import JaxTpuClient
+
+        return JaxTpuClient.from_config(llm_cfg)
+    raise ValueError(
+        f"Unknown llm.provider {provider!r}: this build serves models in-tree "
+        "(jax-tpu) and does not proxy to hosted APIs; use 'mock' for modelless runs"
+    )

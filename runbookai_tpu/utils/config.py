@@ -1,0 +1,346 @@
+"""Layered YAML config with ``${ENV}`` interpolation.
+
+Parity target: reference ``src/utils/config.ts`` (zod ``ConfigSchema`` :211,
+``loadConfig`` :221 with CWD→$HOME search path, ``${ENV_VAR}`` resolution
+:252-269, ``validateConfig`` :292) and ``src/config/services.ts`` (infra
+inventory schemas). zod becomes pydantic. New here: the ``llm.provider:
+jax-tpu`` block carries the TPU serving parameters (model path, mesh shape,
+dtype, max_seq, KV page size, batch caps) that have no reference counterpart.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from pathlib import Path
+from typing import Any, Literal, Optional
+
+import yaml
+from pydantic import BaseModel, Field
+
+CONFIG_DIR = ".runbook"
+CONFIG_FILE = "config.yaml"
+SERVICES_FILE = "services.yaml"
+
+_ENV_RE = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*)\}")
+
+
+def _interpolate(value: Any) -> Any:
+    """Recursively resolve ``${ENV_VAR}`` in strings (unset vars -> '')."""
+    if isinstance(value, str):
+        return _ENV_RE.sub(lambda m: os.environ.get(m.group(1), ""), value)
+    if isinstance(value, list):
+        return [_interpolate(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _interpolate(v) for k, v in value.items()}
+    return value
+
+
+# --------------------------------------------------------------------------- #
+# llm / engine                                                                #
+# --------------------------------------------------------------------------- #
+
+
+class MeshConfig(BaseModel):
+    """Logical device mesh for the serving engine.
+
+    Axis sizes multiply to the device count. ``data`` batches independent
+    sequences (eval DP), ``model`` shards attention heads / MLP (Megatron TP
+    over ICI).
+    """
+
+    data: int = 1
+    model: int = 1
+
+    @property
+    def device_count(self) -> int:
+        return self.data * self.model
+
+
+class LLMConfig(BaseModel):
+    provider: Literal["jax-tpu", "mock"] = "mock"
+    model: str = "llama3-8b-instruct"
+    # Path to weights (HF safetensors dir) — None means random init (CI, no-egress).
+    model_path: Optional[str] = None
+    tokenizer_path: Optional[str] = None
+    dtype: Literal["bfloat16", "float32", "int8"] = "bfloat16"
+    max_seq_len: int = 8192
+    max_new_tokens: int = 1024
+    temperature: float = 0.0
+    top_p: float = 1.0
+    # Paged KV cache (engine):
+    page_size: int = 16  # tokens per KV page
+    num_pages: int = 2048  # page pool size (static for XLA)
+    max_batch_slots: int = 8  # concurrent sequences in the decode batch
+    prefill_chunk: int = 512  # prefill processed in chunks of this many tokens
+    mesh: MeshConfig = Field(default_factory=MeshConfig)
+    guided_json: bool = True  # token-level JSON grammar masks for complete()
+
+
+# --------------------------------------------------------------------------- #
+# providers / incident / knowledge / safety / agent (reference parity blocks) #
+# --------------------------------------------------------------------------- #
+
+
+class AWSProviderConfig(BaseModel):
+    enabled: bool = False
+    profile: Optional[str] = None
+    role_arn: Optional[str] = None
+    regions: list[str] = Field(default_factory=lambda: ["us-east-1"])
+    accounts: list[dict[str, Any]] = Field(default_factory=list)
+    simulated: bool = False  # fixture-backed provider set (no cloud credentials)
+    fixtures_path: Optional[str] = None
+
+
+class KubernetesProviderConfig(BaseModel):
+    enabled: bool = False
+    contexts: list[str] = Field(default_factory=list)
+    simulated: bool = False
+    fixtures_path: Optional[str] = None
+
+
+class GitProviderConfig(BaseModel):
+    enabled: bool = False
+    token: Optional[str] = None
+    base_url: Optional[str] = None
+    repos: list[str] = Field(default_factory=list)
+
+
+class OperabilityContextConfig(BaseModel):
+    enabled: bool = False
+    adapter: Literal["http", "sourcegraph", "entireio", "runbook-context", "custom"] = "http"
+    base_url: Optional[str] = None
+    token: Optional[str] = None
+    capabilities: list[str] = Field(default_factory=list)
+
+
+class ProvidersConfig(BaseModel):
+    aws: AWSProviderConfig = Field(default_factory=AWSProviderConfig)
+    kubernetes: KubernetesProviderConfig = Field(default_factory=KubernetesProviderConfig)
+    github: GitProviderConfig = Field(default_factory=GitProviderConfig)
+    gitlab: GitProviderConfig = Field(default_factory=GitProviderConfig)
+    operability_context: OperabilityContextConfig = Field(
+        default_factory=OperabilityContextConfig
+    )
+
+
+class PagerDutyConfig(BaseModel):
+    enabled: bool = False
+    api_key: Optional[str] = None
+    simulated: bool = False
+
+
+class OpsgenieConfig(BaseModel):
+    enabled: bool = False
+    api_key: Optional[str] = None
+    simulated: bool = False
+
+
+class SlackConfig(BaseModel):
+    enabled: bool = False
+    bot_token: Optional[str] = None
+    signing_secret: Optional[str] = None
+    app_token: Optional[str] = None
+    default_channel: Optional[str] = None
+    allowed_channels: list[str] = Field(default_factory=list)
+    allowed_users: list[str] = Field(default_factory=list)
+    require_thread: bool = False
+
+
+class DatadogConfig(BaseModel):
+    enabled: bool = False
+    api_key: Optional[str] = None
+    app_key: Optional[str] = None
+    site: str = "datadoghq.com"
+    simulated: bool = False
+
+
+class PrometheusConfig(BaseModel):
+    enabled: bool = False
+    base_url: Optional[str] = None
+    simulated: bool = False
+
+
+class IncidentConfig(BaseModel):
+    pagerduty: PagerDutyConfig = Field(default_factory=PagerDutyConfig)
+    opsgenie: OpsgenieConfig = Field(default_factory=OpsgenieConfig)
+    slack: SlackConfig = Field(default_factory=SlackConfig)
+
+
+class ObservabilityConfig(BaseModel):
+    datadog: DatadogConfig = Field(default_factory=DatadogConfig)
+    prometheus: PrometheusConfig = Field(default_factory=PrometheusConfig)
+    cloudwatch_enabled: bool = False
+
+
+class KnowledgeSourceConfig(BaseModel):
+    type: Literal["filesystem", "confluence", "google-drive"] = "filesystem"
+    name: str = "default"
+    path: Optional[str] = None  # filesystem
+    base_url: Optional[str] = None  # confluence
+    space: Optional[str] = None
+    labels: list[str] = Field(default_factory=list)
+    folder_id: Optional[str] = None  # google drive
+    token: Optional[str] = None
+
+
+class EmbedderConfig(BaseModel):
+    """JAX bge-base encoder settings (replaces reference OpenAI embedder,
+    ``src/knowledge/indexer/embedder.ts:20-22``: 1536-d text-embedding-3-small,
+    batch 100 → 768-d bge-base-en-v1.5, on-device batch)."""
+
+    enabled: bool = True
+    model: str = "bge-base-en-v1.5"
+    model_path: Optional[str] = None  # HF dir; None -> random init (tests)
+    dim: int = 768
+    batch_size: int = 64
+    max_length: int = 512
+
+
+class KnowledgeConfig(BaseModel):
+    sources: list[KnowledgeSourceConfig] = Field(default_factory=list)
+    db_path: str = f"{CONFIG_DIR}/knowledge.db"
+    embedder: EmbedderConfig = Field(default_factory=EmbedderConfig)
+    # Hybrid fusion constants (reference hybrid-search.ts:17-19):
+    rrf_k: int = 60
+    fts_weight: float = 0.4
+    vector_weight: float = 0.6
+
+
+class SafetyConfig(BaseModel):
+    """Reference ``config.yaml`` safety block + ``approval.ts`` policy knobs."""
+
+    require_approval: list[str] = Field(default_factory=lambda: ["high", "critical"])
+    auto_approve_low_risk: bool = True
+    max_mutations_per_session: int = 5
+    cooldown_seconds: int = 60
+    approval_timeout_seconds: int = 300
+
+
+class AgentConfig(BaseModel):
+    max_iterations: int = 10  # free-form loop (agent.ts:48)
+    max_investigation_iterations: int = 20  # FSM loop (state-machine.ts:206)
+    max_hypotheses: int = 10
+    max_hypothesis_depth: int = 4
+    context_threshold_tokens: int = 100_000
+    explain_mode: bool = False
+    parallel_tool_calls: bool = True
+    tool_cache_ttl_seconds: int = 300
+    tool_cache_size: int = 100
+
+
+class ClaudeIntegrationConfig(BaseModel):
+    enabled: bool = False
+    session_store: Literal["local", "s3"] = "local"
+    session_store_path: str = f"{CONFIG_DIR}/claude-sessions"
+    s3_bucket: Optional[str] = None
+
+
+class IntegrationsConfig(BaseModel):
+    claude: ClaudeIntegrationConfig = Field(default_factory=ClaudeIntegrationConfig)
+
+
+class Config(BaseModel):
+    llm: LLMConfig = Field(default_factory=LLMConfig)
+    providers: ProvidersConfig = Field(default_factory=ProvidersConfig)
+    incident: IncidentConfig = Field(default_factory=IncidentConfig)
+    observability: ObservabilityConfig = Field(default_factory=ObservabilityConfig)
+    knowledge: KnowledgeConfig = Field(default_factory=KnowledgeConfig)
+    safety: SafetyConfig = Field(default_factory=SafetyConfig)
+    agent: AgentConfig = Field(default_factory=AgentConfig)
+    integrations: IntegrationsConfig = Field(default_factory=IntegrationsConfig)
+    runbook_dir: str = CONFIG_DIR  # session/audit/scratchpad root
+
+
+# --------------------------------------------------------------------------- #
+# services.yaml (infra inventory)                                             #
+# --------------------------------------------------------------------------- #
+
+
+class ServiceEntry(BaseModel):
+    name: str
+    type: str = "service"
+    team: Optional[str] = None
+    tier: Optional[int] = None
+    tags: list[str] = Field(default_factory=list)
+    depends_on: list[str] = Field(default_factory=list)
+    aws: dict[str, Any] = Field(default_factory=dict)
+    observability: dict[str, Any] = Field(default_factory=dict)
+
+
+class ServicesConfig(BaseModel):
+    accounts: list[dict[str, Any]] = Field(default_factory=list)
+    services: list[ServiceEntry] = Field(default_factory=list)
+
+
+# --------------------------------------------------------------------------- #
+# loading                                                                     #
+# --------------------------------------------------------------------------- #
+
+
+def _search_paths(filename: str, cwd: Optional[Path] = None) -> list[Path]:
+    cwd = cwd or Path.cwd()
+    return [cwd / CONFIG_DIR / filename, Path.home() / CONFIG_DIR / filename]
+
+
+def load_config(path: Optional[str | Path] = None, cwd: Optional[Path] = None) -> Config:
+    """Load + validate config. Search order: explicit path, CWD/.runbook,
+    $HOME/.runbook; missing file -> defaults (mock provider, everything off)."""
+    candidates = [Path(path)] if path else _search_paths(CONFIG_FILE, cwd)
+    for p in candidates:
+        if p.is_file():
+            raw = yaml.safe_load(p.read_text()) or {}
+            return Config.model_validate(_interpolate(raw))
+    return Config()
+
+
+def load_services(path: Optional[str | Path] = None, cwd: Optional[Path] = None) -> ServicesConfig:
+    candidates = [Path(path)] if path else _search_paths(SERVICES_FILE, cwd)
+    for p in candidates:
+        if p.is_file():
+            raw = yaml.safe_load(p.read_text()) or {}
+            return ServicesConfig.model_validate(_interpolate(raw))
+    return ServicesConfig()
+
+
+def save_config(config: Config, path: str | Path) -> None:
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(yaml.safe_dump(config.model_dump(mode="json"), sort_keys=False))
+
+
+def set_config_value(config: Config, dotted_key: str, value: str) -> Config:
+    """``runbook config --set a.b.c=v`` nested sets (reference cli.tsx:1587)."""
+    data = config.model_dump()
+    node = data
+    parts = dotted_key.split(".")
+    for part in parts[:-1]:
+        nxt = node.get(part)
+        if not isinstance(nxt, dict):
+            nxt = {}
+            node[part] = nxt
+        node = nxt
+    parsed: Any = value
+    try:
+        parsed = yaml.safe_load(value)
+    except yaml.YAMLError:
+        pass
+    node[parts[-1]] = parsed
+    return Config.model_validate(data)
+
+
+def validate_config(config: Config) -> list[str]:
+    """Return human-readable problems (reference validateConfig :292)."""
+    problems: list[str] = []
+    if config.llm.provider == "jax-tpu" and config.llm.model_path:
+        if not Path(config.llm.model_path).exists():
+            problems.append(f"llm.model_path does not exist: {config.llm.model_path}")
+    for src in config.knowledge.sources:
+        if src.type == "filesystem" and src.path and not Path(src.path).exists():
+            problems.append(f"knowledge source path does not exist: {src.path}")
+        if src.type == "confluence" and not src.base_url:
+            problems.append(f"confluence source {src.name!r} missing base_url")
+    mesh = config.llm.mesh
+    if mesh.data < 1 or mesh.model < 1:
+        problems.append("llm.mesh axes must be >= 1")
+    return problems

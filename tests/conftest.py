@@ -1,0 +1,38 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax initializes.
+
+Sharding/parallelism tests run on CPU with
+``--xla_force_host_platform_device_count=8`` (SURVEY.md §4 implication) so the
+full TP/DP pjit programs compile and execute without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import asyncio
+import inspect
+
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    # Lightweight asyncio support without requiring pytest-asyncio.
+    for item in items:
+        if inspect.iscoroutinefunction(getattr(item, "function", None)):
+            item.add_marker(pytest.mark.asyncio_inline)
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.function
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

@@ -1,0 +1,133 @@
+"""Core contracts: types, mock LLM client, config loading, tokenizers."""
+
+import os
+
+import pytest
+
+from runbookai_tpu.agent.types import LLMResponse, RiskLevel, Tool, ToolCall
+from runbookai_tpu.model.client import MockLLMClient, create_llm_client
+from runbookai_tpu.utils.config import (
+    Config,
+    load_config,
+    save_config,
+    set_config_value,
+    validate_config,
+)
+from runbookai_tpu.utils.tokens import (
+    ByteTokenizer,
+    estimate_tokens,
+    load_tokenizer,
+    truncate_to_tokens,
+)
+
+
+async def test_mock_llm_client_queue_and_recording():
+    client = MockLLMClient(["first", LLMResponse(content="second")])
+    r1 = await client.chat("sys", "hello")
+    r2 = await client.chat("sys", "again")
+    r3 = await client.chat("sys", "empty")
+    assert (r1.content, r2.content, r3.content) == ("first", "second", "")
+    assert [c["user"] for c in client.calls] == ["hello", "again", "empty"]
+
+
+async def test_complete_routes_through_chat():
+    client = MockLLMClient(['{"ok": true}'])
+    assert await client.complete("prompt") == '{"ok": true}'
+
+
+async def test_chat_stream_fallback_chunks():
+    client = MockLLMClient([LLMResponse(content="x" * 150, tool_calls=[ToolCall.new("t", {})])])
+    chunks = [c async for c in client.chat_stream("s", "u")]
+    kinds = [c["type"] for c in chunks]
+    assert kinds.count("text") == 3 and "tool_call" in kinds and kinds[-1] == "done"
+    assert "".join(c["delta"] for c in chunks if c["type"] == "text") == "x" * 150
+
+
+def test_factory_mock_and_unknown():
+    cfg = Config()
+    assert isinstance(create_llm_client(cfg), MockLLMClient)
+    cfg2 = Config.model_validate({"llm": {"provider": "mock"}})
+    assert isinstance(create_llm_client(cfg2), MockLLMClient)
+    with pytest.raises(Exception):
+        Config.model_validate({"llm": {"provider": "openai"}})  # hosted APIs removed
+
+
+def test_config_env_interpolation_and_search(tmp_path, monkeypatch):
+    monkeypatch.setenv("PD_KEY", "secret-123")
+    d = tmp_path / ".runbook"
+    d.mkdir()
+    (d / "config.yaml").write_text(
+        """
+llm:
+  provider: jax-tpu
+  model: llama3-8b-instruct
+  mesh: {data: 2, model: 4}
+incident:
+  pagerduty: {enabled: true, api_key: "${PD_KEY}"}
+agent:
+  max_iterations: 7
+"""
+    )
+    cfg = load_config(cwd=tmp_path)
+    assert cfg.llm.provider == "jax-tpu"
+    assert cfg.llm.mesh.device_count == 8
+    assert cfg.incident.pagerduty.api_key == "secret-123"
+    assert cfg.agent.max_iterations == 7
+    # defaults when nothing exists
+    cfg2 = load_config(cwd=tmp_path / "elsewhere")
+    assert cfg2.llm.provider == "mock"
+
+
+def test_config_set_and_save_roundtrip(tmp_path):
+    cfg = Config()
+    cfg = set_config_value(cfg, "agent.max_iterations", "15")
+    cfg = set_config_value(cfg, "llm.provider", "jax-tpu")
+    assert cfg.agent.max_iterations == 15
+    p = tmp_path / "config.yaml"
+    save_config(cfg, p)
+    cfg2 = load_config(path=p)
+    assert cfg2.agent.max_iterations == 15 and cfg2.llm.provider == "jax-tpu"
+
+
+def test_validate_config_reports_problems(tmp_path):
+    cfg = Config.model_validate(
+        {
+            "llm": {"provider": "jax-tpu", "model_path": "/nonexistent/weights"},
+            "knowledge": {"sources": [{"type": "confluence", "name": "c"}]},
+        }
+    )
+    problems = validate_config(cfg)
+    assert any("model_path" in p for p in problems)
+    assert any("confluence" in p for p in problems)
+
+
+def test_byte_tokenizer_roundtrip_and_specials():
+    tok = ByteTokenizer()
+    text = "<|begin_of_text|>hello ⚡ world<|eot_id|>"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eot_id
+    assert tok.vocab_size == 262
+
+
+def test_estimate_and_truncate():
+    tok = ByteTokenizer()
+    assert estimate_tokens("abcd" * 10, tok) == 40
+    assert estimate_tokens("abcd" * 10) == 10  # chars/4 fallback
+    t = truncate_to_tokens("x" * 100, 10, tok)
+    assert t.startswith("x" * 10) and "truncated" in t
+
+
+def test_load_tokenizer_fallback(tmp_path):
+    tok = load_tokenizer(tmp_path)  # no tokenizer.json -> byte fallback
+    assert isinstance(tok, ByteTokenizer)
+
+
+def test_tool_schema_and_risk():
+    async def run(args):
+        return {"ok": True}
+
+    t = Tool(name="x", description="d", parameters={"type": "object"}, execute=run,
+             risk=RiskLevel.HIGH)
+    assert t.schema() == {"name": "x", "description": "d", "parameters": {"type": "object"}}
+    assert t.risk == RiskLevel.HIGH

@@ -1,0 +1,72 @@
+"""Scratchpad: JSONL audit trail, tiers, graceful limits, drill-down."""
+
+import json
+
+from runbookai_tpu.agent.scratchpad import TIER_CLEARED, TIER_COMPACT, Scratchpad
+from runbookai_tpu.agent.types import ToolCall
+
+
+def _pad(tmp_path, **kw):
+    return Scratchpad(session_id="s1", root=tmp_path, **kw)
+
+
+def test_jsonl_written_and_replayable(tmp_path):
+    pad = _pad(tmp_path)
+    call = ToolCall.new("aws_query", {"service": "ec2"})
+    pad.append_tool_result(call, result={"instances": 3}, duration_ms=12.5)
+    pad.append_thinking("narrowing to ec2")
+    lines = [json.loads(l) for l in (tmp_path / "s1.jsonl").read_text().splitlines()]
+    kinds = [l["kind"] for l in lines]
+    assert kinds == ["init", "tool_result", "thinking"]
+    assert lines[1]["result"] == {"instances": 3}
+
+    replayed = Scratchpad.load("s1", root=tmp_path)
+    assert len(replayed.results) == 1
+    assert replayed.results["r1"].full == {"instances": 3}
+
+
+def test_graceful_limits_warn_never_block(tmp_path):
+    pad = _pad(tmp_path, tool_limits={"aws_query": 2})
+    for _ in range(2):
+        pad.append_tool_result(ToolCall.new("aws_query", {}), result={})
+    allowed, warning = pad.can_call_tool("aws_query")
+    assert allowed is True and warning is not None and "soft limit" in warning
+    allowed2, warning2 = pad.can_call_tool("cloudwatch_alarms")
+    assert allowed2 is True and warning2 is None
+
+
+def test_repeat_signature_guard(tmp_path):
+    pad = _pad(tmp_path)
+    call = ToolCall.new("datadog", {"q": "latency"})
+    assert pad.record_call_signature(call) == 1
+    assert pad.record_call_signature(ToolCall.new("datadog", {"q": "latency"})) == 2
+    assert pad.record_call_signature(ToolCall.new("datadog", {"q": "errors"})) == 1
+
+
+def test_tiers_render_and_compaction_plan(tmp_path):
+    pad = _pad(tmp_path)
+    for i in range(3):
+        pad.append_tool_result(
+            ToolCall.new("cloudwatch_logs", {"group": f"g{i}"}),
+            result={"lines": ["err"] * 5},
+            compact={"summary": f"5 error lines in g{i}", "highlights": ["err x5"]},
+        )
+    pad.apply_compaction_plan({"r1": TIER_CLEARED, "r2": TIER_COMPACT})
+    ctx = pad.build_tiered_context()
+    assert "result cleared" in ctx  # r1
+    assert "5 error lines in g1" in ctx  # r2 compact summary
+    assert '"lines"' in ctx  # r3 still full
+    # drill-down keeps the full data regardless of tier
+    assert pad.get_result_by_id("r1").full == {"lines": ["err"] * 5}
+    listing = pad.list_results()
+    assert [r["tier"] for r in listing] == [TIER_CLEARED, TIER_COMPACT, "full"]
+
+
+def test_clear_oldest_and_usage_status(tmp_path):
+    pad = _pad(tmp_path)
+    for i in range(6):
+        pad.append_tool_result(ToolCall.new("t", {"i": i}), result=i)
+    cleared = pad.clear_oldest_tool_results(keep_last=2)
+    assert cleared == 4
+    assert pad.results["r5"].tier == "full" and pad.results["r1"].tier == TIER_CLEARED
+    assert pad.get_tool_usage_status()["t"]["count"] == 6
