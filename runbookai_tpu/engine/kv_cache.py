@@ -1,0 +1,153 @@
+"""Paged KV cache: device page pool + host-side page allocator.
+
+The pool is a pair of arrays ``[n_layers, num_pages * page_size, n_kv_heads,
+head_dim]`` — fully static shapes so every engine step hits the same compiled
+program. Logical→physical mapping lives in per-slot page tables (int32), and
+the free list is host-side (a C++ allocator can swap in behind the same
+interface; the Python one is O(1) per op and not a bottleneck at v1 scale).
+
+No reference counterpart (SURVEY.md §2.9 item 2 — green-field requirement).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class PagePool:
+    """Device arrays for the paged KV cache."""
+
+    kv_k: jax.Array
+    kv_v: jax.Array
+    page_size: int
+    num_pages: int
+
+    @staticmethod
+    def create(
+        n_layers: int,
+        num_pages: int,
+        page_size: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,
+    ) -> "PagePool":
+        shape = (n_layers, num_pages * page_size, n_kv_heads, head_dim)
+        return PagePool(
+            kv_k=jnp.zeros(shape, dtype=dtype),
+            kv_v=jnp.zeros(shape, dtype=dtype),
+            page_size=page_size,
+            num_pages=num_pages,
+        )
+
+
+class PageAllocator:
+    """Host-side free-list allocator over physical page ids.
+
+    Page 0 is reserved as the "null" page that padding/unused page-table slots
+    point at, so garbage gathers stay in-bounds and get masked downstream.
+    """
+
+    NULL_PAGE = 0
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (one reserved null page)")
+        self.num_pages = num_pages
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))  # stack; 0 reserved
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise MemoryError(f"KV page pool exhausted: want {n}, have {len(self._free)}")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if p != self.NULL_PAGE:
+                self._free.append(p)
+
+
+@dataclass
+class SequenceAllocation:
+    """Pages owned by one live sequence."""
+
+    pages: list[int] = field(default_factory=list)
+    ctx_len: int = 0  # tokens currently cached
+
+    def pages_needed(self, new_len: int, page_size: int) -> int:
+        have = len(self.pages)
+        need = (new_len + page_size - 1) // page_size
+        return max(0, need - have)
+
+
+class KVCacheManager:
+    """Pairs the device pool with the allocator and builds page tables."""
+
+    def __init__(
+        self,
+        n_layers: int,
+        num_pages: int,
+        page_size: int,
+        n_kv_heads: int,
+        head_dim: int,
+        max_seq_len: int,
+        dtype=jnp.bfloat16,
+    ):
+        self.pool = PagePool.create(n_layers, num_pages, page_size, n_kv_heads, head_dim, dtype)
+        self.allocator = PageAllocator(num_pages)
+        self.page_size = page_size
+        self.max_pages_per_seq = (max_seq_len + page_size - 1) // page_size
+        self.seqs: dict[str, SequenceAllocation] = {}
+
+    def add_sequence(self, seq_id: str) -> None:
+        self.seqs[seq_id] = SequenceAllocation()
+
+    def extend(self, seq_id: str, new_ctx_len: int) -> None:
+        """Ensure pages exist to hold ``new_ctx_len`` tokens."""
+        alloc = self.seqs[seq_id]
+        if new_ctx_len > self.max_pages_per_seq * self.page_size:
+            raise MemoryError(f"sequence {seq_id} exceeds max_seq_len")
+        need = alloc.pages_needed(new_ctx_len, self.page_size)
+        if need:
+            alloc.pages.extend(self.allocator.alloc(need))
+        alloc.ctx_len = new_ctx_len
+
+    def can_extend(self, seq_id: str, new_ctx_len: int) -> bool:
+        alloc = self.seqs.get(seq_id)
+        if alloc is None:
+            return False
+        return alloc.pages_needed(new_ctx_len, self.page_size) <= self.allocator.free_pages
+
+    def can_admit(self, prompt_len: int, headroom_tokens: int = 0) -> bool:
+        need = (prompt_len + headroom_tokens + self.page_size - 1) // self.page_size
+        return need <= self.allocator.free_pages
+
+    def release(self, seq_id: str) -> None:
+        alloc = self.seqs.pop(seq_id, None)
+        if alloc:
+            self.allocator.free(alloc.pages)
+
+    def page_table_row(self, seq_id: str) -> np.ndarray:
+        """Padded int32 row of physical page ids for one sequence."""
+        row = np.full(self.max_pages_per_seq, PageAllocator.NULL_PAGE, dtype=np.int32)
+        pages = self.seqs[seq_id].pages
+        row[: len(pages)] = pages
+        return row
+
+    def page_tables(self, seq_ids: list[str]) -> np.ndarray:
+        """[len(seq_ids), max_pages_per_seq] int32; unknown ids -> null rows."""
+        rows = []
+        for sid in seq_ids:
+            if sid in self.seqs:
+                rows.append(self.page_table_row(sid))
+            else:
+                rows.append(np.zeros(self.max_pages_per_seq, dtype=np.int32))
+        return np.stack(rows) if rows else np.zeros((0, self.max_pages_per_seq), np.int32)

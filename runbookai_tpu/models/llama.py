@@ -1,0 +1,172 @@
+"""Llama-3 family in JAX — pure-functional, scan-stacked, paged-KV native.
+
+Design (TPU-first, no reference counterpart — RunbookAI calls hosted APIs):
+
+- Params are a plain pytree with all transformer layers **stacked on a leading
+  axis** and the forward pass runs ``lax.scan`` over them: one compiled layer
+  body regardless of depth (32/80 layers), which keeps XLA compile times flat
+  and makes TP sharding specs uniform.
+- A single forward covers chunked prefill and decode (decode is T=1): the
+  chunk's K/V are scattered into the paged pool, then queries attend over the
+  pool via :func:`runbookai_tpu.ops.attention.paged_attention`.
+- GQA (n_kv_heads < n_heads), RMSNorm in float32, bf16 weights by default,
+  logits in float32 for stable sampling/grammar masking.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from runbookai_tpu.ops.attention import paged_attention, write_kv_pages
+from runbookai_tpu.ops.rope import apply_rope
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    name: str
+    vocab_size: int
+    dim: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    ffn_dim: int
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+CONFIGS: dict[str, LlamaConfig] = {
+    "llama3-8b-instruct": LlamaConfig(
+        name="llama3-8b-instruct", vocab_size=128_256, dim=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, ffn_dim=14_336,
+    ),
+    "llama3-70b-instruct": LlamaConfig(
+        name="llama3-70b-instruct", vocab_size=128_256, dim=8192, n_layers=80,
+        n_heads=64, n_kv_heads=8, ffn_dim=28_672,
+    ),
+    "llama3-1b-bench": LlamaConfig(
+        # Small-dim stand-in for quick single-chip bench sanity runs.
+        name="llama3-1b-bench", vocab_size=128_256, dim=2048, n_layers=16,
+        n_heads=32, n_kv_heads=8, ffn_dim=8192,
+    ),
+    "llama3-test": LlamaConfig(
+        # Tiny config for CPU tests; vocab matches the byte tokenizer (262).
+        name="llama3-test", vocab_size=262, dim=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, ffn_dim=128, max_seq_len=512, rope_theta=10_000.0,
+    ),
+}
+
+
+def get_config(name: str) -> LlamaConfig:
+    if name not in CONFIGS:
+        raise KeyError(f"Unknown model {name!r}; known: {sorted(CONFIGS)}")
+    return CONFIGS[name]
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig, dtype=jnp.bfloat16) -> Params:
+    """Random-init params (scaled normal). Layer weights stacked on axis 0."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) / jnp.sqrt(fan_in)).astype(dtype)
+
+    L, D, H, KV, F = cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.ffn_dim
+    hd = cfg.head_dim
+    ks = jax.random.split(k_layers, 7)
+    layers = {
+        "wq": dense(ks[0], (L, D, H * hd), D),
+        "wk": dense(ks[1], (L, D, KV * hd), D),
+        "wv": dense(ks[2], (L, D, KV * hd), D),
+        "wo": dense(ks[3], (L, H * hd, D), H * hd),
+        "w_gate": dense(ks[4], (L, D, F), D),
+        "w_up": dense(ks[5], (L, D, F), D),
+        "w_down": dense(ks[6], (L, F, D), F),
+        "attn_norm": jnp.ones((L, D), dtype=jnp.float32),
+        "mlp_norm": jnp.ones((L, D), dtype=jnp.float32),
+    }
+    params: Params = {
+        "embed": dense(k_embed, (cfg.vocab_size, D), D),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dtype=jnp.float32),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(k_head, (D, cfg.vocab_size), D)
+    return params
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (norm * weight).astype(x.dtype)
+
+
+@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages"))
+def forward(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [B, T] int32 token ids for the current chunk
+    positions: jnp.ndarray,  # [B, T] absolute positions (pad with pos of last real)
+    kv_k: jnp.ndarray,  # [n_layers, num_pages * page_size, n_kv, head_dim]
+    kv_v: jnp.ndarray,  # same
+    page_tables: jnp.ndarray,  # [B, max_pages]
+    ctx_lens: jnp.ndarray,  # [B] cache length AFTER this chunk
+    page_size: int,
+    block_pages: int = 32,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One forward chunk. Returns (logits [B, T, vocab] f32, kv_k', kv_v').
+
+    Donate ``kv_k``/``kv_v`` at the jit call site for in-place page updates.
+    """
+    b, t = tokens.shape
+    hd, n_kv = cfg.head_dim, cfg.n_kv_heads
+    h = params["embed"][tokens]  # [B, T, D]
+
+    def layer_step(hidden, layer_in):
+        lp, k_pages, v_pages = layer_in
+        x = rms_norm(hidden, lp["attn_norm"], cfg.norm_eps)
+        q = (x @ lp["wq"]).reshape(b, t, cfg.n_heads, hd)
+        k = (x @ lp["wk"]).reshape(b, t, n_kv, hd)
+        v = (x @ lp["wv"]).reshape(b, t, n_kv, hd)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+
+        # Scatter this chunk's K/V into the page pool (per sequence).
+        def write_seq(kv_flat, new, pos_row, table_row):
+            return write_kv_pages(kv_flat, new, pos_row, table_row, page_size)
+
+        # vmap over batch would duplicate the pool; loop sequences instead —
+        # B is small (max_batch_slots) and unrolls at trace time.
+        for i in range(b):
+            k_pages = write_seq(k_pages, k[i], positions[i], page_tables[i])
+            v_pages = write_seq(v_pages, v[i], positions[i], page_tables[i])
+
+        attn = paged_attention(
+            q, k_pages, v_pages, page_tables, ctx_lens, positions,
+            page_size=page_size, block_pages=block_pages,
+        )
+        hidden = hidden + attn.reshape(b, t, cfg.n_heads * hd) @ lp["wo"]
+
+        y = rms_norm(hidden, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(y @ lp["w_gate"])
+        hidden = hidden + (gate * (y @ lp["w_up"])) @ lp["w_down"]
+        return hidden, (k_pages, v_pages)
+
+    h, (kv_k_new, kv_v_new) = jax.lax.scan(
+        layer_step, h, (params["layers"], kv_k, kv_v)
+    )
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (h @ head).astype(jnp.float32)
+    return logits, kv_k_new, kv_v_new

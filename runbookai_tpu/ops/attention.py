@@ -1,0 +1,109 @@
+"""Ragged paged attention — the core serving op.
+
+One code path serves both prefill and decode (decode is T=1): the current
+chunk's K/V are scattered into the paged KV pool first, then queries attend
+over the pool through the page table with a causal/ragged mask. This mirrors
+the semantics of TPU ragged paged attention kernels (PAPERS.md: "Ragged Paged
+Attention for TPU") and keeps shapes fully static for XLA.
+
+Two implementations:
+
+- :func:`paged_attention` — portable XLA path: flash-style blockwise
+  accumulation (running max / normalizer) over KV-page blocks via ``lax.scan``,
+  so HBM traffic per step is O(block) not O(max_seq). Runs on CPU meshes and
+  TPU alike.
+- A Pallas TPU kernel (``runbookai_tpu.ops.paged_attention_pallas``) selected
+  by the engine on real TPU hardware for the decode hot loop.
+
+No reference counterpart — RunbookAI delegates all model execution to hosted
+APIs (SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def write_kv_pages(
+    kv_flat: jnp.ndarray,  # [num_pages * page_size, n_kv, head_dim]
+    new_kv: jnp.ndarray,  # [T, n_kv, head_dim]
+    positions: jnp.ndarray,  # [T] absolute token positions in the sequence
+    page_table_row: jnp.ndarray,  # [max_pages] physical page ids for this seq
+    page_size: int,
+) -> jnp.ndarray:
+    """Scatter one sequence's new K or V vectors into the flat page pool."""
+    logical_page = positions // page_size
+    offset = positions % page_size
+    dest = page_table_row[logical_page] * page_size + offset  # [T]
+    return kv_flat.at[dest].set(new_kv.astype(kv_flat.dtype))
+
+
+@partial(jax.jit, static_argnames=("page_size", "block_pages"))
+def paged_attention(
+    q: jnp.ndarray,  # [B, T, n_q, head_dim]
+    k_flat: jnp.ndarray,  # [num_pages * page_size, n_kv, head_dim]
+    v_flat: jnp.ndarray,  # [num_pages * page_size, n_kv, head_dim]
+    page_tables: jnp.ndarray,  # [B, max_pages]
+    ctx_lens: jnp.ndarray,  # [B] total cached tokens per sequence (incl. chunk)
+    q_positions: jnp.ndarray,  # [B, T] absolute positions of the queries
+    page_size: int,
+    block_pages: int = 32,
+) -> jnp.ndarray:
+    """Blockwise ragged paged attention. Returns [B, T, n_q, head_dim]."""
+    b, t, n_q, d = q.shape
+    n_kv = k_flat.shape[1]
+    group = n_q // n_kv
+    max_pages = page_tables.shape[1]
+    n_blocks = max(1, (max_pages + block_pages - 1) // block_pages)
+    block_tokens = block_pages * page_size
+
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    qf = q.astype(jnp.float32) * scale
+    # [B, T, n_kv, group, d] so kv heads broadcast over their query group.
+    qf = qf.reshape(b, t, n_kv, group, d)
+
+    def block_step(carry, blk):
+        m, l, acc = carry  # [B,T,n_kv,group], same, [B,T,n_kv,group,d]
+        page_idx = blk * block_pages + jnp.arange(block_pages)  # [block_pages]
+        phys = page_tables[:, :]  # [B, max_pages]
+        phys_blk = jnp.take_along_axis(
+            phys, jnp.broadcast_to(page_idx[None, :], (b, block_pages)) % max_pages, axis=1
+        )  # [B, block_pages]
+        token_off = jnp.arange(block_tokens)
+        flat_idx = (
+            phys_blk[:, token_off // page_size] * page_size + token_off % page_size
+        )  # [B, block_tokens]
+        kb = k_flat[flat_idx].astype(jnp.float32)  # [B, block_tokens, n_kv, d]
+        vb = v_flat[flat_idx].astype(jnp.float32)
+
+        # Absolute cache positions covered by this block (same for every seq).
+        cache_pos = blk * block_tokens + token_off  # [block_tokens]
+        # Causal + ragged mask: position visible iff < ctx_len and <= q_position.
+        valid = (cache_pos[None, :] < ctx_lens[:, None])[:, None, :]  # [B,1,block]
+        causal = cache_pos[None, None, :] <= q_positions[:, :, None]  # [B,T,block]
+        mask = (valid & causal)[:, :, None, None, :]  # [B,T,1,1,block]
+
+        scores = jnp.einsum("btkgd,bskd->btkgs", qf, kb)  # [B,T,n_kv,group,block]
+        scores = jnp.where(mask, scores, NEG_INF)
+
+        m_blk = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # Renormalize previous accumulator, add this block's contribution.
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        acc_new = acc * correction[..., None] + jnp.einsum("btkgs,bskd->btkgd", p, vb)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, t, n_kv, group), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, t, n_kv, group), dtype=jnp.float32)
+    acc0 = jnp.zeros((b, t, n_kv, group, d), dtype=jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(block_step, (m0, l0, acc0), jnp.arange(n_blocks))
+
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.reshape(b, t, n_q, d).astype(q.dtype)

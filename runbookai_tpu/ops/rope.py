@@ -1,0 +1,33 @@
+"""Rotary position embeddings (RoPE) for the Llama family.
+
+Pure-functional, jit-friendly: frequencies are computed from a static config
+and applied at arbitrary (possibly ragged) positions, which is what the paged
+engine needs — decode steps apply RoPE at per-sequence positions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
+    """Inverse frequencies, shape [head_dim // 2], float32."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta**exponents)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotate ``x`` of shape [B, T, H, D] at integer ``positions`` [B, T].
+
+    Uses the interleaved-pair convention folded as (first half, second half)
+    rotation — the layout used by HF Llama checkpoints — in float32 for
+    numerical stability, returning the input dtype.
+    """
+    b, t, h, d = x.shape
+    inv_freq = rope_frequencies(d, theta)  # [D/2]
+    angles = positions.astype(jnp.float32)[:, :, None] * inv_freq[None, None, :]  # [B,T,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B,T,1,D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,47 @@
+"""On-device token sampling: greedy / temperature / top-p, plus logit masks.
+
+Runs entirely on device inside the decode step (no host round-trip per token
+beyond fetching the sampled ids). Grammar masks from guided decoding are
+applied as additive ``-inf`` masks before sampling.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@partial(jax.jit, static_argnames=())
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, vocab] float32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B] float32; 0 -> greedy
+    top_p: jnp.ndarray,  # [B] float32 in (0, 1]
+    mask: jnp.ndarray | None = None,  # [B, vocab] bool, True = allowed
+) -> jnp.ndarray:
+    """Sample one token per row. Vectorized top-p via sorted-CDF threshold."""
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+
+    greedy = jnp.argmax(logits, axis=-1)
+
+    # Temperature-scaled distribution (guard t=0 to avoid div-by-zero; those
+    # rows take the greedy branch below).
+    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / safe_t
+
+    # Top-p: sort descending, keep the smallest prefix with cumprob >= top_p.
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
+    # Number of tokens kept per row: first index where cumprob >= top_p, +1.
+    keep = jnp.sum(cumprobs < top_p[:, None], axis=-1) + 1  # [B]
+    cutoff = jnp.take_along_axis(sorted_logits, (keep - 1)[:, None], axis=-1)  # [B,1]
+    filtered = jnp.where(scaled >= cutoff, scaled, NEG_INF)
+
+    sampled = jax.random.categorical(key, filtered, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)

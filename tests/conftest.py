@@ -7,10 +7,18 @@ full TP/DP pjit programs compile and execute without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+# The environment's TPU plugin overrides JAX_PLATFORMS; force CPU explicitly
+# so tests run on the virtual 8-device host mesh, and use full-precision
+# matmuls so numerics tests compare exactly.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
 
 import asyncio
 import inspect

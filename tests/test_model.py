@@ -1,0 +1,156 @@
+"""Numerics: paged forward (chunked prefill + decode) vs a naive dense
+reference implementation of the same architecture. This is the logit-parity
+gate SURVEY.md §4 calls for (the reference had no model to test)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from runbookai_tpu.engine.kv_cache import KVCacheManager
+from runbookai_tpu.models.llama import CONFIGS, forward, init_params, rms_norm
+from runbookai_tpu.ops.rope import apply_rope
+from runbookai_tpu.ops.sampling import sample_tokens
+
+CFG = CONFIGS["llama3-test"]
+
+
+def naive_forward(params, cfg, tokens):
+    """Dense float32 causal forward over the whole sequence [1, T]."""
+    b, t = tokens.shape
+    hd, n_kv, n_q = cfg.head_dim, cfg.n_kv_heads, cfg.n_heads
+    pos = jnp.arange(t)[None, :]
+    h = params["embed"][tokens].astype(jnp.float32)
+    layers = params["layers"]
+    for l in range(cfg.n_layers):
+        lp = {k: v[l] for k, v in layers.items()}
+        x = rms_norm(h, lp["attn_norm"], cfg.norm_eps).astype(jnp.float32)
+        q = apply_rope((x @ lp["wq"].astype(jnp.float32)).reshape(b, t, n_q, hd), pos, cfg.rope_theta)
+        k = apply_rope((x @ lp["wk"].astype(jnp.float32)).reshape(b, t, n_kv, hd), pos, cfg.rope_theta)
+        v = (x @ lp["wv"].astype(jnp.float32)).reshape(b, t, n_kv, hd)
+        group = n_q // n_kv
+        qg = q.reshape(b, t, n_kv, group, hd)
+        scores = jnp.einsum("btkgd,bskd->btkgs", qg, k) / np.sqrt(hd)
+        causal = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(causal[None, :, None, None, :], scores, -1e30)
+        attn = jnp.einsum("btkgs,bskd->btkgd", jax.nn.softmax(scores, axis=-1), v)
+        h = h + attn.reshape(b, t, n_q * hd) @ lp["wo"].astype(jnp.float32)
+        y = rms_norm(h, lp["mlp_norm"], cfg.norm_eps).astype(jnp.float32)
+        h = h + (jax.nn.silu(y @ lp["w_gate"].astype(jnp.float32)) * (y @ lp["w_up"].astype(jnp.float32))) @ lp["w_down"].astype(jnp.float32)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    return (h.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+
+
+def _run_paged(params, tokens_np, chunks):
+    """Run the paged forward over the given chunk split; return last logits of
+    each chunk call and the final-position logits."""
+    mgr = KVCacheManager(
+        n_layers=CFG.n_layers, num_pages=32, page_size=4,
+        n_kv_heads=CFG.n_kv_heads, head_dim=CFG.head_dim, max_seq_len=CFG.max_seq_len,
+        dtype=jnp.float32,
+    )
+    mgr.add_sequence("s")
+    kv_k, kv_v = mgr.pool.kv_k, mgr.pool.kv_v
+    pos = 0
+    all_logits = []
+    for chunk in chunks:
+        t = len(chunk)
+        mgr.extend("s", pos + t)
+        table = jnp.asarray(mgr.page_tables(["s"]))
+        logits, kv_k, kv_v = forward(
+            params, CFG,
+            jnp.asarray([chunk], dtype=jnp.int32),
+            jnp.arange(pos, pos + t, dtype=jnp.int32)[None, :],
+            kv_k, kv_v, table,
+            jnp.asarray([pos + t], dtype=jnp.int32),
+            page_size=4, block_pages=2,
+        )
+        all_logits.append(np.asarray(logits[0]))
+        pos += t
+    return np.concatenate(all_logits, axis=0)
+
+
+def test_paged_forward_matches_dense():
+    p = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    seq = rng.integers(0, CFG.vocab_size, size=17).tolist()
+    dense = np.asarray(naive_forward(p, CFG, jnp.asarray([seq], dtype=jnp.int32))[0])
+
+    # One-shot prefill
+    paged_full = _run_paged(p, seq, [seq])
+    np.testing.assert_allclose(paged_full, dense, rtol=2e-3, atol=2e-3)
+
+    # Chunked prefill (7 + 6 + 4) then compare the same positions
+    paged_chunks = _run_paged(p, seq, [seq[:7], seq[7:13], seq[13:]])
+    np.testing.assert_allclose(paged_chunks, dense, rtol=2e-3, atol=2e-3)
+
+    # Token-by-token decode after a 5-token prefill
+    paged_decode = _run_paged(p, seq, [seq[:5]] + [[t] for t in seq[5:]])
+    np.testing.assert_allclose(paged_decode, dense, rtol=2e-3, atol=2e-3)
+
+
+def test_batched_decode_isolation(params):
+    """Two sequences decoding in the same batch don't contaminate each other."""
+    rng = np.random.default_rng(2)
+    seq_a = rng.integers(0, CFG.vocab_size, size=9).tolist()
+    seq_b = rng.integers(0, CFG.vocab_size, size=6).tolist()
+
+    solo_a = _run_paged(params, seq_a, [seq_a])[-1]
+
+    mgr = KVCacheManager(CFG.n_layers, 32, 4, CFG.n_kv_heads, CFG.head_dim,
+                         CFG.max_seq_len, dtype=jnp.float32)
+    kv_k, kv_v = mgr.pool.kv_k, mgr.pool.kv_v
+    for sid, seq in (("a", seq_a[:-1]), ("b", seq_b)):
+        mgr.add_sequence(sid)
+        mgr.extend(sid, len(seq))
+        table = jnp.asarray(mgr.page_tables([sid]))
+        _, kv_k, kv_v = forward(
+            params, CFG, jnp.asarray([seq], dtype=jnp.int32),
+            jnp.arange(len(seq), dtype=jnp.int32)[None, :], kv_k, kv_v, table,
+            jnp.asarray([len(seq)], dtype=jnp.int32), page_size=4, block_pages=2,
+        )
+    # Joint decode step: a decodes its 9th token, b decodes its 7th.
+    mgr.extend("a", len(seq_a))
+    mgr.extend("b", len(seq_b) + 1)
+    tables = jnp.asarray(mgr.page_tables(["a", "b"]))
+    tokens = jnp.asarray([[seq_a[-1]], [123 % CFG.vocab_size]], dtype=jnp.int32)
+    positions = jnp.asarray([[len(seq_a) - 1], [len(seq_b)]], dtype=jnp.int32)
+    logits, _, _ = forward(
+        params, CFG, tokens, positions, kv_k, kv_v, tables,
+        jnp.asarray([len(seq_a), len(seq_b) + 1], dtype=jnp.int32),
+        page_size=4, block_pages=2,
+    )
+    np.testing.assert_allclose(np.asarray(logits[0, 0]), solo_a, rtol=2e-3, atol=2e-3)
+
+
+def test_sampling_modes():
+    logits = jnp.asarray(
+        [[0.0, 5.0, 1.0, -2.0], [10.0, 0.0, 0.0, 0.0]], dtype=jnp.float32
+    )
+    key = jax.random.PRNGKey(0)
+    greedy = sample_tokens(logits, key, jnp.zeros(2), jnp.ones(2))
+    assert greedy.tolist() == [1, 0]
+    # top_p tiny -> only the argmax survives even at high temperature
+    nucleus = sample_tokens(logits, key, jnp.full(2, 5.0), jnp.full(2, 1e-4))
+    assert nucleus.tolist() == [1, 0]
+    # mask forbids argmax -> next best
+    mask = jnp.asarray([[True, False, True, True], [True, True, True, True]])
+    masked = sample_tokens(logits, key, jnp.zeros(2), jnp.ones(2), mask=mask)
+    assert masked.tolist() == [2, 0]
+
+
+def test_allocator_invariants():
+    from runbookai_tpu.engine.kv_cache import PageAllocator
+
+    a = PageAllocator(8)
+    pages = a.alloc(7)
+    assert 0 not in pages and a.free_pages == 0
+    with pytest.raises(MemoryError):
+        a.alloc(1)
+    a.free(pages)
+    assert a.free_pages == 7
