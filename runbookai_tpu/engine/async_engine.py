@@ -1,0 +1,84 @@
+"""Async facade over :class:`EngineCore` — the host program's serving loop.
+
+The agent's hot loop alternates LLM decode and tool I/O (SURVEY.md §7 hard
+part 3): ``generate`` awaits a completion event while the engine loop task
+keeps stepping the device for *other* live sequences, so eval DP batches and
+concurrent investigations overlap tool latency with decode throughput.
+
+Device work runs in a worker thread (``asyncio.to_thread``) so the event loop
+stays free for tool HTTP/subprocess I/O; a lock serializes core mutation
+between ``submit`` and ``step``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Optional
+
+from runbookai_tpu.engine.engine import EngineCore
+from runbookai_tpu.engine.request import EngineOutput, EngineRequest, SamplingParams
+
+
+class AsyncEngine:
+    def __init__(self, core: EngineCore):
+        self.core = core
+        self._lock = threading.Lock()
+        self._wake: Optional[asyncio.Event] = None
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._wake = asyncio.Event()
+            self._stopped = False
+            self._task = asyncio.create_task(self._loop(), name="engine-loop")
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._wake:
+            self._wake.set()
+        if self._task:
+            await self._task
+            self._task = None
+
+    async def _loop(self) -> None:
+        while not self._stopped:
+            with self._lock:
+                has_work = self.core.has_work
+            if not has_work:
+                self._wake.clear()
+                await self._wake.wait()
+                continue
+            await asyncio.to_thread(self._locked_step)
+
+    def _locked_step(self) -> None:
+        with self._lock:
+            self.core.step()
+
+    async def generate(
+        self,
+        prompt_ids: list[int],
+        sampling: Optional[SamplingParams] = None,
+    ) -> EngineOutput:
+        """Submit one request and await its completion."""
+        if self._task is None:
+            await self.start()
+        req = EngineRequest(prompt_ids=prompt_ids, sampling=sampling or SamplingParams())
+        req.done_event = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        # done_event.set() happens on a worker thread; bridge it safely.
+        done = loop.create_future()
+
+        class _Event:
+            def set(self_inner) -> None:  # noqa: N805
+                loop.call_soon_threadsafe(
+                    lambda: done.done() or done.set_result(True)
+                )
+
+        req.done_event = _Event()  # type: ignore[assignment]
+        with self._lock:
+            self.core.submit(req)
+        self._wake.set()
+        await done
+        return self.core.output_for(req)

@@ -1,0 +1,389 @@
+"""Continuous-batching serving engine (host loop) over the paged JAX model.
+
+The TPU-native replacement for the reference's hosted-LLM HTTP calls
+(``src/model/llm.ts``): requests are admitted mid-flight, prompts prefill in
+fixed-size chunks, and all live sequences share one compiled decode step over
+a fixed batch of slots (static shapes — the same XLA program every step).
+
+Scheduling policy per :meth:`EngineCore.step`:
+
+1. admit waiting requests while decode slots + KV pages allow;
+2. run one prefill chunk for the oldest prefilling request (prefill and
+   decode interleave so TTFT of new requests doesn't starve running decodes);
+3. run one batched decode step for every decoding request;
+4. finish/evict sequences (stop tokens, budgets, grammar end), free pages.
+
+Preemption: if the page pool is exhausted mid-decode the *youngest* request is
+preempted by recompute (pages freed, generated tokens folded into its prompt,
+re-queued) — forward progress for the rest is preserved.
+
+Static-shape tricks:
+
+- decode always runs with ``B = max_batch_slots``; empty slots carry a null
+  page table and ``ctx_len = 0`` (fully masked attention).
+- prefill chunks are right-padded to ``prefill_chunk``; pad tokens write their
+  K/V into the reserved null page (page 0) via an extra "trash" page-table
+  column at logical position ``max_pages``, so they can never corrupt live
+  cache state.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from runbookai_tpu.engine.kv_cache import KVCacheManager
+from runbookai_tpu.engine.request import (
+    EngineOutput,
+    EngineRequest,
+    FinishReason,
+    RequestState,
+)
+from runbookai_tpu.models.llama import LlamaConfig, forward
+from runbookai_tpu.ops.sampling import sample_tokens
+
+
+@dataclass
+class EngineConfig:
+    page_size: int = 16
+    num_pages: int = 2048
+    max_batch_slots: int = 8
+    prefill_chunk: int = 256
+    max_seq_len: int = 8192
+    block_pages: int = 32
+    kv_dtype: Any = jnp.bfloat16
+    # Reserve this many pages of headroom per admitted sequence so decode can
+    # proceed a while before needing new allocations.
+    admit_headroom_tokens: int = 64
+
+
+@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages"), donate_argnums=(4, 5))
+def _decode_step(
+    params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
+    temps, top_ps, key, mask, page_size: int, block_pages: int,
+):
+    logits, kv_k, kv_v = forward(
+        params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
+        page_size=page_size, block_pages=block_pages,
+    )
+    tok = sample_tokens(logits[:, -1], key, temps, top_ps, mask)
+    return tok, logits[:, -1], kv_k, kv_v
+
+
+@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages"), donate_argnums=(3, 4))
+def _prefill_step(
+    params, cfg: LlamaConfig, tokens, kv_k, kv_v, positions, tables, ctx_lens,
+    last_idx, page_size: int, block_pages: int,
+):
+    logits, kv_k, kv_v = forward(
+        params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
+        page_size=page_size, block_pages=block_pages,
+    )
+    return logits[0, last_idx], kv_k, kv_v
+
+
+class EngineCore:
+    """Synchronous stepping core. Drive with :meth:`step` until idle."""
+
+    def __init__(
+        self,
+        model_cfg: LlamaConfig,
+        params: Any,
+        tokenizer: Any,
+        engine_cfg: Optional[EngineConfig] = None,
+        mask_fn: Optional[Callable[[EngineRequest], Optional[np.ndarray]]] = None,
+        advance_fn: Optional[Callable[[EngineRequest, int], bool]] = None,
+        seed: int = 0,
+    ):
+        self.cfg = model_cfg
+        self.ecfg = engine_cfg or EngineConfig()
+        self.params = params
+        self.tokenizer = tokenizer
+        # Guided decoding hooks: mask_fn returns the allowed-token mask for a
+        # request (or None), advance_fn feeds a sampled token to the grammar
+        # automaton and returns True when the grammar has completed.
+        self.mask_fn = mask_fn
+        self.advance_fn = advance_fn
+
+        self.kv = KVCacheManager(
+            n_layers=model_cfg.n_layers,
+            num_pages=self.ecfg.num_pages,
+            page_size=self.ecfg.page_size,
+            n_kv_heads=model_cfg.n_kv_heads,
+            head_dim=model_cfg.head_dim,
+            max_seq_len=self.ecfg.max_seq_len,
+            dtype=self.ecfg.kv_dtype,
+        )
+        self._kv_k = self.kv.pool.kv_k
+        self._kv_v = self.kv.pool.kv_v
+        self._key = jax.random.PRNGKey(seed)
+
+        self.waiting: list[EngineRequest] = []
+        self.prefilling: list[EngineRequest] = []
+        self.decoding: list[EngineRequest] = []
+        self.finished: list[EngineRequest] = []
+        self._slots: list[Optional[EngineRequest]] = [None] * self.ecfg.max_batch_slots
+        self._last_token: dict[str, int] = {}
+        # Serving metrics (BASELINE.md contract: TTFT + tokens/sec/chip).
+        self.metrics = {"decode_tokens": 0, "decode_steps": 0, "prefill_tokens": 0,
+                        "preemptions": 0, "decode_time_s": 0.0, "prefill_time_s": 0.0}
+
+    # ------------------------------------------------------------------ API
+
+    def submit(self, req: EngineRequest) -> None:
+        if not req.prompt_ids:
+            req.prompt_ids = [self.tokenizer.bos_id]
+        if req.guided_state is None and req.sampling.guided and self.mask_fn:
+            pass  # guided_state initialized lazily by the mask provider
+        req.state = RequestState.WAITING
+        self.waiting.append(req)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.prefilling or self.decoding)
+
+    def _trash_pos(self) -> int:
+        return self.kv.max_pages_per_seq * self.ecfg.page_size
+
+    def _tables_for(self, reqs: list[Optional[EngineRequest]]) -> np.ndarray:
+        """[N, max_pages + 1] page tables with the trailing trash column."""
+        n = len(reqs)
+        out = np.zeros((n, self.kv.max_pages_per_seq + 1), dtype=np.int32)
+        for i, r in enumerate(reqs):
+            if r is not None and r.request_id in self.kv.seqs:
+                out[i, : self.kv.max_pages_per_seq] = self.kv.page_table_row(r.request_id)
+        return out
+
+    # ------------------------------------------------------------ scheduling
+
+    def _admit(self) -> None:
+        free_slots = sum(s is None for s in self._slots)
+        in_flight = len(self.prefilling)
+        while self.waiting and (free_slots - in_flight) > 0:
+            req = self.waiting[0]
+            # Headroom never exceeds what the request could actually generate;
+            # an otherwise-idle engine admits with zero headroom so a request
+            # that only fits exactly still makes progress (preemption has
+            # nothing to evict in that case anyway).
+            headroom = min(self.ecfg.admit_headroom_tokens, req.sampling.max_new_tokens)
+            if not (self.prefilling or self.decoding) and in_flight == 0:
+                headroom = 0
+            if not self.kv.can_admit(len(req.prompt_ids), headroom):
+                break
+            self.waiting.pop(0)
+            self.kv.add_sequence(req.request_id)
+            req.state = RequestState.PREFILL
+            req.prefill_pos = 0
+            self.prefilling.append(req)
+            in_flight += 1
+
+    def _preempt_youngest(self) -> bool:
+        """Evict the most recently admitted decoding request (recompute)."""
+        if not self.decoding:
+            return False
+        victim = max(self.decoding, key=lambda r: r.arrival_time)
+        self.decoding.remove(victim)
+        if victim.slot is not None:
+            self._slots[victim.slot] = None
+            victim.slot = None
+        self.kv.release(victim.request_id)
+        victim.prompt_ids = victim.prompt_ids + victim.out_ids
+        victim.prefill_pos = 0
+        victim.state = RequestState.WAITING
+        self.waiting.insert(0, victim)
+        self.metrics["preemptions"] += 1
+        return True
+
+    def _finish(self, req: EngineRequest, reason: FinishReason) -> None:
+        req.state = RequestState.FINISHED
+        req.finish_reason = reason
+        if req.slot is not None:
+            self._slots[req.slot] = None
+            req.slot = None
+        if req in self.decoding:
+            self.decoding.remove(req)
+        if req in self.prefilling:
+            self.prefilling.remove(req)
+        self.kv.release(req.request_id)
+        self._last_token.pop(req.request_id, None)
+        self.finished.append(req)
+        if req.done_event is not None:
+            req.done_event.set()
+
+    # --------------------------------------------------------------- prefill
+
+    def _run_prefill_chunk(self, req: EngineRequest) -> None:
+        t0 = time.perf_counter()
+        chunk_len = min(self.ecfg.prefill_chunk, len(req.prompt_ids) - req.prefill_pos)
+        chunk = req.prompt_ids[req.prefill_pos : req.prefill_pos + chunk_len]
+        new_ctx = req.prefill_pos + chunk_len
+        try:
+            self.kv.extend(req.request_id, new_ctx)
+        except MemoryError:
+            if self._preempt_youngest():
+                return  # retry next step
+            self.prefilling.remove(req)
+            self._finish(req, FinishReason.ABORTED)
+            return
+
+        pad = self.ecfg.prefill_chunk - chunk_len
+        tokens = np.asarray([chunk + [0] * pad], dtype=np.int32)
+        positions = np.asarray(
+            [list(range(req.prefill_pos, new_ctx)) + [self._trash_pos()] * pad],
+            dtype=np.int32,
+        )
+        tables = self._tables_for([req])
+        last_logits, self._kv_k, self._kv_v = _prefill_step(
+            self.params, self.cfg, jnp.asarray(tokens), self._kv_k, self._kv_v,
+            jnp.asarray(positions), jnp.asarray(tables),
+            jnp.asarray([new_ctx], dtype=jnp.int32),
+            jnp.asarray(chunk_len - 1, dtype=jnp.int32),
+            page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
+        )
+        req.prefill_pos = new_ctx
+        self.metrics["prefill_tokens"] += chunk_len
+
+        if req.prefill_pos >= len(req.prompt_ids):
+            # Prompt fully cached: sample the first output token from the last
+            # chunk's final logits, then move to a decode slot.
+            self._key, sub = jax.random.split(self._key)
+            mask = self.mask_fn(req) if (self.mask_fn and req.sampling.guided) else None
+            tok = sample_tokens(
+                last_logits[None, :], sub,
+                jnp.asarray([req.sampling.temperature], jnp.float32),
+                jnp.asarray([req.sampling.top_p], jnp.float32),
+                None if mask is None else jnp.asarray(mask[None, :]),
+            )
+            first = int(tok[0])
+            self.prefilling.remove(req)
+            slot = self._slots.index(None)
+            self._slots[slot] = req
+            req.slot = slot
+            req.state = RequestState.DECODE
+            req.first_token_time = time.perf_counter()
+            self.decoding.append(req)
+            self._emit_token(req, first)
+        self.metrics["prefill_time_s"] += time.perf_counter() - t0
+
+    # ---------------------------------------------------------------- decode
+
+    def _emit_token(self, req: EngineRequest, token: int) -> None:
+        """Record a sampled token and apply finish rules."""
+        req.out_ids.append(token)
+        self._last_token[req.request_id] = token
+        grammar_done = False
+        if self.advance_fn and req.sampling.guided:
+            grammar_done = self.advance_fn(req, token)
+        stop_ids = set(req.sampling.stop_token_ids) | {self.tokenizer.eos_id, self.tokenizer.eot_id}
+        if token in stop_ids:
+            self._finish(req, FinishReason.STOP_TOKEN)
+        elif grammar_done:
+            self._finish(req, FinishReason.GRAMMAR_END)
+        elif len(req.out_ids) >= req.sampling.max_new_tokens:
+            self._finish(req, FinishReason.MAX_TOKENS)
+        elif req.sampling.stop_strings:
+            tail = self.tokenizer.decode(req.out_ids[-32:])
+            if any(s in tail for s in req.sampling.stop_strings):
+                self._finish(req, FinishReason.STOP_STRING)
+
+    def _run_decode(self) -> None:
+        if not self.decoding:
+            return
+        t0 = time.perf_counter()
+        # Grow pages for every decoding sequence; preempt on pressure.
+        for req in list(self.decoding):
+            while (
+                req.state == RequestState.DECODE
+                and not self.kv.can_extend(req.request_id, req.ctx_len + 1)
+            ):
+                # _preempt_youngest may evict ``req`` itself — the state guard
+                # above then exits the loop.
+                if not self._preempt_youngest():
+                    self._finish(req, FinishReason.ABORTED)
+                    break
+            if req.state == RequestState.DECODE and req.request_id in self.kv.seqs:
+                if req.ctx_len + 1 > self.ecfg.max_seq_len:
+                    self._finish(req, FinishReason.MAX_TOKENS)
+                else:
+                    self.kv.extend(req.request_id, req.ctx_len + 1)
+        if not self.decoding:
+            return
+
+        b = self.ecfg.max_batch_slots
+        tokens = np.zeros((b, 1), dtype=np.int32)
+        positions = np.zeros((b, 1), dtype=np.int32)
+        ctx_lens = np.zeros((b,), dtype=np.int32)
+        temps = np.zeros((b,), dtype=np.float32)
+        top_ps = np.ones((b,), dtype=np.float32)
+        need_mask = False
+        mask = np.ones((b, self.cfg.vocab_size), dtype=bool)
+        for req in self.decoding:
+            i = req.slot
+            tokens[i, 0] = self._last_token[req.request_id]
+            positions[i, 0] = req.ctx_len - 1  # position of the token being fed
+            ctx_lens[i] = req.ctx_len
+            temps[i] = req.sampling.temperature
+            top_ps[i] = req.sampling.top_p
+            if self.mask_fn and req.sampling.guided:
+                m = self.mask_fn(req)
+                if m is not None:
+                    mask[i] = m
+                    need_mask = True
+        tables = self._tables_for(self._slots)
+
+        self._key, sub = jax.random.split(self._key)
+        toks, _, self._kv_k, self._kv_v = _decode_step(
+            self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
+            self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
+            jnp.asarray(temps), jnp.asarray(top_ps), sub,
+            jnp.asarray(mask) if need_mask else None,
+            page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
+        )
+        toks_host = np.asarray(jax.device_get(toks))
+        n_active = len(self.decoding)
+        for req in list(self.decoding):
+            self._emit_token(req, int(toks_host[req.slot]))
+        self.metrics["decode_tokens"] += n_active
+        self.metrics["decode_steps"] += 1
+        self.metrics["decode_time_s"] += time.perf_counter() - t0
+
+    # ------------------------------------------------------------------ step
+
+    def step(self) -> list[EngineRequest]:
+        """One scheduler iteration; returns requests finished during it."""
+        before = len(self.finished)
+        self._admit()
+        if self.prefilling:
+            self._run_prefill_chunk(self.prefilling[0])
+        self._run_decode()
+        return self.finished[before:]
+
+    def run_until_idle(self, max_steps: int = 100_000) -> list[EngineRequest]:
+        done: list[EngineRequest] = []
+        for _ in range(max_steps):
+            if not self.has_work:
+                break
+            done.extend(self.step())
+        return done
+
+    def output_for(self, req: EngineRequest) -> EngineOutput:
+        # Strip the stop token from the visible text.
+        ids = req.out_ids
+        stop_ids = set(req.sampling.stop_token_ids) | {self.tokenizer.eos_id, self.tokenizer.eot_id}
+        if ids and ids[-1] in stop_ids:
+            ids = ids[:-1]
+        return EngineOutput(
+            request_id=req.request_id,
+            token_ids=list(req.out_ids),
+            text=self.tokenizer.decode(ids),
+            finish_reason=req.finish_reason or FinishReason.ABORTED,
+            ttft_ms=req.ttft_ms,
+            decode_tokens=len(req.out_ids),
+            elapsed_s=time.perf_counter() - req.arrival_time,
+        )

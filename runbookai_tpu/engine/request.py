@@ -1,0 +1,79 @@
+"""Engine request/response types and sampling parameters."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Optional
+
+
+class RequestState(str, Enum):
+    WAITING = "waiting"  # queued, no pages yet
+    PREFILL = "prefill"  # prompt being processed in chunks
+    DECODE = "decode"  # generating, owns a batch slot
+    FINISHED = "finished"
+    FAILED = "failed"
+
+
+class FinishReason(str, Enum):
+    STOP_TOKEN = "stop_token"
+    MAX_TOKENS = "max_tokens"
+    STOP_STRING = "stop_string"
+    GRAMMAR_END = "grammar_end"
+    ABORTED = "aborted"
+
+
+@dataclass
+class SamplingParams:
+    temperature: float = 0.0
+    top_p: float = 1.0
+    max_new_tokens: int = 512
+    stop_token_ids: tuple[int, ...] = ()
+    stop_strings: tuple[str, ...] = ()
+    # When set, token-level grammar masking constrains output to valid JSON
+    # (see runbookai_tpu.model.guided). Value is a grammar name ("json").
+    guided: Optional[str] = None
+
+
+@dataclass
+class EngineRequest:
+    prompt_ids: list[int]
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    request_id: str = field(default_factory=lambda: f"req-{uuid.uuid4().hex[:10]}")
+    # Monotonic clock — compared against perf_counter() timestamps in the engine.
+    arrival_time: float = field(default_factory=time.perf_counter)
+
+    # Mutable engine-owned state:
+    state: RequestState = RequestState.WAITING
+    prefill_pos: int = 0  # tokens of the prompt already processed
+    out_ids: list[int] = field(default_factory=list)
+    slot: Optional[int] = None  # decode batch slot index
+    first_token_time: Optional[float] = None  # TTFT measurement
+    finish_reason: Optional[FinishReason] = None
+    guided_state: Any = None  # grammar automaton state
+    # Completion signal for the async API (set by AsyncEngine).
+    done_event: Optional[asyncio.Event] = None
+
+    @property
+    def ctx_len(self) -> int:
+        return self.prefill_pos + len(self.out_ids)
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return (self.first_token_time - self.arrival_time) * 1000.0
+
+
+@dataclass
+class EngineOutput:
+    request_id: str
+    token_ids: list[int]
+    text: str
+    finish_reason: FinishReason
+    ttft_ms: Optional[float]
+    decode_tokens: int
+    elapsed_s: float

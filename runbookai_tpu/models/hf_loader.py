@@ -1,0 +1,166 @@
+"""HF checkpoint loading: safetensors → stacked JAX pytrees (+ sharded put).
+
+New construction (SURVEY.md §5.4 — the reference never loads weights). Reads a
+HuggingFace Llama directory (``config.json`` + ``*.safetensors``), transposes
+``[out, in]`` projection weights to this build's ``[in, out]`` convention,
+stacks per-layer weights on a leading axis for the scan-based forward, and —
+when a mesh is supplied — ``device_put``s each leaf with its TP/DP
+``NamedSharding`` so 70B-class checkpoints stream straight to their shards
+without materializing the full model on one host/chip.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from runbookai_tpu.models.llama import CONFIGS, LlamaConfig, init_params
+
+# Our layer-stacked param leaf -> (HF template, transpose?)
+_LAYER_MAP = {
+    "wq": ("model.layers.{i}.self_attn.q_proj.weight", True),
+    "wk": ("model.layers.{i}.self_attn.k_proj.weight", True),
+    "wv": ("model.layers.{i}.self_attn.v_proj.weight", True),
+    "wo": ("model.layers.{i}.self_attn.o_proj.weight", True),
+    "w_gate": ("model.layers.{i}.mlp.gate_proj.weight", True),
+    "w_up": ("model.layers.{i}.mlp.up_proj.weight", True),
+    "w_down": ("model.layers.{i}.mlp.down_proj.weight", True),
+    "attn_norm": ("model.layers.{i}.input_layernorm.weight", False),
+    "mlp_norm": ("model.layers.{i}.post_attention_layernorm.weight", False),
+}
+
+
+def config_from_hf(model_dir: str | Path, name: str = "hf-model") -> LlamaConfig:
+    raw = json.loads((Path(model_dir) / "config.json").read_text())
+    return LlamaConfig(
+        name=name,
+        vocab_size=raw["vocab_size"],
+        dim=raw["hidden_size"],
+        n_layers=raw["num_hidden_layers"],
+        n_heads=raw["num_attention_heads"],
+        n_kv_heads=raw.get("num_key_value_heads", raw["num_attention_heads"]),
+        ffn_dim=raw["intermediate_size"],
+        rope_theta=raw.get("rope_theta", 500_000.0),
+        norm_eps=raw.get("rms_norm_eps", 1e-5),
+        max_seq_len=raw.get("max_position_embeddings", 8192),
+        tie_embeddings=raw.get("tie_word_embeddings", False),
+    )
+
+
+class _ShardIndex:
+    """Maps tensor name -> safetensors file, loading files lazily."""
+
+    def __init__(self, model_dir: Path):
+        self.dir = model_dir
+        index_file = model_dir / "model.safetensors.index.json"
+        self._handles: dict[str, Any] = {}
+        if index_file.is_file():
+            index = json.loads(index_file.read_text())
+            self.weight_map = dict(index["weight_map"])
+        else:
+            shards = sorted(model_dir.glob("*.safetensors"))
+            if not shards:
+                raise FileNotFoundError(f"no .safetensors files under {model_dir}")
+            from safetensors import safe_open
+
+            self.weight_map = {}
+            for shard in shards:
+                with safe_open(str(shard), framework="numpy") as f:
+                    for key in f.keys():
+                        self.weight_map[key] = shard.name
+
+    def get(self, name: str) -> np.ndarray:
+        from safetensors import safe_open
+
+        fname = self.weight_map[name]
+        handle = self._handles.get(fname)
+        if handle is None:
+            handle = safe_open(str(self.dir / fname), framework="numpy")
+            self._handles[fname] = handle
+        return handle.get_tensor(name)
+
+
+def _put(arr: np.ndarray, dtype, sharding=None) -> jax.Array:
+    x = jnp.asarray(arr, dtype=dtype)
+    if sharding is not None:
+        x = jax.device_put(x, sharding)
+    return x
+
+
+def load_params(
+    model_dir: str | Path,
+    cfg: Optional[LlamaConfig] = None,
+    dtype=jnp.bfloat16,
+    shardings: Optional[dict[str, Any]] = None,
+) -> tuple[LlamaConfig, Any]:
+    """Load stacked params from an HF Llama directory.
+
+    ``shardings``, when given, is a pytree-shaped dict matching the params
+    structure whose leaves are ``NamedSharding``s (see
+    :func:`runbookai_tpu.parallel.sharding.param_shardings`).
+    """
+    model_dir = Path(model_dir)
+    cfg = cfg or config_from_hf(model_dir)
+    idx = _ShardIndex(model_dir)
+    sh = shardings or {}
+
+    def shard_of(*path):
+        node: Any = sh
+        for p in path:
+            if not isinstance(node, dict) or p not in node:
+                return None
+            node = node[p]
+        return node
+
+    params: dict[str, Any] = {}
+    params["embed"] = _put(
+        idx.get("model.embed_tokens.weight"), dtype, shard_of("embed")
+    )
+    layers: dict[str, Any] = {}
+    for leaf, (tmpl, transpose) in _LAYER_MAP.items():
+        mats = []
+        for i in range(cfg.n_layers):
+            w = idx.get(tmpl.format(i=i))
+            mats.append(w.T if transpose else w)
+        stacked = np.stack(mats)
+        leaf_dtype = jnp.float32 if leaf.endswith("norm") else dtype
+        layers[leaf] = _put(stacked, leaf_dtype, shard_of("layers", leaf))
+    params["layers"] = layers
+    params["final_norm"] = _put(idx.get("model.norm.weight"), jnp.float32, shard_of("final_norm"))
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _put(
+            idx.get("lm_head.weight").T, dtype, shard_of("lm_head")
+        )
+    return cfg, params
+
+
+def load_or_init(
+    model_name: str,
+    model_path: Optional[str | Path],
+    dtype=jnp.bfloat16,
+    shardings: Optional[dict[str, Any]] = None,
+    seed: int = 0,
+) -> tuple[LlamaConfig, Any]:
+    """Load from ``model_path`` when present, else random-init ``model_name``.
+
+    Random init keeps every serving path exercisable in the no-egress
+    environment (BASELINE.md configs run with real weights when provided).
+    """
+    if model_path and Path(model_path).exists():
+        cfg = config_from_hf(model_path, name=model_name)
+        return load_params(model_path, cfg, dtype=dtype, shardings=shardings)
+    cfg = CONFIGS[model_name] if model_name in CONFIGS else CONFIGS["llama3-test"]
+    params = init_params(jax.random.PRNGKey(seed), cfg, dtype=dtype)
+    if shardings:
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s) if s is not None else x,
+            params,
+            shardings,
+            is_leaf=lambda x: x is None,
+        )
+    return cfg, params
