@@ -1,0 +1,261 @@
+"""Guided decoding: byte-level JSON automaton → token-level logit masks.
+
+SURVEY.md §7 hard part 2: the product depends on schema-valid JSON from the
+model (the reference's zod schemas in ``src/agent/llm-parser.ts:21-210`` were
+parsed tolerantly because hosted models drift). Serving in-tree lets us do
+better: a pushdown automaton over UTF-8 bytes accepts exactly the JSON
+language, and per-step token masks admit only tokens whose *entire* byte
+sequence keeps the automaton alive. The tolerant parser remains downstream as
+a belt-and-suspenders fallback.
+
+Masks are cached by automaton state signature — states repeat heavily (e.g.
+"inside a string"), so even 128k-vocab tokenizers amortize to a handful of
+mask computations per generation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+# Automaton modes
+_VALUE = 0  # expecting start of a value
+_STRING = 1  # inside a string
+_STR_ESC = 2  # after backslash in string
+_NUMBER = 3  # inside a number
+_LITERAL = 4  # inside true/false/null
+_AFTER = 5  # after a complete value (expecting , } ] or end)
+_OBJ_KEY = 6  # expecting object key string or '}'
+_OBJ_COLON = 7  # expecting ':'
+
+_WS = b" \t\n\r"
+_DIGITS = b"0123456789"
+_NUM_CONT = b"0123456789.eE+-"
+_LITERALS = {b"true", b"false", b"null"}
+
+
+class JsonMachine:
+    """Incremental JSON validator over bytes."""
+
+    def __init__(self, max_depth: int = 32):
+        self.mode = _VALUE
+        self.stack: list[int] = []  # 123 for '{', 91 for '['
+        self.literal: bytes = b""
+        self.lit_pos = 0
+        self.max_depth = max_depth
+        self.complete = False
+        self.dead = False
+        self.num_has_digit = False
+
+    @property
+    def is_complete(self) -> bool:
+        """True when the bytes so far form a complete JSON document. A
+        top-level number qualifies once it has a digit (numbers have no
+        terminator byte)."""
+        return self.complete or (
+            self.mode == _NUMBER and not self.stack and self.num_has_digit
+        )
+
+    def signature(self) -> tuple:
+        return (self.mode, tuple(self.stack), self.literal, self.lit_pos,
+                self.complete, self.num_has_digit)
+
+    def copy(self) -> "JsonMachine":
+        m = JsonMachine(self.max_depth)
+        m.mode, m.stack = self.mode, list(self.stack)
+        m.literal, m.lit_pos = self.literal, self.lit_pos
+        m.complete, m.dead = self.complete, self.dead
+        m.num_has_digit = self.num_has_digit
+        return m
+
+    # ------------------------------------------------------------------ core
+
+    def _close_value(self) -> None:
+        """A value just finished; decide what comes next."""
+        if not self.stack:
+            self.mode = _AFTER
+            self.complete = True
+        else:
+            self.mode = _AFTER
+
+    def advance(self, byte: int) -> bool:
+        """Consume one byte; returns False (and goes dead) on violation."""
+        if self.dead:
+            return False
+        b = byte
+        mode = self.mode
+
+        if mode == _STRING:
+            if b == 0x5C:  # backslash
+                self.mode = _STR_ESC
+            elif b == 0x22:  # closing quote
+                if self.stack and self.stack[-1] == -1:
+                    # This string was an object key: pop marker, expect colon.
+                    self.stack.pop()
+                    self.mode = _OBJ_COLON
+                else:
+                    self._close_value()
+            elif b < 0x20:
+                return self._die()
+            return True
+        if mode == _STR_ESC:
+            # Accept any printable escape continuation (full \uXXXX validation
+            # is intentionally lax — invalid escapes are caught by json.loads).
+            self.mode = _STRING
+            return True
+        if mode == _NUMBER:
+            if b in _NUM_CONT:
+                if b in _DIGITS:
+                    self.num_has_digit = True
+                return True
+            # Number ended; reinterpret this byte in AFTER mode.
+            self._close_value()
+            self.complete = not self.stack and self.mode == _AFTER
+            return self.advance(b)
+        if mode == _LITERAL:
+            if self.lit_pos < len(self.literal) and b == self.literal[self.lit_pos]:
+                self.lit_pos += 1
+                if self.lit_pos == len(self.literal):
+                    self._close_value()
+                return True
+            return self._die()
+
+        if b in _WS:
+            return True
+
+        if mode == _VALUE:
+            if b == 0x22:  # '"'
+                self.mode = _STRING
+                return True
+            if b == 0x7B:  # '{'
+                if len(self.stack) >= self.max_depth:
+                    return self._die()
+                self.stack.append(0x7B)
+                self.mode = _OBJ_KEY
+                return True
+            if b == 0x5B:  # '['
+                if len(self.stack) >= self.max_depth:
+                    return self._die()
+                self.stack.append(0x5B)
+                self.mode = _VALUE
+                return True
+            if b == 0x5D and self.stack and self.stack[-1] == 0x5B:  # empty array
+                self.stack.pop()
+                self._close_value()
+                self.complete = not self.stack
+                return True
+            if b in _DIGITS or b == 0x2D:  # digit or '-'
+                self.mode = _NUMBER
+                self.num_has_digit = b in _DIGITS
+                return True
+            for lit in _LITERALS:
+                if b == lit[0]:
+                    self.mode = _LITERAL
+                    self.literal, self.lit_pos = lit, 1
+                    return True
+            return self._die()
+
+        if mode == _OBJ_KEY:
+            if b == 0x22:
+                self.stack.append(-1)  # marker: string being read is a key
+                self.mode = _STRING
+                return True
+            if b == 0x7D:  # '}' — empty object
+                self.stack.pop()
+                self._close_value()
+                self.complete = not self.stack
+                return True
+            return self._die()
+
+        if mode == _OBJ_COLON:
+            if b == 0x3A:  # ':'
+                self.mode = _VALUE
+                return True
+            return self._die()
+
+        if mode == _AFTER:
+            if not self.stack:
+                return self._die()  # trailing garbage after a complete value
+            top = self.stack[-1]
+            if b == 0x2C:  # ','
+                self.mode = _OBJ_KEY if top == 0x7B else _VALUE
+                return True
+            if b == 0x7D and top == 0x7B:
+                self.stack.pop()
+                self._close_value()
+                self.complete = not self.stack
+                return True
+            if b == 0x5D and top == 0x5B:
+                self.stack.pop()
+                self._close_value()
+                self.complete = not self.stack
+                return True
+            return self._die()
+
+        return self._die()
+
+    def _die(self) -> bool:
+        self.dead = True
+        return False
+
+    def advance_bytes(self, data: bytes) -> bool:
+        for b in data:
+            if not self.advance(b):
+                return False
+        return True
+
+
+class JsonMaskProvider:
+    """Builds per-step allowed-token masks for an engine + tokenizer pair."""
+
+    def __init__(self, tokenizer):
+        self.tokenizer = tokenizer
+        self._token_bytes: Optional[list[bytes]] = None
+        self._cache: dict[tuple, np.ndarray] = {}
+
+    def _bytes_table(self) -> list[bytes]:
+        if self._token_bytes is None:
+            self._token_bytes = [
+                self.tokenizer.id_to_bytes(t) for t in range(self.tokenizer.vocab_size)
+            ]
+        return self._token_bytes
+
+    def machine_for(self, req) -> JsonMachine:
+        if req.guided_state is None:
+            req.guided_state = JsonMachine()
+        return req.guided_state
+
+    def mask(self, req) -> np.ndarray:
+        machine = self.machine_for(req)
+        sig = machine.signature()
+        cached = self._cache.get(sig)
+        if cached is not None:
+            return cached
+        table = self._bytes_table()
+        out = np.zeros(self.tokenizer.vocab_size, dtype=bool)
+        for tid, bts in enumerate(table):
+            if not bts:
+                continue
+            probe = machine.copy()
+            if probe.advance_bytes(bts):
+                out[tid] = True
+        # Once the JSON value is complete, the stop token ends generation.
+        if machine.is_complete:
+            out[self.tokenizer.eot_id] = True
+            out[self.tokenizer.eos_id] = True
+        if not out.any():
+            # Dead automaton (shouldn't happen): allow stop so we terminate.
+            out[self.tokenizer.eot_id] = True
+        self._cache[sig] = out
+        return out
+
+    def advance(self, req, token: int) -> bool:
+        """Feed a sampled token; True when the grammar is complete (stop)."""
+        machine = self.machine_for(req)
+        if token in (self.tokenizer.eot_id, self.tokenizer.eos_id):
+            return machine.is_complete
+        machine.advance_bytes(self.tokenizer.id_to_bytes(token))
+        # Completion alone doesn't stop generation (whitespace may follow);
+        # the mask above steers toward the stop token once complete.
+        return False

@@ -1,0 +1,141 @@
+"""The ``jax-tpu`` LLM provider: agent seam → in-tree serving engine.
+
+This is THE replacement seam (SURVEY.md §2.2): where the reference's
+``PiAIClient`` posts to hosted provider HTTP APIs, this client renders the
+Llama-3 chat template, submits to the continuous-batching engine, and parses
+tool calls / JSON out of the decoded text. ``complete()`` uses guided JSON
+decoding so the structured orchestrator receives schema-parseable output.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from runbookai_tpu.agent.types import LLMResponse
+from runbookai_tpu.engine.async_engine import AsyncEngine
+from runbookai_tpu.engine.engine import EngineConfig, EngineCore
+from runbookai_tpu.engine.request import SamplingParams
+from runbookai_tpu.model.chat_template import (
+    build_chat_prompt,
+    build_completion_prompt,
+    parse_assistant_output,
+)
+from runbookai_tpu.model.client import BaseLLMClient
+from runbookai_tpu.model.guided import JsonMaskProvider
+from runbookai_tpu.models.hf_loader import load_or_init
+from runbookai_tpu.utils.tokens import load_tokenizer
+
+
+class JaxTpuClient(BaseLLMClient):
+    def __init__(
+        self,
+        core: EngineCore,
+        tokenizer,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        max_new_tokens: int = 1024,
+        guided_json: bool = True,
+    ):
+        self.core = core
+        self.engine = AsyncEngine(core)
+        self.tokenizer = tokenizer
+        self.temperature = temperature
+        self.top_p = top_p
+        self.max_new_tokens = max_new_tokens
+        self.guided_json = guided_json
+
+    # ------------------------------------------------------------- factories
+
+    @classmethod
+    def from_config(cls, llm_cfg) -> "JaxTpuClient":
+        """Build engine + client from an ``LLMConfig`` (utils/config.py)."""
+        tokenizer = load_tokenizer(llm_cfg.tokenizer_path or llm_cfg.model_path)
+        mesh = None
+        shardings = None
+        model_cfg_name = llm_cfg.model
+        dtype = jnp.bfloat16 if llm_cfg.dtype == "bfloat16" else jnp.float32
+        if llm_cfg.mesh.device_count > 1:
+            from runbookai_tpu.models.llama import CONFIGS
+            from runbookai_tpu.parallel.mesh import build_mesh
+            from runbookai_tpu.parallel.sharding import param_shardings
+
+            mesh = build_mesh(llm_cfg.mesh.data, llm_cfg.mesh.model)
+            if model_cfg_name in CONFIGS:
+                shardings = param_shardings(CONFIGS[model_cfg_name], mesh)
+        cfg, params = load_or_init(
+            model_cfg_name, llm_cfg.model_path, dtype=dtype, shardings=shardings
+        )
+        ecfg = EngineConfig(
+            page_size=llm_cfg.page_size,
+            num_pages=llm_cfg.num_pages,
+            max_batch_slots=llm_cfg.max_batch_slots,
+            prefill_chunk=llm_cfg.prefill_chunk,
+            max_seq_len=min(llm_cfg.max_seq_len, cfg.max_seq_len),
+            kv_dtype=dtype,
+        )
+        masker = JsonMaskProvider(tokenizer)
+        core = EngineCore(
+            cfg, params, tokenizer, ecfg,
+            mask_fn=masker.mask, advance_fn=masker.advance,
+        )
+        return cls(
+            core, tokenizer,
+            temperature=llm_cfg.temperature, top_p=llm_cfg.top_p,
+            max_new_tokens=llm_cfg.max_new_tokens, guided_json=llm_cfg.guided_json,
+        )
+
+    @classmethod
+    def for_testing(cls, model_name: str = "llama3-test", **engine_kw) -> "JaxTpuClient":
+        """Tiny random-init client on the byte tokenizer (CPU tests)."""
+        tokenizer = load_tokenizer(None)
+        cfg, params = load_or_init(model_name, None, dtype=jnp.float32)
+        ecfg = EngineConfig(
+            page_size=4, num_pages=256, max_batch_slots=4, prefill_chunk=32,
+            max_seq_len=256, kv_dtype=jnp.float32, **engine_kw,
+        )
+        masker = JsonMaskProvider(tokenizer)
+        core = EngineCore(cfg, params, tokenizer, ecfg,
+                          mask_fn=masker.mask, advance_fn=masker.advance)
+        return cls(core, tokenizer, max_new_tokens=32)
+
+    # ------------------------------------------------------------------- API
+
+    def _sampling(self, guided: Optional[str] = None, max_new: Optional[int] = None) -> SamplingParams:
+        return SamplingParams(
+            temperature=self.temperature,
+            top_p=self.top_p,
+            max_new_tokens=max_new or self.max_new_tokens,
+            stop_token_ids=(self.tokenizer.eot_id, self.tokenizer.eos_id),
+            guided=guided,
+        )
+
+    async def chat(self, system_prompt, user_prompt, tools=None) -> LLMResponse:
+        prompt = build_chat_prompt(system_prompt, user_prompt, tools)
+        ids = self.tokenizer.encode(prompt)
+        out = await self.engine.generate(ids, self._sampling())
+        content, tool_calls, thinking = parse_assistant_output(out.text)
+        return LLMResponse(
+            content=content,
+            tool_calls=tool_calls,
+            thinking=thinking,
+            usage={
+                "prompt_tokens": len(ids),
+                "completion_tokens": out.decode_tokens,
+                "ttft_ms": int(out.ttft_ms or 0),
+            },
+        )
+
+    async def complete(self, prompt: str, guided: Optional[bool] = None) -> str:
+        """Plain completion; guided JSON masking on by default (config) since
+        every orchestrator prompt expects a JSON document back."""
+        use_guided = self.guided_json if guided is None else guided
+        ids = self.tokenizer.encode(build_completion_prompt(prompt))
+        out = await self.engine.generate(
+            ids, self._sampling(guided="json" if use_guided else None)
+        )
+        return out.text
+
+    async def shutdown(self) -> None:
+        await self.engine.stop()

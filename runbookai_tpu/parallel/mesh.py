@@ -1,0 +1,64 @@
+"""Device mesh / topology module — the distributed-communication backend.
+
+SURVEY.md §2.10: the reference has *no* distributed backend (all communication
+is HTTPS to SaaS APIs); the TPU-native equivalent is XLA collectives over ICI
+expressed through ``jax.sharding.Mesh`` + ``NamedSharding``. This module is
+the single place device topology is defined:
+
+- ``data`` axis — batches independent sequences / eval cases (DP).
+- ``model`` axis — shards attention heads, MLP, vocab (Megatron TP); psum /
+  all-gather reductions ride ICI inside compiled programs.
+
+Multi-host (DCN) scale-out uses the same axis names over
+``jax.distributed``-initialized global device lists.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def build_mesh(
+    data: int = 1,
+    model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data, model) mesh over the first ``data*model`` devices.
+
+    Uses ``mesh_utils.create_device_mesh`` when the whole device set is used
+    (it picks an ICI-friendly physical layout); falls back to a simple reshape
+    for subsets (tests, single-chip).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    need = data * model
+    if need > len(devices):
+        raise ValueError(f"mesh {data}x{model} needs {need} devices, have {len(devices)}")
+    if need == len(devices):
+        try:
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_device_mesh((data, model), devices=devices)
+            return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+        except Exception:
+            pass
+    arr = np.asarray(devices[:need]).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def single_device_mesh() -> Mesh:
+    return build_mesh(1, 1)
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

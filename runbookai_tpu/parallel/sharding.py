@@ -1,0 +1,68 @@
+"""Sharding rules: Megatron-style TP specs for the Llama pytree + KV pool.
+
+The scan-stacked param layout makes the specs uniform: every layer leaf has a
+leading layer axis that is never sharded; the ``model`` mesh axis shards
+attention heads / MLP hidden / vocab:
+
+- ``wq/wk/wv``: [L, D, heads*hd]   → column-parallel, P(None, None, model)
+- ``wo``:       [L, heads*hd, D]   → row-parallel,    P(None, model, None)
+- ``w_gate/up``:[L, D, F]          → column-parallel
+- ``w_down``:   [L, F, D]          → row-parallel
+- ``embed``:    [V, D]             → vocab-sharded
+- ``lm_head``:  [D, V]             → vocab-sharded (logit psum/all-gather by XLA)
+- KV pool:      [L, tokens, n_kv, hd] → kv-heads sharded when divisible,
+  replicated otherwise (e.g. 70B GQA n_kv=8 on TP16 — documented trade-off;
+  a 2D head×seq mesh is the extension path).
+
+XLA inserts the psum/all-gather collectives from these placements (the
+scaling-book recipe: pick a mesh, annotate shardings, let XLA do the rest).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from runbookai_tpu.models.llama import LlamaConfig
+from runbookai_tpu.parallel.mesh import MODEL_AXIS
+
+
+def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> dict[str, Any]:
+    """Pytree of NamedShardings matching ``init_params`` structure."""
+
+    def ns(*spec) -> NamedSharding:
+        return NamedSharding(mesh, P(*spec))
+
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    vocab_ok = cfg.vocab_size % tp == 0
+    heads_ok = cfg.n_heads % tp == 0
+    ffn_ok = cfg.ffn_dim % tp == 0
+    kv_ok = cfg.n_kv_heads % tp == 0
+
+    col = ns(None, None, MODEL_AXIS) if heads_ok else ns()
+    shardings: dict[str, Any] = {
+        "embed": ns(MODEL_AXIS, None) if vocab_ok else ns(),
+        "layers": {
+            "wq": col,
+            "wk": ns(None, None, MODEL_AXIS) if kv_ok else ns(),
+            "wv": ns(None, None, MODEL_AXIS) if kv_ok else ns(),
+            "wo": ns(None, MODEL_AXIS, None) if heads_ok else ns(),
+            "w_gate": ns(None, None, MODEL_AXIS) if ffn_ok else ns(),
+            "w_up": ns(None, None, MODEL_AXIS) if ffn_ok else ns(),
+            "w_down": ns(None, MODEL_AXIS, None) if ffn_ok else ns(),
+            "attn_norm": ns(),
+            "mlp_norm": ns(),
+        },
+        "final_norm": ns(),
+    }
+    if not cfg.tie_embeddings:
+        shardings["lm_head"] = ns(None, MODEL_AXIS) if vocab_ok else ns()
+    return shardings
+
+
+def kv_pool_sharding(cfg: LlamaConfig, mesh: Mesh) -> NamedSharding:
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    if cfg.n_kv_heads % tp == 0:
+        return NamedSharding(mesh, P(None, None, MODEL_AXIS, None))
+    return NamedSharding(mesh, P())

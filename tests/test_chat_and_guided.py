@@ -1,0 +1,106 @@
+"""Chat template rendering, tolerant parsing, JSON automaton, guided decode."""
+
+import json
+
+import numpy as np
+import pytest
+
+from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+from runbookai_tpu.model.chat_template import (
+    build_chat_prompt,
+    extract_json,
+    parse_assistant_output,
+)
+from runbookai_tpu.model.guided import JsonMachine, JsonMaskProvider
+from runbookai_tpu.model.jax_tpu import JaxTpuClient
+from runbookai_tpu.utils.tokens import ByteTokenizer
+
+
+def test_chat_prompt_structure():
+    p = build_chat_prompt("sysP", "userP", tools=[{"name": "t", "description": "d", "parameters": {}}])
+    assert p.startswith("<|begin_of_text|><|start_header_id|>system<|end_header_id|>")
+    assert "sysP" in p and "userP" in p and '"name": "t"' in p
+    assert p.endswith("<|start_header_id|>assistant<|end_header_id|>\n\n")
+    assert p.count("<|eot_id|>") == 2
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ('{"a": 1}', {"a": 1}),
+        ('Here you go:\n```json\n{"a": [1, 2]}\n```\nthanks', {"a": [1, 2]}),
+        ('preamble {"nested": {"x": "y}"}} postamble', {"nested": {"x": "y}"}}),
+        ("no json here", None),
+        ('[1, 2, 3] trailing', [1, 2, 3]),
+    ],
+)
+def test_extract_json_tolerant(text, expected):
+    assert extract_json(text) == expected
+
+
+def test_parse_tool_calls_and_thinking():
+    text = '<thinking>check ec2 first</thinking>{"tool_calls": [{"name": "aws_query", "args": {"service": "ec2"}}, {"name": "bad"}]}'
+    content, calls, thinking = parse_assistant_output(text)
+    assert thinking == "check ec2 first"
+    assert [c.name for c in calls] == ["aws_query", "bad"]
+    assert calls[0].args == {"service": "ec2"}
+
+
+def test_parse_plain_answer():
+    content, calls, thinking = parse_assistant_output("The root cause is X.")
+    assert content == "The root cause is X." and calls == [] and thinking is None
+
+
+@pytest.mark.parametrize(
+    "doc",
+    ['{"k": [1, -2.5e3, true, null, "s\\"x"], "o": {}}', "[]", '"str"', "42", "true",
+     '{"a": {"b": {"c": [1, {"d": "e"}]}}}'],
+)
+def test_json_machine_accepts(doc):
+    m = JsonMachine()
+    assert m.advance_bytes(doc.encode()) and m.is_complete
+
+
+@pytest.mark.parametrize("doc", ['{"a" 1}', "{,}", "tru4", '{"a": 1} x', "[1 2]"])
+def test_json_machine_rejects(doc):
+    m = JsonMachine()
+    ok = m.advance_bytes(doc.encode())
+    assert not ok or not m.is_complete
+
+
+def test_mask_provider_steers_to_valid_json():
+    tok = ByteTokenizer()
+    provider = JsonMaskProvider(tok)
+    req = EngineRequest(prompt_ids=[], sampling=SamplingParams(guided="json"))
+    mask = provider.mask(req)
+    # At the start only value-openers are allowed: { [ " digits - t f n ws
+    assert mask[ord("{")] and mask[ord("[")] and mask[ord('"')] and mask[ord("7")]
+    assert not mask[ord("}")] and not mask[ord("x")] and not mask[tok.eot_id]
+    # Walk a full object through advance(); mask should then include eot.
+    for b in b'{"a": 1}':
+        assert provider.mask(req)[b], f"byte {chr(b)} should be allowed"
+        provider.advance(req, b)
+    final = provider.mask(req)
+    assert final[tok.eot_id]
+    # Mask caching: same signature served from cache
+    assert provider.mask(req) is final
+
+
+async def test_guided_complete_emits_valid_json():
+    """Even a RANDOM-weight model must emit parseable JSON under guidance —
+    the strongest possible test of the grammar masks."""
+    client = JaxTpuClient.for_testing()
+    client.max_new_tokens = 48
+    text = await client.complete("Return a JSON object describing the incident.")
+    await client.shutdown()
+    payload = json.loads(text)  # must parse strictly
+    assert payload is not None or payload == payload
+
+
+async def test_chat_returns_response():
+    client = JaxTpuClient.for_testing()
+    client.max_new_tokens = 8
+    resp = await client.chat("You are an SRE.", "What is up?")
+    await client.shutdown()
+    assert isinstance(resp.content, str)
+    assert resp.usage["prompt_tokens"] > 20
