@@ -1,0 +1,294 @@
+"""The free-form tool-calling agent loop.
+
+Parity target: reference ``src/agent/agent.ts`` ``Agent.run()`` (:279-855) —
+an async generator of :class:`AgentEvent`:
+
+retrieve knowledge → (knowledge-only fast path for procedural queries
+:356-390) → iterate up to ``max_iterations``: build prompt → ``llm.chat`` with
+tools → validate calls (repeat-signature guard :529-548, unknown tools,
+graceful limits) → execute (LRU cache :589-603, parallel :626-687 or
+sequential) → summarize + append to scratchpad (tiered) → update memories and
+re-query knowledge on new services/symptoms (:771-786) → final answer
+(:819-821) + hypothesis markdown + citations (:824-845).
+
+The LLM here is the in-tree TPU engine; tool I/O overlaps decode via asyncio.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, AsyncIterator, Optional
+
+from runbookai_tpu.agent.citation import CitationContext
+from runbookai_tpu.agent.context_compactor import ContextCompactor
+from runbookai_tpu.agent.hypothesis import HypothesisEngine
+from runbookai_tpu.agent.memory import InvestigationMemory
+from runbookai_tpu.agent.parallel_executor import ParallelToolExecutor
+from runbookai_tpu.agent.prompts import (
+    build_final_answer_prompt,
+    build_iteration_prompt,
+    build_knowledge_only_prompt,
+    build_system_prompt,
+    is_procedural_query,
+    render_knowledge,
+)
+from runbookai_tpu.agent.scratchpad import Scratchpad
+from runbookai_tpu.agent.tool_cache import LRUToolCache
+from runbookai_tpu.agent.tool_summarizer import summarize_tool_result
+from runbookai_tpu.agent.types import (
+    AgentEvent,
+    RetrievedKnowledge,
+    RiskLevel,
+    Tool,
+    ToolCall,
+    ToolResult,
+)
+from runbookai_tpu.utils.tokens import estimate_tokens
+
+
+class NullKnowledge:
+    """Knowledge adapter used when no retriever is configured."""
+
+    async def retrieve(self, query: str, services: Optional[list[str]] = None) -> RetrievedKnowledge:
+        return RetrievedKnowledge()
+
+
+class Agent:
+    def __init__(
+        self,
+        llm,
+        tools: list[Tool],
+        knowledge: Optional[Any] = None,
+        max_iterations: int = 10,
+        context_threshold_tokens: int = 100_000,
+        explain_mode: bool = False,
+        parallel_tools: bool = True,
+        scratchpad_root: str = ".runbook/scratchpad",
+        persist: bool = True,
+        compactor_preset: str = "balanced",
+        cache_ttl_seconds: float = 300.0,
+        cache_size: int = 100,
+        tokenizer: Optional[Any] = None,
+    ):
+        self.llm = llm
+        self.tools = {t.name: t for t in tools}
+        self.knowledge = knowledge or NullKnowledge()
+        self.max_iterations = max_iterations
+        self.context_threshold = context_threshold_tokens
+        self.explain_mode = explain_mode
+        self.scratchpad_root = scratchpad_root
+        self.persist = persist
+        self.compactor = ContextCompactor(compactor_preset)
+        self.cache = LRUToolCache(max_size=cache_size, ttl_seconds=cache_ttl_seconds)
+        self.executor = ParallelToolExecutor() if parallel_tools else None
+        self.tokenizer = tokenizer
+
+    # ------------------------------------------------------------------ run
+
+    async def run(
+        self,
+        query: str,
+        session_id: Optional[str] = None,
+        incident_id: Optional[str] = None,
+        extra_context: Optional[list[str]] = None,
+    ) -> AsyncIterator[AgentEvent]:
+        session_id = session_id or f"ask-{uuid.uuid4().hex[:10]}"
+        pad = Scratchpad(session_id=session_id, root=self.scratchpad_root,
+                         persist=self.persist)
+        memory = InvestigationMemory(session_id, persist=False)
+        memory.incident_id = incident_id
+        hypotheses = HypothesisEngine() if incident_id else None
+        citations = CitationContext()
+        # Expose the live scratchpad to the drill-down context tools.
+        from runbookai_tpu.tools import context as context_tools
+
+        context_tools.set_active_scratchpad(pad)
+
+        yield AgentEvent("start", {"session_id": session_id, "query": query})
+
+        knowledge = await self.knowledge.retrieve(query)
+        citations.track(knowledge)
+        knowledge_block = render_knowledge(knowledge)
+        if not knowledge.empty:
+            yield AgentEvent("knowledge_retrieved", {
+                "counts": {
+                    "runbooks": len(knowledge.runbooks),
+                    "postmortems": len(knowledge.postmortems),
+                    "known_issues": len(knowledge.known_issues),
+                    "architecture": len(knowledge.architecture),
+                },
+            })
+
+        # Knowledge-only fast path (reference agent.ts:356-390).
+        if knowledge_block and is_procedural_query(query):
+            resp = await self.llm.chat(
+                build_system_prompt(extra_context),
+                build_knowledge_only_prompt(query, knowledge_block),
+            )
+            if "KNOWLEDGE_INSUFFICIENT" not in resp.content:
+                answer = resp.content + citations.sources_section(resp.content)
+                pad.append("answer", {"text": answer, "fast_path": True})
+                yield AgentEvent("answer", {"text": answer, "fast_path": True})
+                yield AgentEvent("done", {"iterations": 0})
+                return
+
+        memory.observe(query)
+        tool_schemas = [t.schema() for t in self.tools.values()]
+        warnings: list[str] = []
+        final_text: Optional[str] = None
+
+        for iteration in range(self.max_iterations):
+            # Context budget check → compaction (reference agent.ts:414-441).
+            context_text = pad.build_tiered_context()
+            if estimate_tokens(context_text, self.tokenizer) > self.context_threshold:
+                plan = self.compactor.plan(pad, query)
+                pad.apply_compaction_plan(plan)
+                context_text = pad.build_tiered_context()
+                yield AgentEvent("phase", {"name": "compaction",
+                                           "results": len(plan)})
+
+            prompt = build_iteration_prompt(
+                query, context_text, knowledge_block, iteration,
+                self.max_iterations, warnings=warnings,
+                memory_block=memory.to_prompt_block(),
+            )
+            warnings = []
+            yield AgentEvent("iteration", {"n": iteration + 1})
+            if self.explain_mode:
+                yield AgentEvent("phase", {"name": "thinking",
+                                           "detail": f"iteration {iteration + 1}"})
+
+            resp = await self.llm.chat(build_system_prompt(extra_context),
+                                       prompt, tool_schemas)
+            if resp.thinking:
+                pad.append_thinking(resp.thinking)
+                memory.observe(resp.thinking)
+                yield AgentEvent("thinking", {"text": resp.thinking})
+
+            if not resp.tool_calls:
+                final_text = resp.content
+                break
+
+            # ------------------------------------------------- validate calls
+            valid_calls: list[ToolCall] = []
+            for call in resp.tool_calls:
+                if call.name not in self.tools:
+                    warnings.append(f"unknown tool {call.name!r}; available: "
+                                    f"{', '.join(sorted(self.tools))}")
+                    yield AgentEvent("warning", {"text": warnings[-1]})
+                    continue
+                repeats = pad.record_call_signature(call)
+                if repeats > 2:
+                    warnings.append(
+                        f"tool call {call.name} with identical args repeated "
+                        f"{repeats}x — refine the arguments or conclude"
+                    )
+                    yield AgentEvent("warning", {"text": warnings[-1]})
+                    continue
+                _, limit_warning = pad.can_call_tool(call.name)
+                if limit_warning:
+                    warnings.append(limit_warning)
+                    yield AgentEvent("warning", {"text": limit_warning})
+                valid_calls.append(call)
+
+            if not valid_calls:
+                continue
+
+            for call in valid_calls:
+                yield AgentEvent("tool_call", {"id": call.id, "name": call.name,
+                                               "args": call.args})
+
+            results = await self._execute_calls(valid_calls)
+
+            for result in results:
+                compact = None if result.error else summarize_tool_result(
+                    result.call.name, result.call.args, result.result
+                )
+                entry = pad.append_tool_result(
+                    result.call, result=result.result, error=result.error,
+                    duration_ms=result.duration_ms, compact=compact,
+                )
+                yield AgentEvent("tool_result", {
+                    "id": result.call.id, "name": result.call.name,
+                    "result_id": entry.result_id, "error": result.error,
+                    "cached": result.cached, "duration_ms": result.duration_ms,
+                    "summary": (compact or {}).get("summary"),
+                })
+                # Memory update + knowledge re-query triggers.
+                new_services, new_symptoms = memory.observe(
+                    str(result.result)[:4000] if result.result is not None else ""
+                )
+                if new_services or new_symptoms:
+                    extra = await self.knowledge.retrieve(
+                        " ".join([query, *new_services, *new_symptoms]),
+                        services=new_services or None,
+                    )
+                    if not extra.empty:
+                        citations.track(extra)
+                        knowledge_block = render_knowledge(extra) or knowledge_block
+                        yield AgentEvent("knowledge_retrieved",
+                                         {"requery": True,
+                                          "trigger": new_services + new_symptoms})
+
+        if final_text is None:
+            # Iteration budget exhausted: one synthesis call without tools.
+            resp = await self.llm.chat(
+                build_system_prompt(extra_context),
+                build_final_answer_prompt(query, pad.build_tiered_context(),
+                                          knowledge_block,
+                                          memory.to_prompt_block()),
+            )
+            final_text = resp.content
+
+        if hypotheses and hypotheses.nodes:
+            final_text += "\n\n" + hypotheses.to_markdown()
+        if memory.findings or memory.services:
+            summary_bits = []
+            if memory.services:
+                summary_bits.append("Services: " + ", ".join(memory.services[:8]))
+            if memory.findings:
+                summary_bits.append(f"{len(memory.findings)} recorded findings")
+            final_text += "\n\n_" + "; ".join(summary_bits) + "_"
+        final_text += citations.sources_section(final_text)
+
+        pad.append("answer", {"text": final_text})
+        memory.save()
+        yield AgentEvent("answer", {"text": final_text})
+        yield AgentEvent("done", {
+            "iterations": iteration + 1 if self.max_iterations else 0,
+            "tool_calls": len(pad.list_result_ids()),
+            "cache": vars(self.cache.stats),
+        })
+
+    # ------------------------------------------------------------- execution
+
+    async def _execute_calls(self, calls: list[ToolCall]) -> list[ToolResult]:
+        results: list[Optional[ToolResult]] = [None] * len(calls)
+        to_run: list[tuple[int, ToolCall]] = []
+        for i, call in enumerate(calls):
+            tool = self.tools[call.name]
+            if tool.risk == RiskLevel.READ:
+                cached = self.cache.get(call.name, call.args)
+                if cached is not None:
+                    results[i] = ToolResult(call=call, result=cached, cached=True)
+                    continue
+            to_run.append((i, call))
+
+        async def execute(call: ToolCall):
+            return await self.tools[call.name].execute(call.args)
+
+        if to_run:
+            pending_calls = [c for _, c in to_run]
+            if self.executor and len(pending_calls) > 1:
+                executed = await self.executor.execute_all(
+                    pending_calls, execute, self.tools
+                )
+            else:
+                solo = ParallelToolExecutor(max_concurrency=1)
+                executed = [await solo._execute_one(c, execute) for c in pending_calls]
+            for (i, call), res in zip(to_run, executed):
+                results[i] = res
+                tool = self.tools[call.name]
+                if res.ok and tool.risk == RiskLevel.READ:
+                    self.cache.put(call.name, call.args, res.result)
+        return [r for r in results if r is not None]

@@ -1,0 +1,44 @@
+"""Citation tracking: knowledge sources referenced in answers.
+
+Parity target: reference ``src/agent/citation-context.ts`` (:45) — tracks
+retrieved docs and appends a Sources section to the final answer
+(``agent.ts:834-845``).
+"""
+
+from __future__ import annotations
+
+import re
+
+from runbookai_tpu.agent.types import KnowledgeResult, RetrievedKnowledge
+
+
+class CitationContext:
+    def __init__(self) -> None:
+        self.docs: dict[str, KnowledgeResult] = {}
+
+    def track(self, knowledge: RetrievedKnowledge) -> None:
+        for item in knowledge.all():
+            self.docs.setdefault(item.doc_id, item)
+
+    def cited_ids(self, answer: str) -> list[str]:
+        """Doc ids the answer actually references as [doc-id]."""
+        referenced = set(re.findall(r"\[([\w./-]+)\]", answer))
+        return [doc_id for doc_id in self.docs if doc_id in referenced]
+
+    def sources_section(self, answer: str) -> str:
+        """Sources block: cited docs first, then remaining runbooks consulted."""
+        if not self.docs:
+            return ""
+        cited = self.cited_ids(answer)
+        lines = ["", "---", "**Sources**"]
+        listed: set[str] = set()
+        for doc_id in cited:
+            item = self.docs[doc_id]
+            lines.append(f"- [{doc_id}] {item.title} ({item.knowledge_type})")
+            listed.add(doc_id)
+        others = [d for d in self.docs.values() if d.doc_id not in listed]
+        if others:
+            lines.append("**Also consulted**")
+            for item in others[:5]:
+                lines.append(f"- [{item.doc_id}] {item.title} ({item.knowledge_type})")
+        return "\n".join(lines)

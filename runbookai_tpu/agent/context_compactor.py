@@ -1,0 +1,84 @@
+"""Importance-scored context compaction.
+
+Parity target: reference ``src/agent/context-compactor.ts`` (:106 scoring —
+recency, error signals, query relevance, size; presets ``incident`` /
+``research`` / ``balanced`` :598). Emits a ``{result_id: tier}`` plan applied
+by ``Scratchpad.apply_compaction_plan`` when the estimated context exceeds the
+threshold (reference ``agent.ts:414-441``).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass
+
+from runbookai_tpu.agent.scratchpad import TIER_CLEARED, TIER_COMPACT, TIER_FULL, Scratchpad
+
+_ERROR_RE = re.compile(r"error|fail|timeout|exception|5\d\d|critical", re.IGNORECASE)
+
+
+@dataclass(frozen=True)
+class CompactorPreset:
+    name: str
+    keep_full: int  # top-K results kept full
+    keep_compact: int  # next-K kept compact; the rest cleared
+    recency_weight: float
+    error_weight: float
+    relevance_weight: float
+    size_penalty: float
+
+
+PRESETS = {
+    # Incidents favor fresh signals; research favors breadth of retained detail.
+    "incident": CompactorPreset("incident", keep_full=4, keep_compact=8,
+                                recency_weight=3.0, error_weight=2.0,
+                                relevance_weight=1.0, size_penalty=1.0),
+    "research": CompactorPreset("research", keep_full=8, keep_compact=12,
+                                recency_weight=1.0, error_weight=1.0,
+                                relevance_weight=2.0, size_penalty=0.5),
+    "balanced": CompactorPreset("balanced", keep_full=6, keep_compact=10,
+                                recency_weight=2.0, error_weight=1.5,
+                                relevance_weight=1.5, size_penalty=0.8),
+}
+
+
+class ContextCompactor:
+    def __init__(self, preset: str = "balanced"):
+        self.preset = PRESETS[preset]
+
+    def score(self, entry, rank_from_newest: int, query: str) -> float:
+        p = self.preset
+        recency = p.recency_weight / (1.0 + rank_from_newest)
+        text = json.dumps(entry.full, default=str) if entry.full is not None else ""
+        errors = p.error_weight * min(3, len(_ERROR_RE.findall(text[:20000]))) / 3.0
+        q_words = {w for w in re.findall(r"\w{4,}", query.lower())}
+        arg_text = (json.dumps(entry.args, default=str) + text[:2000]).lower()
+        overlap = sum(1 for w in q_words if w in arg_text)
+        relevance = p.relevance_weight * min(1.0, overlap / max(1, len(q_words)))
+        size_penalty = p.size_penalty * min(1.0, len(text) / 50_000)
+        return recency + errors + relevance - size_penalty
+
+    def plan(self, scratchpad: Scratchpad, query: str) -> dict[str, str]:
+        """Score all tool results and assign tiers by rank."""
+        entries = [scratchpad.results[rid] for rid in scratchpad.list_result_ids()]
+        n = len(entries)
+        scored = [
+            (self.score(e, rank_from_newest=n - 1 - i, query=query), e)
+            for i, e in enumerate(entries)
+        ]
+        scored.sort(key=lambda t: t[0], reverse=True)
+        plan: dict[str, str] = {}
+        for rank, (_, entry) in enumerate(scored):
+            if rank < self.preset.keep_full:
+                plan[entry.result_id] = TIER_FULL
+            elif rank < self.preset.keep_full + self.preset.keep_compact:
+                plan[entry.result_id] = TIER_COMPACT
+            else:
+                plan[entry.result_id] = TIER_CLEARED
+        return plan
+
+
+def create_compactor(preset: str = "balanced") -> ContextCompactor:
+    """Reference ``createCompactor`` presets (context-compactor.ts:598)."""
+    return ContextCompactor(preset)

@@ -1,0 +1,214 @@
+"""Hypothesis tree engine used by the free-form agent path.
+
+Parity target: reference ``src/agent/hypothesis.ts`` — depth-limited tree
+(``addHypothesis`` :58, ``prune`` :117, ``confirm`` :137), multi-factor
+confidence (``calculateConfidence`` :192-222), markdown export (:251), JSON
+round-trip (:367). Evidence strength classes and the confidence thresholds
+(high ≥70, medium ≥40) follow ``src/agent/confidence.ts:22-46``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+
+class HypothesisStatus(str, Enum):
+    OPEN = "open"
+    INVESTIGATING = "investigating"
+    CONFIRMED = "confirmed"
+    PRUNED = "pruned"
+
+
+class EvidenceStrength(str, Enum):
+    STRONG_SUPPORT = "strong_support"
+    WEAK_SUPPORT = "weak_support"
+    NEUTRAL = "neutral"
+    WEAK_CONTRADICT = "weak_contradict"
+    STRONG_CONTRADICT = "strong_contradict"
+
+
+@dataclass
+class Evidence:
+    description: str
+    strength: EvidenceStrength = EvidenceStrength.NEUTRAL
+    source: str = ""  # tool name / result_id
+    ts: float = field(default_factory=time.time)
+
+
+@dataclass
+class Hypothesis:
+    id: str
+    statement: str
+    parent_id: Optional[str] = None
+    depth: int = 0
+    priority: float = 0.5
+    status: HypothesisStatus = HypothesisStatus.OPEN
+    evidence: list[Evidence] = field(default_factory=list)
+    children: list[str] = field(default_factory=list)
+    prune_reason: Optional[str] = None
+
+
+# Weights mirroring the reference's multi-factor scoring
+# (confidence.ts:22-46): chain depth, corroboration, contradiction, direct.
+_STRENGTH_SCORE = {
+    EvidenceStrength.STRONG_SUPPORT: 30.0,
+    EvidenceStrength.WEAK_SUPPORT: 12.0,
+    EvidenceStrength.NEUTRAL: 0.0,
+    EvidenceStrength.WEAK_CONTRADICT: -15.0,
+    EvidenceStrength.STRONG_CONTRADICT: -35.0,
+}
+
+
+def confidence_score(h: Hypothesis) -> float:
+    """0-100 score; ≥70 high, ≥40 medium (reference thresholds)."""
+    score = 25.0  # prior for a plausible hypothesis
+    supports = sum(1 for e in h.evidence if "support" in e.strength.value)
+    contradictions = sum(1 for e in h.evidence if "contradict" in e.strength.value)
+    for e in h.evidence:
+        score += _STRENGTH_SCORE[e.strength]
+    if supports >= 2:
+        score += 10.0  # corroboration bonus
+    if contradictions and supports:
+        score -= 5.0  # mixed-signal penalty
+    score += min(10.0, 3.0 * h.depth)  # deeper chains earn specificity credit
+    return max(0.0, min(100.0, score))
+
+
+def confidence_label(score: float) -> str:
+    if score >= 70:
+        return "high"
+    if score >= 40:
+        return "medium"
+    return "low"
+
+
+class HypothesisEngine:
+    def __init__(self, max_depth: int = 4, max_hypotheses: int = 10):
+        self.max_depth = max_depth
+        self.max_hypotheses = max_hypotheses
+        self.nodes: dict[str, Hypothesis] = {}
+        self.root_ids: list[str] = []
+
+    def add(self, statement: str, parent_id: Optional[str] = None,
+            priority: float = 0.5) -> Optional[Hypothesis]:
+        if len(self.nodes) >= self.max_hypotheses:
+            return None
+        depth = 0
+        if parent_id is not None:
+            parent = self.nodes[parent_id]
+            depth = parent.depth + 1
+            if depth > self.max_depth:
+                return None
+        h = Hypothesis(id=f"h{len(self.nodes) + 1}-{uuid.uuid4().hex[:6]}",
+                       statement=statement, parent_id=parent_id, depth=depth,
+                       priority=priority)
+        self.nodes[h.id] = h
+        if parent_id is None:
+            self.root_ids.append(h.id)
+        else:
+            self.nodes[parent_id].children.append(h.id)
+        return h
+
+    def add_evidence(self, hypothesis_id: str, evidence: Evidence) -> None:
+        self.nodes[hypothesis_id].evidence.append(evidence)
+
+    def prune(self, hypothesis_id: str, reason: str) -> None:
+        node = self.nodes[hypothesis_id]
+        node.status = HypothesisStatus.PRUNED
+        node.prune_reason = reason
+        for child in node.children:
+            if self.nodes[child].status == HypothesisStatus.OPEN:
+                self.prune(child, f"parent pruned: {reason}")
+
+    def confirm(self, hypothesis_id: str) -> None:
+        self.nodes[hypothesis_id].status = HypothesisStatus.CONFIRMED
+
+    def open_hypotheses(self) -> list[Hypothesis]:
+        """Open/investigating nodes, highest (priority, confidence) first."""
+        candidates = [
+            h for h in self.nodes.values()
+            if h.status in (HypothesisStatus.OPEN, HypothesisStatus.INVESTIGATING)
+        ]
+        return sorted(candidates,
+                      key=lambda h: (h.priority, confidence_score(h)), reverse=True)
+
+    def best(self) -> Optional[Hypothesis]:
+        confirmed = [h for h in self.nodes.values() if h.status == HypothesisStatus.CONFIRMED]
+        if confirmed:
+            return max(confirmed, key=confidence_score)
+        alive = self.open_hypotheses()
+        return alive[0] if alive else None
+
+    # ------------------------------------------------------------ export
+
+    def to_markdown(self) -> str:
+        lines = ["## Hypothesis tree"]
+        icons = {HypothesisStatus.CONFIRMED: "[CONFIRMED]",
+                 HypothesisStatus.PRUNED: "[pruned]",
+                 HypothesisStatus.OPEN: "[open]",
+                 HypothesisStatus.INVESTIGATING: "[investigating]"}
+
+        def render(node_id: str, indent: int) -> None:
+            h = self.nodes[node_id]
+            score = confidence_score(h)
+            lines.append(
+                "  " * indent
+                + f"- {icons[h.status]} {h.statement} "
+                + f"(confidence {score:.0f}/{confidence_label(score)}, "
+                + f"{len(h.evidence)} evidence)"
+            )
+            for e in h.evidence[:3]:
+                lines.append("  " * (indent + 1) + f"- {e.strength.value}: {e.description[:120]}")
+            for child in h.children:
+                render(child, indent + 1)
+
+        for rid in self.root_ids:
+            render(rid, 0)
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "max_depth": self.max_depth,
+                "max_hypotheses": self.max_hypotheses,
+                "root_ids": self.root_ids,
+                "nodes": {
+                    nid: {
+                        "id": h.id, "statement": h.statement, "parent_id": h.parent_id,
+                        "depth": h.depth, "priority": h.priority, "status": h.status.value,
+                        "prune_reason": h.prune_reason, "children": h.children,
+                        "evidence": [
+                            {"description": e.description, "strength": e.strength.value,
+                             "source": e.source, "ts": e.ts}
+                            for e in h.evidence
+                        ],
+                    }
+                    for nid, h in self.nodes.items()
+                },
+            }
+        )
+
+    @classmethod
+    def from_json(cls, payload: str) -> "HypothesisEngine":
+        data = json.loads(payload)
+        engine = cls(max_depth=data["max_depth"], max_hypotheses=data["max_hypotheses"])
+        engine.root_ids = list(data["root_ids"])
+        for nid, raw in data["nodes"].items():
+            engine.nodes[nid] = Hypothesis(
+                id=raw["id"], statement=raw["statement"], parent_id=raw["parent_id"],
+                depth=raw["depth"], priority=raw["priority"],
+                status=HypothesisStatus(raw["status"]),
+                prune_reason=raw.get("prune_reason"), children=list(raw["children"]),
+                evidence=[
+                    Evidence(description=e["description"],
+                             strength=EvidenceStrength(e["strength"]),
+                             source=e.get("source", ""), ts=e.get("ts", 0.0))
+                    for e in raw["evidence"]
+                ],
+            )
+        return engine

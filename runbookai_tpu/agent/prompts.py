@@ -1,0 +1,161 @@
+"""Prompt construction for the free-form agent path.
+
+Parity target: reference ``src/agent/prompts.ts`` — ``buildSystemPrompt``
+(:37-223: investigation methodology, tool policy, mandatory visualization
+policy, safety rules), iteration prompt (:228), knowledge prompt (:271),
+final-answer prompt (:349), context-aware variants (:524-651). The behavioral
+content (methodology steps, policies) is re-expressed; wording is tuned for an
+open instruction-tuned model rather than hosted frontier models.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from runbookai_tpu.agent.types import RetrievedKnowledge
+
+SYSTEM_PROMPT = """\
+You are RunbookAI, an expert SRE agent that investigates production incidents
+and answers infrastructure questions with evidence.
+
+# Methodology
+1. Understand the question or incident symptom.
+2. Check retrieved knowledge (runbooks, postmortems, known issues) first —
+   if a runbook answers the question, use it and cite it.
+3. Form explicit hypotheses about likely causes; prefer recent changes,
+   resource exhaustion, dependencies, and configuration issues.
+4. Gather evidence with tools. Query the MOST SPECIFIC scope you can
+   (a service, a time window) rather than broad scans.
+5. Corroborate before concluding: one signal is a hint, two are evidence.
+6. Conclude with the root cause, affected services, confidence (high /
+   medium / low), and concrete remediation steps.
+
+# Tool policy
+- Call tools only when you need evidence you do not already have.
+- Never repeat an identical tool call; refine the arguments instead.
+- Prefer narrow queries with service names and short time windows.
+- If a tool fails or is unavailable, try an equivalent signal from another
+  tool rather than giving up.
+
+# Visualization policy
+When you present numeric time-series or comparisons in your final answer,
+render them with the visualization tools (visualize_metrics, generate_flowchart)
+so operators can see the shape of the problem in the terminal.
+
+# Safety rules
+- Read-only queries are always allowed.
+- Mutations (scaling, restarts, deployments) happen ONLY through tools that
+  gate on explicit approval. Never describe a mutation as done unless the
+  tool result confirms it.
+- When evidence is inconclusive, say so; do not invent metrics or log lines.
+"""
+
+
+def build_system_prompt(
+    extra_context: Optional[list[str]] = None,
+) -> str:
+    parts = [SYSTEM_PROMPT]
+    for block in extra_context or []:
+        if block:
+            parts.append(block)
+    return "\n\n".join(parts)
+
+
+def render_knowledge(knowledge: RetrievedKnowledge, max_chars: int = 6000) -> str:
+    """Knowledge block for the prompt (reference prompts.ts:271)."""
+    if knowledge.empty:
+        return ""
+    sections = []
+    for label, items in (
+        ("Runbooks", knowledge.runbooks),
+        ("Known issues", knowledge.known_issues),
+        ("Postmortems", knowledge.postmortems),
+        ("Architecture notes", knowledge.architecture),
+    ):
+        if not items:
+            continue
+        lines = [f"## {label}"]
+        for item in items[:3]:
+            lines.append(f"### {item.title} [{item.doc_id}]")
+            lines.append(item.content[:1500])
+        sections.append("\n".join(lines))
+    text = "# Retrieved knowledge\n\n" + "\n\n".join(sections)
+    return text[:max_chars]
+
+
+def build_iteration_prompt(
+    query: str,
+    scratchpad_context: str,
+    knowledge_block: str,
+    iteration: int,
+    max_iterations: int,
+    warnings: Optional[list[str]] = None,
+    memory_block: str = "",
+) -> str:
+    parts = [f"# Task\n{query}"]
+    if knowledge_block:
+        parts.append(knowledge_block)
+    if memory_block:
+        parts.append(memory_block)
+    if scratchpad_context:
+        parts.append(f"# Evidence gathered so far\n{scratchpad_context}")
+    if warnings:
+        parts.append("# Warnings\n" + "\n".join(f"- {w}" for w in warnings))
+    parts.append(
+        f"# Instructions\nIteration {iteration + 1} of {max_iterations}. "
+        "Either request the tool calls you need next (JSON tool_calls form), "
+        "or, if you have enough evidence, answer in plain text."
+    )
+    return "\n\n".join(parts)
+
+
+def build_final_answer_prompt(
+    query: str,
+    scratchpad_context: str,
+    knowledge_block: str,
+    memory_block: str = "",
+) -> str:
+    """Reference prompts.ts:349 — the no-more-tools synthesis call."""
+    parts = [f"# Task\n{query}"]
+    if knowledge_block:
+        parts.append(knowledge_block)
+    if memory_block:
+        parts.append(memory_block)
+    if scratchpad_context:
+        parts.append(f"# Evidence gathered\n{scratchpad_context}")
+    parts.append(
+        "# Instructions\nWrite your final answer now, in plain text. "
+        "Summarize findings, state the root cause (or best hypothesis with "
+        "confidence high/medium/low), affected services, and next steps. "
+        "Cite runbook ids like [doc-id] where knowledge informed the answer. "
+        "Do not request any more tool calls."
+    )
+    return "\n\n".join(parts)
+
+
+def build_knowledge_only_prompt(query: str, knowledge_block: str) -> str:
+    """Fast path for procedural queries answerable from knowledge alone
+    (reference agent.ts:356-390)."""
+    return (
+        f"# Task\n{query}\n\n{knowledge_block}\n\n# Instructions\n"
+        "Answer directly from the retrieved knowledge above. Cite documents "
+        "as [doc-id]. If the knowledge does not answer the question, say "
+        "exactly: KNOWLEDGE_INSUFFICIENT"
+    )
+
+
+def is_procedural_query(query: str) -> bool:
+    """Heuristic for the knowledge-only fast path: how-to/procedure questions
+    that don't name a live incident."""
+    q = query.lower()
+    procedural = any(
+        kw in q
+        for kw in ("how do i", "how to", "what is the procedure", "runbook for",
+                   "steps to", "what's the process", "where is the documentation")
+    )
+    live = any(
+        kw in q
+        for kw in ("right now", "currently", "is down", "firing", "alert",
+                   "incident", "outage", "error rate", "latency spike")
+    )
+    return procedural and not live

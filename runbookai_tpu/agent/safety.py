@@ -1,0 +1,188 @@
+"""Safety: risk classification, mutation limits, cooldowns, approval flow.
+
+Parity targets: reference ``src/agent/safety.ts`` (``AWS_RISK_CLASSIFICATION``
+:38-82, SafetyManager :89 — mutation limits per session, cooldowns) and
+``src/agent/approval.ts`` (``classifyRisk`` :75, auto-approve policy :216,
+cooldown :310, audit JSONL ``.runbook/audit/approvals.jsonl`` :39-50).
+
+The approval prompt itself is pluggable (CLI stdin, Slack buttons, auto) via
+an async callback; critical operations require the literal confirmation
+string, mirroring the reference's type-"yes" gate.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Awaitable, Callable, Optional
+
+from runbookai_tpu.agent.types import RiskLevel
+
+# Operation → risk classes (reference safety.ts:38-82, re-expressed).
+OPERATION_RISK: dict[str, RiskLevel] = {
+    # reads
+    "describe": RiskLevel.READ, "list": RiskLevel.READ, "get": RiskLevel.READ,
+    "query": RiskLevel.READ, "search": RiskLevel.READ, "top": RiskLevel.READ,
+    # low-risk mutations
+    "add_note": RiskLevel.LOW, "acknowledge": RiskLevel.LOW, "post_update": RiskLevel.LOW,
+    "tag": RiskLevel.LOW,
+    # high-risk mutations
+    "scale": RiskLevel.HIGH, "restart": RiskLevel.HIGH, "update_service": RiskLevel.HIGH,
+    "rollback": RiskLevel.HIGH, "update_function_configuration": RiskLevel.HIGH,
+    "reboot": RiskLevel.HIGH, "start": RiskLevel.HIGH, "close_incident": RiskLevel.HIGH,
+    # critical
+    "stop": RiskLevel.CRITICAL, "delete": RiskLevel.CRITICAL,
+    "terminate": RiskLevel.CRITICAL, "apply": RiskLevel.CRITICAL,
+    "exec": RiskLevel.CRITICAL,
+}
+
+_RISK_ORDER = [RiskLevel.READ, RiskLevel.LOW, RiskLevel.HIGH, RiskLevel.CRITICAL]
+
+
+def classify_risk(operation: str, default: RiskLevel = RiskLevel.HIGH) -> RiskLevel:
+    """Classify an operation name; unknown mutations default to HIGH
+    (fail-safe, reference approval.ts:75)."""
+    op = operation.lower()
+    if op in OPERATION_RISK:
+        return OPERATION_RISK[op]
+    for key, risk in OPERATION_RISK.items():
+        if op.startswith(key) or key in op:
+            return risk
+    return default
+
+
+@dataclass
+class ApprovalRequest:
+    operation: str
+    risk: RiskLevel
+    description: str
+    params: dict[str, Any] = field(default_factory=dict)
+    rollback_hint: Optional[str] = None
+
+
+@dataclass
+class ApprovalDecision:
+    approved: bool
+    approver: str = "auto"
+    reason: str = ""
+
+
+ApprovalCallback = Callable[[ApprovalRequest], Awaitable[ApprovalDecision]]
+
+
+async def auto_deny(req: ApprovalRequest) -> ApprovalDecision:
+    return ApprovalDecision(approved=False, approver="auto",
+                            reason="no approval channel configured")
+
+
+async def auto_approve(req: ApprovalRequest) -> ApprovalDecision:
+    return ApprovalDecision(approved=True, approver="auto", reason="auto-approve policy")
+
+
+class SafetyManager:
+    def __init__(
+        self,
+        require_approval: tuple[str, ...] = ("high", "critical"),
+        auto_approve_low_risk: bool = True,
+        max_mutations_per_session: int = 5,
+        cooldown_seconds: float = 60.0,
+        audit_dir: str | Path = ".runbook/audit",
+        approval_callback: Optional[ApprovalCallback] = None,
+        persist_audit: bool = True,
+    ):
+        self.require_approval = {RiskLevel(r) for r in require_approval}
+        self.auto_approve_low_risk = auto_approve_low_risk
+        self.max_mutations = max_mutations_per_session
+        self.cooldown_seconds = cooldown_seconds
+        self.audit_path = Path(audit_dir) / "approvals.jsonl"
+        self.approval_callback = approval_callback or auto_deny
+        self.persist_audit = persist_audit
+        self.mutation_count = 0
+        self._last_critical_ts: Optional[float] = None
+
+    # ----------------------------------------------------------------- audit
+
+    def _audit(self, event: str, data: dict[str, Any]) -> None:
+        if not self.persist_audit:
+            return
+        self.audit_path.parent.mkdir(parents=True, exist_ok=True)
+        with self.audit_path.open("a") as f:
+            f.write(json.dumps({"event": event, "ts": time.time(), **data}) + "\n")
+
+    # ------------------------------------------------------------------ gate
+
+    def check_mutation_allowed(self, risk: RiskLevel) -> tuple[bool, Optional[str]]:
+        """Session limits + cooldown; returns (allowed, reason_if_denied)."""
+        if risk == RiskLevel.READ:
+            return True, None
+        if self.mutation_count >= self.max_mutations:
+            return False, (
+                f"mutation limit reached ({self.max_mutations} per session)"
+            )
+        if risk == RiskLevel.CRITICAL and self._last_critical_ts is not None:
+            elapsed = time.monotonic() - self._last_critical_ts
+            if elapsed < self.cooldown_seconds:
+                return False, (
+                    f"cooldown: {self.cooldown_seconds - elapsed:.0f}s until the "
+                    "next critical operation is allowed"
+                )
+        return True, None
+
+    async def gate(self, request: ApprovalRequest) -> ApprovalDecision:
+        """Full gate: limits → policy → approval callback → audit."""
+        allowed, reason = self.check_mutation_allowed(request.risk)
+        if not allowed:
+            decision = ApprovalDecision(approved=False, approver="policy", reason=reason or "")
+            self._audit("denied", {"operation": request.operation,
+                                   "risk": request.risk.value, "reason": reason})
+            return decision
+
+        if request.risk == RiskLevel.READ:
+            return ApprovalDecision(approved=True, approver="policy", reason="read-only")
+        if request.risk == RiskLevel.LOW and self.auto_approve_low_risk and \
+                RiskLevel.LOW not in self.require_approval:
+            self._record_mutation(request.risk)
+            self._audit("auto_approved", {"operation": request.operation,
+                                          "risk": request.risk.value})
+            return ApprovalDecision(approved=True, approver="policy",
+                                    reason="low risk auto-approved")
+
+        decision = await self.approval_callback(request)
+        self._audit(
+            "approved" if decision.approved else "rejected",
+            {"operation": request.operation, "risk": request.risk.value,
+             "approver": decision.approver, "reason": decision.reason,
+             "params": request.params},
+        )
+        if decision.approved:
+            self._record_mutation(request.risk)
+        return decision
+
+    def _record_mutation(self, risk: RiskLevel) -> None:
+        self.mutation_count += 1
+        if risk == RiskLevel.CRITICAL:
+            self._last_critical_ts = time.monotonic()
+
+
+def make_cli_approval(input_fn: Callable[[str], str] = input) -> ApprovalCallback:
+    """CLI approval: critical requires typing 'yes' (reference parity)."""
+
+    async def prompt(req: ApprovalRequest) -> ApprovalDecision:
+        header = (
+            f"\nAPPROVAL REQUIRED [{req.risk.value.upper()}]: {req.operation}\n"
+            f"  {req.description}\n  params: {json.dumps(req.params, default=str)}\n"
+        )
+        if req.rollback_hint:
+            header += f"  rollback: {req.rollback_hint}\n"
+        if req.risk == RiskLevel.CRITICAL:
+            answer = input_fn(header + "Type 'yes' to approve: ").strip()
+            ok = answer == "yes"
+        else:
+            answer = input_fn(header + "Approve? [y/N]: ").strip().lower()
+            ok = answer in ("y", "yes")
+        return ApprovalDecision(approved=ok, approver="cli",
+                                reason="operator input")
+
+    return prompt

@@ -172,6 +172,9 @@ class Scratchpad:
     def get_result_by_id(self, result_id: str) -> Optional[ToolResultEntry]:
         return self.results.get(result_id)
 
+    def list_result_ids(self) -> list[str]:
+        return list(self._result_order)
+
     def list_results(self) -> list[dict[str, Any]]:
         return [
             {

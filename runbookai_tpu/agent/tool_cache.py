@@ -1,0 +1,66 @@
+"""TTL'd LRU cache of tool results keyed by (tool, args).
+
+Parity target: reference ``src/agent/tool-cache.ts`` (:74 class, :291 factory;
+stats hits/misses/evictions). Mutating tools must never be cached — the
+registry marks risk levels and the agent only consults the cache for
+read-risk tools.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class CacheStats:
+    hits: int = 0
+    misses: int = 0
+    evictions: int = 0
+    expirations: int = 0
+
+
+class LRUToolCache:
+    def __init__(self, max_size: int = 100, ttl_seconds: float = 300.0):
+        self.max_size = max_size
+        self.ttl = ttl_seconds
+        self._store: OrderedDict[str, tuple[float, Any]] = OrderedDict()
+        self.stats = CacheStats()
+
+    @staticmethod
+    def key(tool: str, args: dict[str, Any]) -> str:
+        return f"{tool}:{json.dumps(args, sort_keys=True, default=str)}"
+
+    def get(self, tool: str, args: dict[str, Any]) -> Optional[Any]:
+        k = self.key(tool, args)
+        item = self._store.get(k)
+        if item is None:
+            self.stats.misses += 1
+            return None
+        ts, value = item
+        if time.monotonic() - ts > self.ttl:
+            del self._store[k]
+            self.stats.expirations += 1
+            self.stats.misses += 1
+            return None
+        self._store.move_to_end(k)
+        self.stats.hits += 1
+        return value
+
+    def put(self, tool: str, args: dict[str, Any], value: Any) -> None:
+        k = self.key(tool, args)
+        self._store[k] = (time.monotonic(), value)
+        self._store.move_to_end(k)
+        while len(self._store) > self.max_size:
+            self._store.popitem(last=False)
+            self.stats.evictions += 1
+
+    def clear(self) -> None:
+        self._store.clear()
+
+
+def create_tool_cache(max_size: int = 100, ttl_seconds: float = 300.0) -> LRUToolCache:
+    return LRUToolCache(max_size, ttl_seconds)

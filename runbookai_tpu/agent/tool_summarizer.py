@@ -1,0 +1,106 @@
+"""Per-tool compact summaries keeping the agent's context small.
+
+Parity target: reference ``src/agent/tool-summarizer.ts`` (``CompactToolResult``
+:13-28 — summary, highlights, itemCount, services, healthStatus; per-tool
+summarizer classes :742). Summaries are pure functions of the result payload —
+no LLM call — and the ``result_id`` enables drill-down via ``get_full_result``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Optional
+
+_ERROR_WORDS = re.compile(
+    r"\b(error|exception|fail(?:ed|ure)?|timeout|throttl|oom|denied|refused|5\d\d|crit)\w*",
+    re.IGNORECASE,
+)
+
+
+def _walk_strings(obj: Any, limit: int = 400):
+    stack = [obj]
+    seen = 0
+    while stack and seen < limit:
+        cur = stack.pop()
+        if isinstance(cur, str):
+            seen += 1
+            yield cur
+        elif isinstance(cur, dict):
+            stack.extend(cur.values())
+        elif isinstance(cur, (list, tuple)):
+            stack.extend(cur)
+
+
+def _count_items(result: Any) -> int:
+    if isinstance(result, list):
+        return len(result)
+    if isinstance(result, dict):
+        for key in ("items", "results", "alarms", "events", "logs", "instances",
+                    "pods", "incidents", "series", "resources", "documents"):
+            v = result.get(key)
+            if isinstance(v, list):
+                return len(v)
+        return len(result)
+    return 1
+
+
+def _find_services(result: Any) -> list[str]:
+    found: set[str] = set()
+    for key in ("service", "serviceName", "service_name", "name", "functionName",
+                "cluster", "namespace", "deployment"):
+        stack = [result]
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, dict):
+                v = cur.get(key)
+                if isinstance(v, str) and 0 < len(v) < 80:
+                    found.add(v)
+                stack.extend(cur.values())
+            elif isinstance(cur, list):
+                stack.extend(cur[:50])
+    return sorted(found)[:10]
+
+
+def _health_status(result: Any) -> str:
+    text_signals = 0
+    for s in _walk_strings(result):
+        if _ERROR_WORDS.search(s):
+            text_signals += 1
+        if text_signals >= 3:
+            return "unhealthy"
+    return "degraded" if text_signals else "healthy"
+
+
+def _highlights(result: Any, max_items: int = 5) -> list[str]:
+    out = []
+    for s in _walk_strings(result):
+        if _ERROR_WORDS.search(s) and len(s) > 10:
+            out.append(s[:200])
+            if len(out) >= max_items:
+                break
+    return out
+
+
+def summarize_tool_result(tool: str, args: dict[str, Any], result: Any) -> dict[str, Any]:
+    """Build the compact representation stored in the scratchpad tier."""
+    items = _count_items(result)
+    services = _find_services(result)
+    health = _health_status(result)
+    highlights = _highlights(result)
+    size = len(json.dumps(result, default=str)) if result is not None else 0
+
+    bits = [f"{tool}: {items} item(s)"]
+    if services:
+        bits.append(f"services: {', '.join(services[:4])}")
+    bits.append(f"signal: {health}")
+    summary = "; ".join(bits)
+
+    return {
+        "summary": summary,
+        "highlights": highlights,
+        "item_count": items,
+        "services": services,
+        "health_status": health,
+        "size_bytes": size,
+    }

@@ -1,0 +1,56 @@
+"""Context drill-down tools: recover cleared/compacted tool results.
+
+Parity target: reference ``src/tools/registry.ts`` ``get_full_result`` (:3081)
+/ ``list_results`` (:3143) with ``setActiveScratchpad`` (:3072). These close
+the loop on tiered storage: the agent can always retrieve the full payload of
+a result whose in-context tier was degraded.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from runbookai_tpu.agent.scratchpad import Scratchpad
+from runbookai_tpu.tools.registry import ToolRegistry, object_schema
+
+_active: Optional[Scratchpad] = None
+
+
+def set_active_scratchpad(pad: Optional[Scratchpad]) -> None:
+    global _active
+    _active = pad
+
+
+def get_active_scratchpad() -> Optional[Scratchpad]:
+    return _active
+
+
+def register(reg: ToolRegistry) -> None:
+    async def get_full_result(args):
+        if _active is None:
+            return {"error": "no active session"}
+        entry = _active.get_result_by_id(str(args.get("result_id", "")))
+        if entry is None:
+            return {"error": f"unknown result_id {args.get('result_id')!r}",
+                    "available": [r["result_id"] for r in _active.list_results()]}
+        return {"result_id": entry.result_id, "tool": entry.tool,
+                "args": entry.args, "result": entry.full, "error": entry.error}
+
+    async def list_results(args):
+        if _active is None:
+            return {"error": "no active session"}
+        return {"results": _active.list_results()}
+
+    reg.define(
+        "get_full_result",
+        "Retrieve the full stored payload of a previous tool result by its "
+        "result_id (results may be compacted or cleared from context).",
+        object_schema({"result_id": {"type": "string"}}, ["result_id"]),
+        get_full_result, category="context",
+    )
+    reg.define(
+        "list_results",
+        "List all tool results from this session with their storage tier and summaries.",
+        object_schema({}),
+        list_results, category="context",
+    )

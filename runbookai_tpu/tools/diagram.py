@@ -1,0 +1,226 @@
+"""Terminal visualization tools: ASCII charts and diagram renderers.
+
+Parity target: reference ``src/tools/diagram/charts.ts`` (asciichart
+line/bar/gauge/sparkline/histogram :31-119) and ``mermaid.ts`` (mermaid →
+ASCII flowchart/sequence renderers :238-516). The system prompt mandates
+visualizing numeric series (reference prompts.ts:128-207), so these tools are
+always registered.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+from runbookai_tpu.tools.registry import ToolRegistry, object_schema
+
+SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list[float]) -> str:
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    return "".join(SPARK_CHARS[int((v - lo) / span * (len(SPARK_CHARS) - 1))] for v in values)
+
+
+def line_chart(values: list[float], height: int = 10, label: str = "") -> str:
+    """asciichart-style plot with a y-axis."""
+    if not values:
+        return "(no data)"
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    rows = []
+    for level in range(height, -1, -1):
+        threshold = lo + span * level / height
+        axis = f"{threshold:>10.2f} ┤"
+        line = []
+        for i, v in enumerate(values):
+            cur = round((v - lo) / span * height)
+            prev = round((values[i - 1] - lo) / span * height) if i else cur
+            if cur == level:
+                line.append("╰" if prev > cur else ("╭" if prev < cur else "─"))
+            elif min(prev, cur) < level < max(prev, cur):
+                line.append("│")
+            else:
+                line.append(" ")
+        rows.append(axis + "".join(line))
+    out = "\n".join(rows)
+    return f"{label}\n{out}" if label else out
+
+
+def bar_chart(items: list[tuple[str, float]], width: int = 40) -> str:
+    if not items:
+        return "(no data)"
+    hi = max(abs(v) for _, v in items) or 1.0
+    label_w = min(24, max(len(str(k)) for k, _ in items))
+    lines = []
+    for k, v in items:
+        bar = "█" * max(1, int(abs(v) / hi * width)) if v else ""
+        lines.append(f"{str(k)[:label_w]:<{label_w}} │{bar} {v:g}")
+    return "\n".join(lines)
+
+
+def gauge(value: float, lo: float = 0.0, hi: float = 100.0, width: int = 30,
+          label: str = "") -> str:
+    frac = 0.0 if hi == lo else max(0.0, min(1.0, (value - lo) / (hi - lo)))
+    filled = int(frac * width)
+    return f"{label} [{'█' * filled}{'░' * (width - filled)}] {value:g}/{hi:g}"
+
+
+def histogram(values: list[float], bins: int = 10, width: int = 30) -> str:
+    if not values:
+        return "(no data)"
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    counts = [0] * bins
+    for v in values:
+        counts[min(bins - 1, int((v - lo) / span * bins))] += 1
+    peak = max(counts) or 1
+    lines = []
+    for i, c in enumerate(counts):
+        start = lo + span * i / bins
+        lines.append(f"{start:>10.2f} │{'█' * int(c / peak * width)} {c}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------- #
+# mermaid-ish diagram rendering                                               #
+# --------------------------------------------------------------------------- #
+
+
+def render_flowchart(nodes: list[dict[str, Any]], edges: list[dict[str, Any]]) -> str:
+    """Vertical boxes-and-arrows flowchart."""
+    by_id = {str(n["id"]): str(n.get("label", n["id"])) for n in nodes}
+    out_edges: dict[str, list[tuple[str, str]]] = {}
+    indegree = {nid: 0 for nid in by_id}
+    for e in edges:
+        src, dst = str(e["from"]), str(e["to"])
+        out_edges.setdefault(src, []).append((dst, str(e.get("label", ""))))
+        if dst in indegree:
+            indegree[dst] += 1
+    order: list[str] = []
+    frontier = [n for n, d in indegree.items() if d == 0] or list(by_id)
+    seen = set()
+    while frontier:
+        cur = frontier.pop(0)
+        if cur in seen:
+            continue
+        seen.add(cur)
+        order.append(cur)
+        for dst, _ in out_edges.get(cur, []):
+            if dst not in seen:
+                frontier.append(dst)
+    for nid in by_id:
+        if nid not in seen:
+            order.append(nid)
+
+    lines = []
+    for i, nid in enumerate(order):
+        label = by_id.get(nid, nid)
+        box_w = len(label) + 4
+        lines.append("┌" + "─" * (box_w - 2) + "┐")
+        lines.append(f"│ {label} │")
+        lines.append("└" + "─" * (box_w - 2) + "┘")
+        for dst, elabel in out_edges.get(nid, []):
+            arrow = f"  │ {elabel}" if elabel else "  │"
+            lines.append(arrow)
+            lines.append(f"  ▼ → {by_id.get(dst, dst)}")
+    return "\n".join(lines)
+
+
+def render_sequence(actors: list[str], messages: list[dict[str, Any]]) -> str:
+    if not actors:
+        return "(no actors)"
+    col_w = max(14, max(len(a) for a in actors) + 4)
+    header = "".join(f"{a:^{col_w}}" for a in actors)
+    lines = [header, "".join(f"{'│':^{col_w}}" for _ in actors)]
+    idx = {a: i for i, a in enumerate(actors)}
+    for msg in messages:
+        src, dst = idx.get(str(msg.get("from"))), idx.get(str(msg.get("to")))
+        text = str(msg.get("label", ""))[: col_w * 2]
+        if src is None or dst is None:
+            continue
+        lo, hi = sorted((src, dst))
+        span = (hi - lo) * col_w - 1
+        arrow = ("─" * (span - 1) + (">" if dst > src else "")) if dst != src else "─┐"
+        if dst < src:
+            arrow = "<" + "─" * (span - 1)
+        pad = lo * col_w + col_w // 2 + 1
+        lines.append(" " * pad + arrow)
+        lines.append(" " * pad + text)
+    return "\n".join(lines)
+
+
+def register(reg: ToolRegistry) -> None:
+    async def visualize_metrics(args):
+        kind = args.get("chart", "line")
+        title = args.get("title", "")
+        if kind == "line":
+            values = [float(v) for v in args.get("values", [])]
+            return {"chart": line_chart(values, label=title),
+                    "sparkline": sparkline(values)}
+        if kind == "sparkline":
+            return {"chart": sparkline([float(v) for v in args.get("values", [])])}
+        if kind == "bar":
+            items = [(str(i.get("label", "?")), float(i.get("value", 0)))
+                     for i in args.get("items", [])]
+            return {"chart": bar_chart(items)}
+        if kind == "gauge":
+            return {"chart": gauge(float(args.get("value", 0)),
+                                   float(args.get("min", 0)),
+                                   float(args.get("max", 100)), label=title)}
+        if kind == "histogram":
+            return {"chart": histogram([float(v) for v in args.get("values", [])])}
+        return {"error": f"unknown chart kind {kind!r}",
+                "available": ["line", "sparkline", "bar", "gauge", "histogram"]}
+
+    async def generate_flowchart(args):
+        return {"diagram": render_flowchart(args.get("nodes", []), args.get("edges", []))}
+
+    async def generate_sequence_diagram(args):
+        return {"diagram": render_sequence(args.get("actors", []),
+                                           args.get("messages", []))}
+
+    async def generate_architecture_diagram(args):
+        # Architecture view = flowchart of services with dependency edges.
+        nodes = [{"id": s, "label": s} for s in args.get("services", [])]
+        edges = [{"from": d.get("from"), "to": d.get("to"),
+                  "label": d.get("label", "depends on")}
+                 for d in args.get("dependencies", [])]
+        return {"diagram": render_flowchart(nodes, edges)}
+
+    reg.define(
+        "visualize_metrics",
+        "Render numeric data as a terminal chart. chart: line|sparkline|bar|"
+        "gauge|histogram; values: number[] (line/sparkline/histogram); "
+        "items: {label,value}[] (bar); value/min/max (gauge).",
+        object_schema({"chart": {"type": "string"}, "title": {"type": "string"},
+                       "values": {"type": "array"}, "items": {"type": "array"},
+                       "value": {"type": "number"}, "min": {"type": "number"},
+                       "max": {"type": "number"}}, ["chart"]),
+        visualize_metrics, category="diagram",
+    )
+    reg.define(
+        "generate_flowchart",
+        "Render an ASCII flowchart. nodes: {id,label}[]; edges: {from,to,label}[].",
+        object_schema({"nodes": {"type": "array"}, "edges": {"type": "array"}},
+                      ["nodes"]),
+        generate_flowchart, category="diagram",
+    )
+    reg.define(
+        "generate_sequence_diagram",
+        "Render an ASCII sequence diagram. actors: string[]; messages: {from,to,label}[].",
+        object_schema({"actors": {"type": "array"}, "messages": {"type": "array"}},
+                      ["actors"]),
+        generate_sequence_diagram, category="diagram",
+    )
+    reg.define(
+        "generate_architecture_diagram",
+        "Render a service architecture diagram. services: string[]; "
+        "dependencies: {from,to,label}[].",
+        object_schema({"services": {"type": "array"},
+                       "dependencies": {"type": "array"}}, ["services"]),
+        generate_architecture_diagram, category="diagram",
+    )

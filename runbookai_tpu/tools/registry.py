@@ -1,0 +1,132 @@
+"""Tool registry: categories, definition helper, runtime config gating.
+
+Parity target: reference ``src/tools/registry.ts`` (``ToolRegistry`` +
+``defineTool`` :109-212; category registration :2067-3685) and
+``src/cli/runtime-tools.ts:19-69`` (config toggles select which categories/
+tools an agent run exposes). The registry itself is dependency-free; tool
+factories live in sibling modules and register on demand.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Awaitable, Callable, Optional
+
+from runbookai_tpu.agent.types import RiskLevel, Tool
+
+CATEGORIES = (
+    "aws", "kubernetes", "code", "observability", "knowledge", "incident",
+    "skills", "context", "diagram", "general",
+)
+
+
+class ToolRegistry:
+    def __init__(self) -> None:
+        self._tools: dict[str, Tool] = {}
+        self._categories: dict[str, list[str]] = {c: [] for c in CATEGORIES}
+
+    def register(self, tool: Tool) -> Tool:
+        if tool.name in self._tools:
+            raise ValueError(f"tool {tool.name!r} already registered")
+        self._tools[tool.name] = tool
+        self._categories.setdefault(tool.category, []).append(tool.name)
+        return tool
+
+    def define(
+        self,
+        name: str,
+        description: str,
+        parameters: dict[str, Any],
+        execute: Callable[[dict[str, Any]], Awaitable[Any]],
+        category: str = "general",
+        risk: RiskLevel = RiskLevel.READ,
+        call_limit: Optional[int] = None,
+    ) -> Tool:
+        """``defineTool`` equivalent (reference registry.ts:198-212)."""
+        return self.register(Tool(
+            name=name, description=description, parameters=parameters,
+            execute=execute, category=category, risk=risk, call_limit=call_limit,
+        ))
+
+    def get(self, name: str) -> Optional[Tool]:
+        return self._tools.get(name)
+
+    def all(self) -> list[Tool]:
+        return list(self._tools.values())
+
+    def by_category(self, category: str) -> list[Tool]:
+        return [self._tools[n] for n in self._categories.get(category, [])]
+
+    def names(self) -> list[str]:
+        return sorted(self._tools)
+
+
+def object_schema(properties: dict[str, Any], required: Optional[list[str]] = None) -> dict[str, Any]:
+    schema: dict[str, Any] = {"type": "object", "properties": properties}
+    if required:
+        schema["required"] = required
+    return schema
+
+
+def get_runtime_tools(config, registry: Optional[ToolRegistry] = None,
+                      knowledge=None, safety=None) -> list[Tool]:
+    """Build the gated tool list for one agent run from config.
+
+    Mirrors ``getRuntimeTools`` (runtime-tools.ts:19): each provider block's
+    ``enabled``/``simulated`` flags select real or fixture-backed tools;
+    context + diagram tools are always on.
+    """
+    reg = registry or ToolRegistry()
+
+    from runbookai_tpu.tools import context as context_tools
+    from runbookai_tpu.tools import diagram as diagram_tools
+    from runbookai_tpu.tools import simulated as simulated_tools
+
+    context_tools.register(reg)
+    diagram_tools.register(reg)
+
+    sim = simulated_tools.SimulatedCloud.from_config(config)
+    aws_cfg = config.providers.aws
+    if aws_cfg.enabled:
+        if aws_cfg.simulated:
+            simulated_tools.register_aws(reg, sim)
+        else:
+            from runbookai_tpu.tools import aws as aws_tools
+
+            aws_tools.register(reg, config, safety=safety)
+    k8s_cfg = config.providers.kubernetes
+    if k8s_cfg.enabled:
+        if k8s_cfg.simulated:
+            simulated_tools.register_kubernetes(reg, sim)
+        else:
+            from runbookai_tpu.tools import kubernetes as k8s_tools
+
+            k8s_tools.register(reg, config)
+    obs = config.observability
+    if obs.datadog.enabled or obs.prometheus.enabled:
+        if (obs.datadog.enabled and obs.datadog.simulated) or (
+            obs.prometheus.enabled and obs.prometheus.simulated
+        ):
+            simulated_tools.register_observability(reg, sim, obs)
+        else:
+            from runbookai_tpu.tools import observability as obs_tools
+
+            obs_tools.register(reg, config)
+    inc = config.incident
+    if inc.pagerduty.enabled or inc.opsgenie.enabled or inc.slack.enabled:
+        if (inc.pagerduty.enabled and inc.pagerduty.simulated) or (
+            inc.opsgenie.enabled and inc.opsgenie.simulated
+        ):
+            simulated_tools.register_incident(reg, sim, inc)
+        else:
+            from runbookai_tpu.tools import incident as incident_tools
+
+            incident_tools.register(reg, config)
+    if config.providers.github.enabled or config.providers.gitlab.enabled:
+        from runbookai_tpu.tools import code as code_tools
+
+        code_tools.register(reg, config)
+    if knowledge is not None:
+        from runbookai_tpu.tools import knowledge_tool
+
+        knowledge_tool.register(reg, knowledge)
+    return reg.all()

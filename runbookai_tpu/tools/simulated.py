@@ -1,0 +1,374 @@
+"""Fixture-backed simulated providers — run the full agent without cloud creds.
+
+SURVEY.md §7 step 4 calls for a simulated provider set so ``runbook ask`` and
+the eval suite run end-to-end on TPU with no AWS/K8s/SaaS credentials. The
+default scenario is a payment-api latency incident (bad deployment shrank the
+DB connection pool) exercising the same signal chain the reference demo data
+models (``src/demo/demo-data.ts``): PagerDuty incident → CloudWatch alarms →
+logs with pool-exhaustion errors → deployment event → pod restarts.
+
+Custom scenarios load from ``providers.aws.fixtures_path`` (JSON with the same
+top-level keys as ``DEFAULT_FIXTURES``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+from runbookai_tpu.agent.types import RiskLevel
+from runbookai_tpu.tools.registry import ToolRegistry, object_schema
+
+
+def _ts(minutes_ago: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() - minutes_ago * 60))
+
+
+def default_fixtures() -> dict[str, Any]:
+    return {
+        "aws": {
+            "ecs": [
+                {"service": "payment-api", "status": "ACTIVE", "runningCount": 2,
+                 "desiredCount": 4, "pendingCount": 2,
+                 "deployments": [{"id": "ecs-svc/9371", "status": "PRIMARY",
+                                  "createdAt": _ts(42), "taskDefinition": "payment-api:57"},
+                                 {"id": "ecs-svc/9368", "status": "DRAINING",
+                                  "taskDefinition": "payment-api:56"}]},
+                {"service": "checkout-web", "status": "ACTIVE", "runningCount": 3,
+                 "desiredCount": 3, "pendingCount": 0},
+                {"service": "inventory-service", "status": "ACTIVE", "runningCount": 2,
+                 "desiredCount": 2, "pendingCount": 0},
+            ],
+            "rds": [
+                {"dbInstance": "payments-db", "engine": "postgres", "status": "available",
+                 "maxConnections": 100, "currentConnections": 98,
+                 "cpuUtilization": 41.0, "freeStorageGb": 212.5},
+            ],
+            "lambda": [
+                {"functionName": "payment-webhook-processor", "state": "Active",
+                 "lastModified": _ts(42), "timeout": 30, "memorySize": 256,
+                 "errors24h": 310},
+            ],
+            "ec2": [
+                {"instanceId": "i-0a1b2c3d", "state": "running", "type": "m5.large",
+                 "name": "bastion"},
+            ],
+        },
+        "cloudwatch_alarms": [
+            {"alarmName": "payment-api-p99-latency", "state": "ALARM",
+             "metric": "TargetResponseTime", "threshold": 1.5,
+             "currentValue": 4.82, "stateChangedAt": _ts(38),
+             "service": "payment-api"},
+            {"alarmName": "payments-db-connections", "state": "ALARM",
+             "metric": "DatabaseConnections", "threshold": 90,
+             "currentValue": 98, "stateChangedAt": _ts(35), "service": "payments-db"},
+            {"alarmName": "checkout-web-5xx", "state": "OK",
+             "metric": "HTTPCode_Target_5XX_Count", "threshold": 25,
+             "currentValue": 3, "service": "checkout-web"},
+        ],
+        "cloudwatch_logs": {
+            "/ecs/payment-api": [
+                {"ts": _ts(36), "level": "ERROR",
+                 "message": "HikariPool-1 - Connection is not available, request timed out after 30000ms (total=20, active=20, idle=0, waiting=142)"},
+                {"ts": _ts(35), "level": "ERROR",
+                 "message": "org.postgresql.util.PSQLException: FATAL: remaining connection slots are reserved"},
+                {"ts": _ts(34), "level": "WARN",
+                 "message": "payment request latency 4831ms exceeds SLO 1500ms for /v2/charge"},
+                {"ts": _ts(30), "level": "ERROR",
+                 "message": "timeout acquiring connection from pool: pool size 20 (was 50 before deploy payment-api:57)"},
+            ],
+            "/aws/lambda/payment-webhook-processor": [
+                {"ts": _ts(33), "level": "ERROR",
+                 "message": "Task timed out after 30.03 seconds while calling payment-api /v2/charge"},
+            ],
+        },
+        "kubernetes": {
+            "pods": [
+                {"name": "payment-api-6d9f7c-x2lq4", "namespace": "prod",
+                 "status": "Running", "restarts": 6, "age": "41m",
+                 "containers": [{"name": "app", "ready": True}]},
+                {"name": "payment-api-6d9f7c-9kzzn", "namespace": "prod",
+                 "status": "CrashLoopBackOff", "restarts": 11, "age": "41m",
+                 "containers": [{"name": "app", "ready": False}]},
+                {"name": "checkout-web-7b4d9-aaaa1", "namespace": "prod",
+                 "status": "Running", "restarts": 0, "age": "6d"},
+            ],
+            "deployments": [
+                {"name": "payment-api", "namespace": "prod", "replicas": "2/4",
+                 "updatedAt": _ts(42), "image": "payment-api:2.31.0"},
+                {"name": "checkout-web", "namespace": "prod", "replicas": "3/3",
+                 "image": "checkout-web:1.9.2"},
+            ],
+            "events": [
+                {"ts": _ts(41), "type": "Normal", "reason": "ScalingReplicaSet",
+                 "object": "deployment/payment-api",
+                 "message": "Scaled up replica set payment-api-6d9f7c to 4"},
+                {"ts": _ts(36), "type": "Warning", "reason": "BackOff",
+                 "object": "pod/payment-api-6d9f7c-9kzzn",
+                 "message": "Back-off restarting failed container"},
+            ],
+            "nodes": [
+                {"name": "node-1", "status": "Ready", "cpu": "61%", "memory": "72%"},
+                {"name": "node-2", "status": "Ready", "cpu": "55%", "memory": "64%"},
+            ],
+        },
+        "datadog": {
+            "metrics": {
+                "payment-api.request.latency.p99": {
+                    "unit": "ms",
+                    "points": [[_ts(60), 310], [_ts(50), 340], [_ts(45), 330],
+                               [_ts(40), 2900], [_ts(30), 4400], [_ts(20), 4820],
+                               [_ts(10), 4710]],
+                },
+                "payments-db.connections.active": {
+                    "unit": "connections",
+                    "points": [[_ts(60), 44], [_ts(50), 46], [_ts(40), 93],
+                               [_ts(30), 98], [_ts(20), 98], [_ts(10), 97]],
+                },
+            },
+            "events": [
+                {"ts": _ts(42), "title": "Deployed payment-api v2.31.0",
+                 "tags": ["service:payment-api", "env:prod", "deploy"],
+                 "text": "config change: db pool max_size 50 -> 20 (PR #4312)"},
+            ],
+            "monitors": [
+                {"name": "payment-api p99 latency", "status": "Alert",
+                 "query": "avg(last_5m):p99:payment-api.request.latency > 1500"},
+            ],
+        },
+        "prometheus": {
+            "alerts": [
+                {"name": "HighLatencyP99", "state": "firing",
+                 "labels": {"service": "payment-api", "severity": "page"},
+                 "activeAt": _ts(38)},
+            ],
+            "queries": {
+                "up": [{"metric": {"job": "payment-api"}, "value": 1},
+                       {"metric": {"job": "checkout-web"}, "value": 1}],
+            },
+        },
+        "pagerduty": [
+            {"id": "PD-12345", "title": "High p99 latency on payment-api",
+             "status": "triggered", "urgency": "high", "createdAt": _ts(38),
+             "service": "payment-api",
+             "description": "p99 latency above 1.5s SLO for 10 minutes; "
+                            "customer checkout failures reported",
+             "notes": []},
+        ],
+        "github": {
+            "payment-api": [
+                {"number": 4312, "title": "Tune DB pool settings",
+                 "mergedAt": _ts(55), "author": "dev-a",
+                 "files": ["config/database.yaml"],
+                 "diff_hint": "max_pool_size: 50 -> 20"},
+            ],
+        },
+    }
+
+
+class SimulatedCloud:
+    """Holds the fixture state + mutation journal for simulated tools."""
+
+    def __init__(self, fixtures: Optional[dict[str, Any]] = None):
+        self.fixtures = fixtures or default_fixtures()
+        self.mutations: list[dict[str, Any]] = []
+
+    @classmethod
+    def from_config(cls, config) -> "SimulatedCloud":
+        path = getattr(config.providers.aws, "fixtures_path", None)
+        if path and Path(path).is_file():
+            return cls(json.loads(Path(path).read_text()))
+        return cls()
+
+
+# --------------------------------------------------------------------------- #
+# registration helpers                                                        #
+# --------------------------------------------------------------------------- #
+
+
+def register_aws(reg: ToolRegistry, sim: SimulatedCloud) -> None:
+    async def aws_query(args):
+        service = args.get("service")
+        aws = sim.fixtures["aws"]
+        if service and service != "all":
+            return {service: aws.get(service, []),
+                    "note": None if service in aws else
+                    f"no {service!r} resources; available: {sorted(aws)}"}
+        return aws
+
+    async def aws_mutate(args):
+        record = {"operation": args.get("operation"), "service": args.get("service"),
+                  "params": args.get("params", {}), "ts": time.time()}
+        sim.mutations.append(record)
+        return {"status": "applied", "simulated": True, **record}
+
+    async def cloudwatch_alarms(args):
+        state = args.get("state")
+        alarms = sim.fixtures["cloudwatch_alarms"]
+        if state:
+            alarms = [a for a in alarms if a["state"] == state.upper()]
+        return {"alarms": alarms}
+
+    async def cloudwatch_logs(args):
+        group = args.get("log_group", "")
+        logs = sim.fixtures["cloudwatch_logs"]
+        if group not in logs:
+            return {"error": f"log group {group!r} not found",
+                    "available": sorted(logs)}
+        events = logs[group]
+        pattern = (args.get("filter_pattern") or "").lower()
+        if pattern:
+            events = [e for e in events if pattern in e["message"].lower()]
+        return {"log_group": group, "events": events}
+
+    reg.define(
+        "aws_query",
+        "Query AWS resource inventory and state. service: one of "
+        "ec2|ecs|rds|lambda|... or 'all'.",
+        object_schema({"service": {"type": "string"},
+                       "region": {"type": "string"}}),
+        aws_query, category="aws",
+    )
+    reg.define(
+        "aws_mutate",
+        "Mutate AWS resources (scale service, restart task, update config). "
+        "Requires approval; high risk.",
+        object_schema({"operation": {"type": "string"},
+                       "service": {"type": "string"},
+                       "params": {"type": "object"}}, ["operation"]),
+        aws_mutate, category="aws", risk=RiskLevel.HIGH,
+    )
+    reg.define(
+        "cloudwatch_alarms",
+        "List CloudWatch alarms, optionally filtered by state (ALARM|OK|INSUFFICIENT_DATA).",
+        object_schema({"state": {"type": "string"}}),
+        cloudwatch_alarms, category="aws",
+    )
+    reg.define(
+        "cloudwatch_logs",
+        "Fetch recent CloudWatch log events from a log group, with optional "
+        "filter_pattern and minutes_back.",
+        object_schema({"log_group": {"type": "string"},
+                       "filter_pattern": {"type": "string"},
+                       "minutes_back": {"type": "number"}}, ["log_group"]),
+        cloudwatch_logs, category="aws",
+    )
+
+
+def register_kubernetes(reg: ToolRegistry, sim: SimulatedCloud) -> None:
+    async def kubernetes_query(args):
+        action = args.get("action", "pods")
+        k8s = sim.fixtures["kubernetes"]
+        if action in ("status", "cluster-info"):
+            return {"nodes": k8s["nodes"], "healthy": all(
+                n["status"] == "Ready" for n in k8s["nodes"])}
+        if action in k8s:
+            items = k8s[action]
+            ns = args.get("namespace")
+            if ns and isinstance(items, list):
+                items = [i for i in items if i.get("namespace", ns) == ns]
+            return {action: items}
+        return {"error": f"unknown action {action!r}",
+                "available": ["status", *sorted(k8s)]}
+
+    reg.define(
+        "kubernetes_query",
+        "Read-only Kubernetes queries. action: status|pods|deployments|nodes|events.",
+        object_schema({"action": {"type": "string"},
+                       "namespace": {"type": "string"},
+                       "context": {"type": "string"}}, ["action"]),
+        kubernetes_query, category="kubernetes",
+    )
+
+
+def register_observability(reg: ToolRegistry, sim: SimulatedCloud, obs_cfg) -> None:
+    async def datadog(args):
+        action = args.get("action", "metrics")
+        dd = sim.fixtures["datadog"]
+        if action == "metrics":
+            query = args.get("query", "")
+            series = {k: v for k, v in dd["metrics"].items() if not query or query in k}
+            return {"series": series or {"note": f"no series match {query!r}",
+                                         "available": sorted(dd['metrics'])}}
+        if action in dd:
+            return {action: dd[action]}
+        return {"error": f"unknown action {action!r}",
+                "available": ["metrics", *sorted(dd)]}
+
+    async def prometheus(args):
+        action = args.get("action", "alerts")
+        prom = sim.fixtures["prometheus"]
+        if action == "alerts":
+            return {"alerts": prom["alerts"]}
+        if action in ("query", "query_range"):
+            q = args.get("query", "up")
+            return {"result": prom["queries"].get(q, []),
+                    "query": q}
+        return {"error": f"unknown action {action!r}"}
+
+    if obs_cfg.datadog.enabled:
+        reg.define(
+            "datadog",
+            "Datadog queries. action: metrics|events|monitors; query filters series.",
+            object_schema({"action": {"type": "string"}, "query": {"type": "string"},
+                           "minutes_back": {"type": "number"}}, ["action"]),
+            datadog, category="observability",
+        )
+    if obs_cfg.prometheus.enabled:
+        reg.define(
+            "prometheus",
+            "Prometheus queries. action: alerts|query|query_range with PromQL query.",
+            object_schema({"action": {"type": "string"}, "query": {"type": "string"}},
+                          ["action"]),
+            prometheus, category="observability",
+        )
+
+
+def register_incident(reg: ToolRegistry, sim: SimulatedCloud, inc_cfg) -> None:
+    def _find(incident_id: str) -> Optional[dict[str, Any]]:
+        for inc in sim.fixtures["pagerduty"]:
+            if inc["id"] == incident_id:
+                return inc
+        return None
+
+    async def get_incident(args):
+        inc = _find(args.get("incident_id", ""))
+        return inc or {"error": f"incident {args.get('incident_id')!r} not found",
+                       "known": [i["id"] for i in sim.fixtures["pagerduty"]]}
+
+    async def list_incidents(args):
+        status = args.get("status")
+        items = sim.fixtures["pagerduty"]
+        if status:
+            items = [i for i in items if i["status"] == status]
+        return {"incidents": items}
+
+    async def add_note(args):
+        inc = _find(args.get("incident_id", ""))
+        if not inc:
+            return {"error": "incident not found"}
+        inc.setdefault("notes", []).append(
+            {"ts": time.time(), "content": args.get("content", "")})
+        return {"status": "ok", "notes": len(inc["notes"])}
+
+    reg.define(
+        "pagerduty_get_incident",
+        "Fetch a PagerDuty incident by id (e.g. PD-12345).",
+        object_schema({"incident_id": {"type": "string"}}, ["incident_id"]),
+        get_incident, category="incident",
+    )
+    reg.define(
+        "pagerduty_list_incidents",
+        "List PagerDuty incidents, optionally by status (triggered|acknowledged|resolved).",
+        object_schema({"status": {"type": "string"}}),
+        list_incidents, category="incident",
+    )
+    reg.define(
+        "pagerduty_add_note",
+        "Add a note to a PagerDuty incident.",
+        object_schema({"incident_id": {"type": "string"},
+                       "content": {"type": "string"}}, ["incident_id", "content"]),
+        add_note, category="incident", risk=RiskLevel.LOW,
+    )

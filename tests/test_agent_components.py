@@ -1,0 +1,188 @@
+"""Agent-layer components: executor, cache, hypotheses, safety, memory,
+summarizer, compactor."""
+
+import asyncio
+import json
+
+import pytest
+
+from runbookai_tpu.agent.context_compactor import ContextCompactor
+from runbookai_tpu.agent.hypothesis import (
+    Evidence,
+    EvidenceStrength,
+    HypothesisEngine,
+    confidence_label,
+    confidence_score,
+)
+from runbookai_tpu.agent.memory import ConversationMemory, InvestigationMemory
+from runbookai_tpu.agent.parallel_executor import (
+    ParallelToolExecutor,
+    analyze_tool_dependencies,
+)
+from runbookai_tpu.agent.safety import (
+    ApprovalDecision,
+    ApprovalRequest,
+    RiskLevel,
+    SafetyManager,
+    classify_risk,
+)
+from runbookai_tpu.agent.scratchpad import Scratchpad
+from runbookai_tpu.agent.tool_cache import LRUToolCache
+from runbookai_tpu.agent.tool_summarizer import summarize_tool_result
+from runbookai_tpu.agent.types import Tool, ToolCall
+from runbookai_tpu.model.client import MockLLMClient
+
+
+def _tool(name, risk=RiskLevel.READ):
+    async def run(args):
+        return {"ok": name}
+
+    return Tool(name=name, description="", parameters={}, execute=run, risk=risk)
+
+
+def test_dependency_stages_serialize_mutations():
+    tools = {"r1": _tool("r1"), "r2": _tool("r2"), "m": _tool("m", RiskLevel.HIGH)}
+    calls = [ToolCall.new("r1", {}), ToolCall.new("m", {}), ToolCall.new("r2", {})]
+    stages = analyze_tool_dependencies(calls, tools)
+    assert [[c.name for c in s] for s in stages] == [["r1"], ["m"], ["r2"]]
+    # all reads -> one stage
+    stages2 = analyze_tool_dependencies([ToolCall.new("r1", {}), ToolCall.new("r2", {})], tools)
+    assert len(stages2) == 1
+
+
+async def test_parallel_executor_concurrency_and_errors():
+    order = []
+
+    async def slow(call):
+        order.append(f"start-{call.name}")
+        await asyncio.sleep(0.01)
+        if call.name == "boom":
+            raise RuntimeError("kaput")
+        return call.name
+
+    ex = ParallelToolExecutor(max_concurrency=4)
+    calls = [ToolCall.new(n, {}) for n in ("a", "b", "boom")]
+    results = await ex.execute_all(calls, slow)
+    assert [r.call.name for r in results] == ["a", "b", "boom"]
+    assert results[0].ok and results[1].ok
+    assert not results[2].ok and "kaput" in results[2].error
+    assert all(r.duration_ms > 0 for r in results)
+    # all three started before any finished (true concurrency)
+    assert order[:3] == ["start-a", "start-b", "start-boom"]
+
+
+def test_tool_cache_ttl_lru(monkeypatch):
+    cache = LRUToolCache(max_size=2, ttl_seconds=100)
+    t = [0.0]
+    monkeypatch.setattr("runbookai_tpu.agent.tool_cache.time.monotonic", lambda: t[0])
+    cache.put("a", {"x": 1}, "va")
+    cache.put("b", {}, "vb")
+    assert cache.get("a", {"x": 1}) == "va"
+    cache.put("c", {}, "vc")  # evicts b (a was freshly used)
+    assert cache.get("b", {}) is None and cache.stats.evictions == 1
+    t[0] = 200.0  # expire everything
+    assert cache.get("a", {"x": 1}) is None and cache.stats.expirations == 1
+
+
+def test_hypothesis_tree_depth_caps_confidence_roundtrip():
+    eng = HypothesisEngine(max_depth=2, max_hypotheses=5)
+    root = eng.add("db pool exhausted", priority=0.9)
+    child = eng.add("deploy shrank pool", parent_id=root.id, priority=0.8)
+    grand = eng.add("PR 4312 bad config", parent_id=child.id)
+    assert grand.depth == 2
+    assert eng.add("too deep", parent_id=grand.id) is None  # depth cap
+    eng.add_evidence(root.id, Evidence("98/100 connections", EvidenceStrength.STRONG_SUPPORT))
+    eng.add_evidence(root.id, Evidence("pool timeout logs", EvidenceStrength.STRONG_SUPPORT))
+    score = confidence_score(eng.nodes[root.id])
+    assert score >= 70 and confidence_label(score) == "high"
+    eng.confirm(root.id)
+    eng.prune(child.id, "superseded")
+    assert eng.nodes[grand.id].status.value == "pruned"  # cascades
+    md = eng.to_markdown()
+    assert "[CONFIRMED] db pool exhausted" in md and "[pruned]" in md
+    restored = HypothesisEngine.from_json(eng.to_json())
+    assert restored.best().statement == "db pool exhausted"
+    assert len(restored.nodes) == 3
+
+
+def test_classify_risk_defaults_high():
+    assert classify_risk("describe_instances") == RiskLevel.READ
+    assert classify_risk("delete_stack") == RiskLevel.CRITICAL
+    assert classify_risk("scale_service") == RiskLevel.HIGH
+    assert classify_risk("frobnicate") == RiskLevel.HIGH  # unknown -> fail safe
+
+
+async def test_safety_limits_cooldown_audit(tmp_path):
+    calls = []
+
+    async def approver(req):
+        calls.append(req.operation)
+        return ApprovalDecision(approved=True, approver="test")
+
+    mgr = SafetyManager(max_mutations_per_session=2, cooldown_seconds=1000,
+                        audit_dir=tmp_path, approval_callback=approver)
+    read = ApprovalRequest("describe", RiskLevel.READ, "")
+    assert (await mgr.gate(read)).approved
+    low = ApprovalRequest("add_note", RiskLevel.LOW, "")
+    assert (await mgr.gate(low)).approved and calls == []  # auto-approved
+    crit = ApprovalRequest("terminate", RiskLevel.CRITICAL, "")
+    assert (await mgr.gate(crit)).approved and calls == ["terminate"]
+    # cooldown blocks the second critical; mutation limit already at 2
+    denied = await mgr.gate(ApprovalRequest("delete", RiskLevel.CRITICAL, ""))
+    assert not denied.approved
+    lines = [json.loads(l) for l in (tmp_path / "approvals.jsonl").read_text().splitlines()]
+    assert [l["event"] for l in lines] == ["auto_approved", "approved", "denied"]
+
+
+def test_investigation_memory_observes():
+    mem = InvestigationMemory("s", persist=False)
+    new_s, new_sym = mem.observe(
+        "Found payment-api latency spike; payments-db connection pool exhausted"
+    )
+    assert "payment-api" in new_s and "payments-db" in new_s
+    assert "latency" in new_sym and "connection" in new_sym
+    again_s, _ = mem.observe("payment-api still degraded")
+    assert again_s == []  # dedup
+    block = mem.to_prompt_block()
+    assert "payment-api" in block and "Key findings" in block
+
+
+async def test_conversation_memory_summarizes():
+    mem = ConversationMemory(summarize_after_messages=4, keep_recent=2)
+    llm = MockLLMClient(["summary: payment-api incident discussed"])
+    for i in range(4):
+        mem.add("user" if i % 2 == 0 else "assistant", f"msg {i} about payment-api")
+    assert mem.needs_summarization
+    await mem.summarize(llm)
+    assert "summary" in mem.summary and len(mem.turns) == 2
+    assert "payment-api" in mem.mentioned_services
+    restored = ConversationMemory.deserialize(mem.serialize())
+    assert restored.summary == mem.summary
+
+
+def test_summarizer_detects_errors_and_services():
+    result = {
+        "alarms": [
+            {"alarmName": "x", "state": "ALARM", "service": "payment-api",
+             "message": "error rate critical"},
+            {"alarmName": "y", "state": "OK", "service": "checkout-web"},
+        ]
+    }
+    compact = summarize_tool_result("cloudwatch_alarms", {}, result)
+    assert compact["item_count"] == 2
+    assert "payment-api" in compact["services"]
+    assert compact["health_status"] in ("degraded", "unhealthy")
+    assert compact["summary"].startswith("cloudwatch_alarms")
+
+
+def test_compactor_plan_tiers(tmp_path):
+    pad = Scratchpad(session_id="c", root=tmp_path)
+    for i in range(8):
+        payload = {"data": "error timeout" if i == 0 else "fine", "i": i}
+        pad.append_tool_result(ToolCall.new("t", {"i": i}), result=payload)
+    compactor = ContextCompactor("incident")  # keep_full=4, keep_compact=8
+    plan = compactor.plan(pad, query="timeout")
+    assert set(plan) == set(pad.list_result_ids())
+    assert list(plan.values()).count("full") == 4
+    # the old-but-error-laden result survives at full tier despite age
+    assert plan["r1"] == "full"

@@ -1,0 +1,175 @@
+"""The free-form agent loop end-to-end on MockLLMClient + simulated tools."""
+
+import json
+
+import pytest
+
+from runbookai_tpu.agent.agent import Agent
+from runbookai_tpu.agent.types import (
+    KnowledgeResult,
+    LLMResponse,
+    RetrievedKnowledge,
+    ToolCall,
+)
+from runbookai_tpu.model.client import MockLLMClient
+from runbookai_tpu.tools.registry import ToolRegistry
+from runbookai_tpu.tools import context as context_tools
+from runbookai_tpu.tools import simulated as sim_tools
+
+
+@pytest.fixture()
+def tools():
+    reg = ToolRegistry()
+    sim = sim_tools.SimulatedCloud()
+    sim_tools.register_aws(reg, sim)
+    sim_tools.register_kubernetes(reg, sim)
+    context_tools.register(reg)
+    return reg.all()
+
+
+def tc(name, args):
+    return ToolCall.new(name, args)
+
+
+async def collect(agent, query, **kw):
+    events = []
+    async for ev in agent.run(query, **kw):
+        events.append(ev)
+    return events
+
+
+def kinds(events):
+    return [e.kind for e in events]
+
+
+async def test_loop_executes_tools_then_answers(tools, tmp_path):
+    llm = MockLLMClient([
+        LLMResponse(content="", tool_calls=[
+            tc("cloudwatch_alarms", {"state": "ALARM"}),
+            tc("kubernetes_query", {"action": "pods"}),
+        ]),
+        LLMResponse(content="Root cause: db pool exhaustion after deploy. confidence high"),
+    ])
+    agent = Agent(llm, tools, scratchpad_root=tmp_path, persist=True)
+    events = await collect(agent, "why is payment-api slow?")
+    ks = kinds(events)
+    assert ks[0] == "start" and ks[-1] == "done"
+    assert ks.count("tool_call") == 2 and ks.count("tool_result") == 2
+    answer = next(e for e in events if e.kind == "answer")
+    assert "Root cause" in answer.data["text"]
+    # Second chat call got the evidence in the prompt
+    assert "payment-api-p99-latency" in llm.calls[1]["user"]
+    # investigation memory summary is appended
+    assert "Services:" in answer.data["text"]
+
+
+async def test_unknown_tool_and_repeat_guard(tools, tmp_path):
+    same = {"state": "ALARM"}
+    llm = MockLLMClient([
+        LLMResponse(content="", tool_calls=[tc("nope_tool", {})]),
+        LLMResponse(content="", tool_calls=[tc("cloudwatch_alarms", same)]),
+        LLMResponse(content="", tool_calls=[tc("cloudwatch_alarms", same)]),
+        LLMResponse(content="", tool_calls=[tc("cloudwatch_alarms", same)]),
+        LLMResponse(content="done answering"),
+    ])
+    agent = Agent(llm, tools, scratchpad_root=tmp_path, persist=False)
+    events = await collect(agent, "q")
+    warnings = [e.data["text"] for e in events if e.kind == "warning"]
+    assert any("unknown tool" in w for w in warnings)
+    assert any("repeated" in w for w in warnings)
+    # repeat guard: third identical call was executed at most twice... the
+    # second call is a cache hit anyway.
+    results = [e for e in events if e.kind == "tool_result"]
+    assert len(results) == 2
+    assert results[1].data["cached"] is True
+
+
+async def test_cache_serves_repeat_reads(tools, tmp_path):
+    llm = MockLLMClient([
+        LLMResponse(content="", tool_calls=[tc("aws_query", {"service": "rds"})]),
+        LLMResponse(content="", tool_calls=[tc("aws_query", {"service": "rds"})]),
+        LLMResponse(content="answer"),
+    ])
+    agent = Agent(llm, tools, scratchpad_root=tmp_path, persist=False)
+    events = await collect(agent, "db state?")
+    results = [e for e in events if e.kind == "tool_result"]
+    assert [r.data["cached"] for r in results] == [False, True]
+    done = events[-1]
+    assert done.data["cache"]["hits"] == 1
+
+
+async def test_drilldown_tool_reads_scratchpad(tools, tmp_path):
+    llm = MockLLMClient([
+        LLMResponse(content="", tool_calls=[tc("cloudwatch_alarms", {})]),
+        LLMResponse(content="", tool_calls=[tc("get_full_result", {"result_id": "r1"})]),
+        LLMResponse(content="final"),
+    ])
+    agent = Agent(llm, tools, scratchpad_root=tmp_path, persist=False)
+    events = await collect(agent, "q")
+    results = [e for e in events if e.kind == "tool_result"]
+    assert len(results) == 2
+    # the drilldown returned the alarms payload from the scratchpad
+    pad = context_tools.get_active_scratchpad()
+    drill = pad.get_result_by_id("r2").full
+    assert drill["tool"] == "cloudwatch_alarms"
+    assert "alarms" in drill["result"]
+
+
+async def test_iteration_budget_forces_synthesis(tools, tmp_path):
+    responses = [
+        LLMResponse(content="", tool_calls=[tc("aws_query", {"service": "ecs", "region": f"r{i}"})])
+        for i in range(3)
+    ] + [LLMResponse(content="synthesized answer")]
+    llm = MockLLMClient(responses)
+    agent = Agent(llm, tools, max_iterations=3, scratchpad_root=tmp_path, persist=False)
+    events = await collect(agent, "q")
+    answer = next(e for e in events if e.kind == "answer")
+    # after 3 iterations the 4th llm call is the no-tools synthesis prompt
+    assert "final answer" in llm.calls[3]["user"].lower()
+    assert llm.calls[3]["tools"] is None
+    assert answer.data["text"].startswith("synthesized answer")
+
+
+class StubKnowledge:
+    def __init__(self):
+        self.queries = []
+
+    async def retrieve(self, query, services=None):
+        self.queries.append(query)
+        if "payment" in query:
+            return RetrievedKnowledge(runbooks=[KnowledgeResult(
+                doc_id="rb-001", title="Payment latency runbook",
+                knowledge_type="runbook",
+                content="1. Check db pool.\n2. Check recent deploys.")])
+        return RetrievedKnowledge()
+
+
+async def test_knowledge_fast_path_and_citations(tools, tmp_path):
+    llm = MockLLMClient([
+        LLMResponse(content="Per the runbook [rb-001]: check the db pool first."),
+    ])
+    agent = Agent(llm, tools, knowledge=StubKnowledge(),
+                  scratchpad_root=tmp_path, persist=False)
+    events = await collect(agent, "how do I investigate payment latency?")
+    ks = kinds(events)
+    assert "knowledge_retrieved" in ks
+    answer = next(e for e in events if e.kind == "answer")
+    assert answer.data.get("fast_path") is True
+    assert "Sources" in answer.data["text"] and "rb-001" in answer.data["text"]
+    assert len(llm.calls) == 1  # single LLM call, zero tools
+
+
+async def test_knowledge_requery_on_new_services(tools, tmp_path):
+    knowledge = StubKnowledge()
+    llm = MockLLMClient([
+        # tool result mentions payment-api -> triggers re-query
+        LLMResponse(content="", tool_calls=[tc("kubernetes_query", {"action": "deployments"})]),
+        LLMResponse(content="done"),
+    ])
+    agent = Agent(llm, tools, knowledge=knowledge,
+                  scratchpad_root=tmp_path, persist=False)
+    events = await collect(agent, "what changed recently?")
+    requeried = [e for e in events if e.kind == "knowledge_retrieved"
+                 and e.data.get("requery")]
+    assert requeried and "payment-api" in requeried[0].data["trigger"]
+    assert len(knowledge.queries) >= 2
