@@ -1,0 +1,119 @@
+"""The knowledge embedder: batched JAX bge encode with caching.
+
+Parity target: reference ``src/knowledge/indexer/embedder.ts`` — the exact API
+to reimplement (:57-163): ``embed_text`` (single), ``embed_texts`` (batched
+with md5 in-memory cache :49), ``cosine_similarity`` (:168), cost estimation
+(:261 — becomes token counts; there is no per-token dollar cost on-device).
+
+Batches are padded to fixed (batch, length) buckets so XLA compiles a small
+number of programs; encode bursts run between decode steps when co-resident
+with the LLM on one slice (SURVEY.md §7 hard part 5).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+import numpy as np
+
+from runbookai_tpu.utils.tokens import load_tokenizer
+
+
+class Embedder:
+    def __init__(
+        self,
+        model_name: str = "bge-test",
+        model_path: Optional[str] = None,
+        tokenizer_path: Optional[str] = None,
+        max_length: int = 512,
+        batch_size: int = 64,
+        query_instruction: str = "Represent this sentence for searching relevant passages: ",
+    ):
+        import jax.numpy as jnp  # deferred
+
+        from runbookai_tpu.models import bge
+
+        self.cfg, self.params = bge.load_or_init(model_name, model_path)
+        self._encode = bge.encode
+        self.tokenizer = load_tokenizer(tokenizer_path or model_path)
+        self.max_length = min(max_length, self.cfg.max_positions)
+        self.batch_size = batch_size
+        self.query_instruction = query_instruction
+        self.dim = self.cfg.dim
+        self._cache: dict[str, np.ndarray] = {}
+        self._jnp = jnp
+        self.stats = {"texts": 0, "tokens": 0, "cache_hits": 0, "batches": 0}
+
+    @staticmethod
+    def _key(text: str) -> str:
+        return hashlib.md5(text.encode()).hexdigest()
+
+    def _bucket_len(self, longest: int) -> int:
+        """Round up to a power-of-two bucket to bound compilation count."""
+        n = 16
+        while n < longest and n < self.max_length:
+            n *= 2
+        return min(n, self.max_length)
+
+    def _tokenize(self, text: str) -> list[int]:
+        ids = self.tokenizer.encode(text)[: self.max_length - 2]
+        # CLS/BOS ... SEP/EOS framing; byte fallback uses bos/eos ids.
+        cls = getattr(self.tokenizer, "bos_id", None) or 0
+        sep = getattr(self.tokenizer, "eos_id", None) or 0
+        return [cls, *ids, sep]
+
+    def embed_texts(self, texts: list[str], is_query: bool = False) -> np.ndarray:
+        """Batched embed with cache; returns [N, dim] float32 (L2-normalized)."""
+        jnp = self._jnp
+        out = np.zeros((len(texts), self.dim), dtype=np.float32)
+        pending: list[tuple[int, list[int]]] = []
+        for i, text in enumerate(texts):
+            rendered = (self.query_instruction + text) if is_query else text
+            key = self._key(("q:" if is_query else "d:") + rendered)
+            cached = self._cache.get(key)
+            if cached is not None:
+                out[i] = cached
+                self.stats["cache_hits"] += 1
+            else:
+                pending.append((i, self._tokenize(rendered)))
+
+        for start in range(0, len(pending), self.batch_size):
+            batch = pending[start : start + self.batch_size]
+            longest = max(len(ids) for _, ids in batch)
+            pad_to = self._bucket_len(longest)
+            pad_id = getattr(self.tokenizer, "pad_id", 0) % self.cfg.vocab_size
+            tokens = np.full((len(batch), pad_to), pad_id, dtype=np.int32)
+            mask = np.zeros((len(batch), pad_to), dtype=np.int32)
+            for row, (_, ids) in enumerate(batch):
+                ids = [t % self.cfg.vocab_size for t in ids[:pad_to]]
+                tokens[row, : len(ids)] = ids
+                mask[row, : len(ids)] = 1
+                self.stats["tokens"] += len(ids)
+            embs = np.asarray(self._encode(
+                self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(mask)
+            ))
+            for row, (i, _) in enumerate(batch):
+                out[i] = embs[row]
+            self.stats["batches"] += 1
+
+        # Fill cache after computing.
+        for i, text in enumerate(texts):
+            rendered = (self.query_instruction + text) if is_query else text
+            key = self._key(("q:" if is_query else "d:") + rendered)
+            self._cache.setdefault(key, out[i])
+        self.stats["texts"] += len(texts)
+        return out
+
+    def embed_text(self, text: str, is_query: bool = False) -> np.ndarray:
+        return self.embed_texts([text], is_query=is_query)[0]
+
+    def estimate_tokens(self, texts: list[str]) -> int:
+        """Reference cost estimation analogue: token counts (no dollar cost
+        for an in-tree encoder)."""
+        return sum(len(self._tokenize(t)) for t in texts)
+
+
+def cosine_similarity(a: np.ndarray, b: np.ndarray) -> float:
+    denom = float(np.linalg.norm(a) * np.linalg.norm(b)) or 1e-9
+    return float(np.dot(a, b) / denom)

@@ -1,0 +1,199 @@
+"""bge-base-en-v1.5 (BERT-base) encoder in JAX — the knowledge embedder model.
+
+Replaces the reference's hosted OpenAI embedder
+(``src/knowledge/indexer/embedder.ts:20-22``: text-embedding-3-small, 1536-d)
+with an on-device 768-d encoder. Same scan-stacked design as the Llama stack:
+one compiled layer body, bidirectional attention with a padding mask, post-LN
+BERT blocks, CLS pooling + L2 normalization (the bge recipe).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from functools import partial
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    name: str
+    vocab_size: int = 30_522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    max_positions: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+CONFIGS = {
+    "bge-base-en-v1.5": BertConfig(name="bge-base-en-v1.5"),
+    "bge-test": BertConfig(name="bge-test", vocab_size=262, dim=32, n_layers=2,
+                           n_heads=4, ffn_dim=64, max_positions=128),
+}
+
+
+def init_params(key: jax.Array, cfg: BertConfig, dtype=jnp.float32) -> dict[str, Any]:
+    ks = jax.random.split(key, 12)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)).astype(dtype)
+
+    L, D, F = cfg.n_layers, cfg.dim, cfg.ffn_dim
+    return {
+        "word_emb": dense(ks[0], (cfg.vocab_size, D), D),
+        "pos_emb": dense(ks[1], (cfg.max_positions, D), D),
+        "type_emb": dense(ks[2], (cfg.type_vocab_size, D), D),
+        "emb_norm_w": jnp.ones((D,), jnp.float32),
+        "emb_norm_b": jnp.zeros((D,), jnp.float32),
+        "layers": {
+            "wq": dense(ks[3], (L, D, D), D),
+            "bq": jnp.zeros((L, D), dtype),
+            "wk": dense(ks[4], (L, D, D), D),
+            "bk": jnp.zeros((L, D), dtype),
+            "wv": dense(ks[5], (L, D, D), D),
+            "bv": jnp.zeros((L, D), dtype),
+            "wo": dense(ks[6], (L, D, D), D),
+            "bo": jnp.zeros((L, D), dtype),
+            "attn_norm_w": jnp.ones((L, D), jnp.float32),
+            "attn_norm_b": jnp.zeros((L, D), jnp.float32),
+            "w1": dense(ks[7], (L, D, F), D),
+            "b1": jnp.zeros((L, F), dtype),
+            "w2": dense(ks[8], (L, F, D), F),
+            "b2": jnp.zeros((L, D), dtype),
+            "mlp_norm_w": jnp.ones((L, D), jnp.float32),
+            "mlp_norm_b": jnp.zeros((L, D), jnp.float32),
+        },
+    }
+
+
+def layer_norm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+    return (((xf - mean) * jax.lax.rsqrt(var + eps)) * w + b).astype(x.dtype)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def encode(
+    params: dict[str, Any],
+    cfg: BertConfig,
+    tokens: jnp.ndarray,  # [B, T] int32 (padded)
+    attention_mask: jnp.ndarray,  # [B, T] 1 for real tokens
+) -> jnp.ndarray:
+    """Returns L2-normalized [B, dim] float32 embeddings (CLS pooling)."""
+    b, t = tokens.shape
+    h = (
+        params["word_emb"][tokens]
+        + params["pos_emb"][None, :t]
+        + params["type_emb"][0][None, None, :]
+    )
+    h = layer_norm(h, params["emb_norm_w"], params["emb_norm_b"], cfg.norm_eps)
+
+    # Additive mask: [B, 1, 1, T] — padded keys masked for every query.
+    neg = jnp.asarray(-1e30, jnp.float32)
+    mask = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, neg)
+
+    def layer_step(hidden, lp):
+        hd, nh = cfg.head_dim, cfg.n_heads
+        q = (hidden @ lp["wq"] + lp["bq"]).reshape(b, t, nh, hd)
+        k = (hidden @ lp["wk"] + lp["bk"]).reshape(b, t, nh, hd)
+        v = (hidden @ lp["wv"] + lp["bv"]).reshape(b, t, nh, hd)
+        scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
+        scores = scores / np.sqrt(hd) + mask
+        attn = jax.nn.softmax(scores, axis=-1).astype(hidden.dtype)
+        ctx = jnp.einsum("bhts,bshd->bthd", attn, v).reshape(b, t, cfg.dim)
+        hidden = layer_norm(hidden + (ctx @ lp["wo"] + lp["bo"]),
+                            lp["attn_norm_w"], lp["attn_norm_b"], cfg.norm_eps)
+        ffn = jax.nn.gelu(hidden @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
+        hidden = layer_norm(hidden + ffn, lp["mlp_norm_w"], lp["mlp_norm_b"], cfg.norm_eps)
+        return hidden, None
+
+    h, _ = jax.lax.scan(layer_step, h, params["layers"])
+    cls = h[:, 0].astype(jnp.float32)
+    return cls / jnp.maximum(jnp.linalg.norm(cls, axis=-1, keepdims=True), 1e-9)
+
+
+# --------------------------------------------------------------------------- #
+# HF loading                                                                  #
+# --------------------------------------------------------------------------- #
+
+_HF_LAYER = {
+    "wq": ("encoder.layer.{i}.attention.self.query.weight", True),
+    "bq": ("encoder.layer.{i}.attention.self.query.bias", False),
+    "wk": ("encoder.layer.{i}.attention.self.key.weight", True),
+    "bk": ("encoder.layer.{i}.attention.self.key.bias", False),
+    "wv": ("encoder.layer.{i}.attention.self.value.weight", True),
+    "bv": ("encoder.layer.{i}.attention.self.value.bias", False),
+    "wo": ("encoder.layer.{i}.attention.output.dense.weight", True),
+    "bo": ("encoder.layer.{i}.attention.output.dense.bias", False),
+    "attn_norm_w": ("encoder.layer.{i}.attention.output.LayerNorm.weight", False),
+    "attn_norm_b": ("encoder.layer.{i}.attention.output.LayerNorm.bias", False),
+    "w1": ("encoder.layer.{i}.intermediate.dense.weight", True),
+    "b1": ("encoder.layer.{i}.intermediate.dense.bias", False),
+    "w2": ("encoder.layer.{i}.output.dense.weight", True),
+    "b2": ("encoder.layer.{i}.output.dense.bias", False),
+    "mlp_norm_w": ("encoder.layer.{i}.output.LayerNorm.weight", False),
+    "mlp_norm_b": ("encoder.layer.{i}.output.LayerNorm.bias", False),
+}
+
+
+def load_params(model_dir: str | Path, dtype=jnp.float32) -> tuple[BertConfig, dict]:
+    """Load a bge/BERT checkpoint from an HF directory (safetensors)."""
+    from safetensors import safe_open
+
+    model_dir = Path(model_dir)
+    raw = json.loads((model_dir / "config.json").read_text())
+    cfg = BertConfig(
+        name=model_dir.name,
+        vocab_size=raw["vocab_size"], dim=raw["hidden_size"],
+        n_layers=raw["num_hidden_layers"], n_heads=raw["num_attention_heads"],
+        ffn_dim=raw["intermediate_size"],
+        max_positions=raw.get("max_position_embeddings", 512),
+        type_vocab_size=raw.get("type_vocab_size", 2),
+        norm_eps=raw.get("layer_norm_eps", 1e-12),
+    )
+    shard = next(iter(sorted(model_dir.glob("*.safetensors"))))
+    f = safe_open(str(shard), framework="numpy")
+    names = set(f.keys())
+
+    def get(name: str) -> np.ndarray:
+        for candidate in (name, f"bert.{name}"):
+            if candidate in names:
+                return f.get_tensor(candidate)
+        raise KeyError(name)
+
+    params = {
+        "word_emb": jnp.asarray(get("embeddings.word_embeddings.weight"), dtype),
+        "pos_emb": jnp.asarray(get("embeddings.position_embeddings.weight"), dtype),
+        "type_emb": jnp.asarray(get("embeddings.token_type_embeddings.weight"), dtype),
+        "emb_norm_w": jnp.asarray(get("embeddings.LayerNorm.weight"), jnp.float32),
+        "emb_norm_b": jnp.asarray(get("embeddings.LayerNorm.bias"), jnp.float32),
+    }
+    layers: dict[str, Any] = {}
+    for leaf, (tmpl, transpose) in _HF_LAYER.items():
+        mats = [get(tmpl.format(i=i)) for i in range(cfg.n_layers)]
+        stacked = np.stack([m.T if transpose else m for m in mats])
+        leaf_dtype = jnp.float32 if "norm" in leaf else dtype
+        layers[leaf] = jnp.asarray(stacked, leaf_dtype)
+    params["layers"] = layers
+    return cfg, params
+
+
+def load_or_init(model_name: str, model_path: Optional[str | Path],
+                 dtype=jnp.float32, seed: int = 0) -> tuple[BertConfig, dict]:
+    if model_path and Path(model_path).exists():
+        return load_params(model_path, dtype=dtype)
+    cfg = CONFIGS.get(model_name, CONFIGS["bge-test"])
+    return cfg, init_params(jax.random.PRNGKey(seed), cfg, dtype=dtype)

@@ -1,0 +1,204 @@
+"""Knowledge subsystem: chunker, FTS store, embedder, vector + hybrid search,
+service graph, retriever facade with incremental sync."""
+
+import time
+
+import numpy as np
+import pytest
+
+from runbookai_tpu.knowledge.chunker import (
+    chunk_markdown,
+    document_from_markdown,
+    parse_frontmatter,
+)
+from runbookai_tpu.knowledge.embedder import Embedder, cosine_similarity
+from runbookai_tpu.knowledge.retriever import (
+    FilesystemSource,
+    HybridRetriever,
+    KnowledgeRetriever,
+    reciprocal_rank_fusion,
+)
+from runbookai_tpu.knowledge.store.graph import ServiceGraph
+from runbookai_tpu.knowledge.store.sqlite_fts import KnowledgeStore
+from runbookai_tpu.knowledge.store.vector import VectorStore
+
+RUNBOOK_MD = """---
+type: runbook
+services: [payment-api, payments-db]
+symptoms: [latency, timeouts]
+severity: high
+---
+# Payment latency runbook
+
+## Background
+The payment-api talks to payments-db through a connection pool.
+
+## Investigation steps
+1. Check pool saturation metrics.
+2. Check recent deployments for config changes.
+3. Inspect db connection counts.
+
+## Commands
+```
+kubectl get pods -n prod
+```
+"""
+
+
+def test_frontmatter_and_chunking():
+    meta, body = parse_frontmatter(RUNBOOK_MD)
+    assert meta["type"] == "runbook" and "payment-api" in meta["services"]
+    chunks = chunk_markdown("d1", body)
+    sections = [c.section for c in chunks]
+    assert "Investigation steps" in sections and "Commands" in sections
+    steps = next(c for c in chunks if c.section == "Investigation steps")
+    assert steps.chunk_type == "procedure"
+    cmd = next(c for c in chunks if c.section == "Commands")
+    assert cmd.chunk_type == "command"
+
+
+def test_document_from_markdown():
+    doc = document_from_markdown("runbooks/payment.md", RUNBOOK_MD)
+    assert doc.title == "Payment latency runbook"
+    assert doc.knowledge_type == "runbook"
+    assert doc.services == ["payment-api", "payments-db"]
+    assert len(doc.chunks) >= 3
+
+
+@pytest.fixture()
+def store():
+    s = KnowledgeStore(":memory:")
+    s.upsert_document(document_from_markdown("runbooks/payment.md", RUNBOOK_MD))
+    s.upsert_document(document_from_markdown(
+        "postmortems/2026-01.md",
+        "---\ntype: postmortem\nservices: [checkout-web]\n---\n# Checkout outage\n\nCDN misconfiguration caused 5xx errors.",
+    ))
+    return s
+
+
+def test_fts_search_and_filters(store):
+    hits = store.search("connection pool saturation")
+    assert hits and hits[0].doc.knowledge_type == "runbook"
+    assert "pool" in hits[0].chunk.content.lower()
+    only_pm = store.search("errors outage", knowledge_type="postmortem")
+    assert only_pm and all(h.doc.knowledge_type == "postmortem" for h in only_pm)
+    by_service = store.search("latency pool", service="payment-api")
+    assert by_service and all("payment-api" in h.doc.services for h in by_service)
+
+
+def test_store_upsert_replaces_chunks(store):
+    doc = document_from_markdown("runbooks/payment.md", RUNBOOK_MD + "\n## New section\nExtra content here.")
+    store.upsert_document(doc)
+    stats = store.stats()
+    assert stats["documents"] == 2
+    assert store.search("Extra content")  # new chunk searchable
+    assert store.get_last_sync_time("fs") is None
+    store.set_last_sync_time("fs", 123.0)
+    assert store.get_last_sync_time("fs") == 123.0
+
+
+def test_embedder_batching_cache_and_determinism():
+    emb = Embedder(model_name="bge-test", batch_size=2, max_length=64)
+    texts = ["connection pool exhausted", "cdn misconfigured", "pool saturation"]
+    vecs = emb.embed_texts(texts)
+    assert vecs.shape == (3, emb.dim)
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=1), 1.0, rtol=1e-4)
+    # determinism + cache
+    again = emb.embed_texts([texts[0]])
+    np.testing.assert_allclose(again[0], vecs[0], rtol=1e-5)
+    assert emb.stats["cache_hits"] == 1
+    # query instruction changes the embedding
+    q = emb.embed_text(texts[0], is_query=True)
+    assert cosine_similarity(q, vecs[0]) < 0.9999
+
+
+def test_vector_store_topk(store):
+    vs = VectorStore(store.db)
+    rng = np.random.default_rng(0)
+    base = rng.normal(size=8)
+    rows = []
+    for i in range(6):
+        vec = base + rng.normal(scale=0.1 * (i + 1), size=8)
+        rows.append((f"c{i}", f"d{i}", vec))
+    vs.store_many(rows)
+    assert vs.count() == 6
+    hits = vs.search(base, limit=3)
+    assert len(hits) == 3 and hits[0][0] == "c0"
+    assert hits[0][1] > hits[2][1]
+    vs.delete_doc("d0")
+    assert vs.count() == 5
+
+
+def test_rrf_fusion_math():
+    fused = reciprocal_rank_fusion(
+        [(0.4, ["a", "b"]), (0.6, ["b", "c"])], k=60
+    )
+    assert fused["b"] == pytest.approx(0.4 / 62 + 0.6 / 61)
+    assert max(fused, key=fused.get) == "b"
+
+
+def test_hybrid_search_end_to_end(store):
+    emb = Embedder(model_name="bge-test", max_length=64)
+    vs = VectorStore(store.db)
+    rows = []
+    for chunk in store.all_chunks():
+        vec = emb.embed_texts([chunk.content])[0]
+        rows.append((chunk.chunk_id, chunk.doc_id, vec))
+    vs.store_many(rows)
+    hybrid = HybridRetriever(store, vectors=vs, embedder=emb)
+    hits = hybrid.search("database connection pool problems", limit=4)
+    assert hits and hits[0].mode == "hybrid"
+    assert any("pool" in h.chunk.content.lower() for h in hits)
+    # FTS fallback when no vectors
+    empty_store = KnowledgeStore(":memory:")
+    empty_store.upsert_document(document_from_markdown("x.md", "# T\npool text"))
+    fallback = HybridRetriever(empty_store, vectors=VectorStore(empty_store.db),
+                               embedder=emb)
+    assert all(h.mode == "fts" for h in fallback.search("pool"))
+
+
+def test_retriever_facade_sync_and_group(tmp_path):
+    (tmp_path / "runbooks").mkdir()
+    (tmp_path / "runbooks" / "payment.md").write_text(RUNBOOK_MD)
+    store = KnowledgeStore(":memory:")
+    emb = Embedder(model_name="bge-test", max_length=64)
+    vs = VectorStore(store.db)
+    retriever = KnowledgeRetriever(
+        store, HybridRetriever(store, vectors=vs, embedder=emb),
+        sources=[FilesystemSource(tmp_path, name="fs")],
+    )
+    counts = retriever.sync()
+    assert counts["fs"] == 1 and vs.count() >= 3
+    # incremental: second sync sees nothing new
+    assert retriever.sync()["fs"] == 0
+    grouped = retriever.search_grouped("payment latency pool")
+    assert grouped.runbooks and grouped.runbooks[0].doc_id
+    stats = retriever.stats()
+    assert stats["documents"] == 1 and stats["embeddings"] >= 3
+
+
+def test_service_graph():
+    g = ServiceGraph()
+    g.add_dependency("checkout-web", "payment-api")
+    g.add_dependency("payment-api", "payments-db")
+    g.add_dependency("payment-api", "fraud-service")
+    g.add_service("payment-api", team="payments", tier=1, tags=["critical"])
+    assert g.downstream_impact("payments-db") == ["payment-api", "checkout-web"]
+    assert set(g.upstream_impact("checkout-web")) == {"payment-api", "payments-db", "fraud-service"}
+    assert g.find_path("checkout-web", "payments-db") == ["checkout-web", "payment-api", "payments-db"]
+    assert g.find_cycles() == []
+    g.add_dependency("payments-db", "checkout-web")  # cycle
+    assert g.find_cycles()
+    assert g.filter(team="payments")[0].name == "payment-api"
+    stats = g.stats()
+    assert stats["services"] == 4 and stats["cycles"] >= 1
+
+
+def test_service_graph_persistence(tmp_path):
+    g = ServiceGraph()
+    g.add_dependency("a-svc", "b-svc", kind="async", description="queue")
+    path = tmp_path / "graph.json"
+    g.save(path)
+    g2 = ServiceGraph.load(path)
+    assert g2.dependencies_of("a-svc") == ["b-svc"]
+    assert g2.edges[0].kind == "async"
