@@ -1,0 +1,215 @@
+"""Causal query generation: hypothesis statement → targeted tool queries.
+
+Parity target: reference ``src/agent/causal-query.ts`` — ``FAILURE_PATTERNS``
+(:30-240: high_latency, high_error_rate, memory_issues, cpu_issues,
+connectivity_issues, deployment_issues, database_issues, scaling_issues),
+``generateQueriesForHypothesis`` (:241), ``isQueryTooBroad`` (:333),
+``suggestQueryRefinements`` (:359), ``prioritizeQueries`` (:397),
+``summarizeQueryResults`` (:435).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class CausalQuery:
+    tool: str
+    params: dict[str, Any]
+    expected_outcome: str
+    relevance: float = 0.5  # 0-1
+    pattern: str = ""
+
+
+@dataclass
+class FailurePattern:
+    name: str
+    keywords: tuple[str, ...]
+    queries: list[CausalQuery] = field(default_factory=list)
+
+
+def _q(tool: str, params: dict[str, Any], expected: str, relevance: float,
+       pattern: str = "") -> CausalQuery:
+    return CausalQuery(tool=tool, params=params, expected_outcome=expected,
+                       relevance=relevance, pattern=pattern)
+
+
+FAILURE_PATTERNS: list[FailurePattern] = [
+    FailurePattern(
+        "high_latency",
+        ("latency", "slow", "p99", "p95", "response time", "timeout", "timeouts", "slo"),
+        [
+            _q("datadog", {"action": "metrics", "query": "latency"},
+               "latency series showing when the spike started", 0.9),
+            _q("cloudwatch_alarms", {"state": "ALARM"},
+               "latency/response-time alarms in ALARM", 0.8),
+            _q("cloudwatch_logs", {"log_group": "{log_group}", "filter_pattern": "timeout"},
+               "timeout or slow-request log lines", 0.7),
+        ],
+    ),
+    FailurePattern(
+        "high_error_rate",
+        ("error rate", "5xx", "errors", "failing", "failures", "exceptions", "500"),
+        [
+            _q("cloudwatch_alarms", {"state": "ALARM"},
+               "error-count alarms firing", 0.85),
+            _q("cloudwatch_logs", {"log_group": "{log_group}", "filter_pattern": "error"},
+               "error/exception log lines with stack traces", 0.85),
+            _q("datadog", {"action": "metrics", "query": "error"},
+               "error-rate series", 0.7),
+        ],
+    ),
+    FailurePattern(
+        "memory_issues",
+        ("memory", "oom", "out of memory", "heap", "leak", "swap"),
+        [
+            _q("kubernetes_query", {"action": "pods"},
+               "pods OOMKilled or restarting", 0.85),
+            _q("datadog", {"action": "metrics", "query": "memory"},
+               "memory utilization trending up", 0.8),
+        ],
+    ),
+    FailurePattern(
+        "cpu_issues",
+        ("cpu", "throttl", "saturation", "load"),
+        [
+            _q("datadog", {"action": "metrics", "query": "cpu"},
+               "cpu utilization/throttling series", 0.8),
+            _q("kubernetes_query", {"action": "nodes"},
+               "node cpu pressure", 0.6),
+        ],
+    ),
+    FailurePattern(
+        "connectivity_issues",
+        ("connection", "connections", "refused", "dns", "network", "unreachable", "pool"),
+        [
+            _q("cloudwatch_logs", {"log_group": "{log_group}", "filter_pattern": "connection"},
+               "connection failures / pool exhaustion lines", 0.9),
+            _q("aws_query", {"service": "rds"},
+               "db connection counts vs limits", 0.75),
+        ],
+    ),
+    FailurePattern(
+        "deployment_issues",
+        ("deploy", "deployment", "release", "rollout", "version", "config change", "changed"),
+        [
+            _q("kubernetes_query", {"action": "deployments"},
+               "recently updated deployments and replica health", 0.9),
+            _q("datadog", {"action": "events"},
+               "deploy events near incident start", 0.85),
+            _q("aws_query", {"service": "ecs"},
+               "ECS services mid-deployment or unstable", 0.7),
+        ],
+    ),
+    FailurePattern(
+        "database_issues",
+        ("database", "db", "sql", "postgres", "mysql", "rds", "query", "deadlock", "replica"),
+        [
+            _q("aws_query", {"service": "rds"},
+               "db instance status, connections, storage", 0.9),
+            _q("cloudwatch_logs", {"log_group": "{log_group}", "filter_pattern": "SQL"},
+               "slow queries / db errors in app logs", 0.65),
+        ],
+    ),
+    FailurePattern(
+        "scaling_issues",
+        ("scaling", "autoscal", "capacity", "replicas", "throughput", "queue depth", "backlog"),
+        [
+            _q("kubernetes_query", {"action": "deployments"},
+               "replica counts vs desired", 0.8),
+            _q("aws_query", {"service": "ecs"},
+               "running vs desired task counts", 0.75),
+        ],
+    ),
+]
+
+
+def match_patterns(statement: str) -> list[FailurePattern]:
+    s = statement.lower()
+    matched = [p for p in FAILURE_PATTERNS if any(k in s for k in p.keywords)]
+    return matched
+
+
+def generate_queries_for_hypothesis(
+    statement: str,
+    log_group: Optional[str] = None,
+    available_tools: Optional[set[str]] = None,
+    max_queries: int = 3,
+) -> list[CausalQuery]:
+    """Pattern-match the hypothesis and emit up to N targeted queries."""
+    queries: list[CausalQuery] = []
+    seen: set[str] = set()
+    for pattern in match_patterns(statement):
+        for q in pattern.queries:
+            params = dict(q.params)
+            if params.get("log_group") == "{log_group}":
+                if not log_group:
+                    continue
+                params["log_group"] = log_group
+            key = f"{q.tool}:{sorted(params.items())}"
+            if key in seen:
+                continue
+            seen.add(key)
+            queries.append(CausalQuery(
+                tool=q.tool, params=params, expected_outcome=q.expected_outcome,
+                relevance=q.relevance, pattern=pattern.name,
+            ))
+    if not queries:
+        # Generic fallback: look at alarms + recent deploy state.
+        queries = [
+            _q("cloudwatch_alarms", {"state": "ALARM"}, "any firing alarms", 0.5, "generic"),
+            _q("kubernetes_query", {"action": "events"}, "recent cluster events", 0.4, "generic"),
+        ]
+    if available_tools is not None:
+        queries = [q for q in queries if q.tool in available_tools] or queries
+    return prioritize_queries(queries)[:max_queries]
+
+
+def is_query_too_broad(query: CausalQuery) -> bool:
+    """Anti-broad-query detection (causal-query.ts:333)."""
+    params = query.params
+    if query.tool == "aws_query" and params.get("service") in (None, "all", ""):
+        return True
+    if query.tool == "cloudwatch_logs" and not params.get("filter_pattern"):
+        return True
+    if query.tool == "datadog" and params.get("action") == "metrics" \
+            and not params.get("query"):
+        return True
+    return False
+
+
+def suggest_query_refinements(query: CausalQuery,
+                              services: Optional[list[str]] = None) -> CausalQuery:
+    """Narrow a too-broad query using known context (causal-query.ts:359)."""
+    params = dict(query.params)
+    if query.tool == "aws_query" and params.get("service") in (None, "all", ""):
+        params["service"] = (services or ["ecs"])[0]
+    if query.tool == "cloudwatch_logs" and not params.get("filter_pattern"):
+        params["filter_pattern"] = "error"
+    if query.tool == "datadog" and not params.get("query"):
+        params["query"] = (services or ["latency"])[0]
+    return CausalQuery(tool=query.tool, params=params,
+                       expected_outcome=query.expected_outcome,
+                       relevance=query.relevance, pattern=query.pattern)
+
+
+def prioritize_queries(queries: list[CausalQuery]) -> list[CausalQuery]:
+    return sorted(queries, key=lambda q: q.relevance, reverse=True)
+
+
+def summarize_query_results(results: list[tuple[CausalQuery, Any, Optional[str]]]) -> str:
+    """Render (query, result, error) triples for the evaluation prompt."""
+    lines = []
+    for query, result, error in results:
+        head = f"- {query.tool}({query.params}) [expected: {query.expected_outcome}]"
+        if error:
+            lines.append(f"{head}\n  ERROR: {error}")
+            continue
+        text = str(result)
+        if len(text) > 1200:
+            text = text[:1200] + "…"
+        lines.append(f"{head}\n  {text}")
+    return "\n".join(lines) if lines else "(no query results)"

@@ -1,0 +1,234 @@
+"""Structured-output layer: schemas, tolerant parsers, prompt templates.
+
+Parity target: reference ``src/agent/llm-parser.ts`` — zod schemas (:21-210)
+become pydantic models (Triage / HypothesisGeneration / EvidenceEvaluation /
+Conclusion / RemediationPlan / LogAnalysis); tolerant JSON extraction (:215;
+shared with the chat template); prompt templates with ``{placeholders}``
+(:396-563) and ``fill_prompt`` (:564).
+
+With guided JSON decoding upstream the parse almost always succeeds on the
+first strategy; the tolerant path stays as the fallback (SURVEY.md §7 step 3).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Literal, Optional
+
+from pydantic import BaseModel, Field, ValidationError
+
+from runbookai_tpu.model.chat_template import extract_json
+
+Confidence = Literal["high", "medium", "low"]
+
+
+class TriageResult(BaseModel):
+    severity: Literal["critical", "high", "medium", "low"] = "medium"
+    summary: str = ""
+    affected_services: list[str] = Field(default_factory=list)
+    symptoms: list[str] = Field(default_factory=list)
+    signals: list[str] = Field(default_factory=list)  # notable evidence seen
+
+
+class GeneratedHypothesis(BaseModel):
+    statement: str
+    priority: float = 0.5
+    rationale: str = ""
+
+
+class HypothesisGeneration(BaseModel):
+    hypotheses: list[GeneratedHypothesis] = Field(default_factory=list)
+
+
+class EvidenceEvaluation(BaseModel):
+    action: Literal["continue", "branch", "prune", "confirm"] = "continue"
+    confidence: float = 0.0
+    reasoning: str = ""
+    supports: bool = True
+    strength: Literal["strong", "weak", "neutral"] = "weak"
+    sub_hypotheses: list[GeneratedHypothesis] = Field(default_factory=list)
+
+
+class Conclusion(BaseModel):
+    root_cause: str = ""
+    confidence: Confidence = "low"
+    affected_services: list[str] = Field(default_factory=list)
+    contributing_factors: list[str] = Field(default_factory=list)
+    summary: str = ""
+
+
+class PlannedRemediationStep(BaseModel):
+    description: str
+    action: str = ""  # tool or skill id
+    params: dict[str, Any] = Field(default_factory=dict)
+    risk: Literal["read", "low", "high", "critical"] = "low"
+    requires_approval: bool = True
+
+
+class RemediationPlan(BaseModel):
+    steps: list[PlannedRemediationStep] = Field(default_factory=list)
+    rollback: str = ""
+    notes: str = ""
+
+
+class LogAnalysis(BaseModel):
+    error_categories: list[str] = Field(default_factory=list)
+    services_mentioned: list[str] = Field(default_factory=list)
+    notable_lines: list[str] = Field(default_factory=list)
+    suggested_hypotheses: list[GeneratedHypothesis] = Field(default_factory=list)
+
+
+# --------------------------------------------------------------------------- #
+# tolerant parsing                                                            #
+# --------------------------------------------------------------------------- #
+
+
+def _coerce(payload: Any, model: type[BaseModel]) -> Optional[BaseModel]:
+    if not isinstance(payload, dict):
+        return None
+    try:
+        return model.model_validate(payload)
+    except ValidationError:
+        # Second chance: drop unknown-shaped fields, keep what validates.
+        cleaned = {}
+        for name, field_info in model.model_fields.items():
+            if name in payload:
+                cleaned[name] = payload[name]
+        try:
+            return model.model_validate(cleaned)
+        except ValidationError:
+            try:
+                return model()  # defaults — caller checks emptiness
+            except ValidationError:
+                return None
+
+
+def parse_structured(text: str, model: type[BaseModel]) -> Optional[BaseModel]:
+    payload = extract_json(text)
+    # Tolerate a bare list where a wrapper object is expected
+    # (e.g. the model emits [..] instead of {"hypotheses": [..]}).
+    if isinstance(payload, list):
+        list_fields = [
+            n for n, f in model.model_fields.items()
+            if "list" in str(f.annotation)
+        ]
+        if len(list_fields) >= 1:
+            payload = {list_fields[0]: payload}
+    return _coerce(payload, model)
+
+
+def parse_triage(text: str) -> TriageResult:
+    return parse_structured(text, TriageResult) or TriageResult()
+
+
+def parse_hypotheses(text: str) -> HypothesisGeneration:
+    return parse_structured(text, HypothesisGeneration) or HypothesisGeneration()
+
+
+def parse_evaluation(text: str) -> EvidenceEvaluation:
+    return parse_structured(text, EvidenceEvaluation) or EvidenceEvaluation()
+
+
+def parse_conclusion(text: str) -> Conclusion:
+    return parse_structured(text, Conclusion) or Conclusion()
+
+
+def parse_remediation(text: str) -> RemediationPlan:
+    return parse_structured(text, RemediationPlan) or RemediationPlan()
+
+
+def parse_log_analysis(text: str) -> LogAnalysis:
+    return parse_structured(text, LogAnalysis) or LogAnalysis()
+
+
+# --------------------------------------------------------------------------- #
+# prompt templates (llm-parser.ts:396-563)                                    #
+# --------------------------------------------------------------------------- #
+
+PROMPTS: dict[str, str] = {
+    "triage": """\
+You are triaging a production incident.
+
+Incident context:
+{context}
+
+Respond with ONLY a JSON object:
+{{"severity": "critical|high|medium|low", "summary": "<one sentence>",
+  "affected_services": ["..."], "symptoms": ["..."], "signals": ["..."]}}""",
+    "generate_hypotheses": """\
+You are investigating this incident:
+{summary}
+
+Symptoms: {symptoms}
+Affected services: {services}
+Evidence so far:
+{evidence}
+
+Generate 3-5 testable root-cause hypotheses, most likely first. Respond with
+ONLY a JSON object:
+{{"hypotheses": [{{"statement": "...", "priority": 0.0-1.0, "rationale": "..."}}]}}""",
+    "evaluate_evidence": """\
+Hypothesis under test: {hypothesis}
+
+New evidence from queries:
+{evidence}
+
+Decide the next action:
+- "confirm" if the evidence establishes this as the root cause,
+- "prune" if the evidence contradicts it,
+- "branch" if it should split into more specific sub-hypotheses,
+- "continue" if more evidence is needed.
+
+Respond with ONLY a JSON object:
+{{"action": "continue|branch|prune|confirm", "confidence": 0.0-1.0,
+  "supports": true|false, "strength": "strong|weak|neutral",
+  "reasoning": "...",
+  "sub_hypotheses": [{{"statement": "...", "priority": 0.0-1.0}}]}}""",
+    "generate_conclusion": """\
+Investigation summary:
+{summary}
+
+Hypothesis tree:
+{tree}
+
+Evidence:
+{evidence}
+
+State the conclusion. Respond with ONLY a JSON object:
+{{"root_cause": "...", "confidence": "high|medium|low",
+  "affected_services": ["..."], "contributing_factors": ["..."],
+  "summary": "<2-3 sentences for the incident channel>"}}""",
+    "generate_remediation": """\
+Root cause: {root_cause}
+Affected services: {services}
+
+Relevant runbooks:
+{runbooks}
+
+Code-fix candidates:
+{fixes}
+
+Plan the remediation. Respond with ONLY a JSON object:
+{{"steps": [{{"description": "...", "action": "<tool or skill id>",
+   "params": {{}}, "risk": "read|low|high|critical", "requires_approval": true}}],
+  "rollback": "...", "notes": "..."}}""",
+    "analyze_logs": """\
+Analyze these log lines for error patterns:
+
+{logs}
+
+Respond with ONLY a JSON object:
+{{"error_categories": ["..."], "services_mentioned": ["..."],
+  "notable_lines": ["..."],
+  "suggested_hypotheses": [{{"statement": "...", "priority": 0.0-1.0}}]}}""",
+}
+
+
+def fill_prompt(name: str, **values: Any) -> str:
+    """Fill a template; missing keys become empty strings (llm-parser.ts:564)."""
+    template = PROMPTS[name]
+
+    class _Default(dict):
+        def __missing__(self, key):
+            return ""
+
+    return template.format_map(_Default(**{k: str(v) for k, v in values.items()}))

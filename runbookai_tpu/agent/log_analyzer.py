@@ -1,0 +1,135 @@
+"""Regex-based log analysis: error patterns, service mentions, hypotheses.
+
+Parity target: reference ``src/agent/log-analyzer.ts`` — ``ERROR_PATTERNS``
+(:14, 11 categories), ``parseLogLine`` (:230), ``analyzePatterns`` (:274),
+``extractServiceMentions`` (:327), ``generateHypothesesFromPatterns`` (:415),
+``analyzeLogs`` (:473), time/level filters (:584-622). Optionally merged with
+LLM analysis by the orchestrator.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+# 11 error categories (reference log-analyzer.ts:14).
+ERROR_PATTERNS: dict[str, re.Pattern] = {
+    "connection_failure": re.compile(
+        r"connection (?:refused|reset|timed? ?out|is not available)|"
+        r"remaining connection slots|pool (?:exhaust|timeout|size)|ECONNREFUSED",
+        re.IGNORECASE),
+    "timeout": re.compile(r"\btim(?:ed?|e) ?out\b|deadline exceeded|ETIMEDOUT", re.IGNORECASE),
+    "memory": re.compile(r"out of memory|OOM[- ]?Kill|heap (?:space|exhaust)|memory limit", re.IGNORECASE),
+    "cpu_throttle": re.compile(r"cpu throttl|high load|saturat", re.IGNORECASE),
+    "disk": re.compile(r"no space left|disk full|I/O error|read-only file system", re.IGNORECASE),
+    "auth": re.compile(r"access denied|unauthoriz|forbidden|401|403|invalid credentials|expired token", re.IGNORECASE),
+    "rate_limit": re.compile(r"rate limit|too many requests|429|throttlingexception", re.IGNORECASE),
+    "dns": re.compile(r"dns|name resolution|getaddrinfo|NXDOMAIN", re.IGNORECASE),
+    "database": re.compile(r"SQL(?:state)?|deadlock|postgres|mysql|PSQLException|ORA-\d+", re.IGNORECASE),
+    "http_5xx": re.compile(r"\b5\d\d\b|internal server error|bad gateway|service unavailable", re.IGNORECASE),
+    "crash": re.compile(r"panic|segfault|core dump|fatal|CrashLoopBackOff|exit code [1-9]", re.IGNORECASE),
+}
+
+_LEVEL_RE = re.compile(r"\b(TRACE|DEBUG|INFO|WARN(?:ING)?|ERROR|FATAL|CRIT(?:ICAL)?)\b", re.IGNORECASE)
+_TS_RE = re.compile(r"\d{4}-\d{2}-\d{2}[T ]\d{2}:\d{2}:\d{2}")
+_SERVICE_RE = re.compile(r"\b([a-z][a-z0-9]*(?:-[a-z0-9]+)+)\b")
+
+_CATEGORY_HYPOTHESES = {
+    "connection_failure": ("Connection pool or downstream connectivity exhaustion", 0.85),
+    "timeout": ("A downstream dependency is timing out under load", 0.7),
+    "memory": ("Memory exhaustion (leak or undersized limits)", 0.8),
+    "cpu_throttle": ("CPU saturation or throttling degrading throughput", 0.6),
+    "disk": ("Disk exhaustion or I/O failure", 0.7),
+    "auth": ("Credential/permission misconfiguration after a change", 0.6),
+    "rate_limit": ("An upstream dependency is rate-limiting requests", 0.6),
+    "dns": ("DNS resolution failures breaking service discovery", 0.6),
+    "database": ("Database errors (locks, capacity, or bad queries)", 0.8),
+    "http_5xx": ("A backend is returning 5xx under fault or overload", 0.6),
+    "crash": ("Process crash-loop from a bad build or config", 0.8),
+}
+
+
+@dataclass
+class ParsedLogLine:
+    raw: str
+    timestamp: Optional[str] = None
+    level: Optional[str] = None
+    message: str = ""
+    categories: list[str] = field(default_factory=list)
+
+
+@dataclass
+class LogAnalysisResult:
+    lines_analyzed: int = 0
+    error_lines: int = 0
+    pattern_counts: dict[str, int] = field(default_factory=dict)
+    services: list[str] = field(default_factory=list)
+    notable_lines: list[str] = field(default_factory=list)
+    hypotheses: list[dict[str, Any]] = field(default_factory=list)
+
+
+def parse_log_line(raw: str) -> ParsedLogLine:
+    level_match = _LEVEL_RE.search(raw)
+    ts_match = _TS_RE.search(raw)
+    categories = [name for name, pattern in ERROR_PATTERNS.items() if pattern.search(raw)]
+    return ParsedLogLine(
+        raw=raw,
+        timestamp=ts_match.group(0) if ts_match else None,
+        level=level_match.group(1).upper() if level_match else None,
+        message=raw.strip(),
+        categories=categories,
+    )
+
+
+def extract_service_mentions(lines: list[str]) -> list[str]:
+    counts: dict[str, int] = {}
+    for line in lines:
+        for m in _SERVICE_RE.finditer(line):
+            name = m.group(1)
+            counts[name] = counts.get(name, 0) + 1
+    return [s for s, _ in sorted(counts.items(), key=lambda kv: kv[1], reverse=True)][:10]
+
+
+def filter_lines(
+    parsed: list[ParsedLogLine],
+    min_level: Optional[str] = None,
+    since: Optional[str] = None,
+) -> list[ParsedLogLine]:
+    """Level/time filters (log-analyzer.ts:584-622)."""
+    order = ["TRACE", "DEBUG", "INFO", "WARN", "WARNING", "ERROR", "FATAL", "CRIT", "CRITICAL"]
+    out = parsed
+    if min_level:
+        threshold = order.index(min_level.upper())
+        out = [p for p in out if p.level and order.index(p.level) >= threshold]
+    if since:
+        out = [p for p in out if p.timestamp is None or p.timestamp >= since]
+    return out
+
+
+def analyze_logs(
+    lines: list[str],
+    min_level: Optional[str] = None,
+    since: Optional[str] = None,
+    max_notable: int = 8,
+) -> LogAnalysisResult:
+    parsed = [parse_log_line(l) for l in lines if l.strip()]
+    parsed = filter_lines(parsed, min_level=min_level, since=since)
+    result = LogAnalysisResult(lines_analyzed=len(parsed))
+    for p in parsed:
+        if p.categories or (p.level in ("ERROR", "FATAL", "CRIT", "CRITICAL")):
+            result.error_lines += 1
+            if len(result.notable_lines) < max_notable:
+                result.notable_lines.append(p.raw[:240])
+        for cat in p.categories:
+            result.pattern_counts[cat] = result.pattern_counts.get(cat, 0) + 1
+    result.services = extract_service_mentions([p.raw for p in parsed])
+    for cat, count in sorted(result.pattern_counts.items(), key=lambda kv: kv[1], reverse=True):
+        statement, priority = _CATEGORY_HYPOTHESES[cat]
+        result.hypotheses.append({
+            "statement": statement,
+            "priority": min(1.0, priority + 0.05 * min(count, 3)),
+            "category": cat,
+            "occurrences": count,
+        })
+    return result

@@ -1,0 +1,486 @@
+"""Structured investigation orchestrator driving the FSM.
+
+Parity target: reference ``src/agent/investigation-orchestrator.ts`` —
+``investigate`` (:633), ``runTriage`` (:723) with ``gatherTriageContext``
+(:751: incident fetch then a fallback chain search_knowledge →
+cloudwatch_alarms → datadog → aws_query stopping at the first meaningful
+signal :364-415), ``generateHypotheses`` (:877), ``runInvestigationCycle``
+(:901) with per-hypothesis causal queries, broadness refinement and tool
+fallback ``adaptQueryToEnvironment`` (:441-462), ``evaluateEvidence`` (:1005)
+→ ``applyEvaluation`` branch/prune/confirm/continue, ``runConclusion``
+(:1044), ``runRemediation`` (:1097) with runbook + code-fix retrieval, and
+``executeRemediation`` (:1148) through approval callbacks.
+
+The LLM seam is the simple ``complete(prompt) -> str`` interface
+(investigation-orchestrator.ts:59-61); with the jax-tpu client this uses
+guided JSON decoding.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Optional
+
+from runbookai_tpu.agent import llm_parser as lp
+from runbookai_tpu.agent.causal_query import (
+    generate_queries_for_hypothesis,
+    is_query_too_broad,
+    suggest_query_refinements,
+    summarize_query_results,
+)
+from runbookai_tpu.agent.log_analyzer import analyze_logs
+from runbookai_tpu.agent.state_machine import (
+    EvaluationAction,
+    EvidenceRecord,
+    InvestigationStateMachine,
+    Phase,
+    RemediationStep,
+)
+from runbookai_tpu.agent.types import AgentEvent
+
+# Tool substitution chains when a query's tool is unavailable
+# (investigation-orchestrator.ts:441-462).
+TOOL_FALLBACKS: dict[str, list[str]] = {
+    "datadog": ["cloudwatch_alarms", "cloudwatch_logs", "prometheus", "aws_query"],
+    "prometheus": ["datadog", "cloudwatch_alarms", "aws_query"],
+    "cloudwatch_alarms": ["datadog", "prometheus", "aws_query"],
+    "cloudwatch_logs": ["datadog", "kubernetes_query"],
+    "kubernetes_query": ["aws_query"],
+    "aws_query": ["kubernetes_query"],
+}
+
+
+@dataclass
+class OrchestratorResult:
+    summary: dict[str, Any]
+    root_cause: str
+    confidence: str
+    affected_services: list[str]
+    conclusion_summary: str = ""
+    remediation: list[dict[str, Any]] = field(default_factory=list)
+    events: list[AgentEvent] = field(default_factory=list)
+
+
+class ToolExecutor:
+    """Thin seam: name + params -> result (the orchestrator's tool interface)."""
+
+    def __init__(self, tools: dict[str, Any]):
+        self.tools = tools
+
+    def available(self) -> set[str]:
+        return set(self.tools)
+
+    async def execute(self, name: str, params: dict[str, Any]) -> Any:
+        tool = self.tools.get(name)
+        if tool is None:
+            raise KeyError(f"tool {name!r} unavailable")
+        return await tool.execute(params)
+
+
+class InvestigationOrchestrator:
+    def __init__(
+        self,
+        llm,  # needs .complete(prompt) -> str
+        executor: ToolExecutor,
+        machine: Optional[InvestigationStateMachine] = None,
+        knowledge=None,  # optional retriever facade
+        approval_callback: Optional[Callable[[RemediationStep], Awaitable[bool]]] = None,
+        log_group_hint: Optional[str] = None,
+        event_sink: Optional[Callable[[AgentEvent], None]] = None,
+        queries_per_cycle: int = 3,
+        execute_remediation: bool = False,
+    ):
+        self.llm = llm
+        self.executor = executor
+        self.machine = machine or InvestigationStateMachine()
+        self.knowledge = knowledge
+        self.approval_callback = approval_callback
+        self.log_group_hint = log_group_hint
+        self.event_sink = event_sink
+        self.queries_per_cycle = queries_per_cycle
+        self.execute_remediation_steps = execute_remediation
+        self.events: list[AgentEvent] = []
+
+    def _emit(self, kind: str, **data: Any) -> None:
+        ev = AgentEvent(kind, data)
+        self.events.append(ev)
+        if self.event_sink:
+            self.event_sink(ev)
+
+    # ------------------------------------------------------------------ main
+
+    async def investigate(self, incident_id: str = "",
+                          description: str = "") -> OrchestratorResult:
+        m = self.machine
+        if incident_id:
+            m.incident_id = incident_id
+        m.start()
+        self._emit("phase_change", phase=Phase.TRIAGE.value)
+
+        triage = await self.run_triage(incident_id, description)
+        m.symptoms = triage.symptoms
+        m.affected_services = triage.affected_services
+
+        m.transition(Phase.HYPOTHESIZE)
+        self._emit("phase_change", phase=Phase.HYPOTHESIZE.value)
+        await self.generate_hypotheses(triage)
+
+        if not m.hypotheses:
+            m.record_error("no hypotheses generated")
+            m.transition(Phase.CONCLUDE)
+        else:
+            m.transition(Phase.INVESTIGATE)
+            self._emit("phase_change", phase=Phase.INVESTIGATE.value)
+
+        # HOT LOOP (investigation-orchestrator.ts:651).
+        while m.can_continue():
+            m.iterations += 1
+            confirmed = await self.run_investigation_cycle()
+            if confirmed:
+                break
+            if m.open_count() == 0:
+                break
+            if m.phase == Phase.EVALUATE:
+                m.transition(Phase.INVESTIGATE)
+
+        if m.phase not in (Phase.CONCLUDE, Phase.COMPLETE, Phase.FAILED):
+            m.transition(Phase.CONCLUDE)
+        self._emit("phase_change", phase=Phase.CONCLUDE.value)
+        conclusion = await self.run_conclusion(description)
+
+        m.transition(Phase.REMEDIATE)
+        self._emit("phase_change", phase=Phase.REMEDIATE.value)
+        remediation = await self.run_remediation(conclusion)
+        if self.execute_remediation_steps and remediation.steps:
+            await self.execute_remediation()
+
+        m.transition(Phase.COMPLETE)
+        self._emit("phase_change", phase=Phase.COMPLETE.value)
+
+        return OrchestratorResult(
+            summary=m.get_summary(),
+            root_cause=m.root_cause or "",
+            confidence=m.conclusion_confidence or "low",
+            affected_services=m.affected_services,
+            conclusion_summary=conclusion.summary,
+            remediation=[
+                {"description": s.description, "action": s.action,
+                 "risk": s.risk, "status": s.status, "result": s.result}
+                for s in m.remediation_plan
+            ],
+            events=self.events,
+        )
+
+    # ---------------------------------------------------------------- triage
+
+    async def gather_triage_context(self, incident_id: str,
+                                    description: str) -> str:
+        """Incident fetch then fallback-chain until a meaningful signal."""
+        blocks: list[str] = []
+        if description:
+            blocks.append(f"Description: {description}")
+        incident = None
+        for tool in ("pagerduty_get_incident", "opsgenie_get_alert"):
+            if incident_id and tool in self.executor.available():
+                try:
+                    incident = await self.executor.execute(
+                        tool, {"incident_id": incident_id})
+                    if isinstance(incident, dict) and not incident.get("error"):
+                        blocks.append(f"Incident: {json.dumps(incident)[:1500]}")
+                        break
+                except Exception as exc:  # noqa: BLE001 — move to next source
+                    self.machine.record_error(f"{tool}: {exc}")
+
+        # Fallback chain (orchestrator :815-869) — stop at first real signal.
+        chain = [
+            ("search_knowledge", {"query": description or incident_id or "incident"}),
+            ("cloudwatch_alarms", {"state": "ALARM"}),
+            ("datadog", {"action": "monitors"}),
+            ("prometheus", {"action": "alerts"}),
+            ("aws_query", {"service": "ecs"}),
+        ]
+        for tool, params in chain:
+            if tool not in self.executor.available():
+                continue
+            try:
+                result = await self.executor.execute(tool, params)
+            except Exception as exc:  # noqa: BLE001
+                self.machine.record_error(f"{tool}: {exc}")
+                continue
+            text = json.dumps(result, default=str)
+            if self._meaningful(result):
+                blocks.append(f"{tool}: {text[:1500]}")
+                break
+            blocks.append(f"{tool}: (no significant signal)")
+        return "\n".join(blocks) if blocks else "(no context available)"
+
+    @staticmethod
+    def _meaningful(result: Any) -> bool:
+        if not result:
+            return False
+        if isinstance(result, dict):
+            if result.get("error"):
+                return False
+            for v in result.values():
+                if isinstance(v, list) and v:
+                    return True
+                if isinstance(v, dict) and v:
+                    return True
+            return False
+        return bool(result)
+
+    async def run_triage(self, incident_id: str, description: str) -> lp.TriageResult:
+        context = await self.gather_triage_context(incident_id, description)
+        raw = await self.llm.complete(lp.fill_prompt("triage", context=context))
+        triage = lp.parse_triage(raw)
+        if not triage.summary:
+            triage.summary = description or f"incident {incident_id}"
+        self._emit("triage", severity=triage.severity, summary=triage.summary,
+                   services=triage.affected_services)
+        self._triage_context = context
+        return triage
+
+    # ------------------------------------------------------------ hypotheses
+
+    async def generate_hypotheses(self, triage: lp.TriageResult) -> None:
+        raw = await self.llm.complete(lp.fill_prompt(
+            "generate_hypotheses",
+            summary=triage.summary,
+            symptoms=", ".join(triage.symptoms),
+            services=", ".join(triage.affected_services),
+            evidence="\n".join(triage.signals),
+        ))
+        generated = lp.parse_hypotheses(raw)
+        for g in generated.hypotheses[:5]:
+            if g.statement:
+                h = self.machine.add_hypothesis(g.statement, priority=g.priority)
+                if h:
+                    self._emit("hypothesis_created", id=h.id, statement=h.statement,
+                               priority=h.priority)
+
+    # ----------------------------------------------------------------- cycle
+
+    def adapt_query_to_environment(self, tool: str) -> Optional[str]:
+        available = self.executor.available()
+        if tool in available:
+            return tool
+        for fallback in TOOL_FALLBACKS.get(tool, []):
+            if fallback in available:
+                return fallback
+        return None
+
+    async def execute_queries_for_hypothesis(self, hypothesis) -> list[tuple]:
+        queries = generate_queries_for_hypothesis(
+            hypothesis.statement,
+            log_group=self.log_group_hint,
+            available_tools=self.executor.available(),
+            max_queries=self.queries_per_cycle,
+        )
+        results = []
+        for query in queries:
+            if is_query_too_broad(query):
+                query = suggest_query_refinements(
+                    query, services=self.machine.affected_services)
+            tool = self.adapt_query_to_environment(query.tool)
+            if tool is None:
+                results.append((query, None, f"no tool available for {query.tool}"))
+                continue
+            params = query.params if tool == query.tool else self._fallback_params(tool)
+            try:
+                result = await self.executor.execute(tool, params)
+                results.append((query, result, None))
+                self._emit("evidence", hypothesis=hypothesis.id, tool=tool,
+                           params=params)
+            except Exception as exc:  # noqa: BLE001
+                results.append((query, None, str(exc)))
+                self.machine.record_error(f"{tool}: {exc}")
+        return results
+
+    @staticmethod
+    def _fallback_params(tool: str) -> dict[str, Any]:
+        return {
+            "cloudwatch_alarms": {"state": "ALARM"},
+            "cloudwatch_logs": {"log_group": "", "filter_pattern": "error"},
+            "datadog": {"action": "metrics", "query": "latency"},
+            "prometheus": {"action": "alerts"},
+            "aws_query": {"service": "ecs"},
+            "kubernetes_query": {"action": "pods"},
+        }.get(tool, {})
+
+    async def run_investigation_cycle(self) -> bool:
+        """One hypothesis cycle; returns True when a hypothesis is confirmed."""
+        m = self.machine
+        hypothesis = m.get_next_hypothesis()
+        if hypothesis is None:
+            return False
+        hypothesis.status = "investigating"
+        results = await self.execute_queries_for_hypothesis(hypothesis)
+        evidence_text = summarize_query_results(results)
+
+        if m.can_transition(Phase.EVALUATE):
+            m.transition(Phase.EVALUATE)
+        raw = await self.llm.complete(lp.fill_prompt(
+            "evaluate_evidence", hypothesis=hypothesis.statement,
+            evidence=evidence_text,
+        ))
+        evaluation = lp.parse_evaluation(raw)
+
+        for query, result, error in results:
+            if error is None:
+                m.add_evidence(EvidenceRecord(
+                    hypothesis_id=hypothesis.id, query=query.expected_outcome,
+                    tool=query.tool, result_summary=str(result)[:400],
+                    supports=evaluation.supports, strength=evaluation.strength,
+                ))
+
+        created = m.apply_evaluation(
+            hypothesis.id,
+            EvaluationAction(evaluation.action),
+            confidence=evaluation.confidence,
+            sub_hypotheses=[s.model_dump() for s in evaluation.sub_hypotheses],
+            reason=evaluation.reasoning,
+        )
+        for child in created:
+            self._emit("hypothesis_created", id=child.id, statement=child.statement,
+                       parent=hypothesis.id)
+        self._emit("hypothesis_updated", id=hypothesis.id,
+                   action=evaluation.action, confidence=evaluation.confidence)
+
+        if evaluation.action == "confirm":
+            m.transition(Phase.CONCLUDE)
+            return True
+        return False
+
+    # ------------------------------------------------------------ conclusion
+
+    async def run_conclusion(self, description: str = "") -> lp.Conclusion:
+        m = self.machine
+        evidence_text = "\n".join(
+            f"- [{e.tool}] {e.result_summary[:200]}" for e in m.evidence[-15:]
+        )
+        raw = await self.llm.complete(lp.fill_prompt(
+            "generate_conclusion",
+            summary=description or m.incident_id,
+            tree=m.hypothesis_tree_markdown(),
+            evidence=evidence_text,
+        ))
+        conclusion = lp.parse_conclusion(raw)
+        confirmed = m.confirmed_hypothesis()
+        if not conclusion.root_cause and confirmed is not None:
+            conclusion.root_cause = confirmed.statement
+            conclusion.confidence = "medium"
+        m.root_cause = conclusion.root_cause
+        m.conclusion_confidence = conclusion.confidence
+        for svc in conclusion.affected_services:
+            if svc not in m.affected_services:
+                m.affected_services.append(svc)
+        self._emit("conclusion", root_cause=m.root_cause,
+                   confidence=m.conclusion_confidence,
+                   services=m.affected_services)
+        return conclusion
+
+    # ----------------------------------------------------------- remediation
+
+    async def fetch_relevant_runbooks(self) -> str:
+        if self.knowledge is None:
+            return "(no knowledge base)"
+        try:
+            grouped = self.knowledge.search_grouped(
+                self.machine.root_cause or "remediation",
+                service=self.machine.affected_services[0]
+                if self.machine.affected_services else None,
+            )
+            docs = grouped.runbooks[:2]
+            return "\n".join(f"[{d.doc_id}] {d.title}: {d.content[:600]}"
+                             for d in docs) or "(none found)"
+        except Exception as exc:  # noqa: BLE001
+            self.machine.record_error(f"runbook fetch: {exc}")
+            return "(runbook fetch failed)"
+
+    async def fetch_code_fix_candidates(self) -> str:
+        for tool in ("github_query", "gitlab_query"):
+            if tool in self.executor.available():
+                try:
+                    result = await self.executor.execute(tool, {
+                        "action": "fix_candidates",
+                        "service": self.machine.affected_services[0]
+                        if self.machine.affected_services else "",
+                    })
+                    return json.dumps(result, default=str)[:1200]
+                except Exception as exc:  # noqa: BLE001
+                    self.machine.record_error(f"{tool}: {exc}")
+        return "(no code providers configured)"
+
+    async def run_remediation(self, conclusion: lp.Conclusion) -> lp.RemediationPlan:
+        runbooks = await self.fetch_relevant_runbooks()
+        fixes = await self.fetch_code_fix_candidates()
+        raw = await self.llm.complete(lp.fill_prompt(
+            "generate_remediation",
+            root_cause=self.machine.root_cause or "",
+            services=", ".join(self.machine.affected_services),
+            runbooks=runbooks, fixes=fixes,
+        ))
+        plan = lp.parse_remediation(raw)
+        for step in plan.steps:
+            self.machine.remediation_plan.append(RemediationStep(
+                description=step.description, action=step.action,
+                params=step.params, risk=step.risk,
+                requires_approval=step.requires_approval,
+            ))
+            self._emit("remediation_step", description=step.description,
+                       risk=step.risk)
+        return plan
+
+    async def execute_remediation(self) -> None:
+        """Execute plan steps through approval + the skill/tool layer."""
+        for step in self.machine.remediation_plan:
+            if step.requires_approval and self.approval_callback is not None:
+                approved = await self.approval_callback(step)
+                if not approved:
+                    step.status = "rejected"
+                    continue
+            elif step.requires_approval:
+                step.status = "pending"  # no approval channel: leave pending
+                continue
+            step.status = "approved"
+            if not step.action:
+                step.status = "executed"
+                step.result = "manual step (no action bound)"
+                continue
+            try:
+                tool = self.adapt_query_to_environment(step.action) or step.action
+                result = await self.executor.execute(tool, step.params)
+                step.status = "executed"
+                step.result = str(result)[:400]
+            except Exception as exc:  # noqa: BLE001
+                step.status = "failed"
+                step.result = str(exc)
+                self.machine.record_error(f"remediation {step.action}: {exc}")
+
+    # ------------------------------------------------------------------ logs
+
+    async def analyze_log_lines(self, lines: list[str], use_llm: bool = True) -> lp.LogAnalysis:
+        """Regex analysis merged with LLM analysis (orchestrator :1224-1255)."""
+        regex = analyze_logs(lines)
+        merged = lp.LogAnalysis(
+            error_categories=list(regex.pattern_counts),
+            services_mentioned=regex.services,
+            notable_lines=regex.notable_lines,
+            suggested_hypotheses=[
+                lp.GeneratedHypothesis(statement=h["statement"], priority=h["priority"])
+                for h in regex.hypotheses
+            ],
+        )
+        if use_llm and lines:
+            raw = await self.llm.complete(lp.fill_prompt(
+                "analyze_logs", logs="\n".join(lines[:80])))
+            llm_result = lp.parse_log_analysis(raw)
+            for cat in llm_result.error_categories:
+                if cat not in merged.error_categories:
+                    merged.error_categories.append(cat)
+            for h in llm_result.suggested_hypotheses:
+                if h.statement and all(h.statement != x.statement
+                                       for x in merged.suggested_hypotheses):
+                    merged.suggested_hypotheses.append(h)
+        return merged

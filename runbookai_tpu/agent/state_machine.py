@@ -1,0 +1,280 @@
+"""Investigation state machine: phases, hypothesis tree, evaluations.
+
+Parity target: reference ``src/agent/state-machine.ts`` — phases (:15-23),
+valid transitions (:299-311), ``maxHypotheses=10`` / ``maxDepth=4`` (:184-185),
+``maxIterations=20`` (:206), ``addHypothesis`` (:329), ``getNextHypothesis``
+(:413 priority/depth sort), ``applyEvaluation`` (:461 —
+branch/prune/confirm/continue), ``getSummary`` (:566), event listeners
+(:167-177), per-phase error buffer (:549-561).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Optional
+
+
+class Phase(str, Enum):
+    IDLE = "idle"
+    TRIAGE = "triage"
+    HYPOTHESIZE = "hypothesize"
+    INVESTIGATE = "investigate"
+    EVALUATE = "evaluate"
+    CONCLUDE = "conclude"
+    REMEDIATE = "remediate"
+    COMPLETE = "complete"
+    FAILED = "failed"
+
+
+VALID_TRANSITIONS: dict[Phase, tuple[Phase, ...]] = {
+    Phase.IDLE: (Phase.TRIAGE,),
+    Phase.TRIAGE: (Phase.HYPOTHESIZE, Phase.FAILED),
+    Phase.HYPOTHESIZE: (Phase.INVESTIGATE, Phase.CONCLUDE, Phase.FAILED),
+    Phase.INVESTIGATE: (Phase.EVALUATE, Phase.CONCLUDE, Phase.FAILED),
+    Phase.EVALUATE: (Phase.INVESTIGATE, Phase.HYPOTHESIZE, Phase.CONCLUDE, Phase.FAILED),
+    Phase.CONCLUDE: (Phase.REMEDIATE, Phase.COMPLETE, Phase.FAILED),
+    Phase.REMEDIATE: (Phase.COMPLETE, Phase.FAILED),
+    Phase.COMPLETE: (),
+    Phase.FAILED: (),
+}
+
+
+class EvaluationAction(str, Enum):
+    CONTINUE = "continue"  # keep investigating this hypothesis
+    BRANCH = "branch"  # spawn sub-hypotheses
+    PRUNE = "prune"  # discard this hypothesis
+    CONFIRM = "confirm"  # root cause found
+
+
+@dataclass
+class FSMHypothesis:
+    id: str
+    statement: str
+    priority: float = 0.5
+    depth: int = 0
+    parent_id: Optional[str] = None
+    status: str = "open"  # open | investigating | confirmed | pruned
+    confidence: float = 0.0
+    evidence: list[dict[str, Any]] = field(default_factory=list)
+    children: list[str] = field(default_factory=list)
+    cycles: int = 0  # investigation cycles spent on this node
+
+
+@dataclass
+class EvidenceRecord:
+    hypothesis_id: str
+    query: str
+    tool: str
+    result_summary: str
+    supports: bool
+    strength: str = "weak"
+    ts: float = field(default_factory=time.time)
+
+
+@dataclass
+class RemediationStep:
+    description: str
+    action: str = ""  # skill/tool to run
+    params: dict[str, Any] = field(default_factory=dict)
+    risk: str = "read"
+    requires_approval: bool = True
+    status: str = "pending"  # pending | approved | executed | rejected | failed
+    result: Optional[str] = None
+
+
+class InvestigationStateMachine:
+    def __init__(self, incident_id: str = "", max_hypotheses: int = 10,
+                 max_depth: int = 4, max_iterations: int = 20):
+        self.incident_id = incident_id or f"inv-{uuid.uuid4().hex[:8]}"
+        self.max_hypotheses = max_hypotheses
+        self.max_depth = max_depth
+        self.max_iterations = max_iterations
+        self.phase = Phase.IDLE
+        self.iterations = 0
+        self.hypotheses: dict[str, FSMHypothesis] = {}
+        self.evidence: list[EvidenceRecord] = []
+        self.remediation_plan: list[RemediationStep] = []
+        self.root_cause: Optional[str] = None
+        self.conclusion_confidence: Optional[str] = None
+        self.affected_services: list[str] = []
+        self.symptoms: list[str] = []
+        self.errors: dict[str, list[str]] = {}
+        self.started_at = time.time()
+        self._listeners: dict[str, list[Callable[..., None]]] = {}
+
+    # ---------------------------------------------------------------- events
+
+    def on(self, event: str, callback: Callable[..., None]) -> None:
+        self._listeners.setdefault(event, []).append(callback)
+
+    def _emit(self, event: str, *args: Any) -> None:
+        for cb in self._listeners.get(event, []):
+            cb(*args)
+
+    def record_error(self, message: str) -> None:
+        """Buffer per-phase errors without crashing (state-machine.ts:549)."""
+        self.errors.setdefault(self.phase.value, []).append(message)
+        if self._listeners.get("error"):
+            self._emit("error", self.phase.value, message)
+
+    # ----------------------------------------------------------- transitions
+
+    def start(self) -> None:
+        self.transition(Phase.TRIAGE)
+
+    def can_transition(self, to: Phase) -> bool:
+        return to in VALID_TRANSITIONS[self.phase]
+
+    def transition(self, to: Phase) -> None:
+        if not self.can_transition(to):
+            raise ValueError(f"invalid transition {self.phase.value} -> {to.value}")
+        old = self.phase
+        self.phase = to
+        self._emit("phaseChange", old.value, to.value)
+
+    def can_continue(self) -> bool:
+        if self.phase in (Phase.COMPLETE, Phase.FAILED, Phase.CONCLUDE,
+                          Phase.REMEDIATE):
+            return False
+        if self.iterations >= self.max_iterations:
+            return False
+        return True
+
+    # ------------------------------------------------------------ hypotheses
+
+    def add_hypothesis(self, statement: str, priority: float = 0.5,
+                       parent_id: Optional[str] = None) -> Optional[FSMHypothesis]:
+        if len(self.hypotheses) >= self.max_hypotheses:
+            self.record_error(f"hypothesis cap {self.max_hypotheses} reached")
+            return None
+        depth = 0
+        if parent_id:
+            parent = self.hypotheses.get(parent_id)
+            if parent is None:
+                return None
+            depth = parent.depth + 1
+            if depth > self.max_depth:
+                self.record_error(f"depth cap {self.max_depth} reached")
+                return None
+        h = FSMHypothesis(
+            id=f"H{len(self.hypotheses) + 1}", statement=statement,
+            priority=priority, depth=depth, parent_id=parent_id,
+        )
+        self.hypotheses[h.id] = h
+        if parent_id:
+            self.hypotheses[parent_id].children.append(h.id)
+        self._emit("hypothesisCreated", h)
+        return h
+
+    def get_next_hypothesis(self) -> Optional[FSMHypothesis]:
+        """Highest (priority, -depth) open hypothesis (state-machine.ts:413)."""
+        candidates = [
+            h for h in self.hypotheses.values()
+            if h.status in ("open", "investigating")
+        ]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda h: (h.priority, -h.depth, -h.cycles))
+
+    def add_evidence(self, record: EvidenceRecord) -> None:
+        self.evidence.append(record)
+        h = self.hypotheses.get(record.hypothesis_id)
+        if h is not None:
+            h.evidence.append({
+                "query": record.query, "tool": record.tool,
+                "summary": record.result_summary, "supports": record.supports,
+                "strength": record.strength,
+            })
+        self._emit("evidenceAdded", record)
+
+    def apply_evaluation(
+        self,
+        hypothesis_id: str,
+        action: EvaluationAction,
+        confidence: float = 0.0,
+        sub_hypotheses: Optional[list[dict[str, Any]]] = None,
+        reason: str = "",
+    ) -> list[FSMHypothesis]:
+        """Apply an evaluation verdict; returns newly created sub-hypotheses."""
+        h = self.hypotheses.get(hypothesis_id)
+        if h is None:
+            self.record_error(f"unknown hypothesis {hypothesis_id}")
+            return []
+        h.confidence = confidence
+        h.cycles += 1
+        created: list[FSMHypothesis] = []
+        if action == EvaluationAction.CONFIRM:
+            h.status = "confirmed"
+        elif action == EvaluationAction.PRUNE:
+            h.status = "pruned"
+            for child_id in h.children:
+                child = self.hypotheses[child_id]
+                if child.status == "open":
+                    child.status = "pruned"
+        elif action == EvaluationAction.BRANCH:
+            h.status = "investigating"
+            for sub in sub_hypotheses or []:
+                child = self.add_hypothesis(
+                    str(sub.get("statement", "")),
+                    priority=float(sub.get("priority", h.priority)),
+                    parent_id=hypothesis_id,
+                )
+                if child:
+                    created.append(child)
+        else:
+            h.status = "investigating"
+        self._emit("hypothesisUpdated", h, action.value, reason)
+        return created
+
+    def confirmed_hypothesis(self) -> Optional[FSMHypothesis]:
+        confirmed = [h for h in self.hypotheses.values() if h.status == "confirmed"]
+        return max(confirmed, key=lambda h: h.confidence) if confirmed else None
+
+    def open_count(self) -> int:
+        return sum(1 for h in self.hypotheses.values()
+                   if h.status in ("open", "investigating"))
+
+    # --------------------------------------------------------------- summary
+
+    def get_summary(self) -> dict[str, Any]:
+        return {
+            "incident_id": self.incident_id,
+            "phase": self.phase.value,
+            "iterations": self.iterations,
+            "elapsed_s": round(time.time() - self.started_at, 2),
+            "hypotheses": {
+                "total": len(self.hypotheses),
+                "confirmed": sum(1 for h in self.hypotheses.values() if h.status == "confirmed"),
+                "pruned": sum(1 for h in self.hypotheses.values() if h.status == "pruned"),
+                "open": self.open_count(),
+            },
+            "evidence_count": len(self.evidence),
+            "root_cause": self.root_cause,
+            "confidence": self.conclusion_confidence,
+            "affected_services": self.affected_services,
+            "remediation_steps": [
+                {"description": s.description, "status": s.status}
+                for s in self.remediation_plan
+            ],
+            "errors": self.errors,
+        }
+
+    def hypothesis_tree_markdown(self) -> str:
+        lines = ["## Hypotheses"]
+        icons = {"confirmed": "[CONFIRMED]", "pruned": "[pruned]",
+                 "open": "[open]", "investigating": "[investigating]"}
+
+        def render(hid: str, indent: int) -> None:
+            h = self.hypotheses[hid]
+            lines.append("  " * indent + f"- {icons[h.status]} {h.id}: {h.statement} "
+                         f"(priority {h.priority:.2f}, confidence {h.confidence:.2f})")
+            for child in h.children:
+                render(child, indent + 1)
+
+        for h in self.hypotheses.values():
+            if h.parent_id is None:
+                render(h.id, 0)
+        return "\n".join(lines)

@@ -1,0 +1,117 @@
+"""llm_parser tolerance, causal query patterns, log analyzer."""
+
+from runbookai_tpu.agent import llm_parser as lp
+from runbookai_tpu.agent.causal_query import (
+    CausalQuery,
+    generate_queries_for_hypothesis,
+    is_query_too_broad,
+    match_patterns,
+    suggest_query_refinements,
+    summarize_query_results,
+)
+from runbookai_tpu.agent.log_analyzer import (
+    analyze_logs,
+    extract_service_mentions,
+    parse_log_line,
+)
+
+
+def test_parse_triage_variants():
+    clean = lp.parse_triage('{"severity": "high", "summary": "s", "affected_services": ["a-b"]}')
+    assert clean.severity == "high" and clean.affected_services == ["a-b"]
+    fenced = lp.parse_triage('Sure!\n```json\n{"severity": "low", "summary": "x"}\n```')
+    assert fenced.severity == "low"
+    junk = lp.parse_triage("not json at all")
+    assert junk.severity == "medium"  # defaults, never raises
+    # invalid enum degrades to defaults rather than raising
+    bad = lp.parse_triage('{"severity": "catastrophic", "summary": "s"}')
+    assert bad.severity == "medium"
+
+
+def test_parse_hypotheses_bare_list_tolerated():
+    out = lp.parse_hypotheses('[{"statement": "a", "priority": 0.9}]')
+    assert out.hypotheses[0].statement == "a"
+
+
+def test_parse_evaluation_and_conclusion():
+    ev = lp.parse_evaluation(
+        '{"action": "branch", "confidence": 0.6, "sub_hypotheses": '
+        '[{"statement": "narrower"}], "reasoning": "split"}')
+    assert ev.action == "branch" and ev.sub_hypotheses[0].statement == "narrower"
+    con = lp.parse_conclusion('{"root_cause": "pool", "confidence": "high"}')
+    assert con.root_cause == "pool" and con.confidence == "high"
+
+
+def test_fill_prompt_missing_keys():
+    text = lp.fill_prompt("triage", context="CTX")
+    assert "CTX" in text and '{"severity"' in text
+    # missing placeholder -> empty, no KeyError
+    text2 = lp.fill_prompt("generate_hypotheses", summary="s")
+    assert "Symptoms: \n" in text2
+
+
+def test_pattern_matching_and_queries():
+    patterns = {p.name for p in match_patterns(
+        "latency spike caused by db connection pool exhaustion after deploy")}
+    assert {"high_latency", "connectivity_issues", "deployment_issues",
+            "database_issues"} <= patterns
+    queries = generate_queries_for_hypothesis(
+        "db connection pool exhaustion",
+        log_group="/ecs/payment-api",
+        available_tools={"cloudwatch_logs", "aws_query"},
+    )
+    assert queries and all(q.tool in {"cloudwatch_logs", "aws_query"} for q in queries)
+    assert queries == sorted(queries, key=lambda q: q.relevance, reverse=True)
+    # unmatched statement falls back to generic queries
+    generic = generate_queries_for_hypothesis("mysterious gremlins")
+    assert generic and generic[0].pattern == "generic"
+
+
+def test_broadness_detection_and_refinement():
+    broad = CausalQuery("aws_query", {"service": "all"}, "x", 0.5)
+    assert is_query_too_broad(broad)
+    refined = suggest_query_refinements(broad, services=["payment-api"])
+    assert refined.params["service"] == "payment-api"
+    logs = CausalQuery("cloudwatch_logs", {"log_group": "/g"}, "x", 0.5)
+    assert is_query_too_broad(logs)
+    assert suggest_query_refinements(logs).params["filter_pattern"] == "error"
+    ok = CausalQuery("cloudwatch_logs", {"log_group": "/g", "filter_pattern": "oom"}, "x", 0.5)
+    assert not is_query_too_broad(ok)
+
+
+def test_summarize_query_results_truncates():
+    q = CausalQuery("datadog", {"action": "metrics"}, "latency series", 0.9)
+    text = summarize_query_results([(q, {"big": "y" * 5000}, None), (q, None, "boom")])
+    assert "latency series" in text and "ERROR: boom" in text
+    assert len(text) < 3000
+
+
+def test_parse_log_line_and_categories():
+    line = "2026-07-29T10:00:00Z ERROR HikariPool-1 - Connection is not available, request timed out"
+    parsed = parse_log_line(line)
+    assert parsed.level == "ERROR" and parsed.timestamp
+    assert "connection_failure" in parsed.categories and "timeout" in parsed.categories
+
+
+def test_analyze_logs_end_to_end():
+    lines = [
+        "2026-07-29T10:00:00Z ERROR payment-api HikariPool-1 pool exhausted",
+        "2026-07-29T10:00:05Z ERROR payment-api PSQLException: remaining connection slots are reserved",
+        "2026-07-29T10:00:10Z INFO checkout-web request ok",
+        "2026-07-29T10:00:12Z FATAL payment-api OOMKilled container restarting",
+    ]
+    result = analyze_logs(lines)
+    assert result.lines_analyzed == 4 and result.error_lines == 3
+    assert result.pattern_counts["connection_failure"] == 2
+    assert "memory" in result.pattern_counts
+    assert result.services[0] == "payment-api"
+    statements = [h["statement"] for h in result.hypotheses]
+    assert any("pool" in s.lower() or "connect" in s.lower() for s in statements)
+    # level filter
+    errors_only = analyze_logs(lines, min_level="ERROR")
+    assert errors_only.lines_analyzed == 3
+
+
+def test_extract_service_mentions_ranked():
+    lines = ["payment-api failed", "payment-api retry", "checkout-web ok"]
+    assert extract_service_mentions(lines)[0] == "payment-api"

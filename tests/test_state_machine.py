@@ -1,0 +1,112 @@
+"""FSM tested exhaustively without any LLM (reference pattern §4.2)."""
+
+import pytest
+
+from runbookai_tpu.agent.state_machine import (
+    EvaluationAction,
+    EvidenceRecord,
+    InvestigationStateMachine,
+    Phase,
+)
+
+
+def test_valid_and_invalid_transitions():
+    m = InvestigationStateMachine()
+    assert m.phase == Phase.IDLE
+    with pytest.raises(ValueError):
+        m.transition(Phase.INVESTIGATE)
+    m.start()
+    assert m.phase == Phase.TRIAGE
+    m.transition(Phase.HYPOTHESIZE)
+    m.transition(Phase.INVESTIGATE)
+    m.transition(Phase.EVALUATE)
+    m.transition(Phase.INVESTIGATE)  # evaluate can loop back
+    m.transition(Phase.CONCLUDE)
+    m.transition(Phase.REMEDIATE)
+    m.transition(Phase.COMPLETE)
+    with pytest.raises(ValueError):
+        m.transition(Phase.TRIAGE)  # terminal
+
+
+def test_phase_change_events():
+    m = InvestigationStateMachine()
+    seen = []
+    m.on("phaseChange", lambda old, new: seen.append((old, new)))
+    m.start()
+    m.transition(Phase.HYPOTHESIZE)
+    assert seen == [("idle", "triage"), ("triage", "hypothesize")]
+
+
+def test_hypothesis_caps():
+    m = InvestigationStateMachine(max_hypotheses=3, max_depth=1)
+    a = m.add_hypothesis("a")
+    b = m.add_hypothesis("b", parent_id=a.id)
+    assert b.depth == 1
+    assert m.add_hypothesis("too deep", parent_id=b.id) is None
+    assert "depth cap 1 reached" in m.errors["idle"]
+    m.add_hypothesis("c")
+    assert m.add_hypothesis("over cap") is None
+    assert len(m.hypotheses) == 3
+
+
+def test_next_hypothesis_priority_and_depth_order():
+    m = InvestigationStateMachine()
+    low = m.add_hypothesis("low", priority=0.2)
+    high = m.add_hypothesis("high", priority=0.9)
+    child = m.add_hypothesis("child of high", priority=0.9, parent_id=high.id)
+    # same priority -> shallower first
+    assert m.get_next_hypothesis().id == high.id
+    high.status = "pruned"
+    assert m.get_next_hypothesis().id == child.id
+    child.status = "confirmed"
+    assert m.get_next_hypothesis().id == low.id
+    low.status = "pruned"
+    assert m.get_next_hypothesis() is None
+
+
+def test_apply_evaluation_actions():
+    m = InvestigationStateMachine()
+    h = m.add_hypothesis("root", priority=0.8)
+    # branch creates children
+    created = m.apply_evaluation(h.id, EvaluationAction.BRANCH, confidence=0.5,
+                                 sub_hypotheses=[{"statement": "s1", "priority": 0.7},
+                                                 {"statement": "s2"}])
+    assert [c.statement for c in created] == ["s1", "s2"]
+    assert all(c.parent_id == h.id and c.depth == 1 for c in created)
+    # prune cascades to open children
+    m.apply_evaluation(h.id, EvaluationAction.PRUNE)
+    assert m.hypotheses[created[0].id].status == "pruned"
+    # confirm
+    h2 = m.add_hypothesis("other")
+    m.apply_evaluation(h2.id, EvaluationAction.CONFIRM, confidence=0.9)
+    assert m.confirmed_hypothesis().id == h2.id
+    # unknown id records an error, doesn't raise
+    m.apply_evaluation("nope", EvaluationAction.CONTINUE)
+    assert any("unknown hypothesis" in e for errs in m.errors.values() for e in errs)
+
+
+def test_can_continue_iteration_budget():
+    m = InvestigationStateMachine(max_iterations=2)
+    m.start()
+    m.transition(Phase.HYPOTHESIZE)
+    m.transition(Phase.INVESTIGATE)
+    assert m.can_continue()
+    m.iterations = 2
+    assert not m.can_continue()
+
+
+def test_evidence_and_summary():
+    m = InvestigationStateMachine(incident_id="PD-1")
+    h = m.add_hypothesis("db pool")
+    m.add_evidence(EvidenceRecord(
+        hypothesis_id=h.id, query="check pool", tool="cloudwatch_logs",
+        result_summary="pool exhausted", supports=True, strength="strong"))
+    m.root_cause = "pool too small"
+    m.conclusion_confidence = "high"
+    s = m.get_summary()
+    assert s["incident_id"] == "PD-1"
+    assert s["hypotheses"]["total"] == 1 and s["evidence_count"] == 1
+    assert s["root_cause"] == "pool too small"
+    assert m.hypotheses[h.id].evidence[0]["summary"] == "pool exhausted"
+    md = m.hypothesis_tree_markdown()
+    assert "H1: db pool" in md
