@@ -1,0 +1,105 @@
+"""Serving benchmark — prints ONE JSON line for the driver.
+
+Measures the BASELINE.md contract metrics on the continuous-batching engine:
+decode tokens/sec/chip (headline) and p50 TTFT, using a Llama-3-shaped model
+(~1B params, bf16, full 128k vocab) on the real chip. Weights are random-init
+when no checkpoint is present (no-egress environment) — identical compute to
+real weights. The reference publishes no numbers (`published: {}`), so
+``vs_baseline`` is reported against 1.0 (this repo establishes the baseline).
+
+Env knobs: BENCH_MODEL, BENCH_REQUESTS, BENCH_PROMPT, BENCH_NEW, BENCH_SLOTS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from runbookai_tpu.engine.engine import EngineConfig, EngineCore
+    from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+    from runbookai_tpu.models.llama import CONFIGS, init_params
+    from runbookai_tpu.utils.tokens import ByteTokenizer
+
+    platform = jax.devices()[0].platform
+    on_accel = platform in ("tpu", "axon")
+    model_name = os.environ.get(
+        "BENCH_MODEL", "llama3-1b-bench" if on_accel else "llama3-test")
+    n_requests = int(os.environ.get("BENCH_REQUESTS", 8))
+    prompt_len = int(os.environ.get("BENCH_PROMPT", 128))
+    new_tokens = int(os.environ.get("BENCH_NEW", 64))
+    slots = int(os.environ.get("BENCH_SLOTS", 8))
+
+    cfg = CONFIGS[model_name]
+    dtype = jnp.bfloat16 if on_accel else jnp.float32
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
+    tok = ByteTokenizer()
+    ecfg = EngineConfig(
+        page_size=16, num_pages=1024, max_batch_slots=slots,
+        prefill_chunk=128, max_seq_len=2048, kv_dtype=dtype, block_pages=16,
+    )
+    core = EngineCore(cfg, params, tok, ecfg)
+
+    rng = np.random.default_rng(0)
+
+    def make_req():
+        prompt = rng.integers(0, 256, size=prompt_len).tolist()
+        return EngineRequest(
+            prompt_ids=prompt,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=new_tokens,
+                                    stop_token_ids=()),
+        )
+
+    # Warmup: compile prefill + decode programs.
+    warm = make_req()
+    warm.sampling = SamplingParams(temperature=0.0, max_new_tokens=4, stop_token_ids=())
+    core.submit(warm)
+    core.run_until_idle()
+    core.metrics.update(decode_tokens=0, decode_steps=0, prefill_tokens=0,
+                        decode_time_s=0.0, prefill_time_s=0.0)
+
+    reqs = [make_req() for _ in range(n_requests)]
+    t0 = time.perf_counter()
+    for r in reqs:
+        core.submit(r)
+    core.run_until_idle()
+    wall = time.perf_counter() - t0
+
+    m = core.metrics
+    decode_tps = m["decode_tokens"] / max(m["decode_time_s"], 1e-9)
+    total_tokens = m["decode_tokens"] + m["prefill_tokens"]
+    ttfts = sorted(r.ttft_ms for r in reqs if r.ttft_ms is not None)
+    p50_ttft = ttfts[len(ttfts) // 2] if ttfts else None
+
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(decode_tps, 2),
+        "unit": "tok/s",
+        "vs_baseline": 1.0,
+        "details": {
+            "model": model_name,
+            "platform": platform,
+            "devices": len(jax.devices()),
+            "requests": n_requests,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "batch_slots": slots,
+            "p50_ttft_ms": round(p50_ttft, 1) if p50_ttft is not None else None,
+            "wall_s": round(wall, 2),
+            "total_tokens": total_tokens,
+            "total_throughput_tok_s": round(total_tokens / wall, 2),
+            "decode_steps": m["decode_steps"],
+            "preemptions": m["preemptions"],
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,173 @@
+"""Investigation benchmark runner: offline scoring or live DP-batched runs.
+
+Parity target: reference ``src/eval/investigation-benchmark.ts`` (offline mode
+:184-210 scores fixture ``mock_result`` without any model; live mode builds the
+real runtime per case :121-187) and ``run-all-benchmarks.ts`` (:133-344 —
+per-benchmark reports + ``summary.json``, skipped/failed statuses).
+
+The TPU upgrade (SURVEY.md §3.5): cases are independent, so live mode runs N
+investigations **concurrently** against the shared continuous-batching engine
+(asyncio gather = data parallelism over the engine's batch slots; on a pod,
+engines per data-replica extend this across chips over ICI).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+from runbookai_tpu.agent.orchestrator import InvestigationOrchestrator, ToolExecutor
+from runbookai_tpu.agent.state_machine import InvestigationStateMachine
+from runbookai_tpu.evalsuite.scoring import CaseScore, EvalCase, score_investigation_result
+from runbookai_tpu.tools import simulated as sim_tools
+from runbookai_tpu.tools.registry import ToolRegistry
+
+
+@dataclass
+class BenchmarkReport:
+    name: str
+    cases: list[dict[str, Any]] = field(default_factory=list)
+    started_at: float = field(default_factory=time.time)
+    elapsed_s: float = 0.0
+
+    @property
+    def passed(self) -> int:
+        return sum(1 for c in self.cases if c["passed"])
+
+    @property
+    def pass_rate(self) -> float:
+        return self.passed / len(self.cases) if self.cases else 0.0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "benchmark": self.name,
+            "total": len(self.cases),
+            "passed": self.passed,
+            "pass_rate": round(self.pass_rate, 4),
+            "elapsed_s": round(self.elapsed_s, 2),
+            "cases": self.cases,
+        }
+
+
+def load_fixtures_file(path: str | Path) -> list[EvalCase]:
+    raw = json.loads(Path(path).read_text())
+    cases = raw["cases"] if isinstance(raw, dict) else raw
+    default_threshold = raw.get("pass_threshold", 0.7) if isinstance(raw, dict) else 0.7
+    out = []
+    for c in cases:
+        c.setdefault("pass_threshold", default_threshold)
+        out.append(EvalCase.from_dict(c))
+    return out
+
+
+def run_offline(cases: list[EvalCase], name: str = "offline") -> BenchmarkReport:
+    """Score fixture mock_results without any model (regression harness)."""
+    report = BenchmarkReport(name=name)
+    t0 = time.perf_counter()
+    for case in cases:
+        if case.mock_result is None:
+            report.cases.append({"case_id": case.case_id, "status": "skipped",
+                                 "passed": False, "reason": "no mock_result"})
+            continue
+        score = score_investigation_result(case, case.mock_result)
+        report.cases.append({
+            "case_id": case.case_id, "status": "scored", "passed": score.passed,
+            "score": score.total, "dimensions": score.dimensions,
+            "notes": score.notes,
+        })
+    report.elapsed_s = time.perf_counter() - t0
+    return report
+
+
+def _executor_for_case(case: EvalCase) -> ToolExecutor:
+    reg = ToolRegistry()
+    sim = sim_tools.SimulatedCloud(case.fixtures)
+    sim_tools.register_aws(reg, sim)
+    sim_tools.register_kubernetes(reg, sim)
+    sim_tools.register_incident(reg, sim, None)
+    return ToolExecutor({t.name: t for t in reg.all()})
+
+
+async def run_live(
+    cases: list[EvalCase],
+    llm_factory: Callable[[], Any],
+    name: str = "live",
+    concurrency: int = 4,
+    knowledge=None,
+    max_iterations: int = 20,
+) -> BenchmarkReport:
+    """Run full investigations concurrently against a shared engine.
+
+    ``llm_factory`` returns the (shared) client exposing ``complete``; the
+    continuous-batching engine interleaves all cases' decodes (DP batching).
+    """
+    report = BenchmarkReport(name=name)
+    llm = llm_factory()
+    sem = asyncio.Semaphore(concurrency)
+    t0 = time.perf_counter()
+
+    async def run_case(case: EvalCase) -> dict[str, Any]:
+        async with sem:
+            try:
+                orch = InvestigationOrchestrator(
+                    llm, _executor_for_case(case),
+                    machine=InvestigationStateMachine(
+                        incident_id=case.incident_id or case.case_id,
+                        max_iterations=max_iterations),
+                    knowledge=knowledge,
+                )
+                result = await orch.investigate(case.incident_id, case.description)
+                payload = {
+                    "root_cause": result.root_cause,
+                    "confidence": result.confidence,
+                    "affected_services": result.affected_services,
+                    "summary": result.conclusion_summary,
+                }
+                score = score_investigation_result(case, payload)
+                return {
+                    "case_id": case.case_id, "status": "completed",
+                    "passed": score.passed, "score": score.total,
+                    "dimensions": score.dimensions,
+                    "result": payload,
+                    "event_counts": _count_events(result.events),
+                    "iterations": result.summary["iterations"],
+                }
+            except Exception as exc:  # noqa: BLE001 — a case failure is a result
+                return {"case_id": case.case_id, "status": "failed",
+                        "passed": False, "error": f"{type(exc).__name__}: {exc}"}
+
+    report.cases = list(await asyncio.gather(*(run_case(c) for c in cases)))
+    report.elapsed_s = time.perf_counter() - t0
+    return report
+
+
+def _count_events(events) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for ev in events:
+        counts[ev.kind] = counts.get(ev.kind, 0) + 1
+    return counts
+
+
+def write_reports(reports: list[BenchmarkReport], out_dir: str | Path) -> Path:
+    """Per-benchmark JSONs + aggregate summary.json (run-all-benchmarks.ts)."""
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    for report in reports:
+        (out / f"{report.name}.json").write_text(json.dumps(report.to_dict(), indent=2))
+    summary = {
+        "generated_at": time.time(),
+        "benchmarks": [
+            {"name": r.name, "total": len(r.cases), "passed": r.passed,
+             "pass_rate": round(r.pass_rate, 4), "elapsed_s": round(r.elapsed_s, 2)}
+            for r in reports
+        ],
+        "overall_pass_rate": round(
+            sum(r.passed for r in reports) / max(1, sum(len(r.cases) for r in reports)), 4),
+    }
+    path = out / "summary.json"
+    path.write_text(json.dumps(summary, indent=2))
+    return path

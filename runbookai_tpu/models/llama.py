@@ -170,3 +170,39 @@ def forward(
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = (h @ head).astype(jnp.float32)
     return logits, kv_k_new, kv_v_new
+
+
+def forward_train(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Training-mode forward: dense causal attention over [B, T], no KV cache.
+
+    Used by the fine-tuning path and the multi-chip dry-run; shares every
+    parameter and norm with the serving forward, differing only in attention
+    materialization (XLA fuses the masked softmax; sequence fits in one pass).
+    """
+    b, t = tokens.shape
+    hd, n_kv, n_q = cfg.head_dim, cfg.n_kv_heads, cfg.n_heads
+    group = n_q // n_kv
+    positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+    causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+    h = params["embed"][tokens]
+
+    def layer_step(hidden, lp):
+        x = rms_norm(hidden, lp["attn_norm"], cfg.norm_eps)
+        q = apply_rope((x @ lp["wq"]).reshape(b, t, n_q, hd), positions, cfg.rope_theta)
+        k = apply_rope((x @ lp["wk"]).reshape(b, t, n_kv, hd), positions, cfg.rope_theta)
+        v = (x @ lp["wv"]).reshape(b, t, n_kv, hd)
+        qg = (q * (1.0 / jnp.sqrt(jnp.float32(hd)))).reshape(b, t, n_kv, group, hd)
+        scores = jnp.einsum("btkgd,bskd->btkgs", qg.astype(jnp.float32),
+                            k.astype(jnp.float32))
+        scores = jnp.where(causal[None, :, None, None, :], scores, -1e30)
+        attn = jax.nn.softmax(scores, axis=-1).astype(hidden.dtype)
+        ctx = jnp.einsum("btkgs,bskd->btkgd", attn, v).reshape(b, t, n_q * hd)
+        hidden = hidden + ctx @ lp["wo"]
+        y = rms_norm(hidden, lp["mlp_norm"], cfg.norm_eps)
+        hidden = hidden + (jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"])) @ lp["w_down"]
+        return hidden, None
+
+    h, _ = jax.lax.scan(layer_step, h, params["layers"])
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return (h @ head).astype(jnp.float32)

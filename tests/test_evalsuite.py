@@ -1,0 +1,144 @@
+"""Eval harness: scoring dimensions, offline regression mode, live DP run,
+dataset converters, report writing."""
+
+import json
+
+import pytest
+
+from runbookai_tpu.evalsuite.converters import convert, rcaeval_to_fixtures
+from runbookai_tpu.evalsuite.runner import (
+    load_fixtures_file,
+    run_live,
+    run_offline,
+    write_reports,
+)
+from runbookai_tpu.evalsuite.scoring import (
+    EvalCase,
+    score_confidence,
+    score_investigation_result,
+    score_root_cause,
+    score_services,
+)
+
+FIXTURES = "examples/evals/investigation-fixtures.sample.json"
+
+
+def test_score_root_cause_modes():
+    assert score_root_cause("pool exhausted", [], "The pool exhausted after deploy")[0] == 1.0
+    partial, note = score_root_cause("x", ["pool", "deploy", "kafka"],
+                                     "pool shrank after deploy")
+    assert partial == pytest.approx(2 / 3) and "2/3" in note
+    assert score_root_cause("pool", [], "")[0] == 0.0
+
+
+def test_score_services_with_aliases():
+    score, _ = score_services(
+        ["payments-db", "payment-api"],
+        {"payments-db": ["payments database"]},
+        ["payment-api"],
+        answer_text="the payments database was saturated",
+    )
+    assert score == 1.0
+    score2, _ = score_services(["a", "b"], {}, ["a"], "")
+    assert score2 == 0.5
+
+
+def test_score_confidence_ordinal():
+    assert score_confidence("high", "high") == 1.0
+    assert score_confidence("high", "medium") == 0.5
+    assert score_confidence("high", "low") == 0.0
+    assert score_confidence("high", "banana") == 0.0
+
+
+def test_score_full_case_with_forbidden_phrase():
+    case = EvalCase(
+        case_id="c", description="", expected_root_cause="pool exhausted",
+        expected_services=["svc-a"], expected_confidence="high",
+        required_phrases=["pool"], forbidden_phrases=["dns"],
+    )
+    good = score_investigation_result(case, {
+        "root_cause": "pool exhausted", "confidence": "high",
+        "affected_services": ["svc-a"], "summary": "the pool was exhausted"})
+    assert good.passed and good.total > 0.9
+    bad = score_investigation_result(case, {
+        "root_cause": "dns failure maybe pool exhausted", "confidence": "low",
+        "affected_services": [], "summary": "dns problems"})
+    assert not bad.passed
+    assert any("forbidden" in n for n in bad.notes)
+
+
+def test_offline_mode_scores_sample_fixtures(tmp_path):
+    cases = load_fixtures_file(FIXTURES)
+    assert len(cases) == 3
+    report = run_offline(cases, name="sample")
+    by_id = {c["case_id"]: c for c in report.cases}
+    assert by_id["payment-db-pool"]["passed"] is True
+    assert by_id["failing-case-regression"]["passed"] is False
+    assert 0 < report.pass_rate < 1
+    summary_path = write_reports([report], tmp_path)
+    summary = json.loads(summary_path.read_text())
+    assert summary["benchmarks"][0]["name"] == "sample"
+    assert (tmp_path / "sample.json").exists()
+
+
+async def test_live_mode_concurrent_cases():
+    """Live DP run against canned completions + the simulated cloud."""
+    import itertools
+
+    TRIAGE = json.dumps({"severity": "high", "summary": "latency",
+                         "affected_services": ["payment-api"],
+                         "symptoms": ["latency"], "signals": []})
+    HYPS = json.dumps({"hypotheses": [
+        {"statement": "db connection pool exhaustion after deploy", "priority": 0.9}]})
+    CONFIRM = json.dumps({"action": "confirm", "confidence": 0.9,
+                          "supports": True, "strength": "strong", "reasoning": "r"})
+    CONCL = json.dumps({"root_cause": "db connection pool exhausted after deploy",
+                        "confidence": "high",
+                        "affected_services": ["payment-api", "payments-db"],
+                        "summary": "pool exhausted."})
+    REMED = json.dumps({"steps": [], "rollback": "", "notes": ""})
+
+    class CyclingLLM:
+        def __init__(self):
+            self.cycle = itertools.cycle([TRIAGE, HYPS, CONFIRM, CONCL, REMED])
+            self.calls = 0
+
+        async def complete(self, prompt):
+            self.calls += 1
+            return next(self.cycle)
+
+    cases = [c for c in load_fixtures_file(FIXTURES) if c.case_id == "payment-db-pool"]
+    cases = cases * 3  # three concurrent copies
+    report = await run_live(cases, CyclingLLM, name="live", concurrency=3)
+    assert len(report.cases) == 3
+    assert all(c["status"] == "completed" for c in report.cases)
+    assert all(c["passed"] for c in report.cases)
+    assert all(c["event_counts"]["phase_change"] >= 5 for c in report.cases)
+
+
+def test_rcaeval_converter(tmp_path):
+    src = tmp_path / "data.jsonl"
+    src.write_text("\n".join([
+        json.dumps({"case": "c1", "system": "online-boutique",
+                    "root_cause_service": "cartservice", "fault_type": "cpu stress"}),
+        json.dumps({"case": "c2", "system": "trainticket",
+                    "root_cause_service": "ts-order-service", "fault_type": "network delay"}),
+    ]))
+    fixtures = rcaeval_to_fixtures(src)
+    assert len(fixtures) == 2
+    assert fixtures[0]["expected_services"] == ["cartservice"]
+    assert "cartservice" in fixtures[0]["root_cause_keywords"]
+    dst = tmp_path / "out.json"
+    assert convert("rcaeval", src, dst) == 2
+    loaded = load_fixtures_file(dst)
+    assert loaded[0].case_id == "c1"
+
+
+def test_csv_and_tsv_rows(tmp_path):
+    src = tmp_path / "rootly.csv"
+    src.write_text("id,title,cause,services\n1,API down,expired certificate,edge-proxy\n")
+    from runbookai_tpu.evalsuite.converters import rootly_to_fixtures
+
+    fx = rootly_to_fixtures(src)
+    assert fx[0]["expected_services"] == ["edge-proxy"]
+    assert "certificate" in fx[0]["root_cause_keywords"]
