@@ -1,0 +1,461 @@
+"""``runbook`` CLI — argparse command surface.
+
+Parity target: reference ``src/cli.tsx`` (commander + Ink): ask :1104, chat
+:1119, investigate :1133, status :1193, init :1208, demo :1240, knowledge
+:1250-1471, config :1587, webhook :1999, slack-gateway :2057, mcp :2182,
+checkpoint :2353, plus the eval runners. Rendering is plain-text streaming of
+the shared AgentEvent vocabulary (runbookai_tpu.demo.runner.render_event)
+instead of a React terminal UI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+from runbookai_tpu.utils.config import (
+    Config,
+    load_config,
+    save_config,
+    set_config_value,
+    validate_config,
+)
+
+
+def _print_event(ev) -> None:
+    from runbookai_tpu.demo.runner import render_event
+
+    print(render_event(ev), flush=True)
+
+
+def _load(args) -> Config:
+    return load_config(path=getattr(args, "config", None))
+
+
+# --------------------------------------------------------------------------- #
+# commands                                                                    #
+# --------------------------------------------------------------------------- #
+
+
+def cmd_ask(args) -> int:
+    from runbookai_tpu.cli.runtime import build_agent, build_runtime
+
+    config = _load(args)
+    runtime = build_runtime(config, interactive=not args.yes)
+    agent = build_agent(runtime)
+
+    async def run() -> None:
+        async for ev in agent.run(args.query, session_id=args.session):
+            _print_event(ev)
+
+    asyncio.run(run())
+    return 0
+
+
+def cmd_chat(args) -> int:
+    from runbookai_tpu.agent.memory import ConversationMemory
+    from runbookai_tpu.cli.runtime import build_agent, build_runtime
+
+    config = _load(args)
+    runtime = build_runtime(config)
+    agent = build_agent(runtime)
+    memory = ConversationMemory(summarize_after_messages=16)
+    print("runbook chat — empty line or 'exit' to quit")
+
+    async def turn(text: str) -> None:
+        memory.add("user", text)
+        answer = ""
+        query = text
+        context = memory.context_block()
+        if context:
+            query = f"{context}\n\n# Current question\n{text}"
+        async for ev in agent.run(query):
+            if ev.kind == "answer":
+                answer = ev.data["text"]
+            _print_event(ev)
+        memory.add("assistant", answer)
+        if memory.needs_summarization:
+            await memory.summarize(runtime.llm)
+
+    while True:
+        try:
+            line = input("\nyou> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            break
+        if not line or line in ("exit", "quit"):
+            break
+        asyncio.run(turn(line))
+    return 0
+
+
+def cmd_investigate(args) -> int:
+    from runbookai_tpu.cli.runtime import build_orchestrator, build_runtime
+    from runbookai_tpu.session.checkpoint import CheckpointStore
+
+    config = _load(args)
+    runtime = build_runtime(config, interactive=not args.yes)
+    orch = build_orchestrator(runtime, incident_id=args.incident_id,
+                              execute_remediation=args.execute)
+    orch.event_sink = _print_event
+    result = asyncio.run(orch.investigate(args.incident_id, args.description or ""))
+    store = CheckpointStore(f"{config.runbook_dir}/checkpoints")
+    store.save_machine(orch.machine, label="final")
+    print(f"\nroot cause: {result.root_cause}")
+    print(f"confidence: {result.confidence}")
+    print(f"services:   {', '.join(result.affected_services)}")
+    if args.learn:
+        from runbookai_tpu.learning.loop import run_learning_loop
+
+        artifacts = asyncio.run(run_learning_loop(
+            runtime.llm, result, out_dir=f"{config.runbook_dir}/learning"))
+        print(f"learning artifacts: {artifacts}")
+    return 0
+
+
+def cmd_demo(args) -> int:
+    from runbookai_tpu.demo.runner import run_demo
+
+    run_demo(emit=_print_event, fast=args.fast)
+    return 0
+
+
+def cmd_status(args) -> int:
+    config = _load(args)
+    problems = validate_config(config)
+    print(f"llm provider: {config.llm.provider} ({config.llm.model})")
+    enabled = []
+    if config.providers.aws.enabled:
+        enabled.append("aws" + (" (simulated)" if config.providers.aws.simulated else ""))
+    if config.providers.kubernetes.enabled:
+        enabled.append("kubernetes" + (" (simulated)" if config.providers.kubernetes.simulated else ""))
+    for name, c in (("datadog", config.observability.datadog),
+                    ("prometheus", config.observability.prometheus),
+                    ("pagerduty", config.incident.pagerduty),
+                    ("opsgenie", config.incident.opsgenie),
+                    ("slack", config.incident.slack)):
+        if c.enabled:
+            enabled.append(name)
+    print(f"providers: {', '.join(enabled) or '(none enabled)'}")
+    db = Path(config.knowledge.db_path)
+    if db.is_file():
+        from runbookai_tpu.knowledge.store.sqlite_fts import KnowledgeStore
+
+        stats = KnowledgeStore(db).stats()
+        print(f"knowledge: {stats['documents']} docs / {stats['chunks']} chunks")
+    else:
+        print("knowledge: (no database — run `runbook knowledge sync`)")
+    if problems:
+        print("config problems:")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print("config: ok")
+    return 0
+
+
+def cmd_init(args) -> int:
+    target = Path(args.dir or ".") / ".runbook" / "config.yaml"
+    if target.exists() and not args.force:
+        print(f"{target} already exists (use --force to overwrite)")
+        return 1
+    config = Config()
+    if args.template == "simulated":
+        config = Config.model_validate({
+            "llm": {"provider": "mock"},
+            "providers": {"aws": {"enabled": True, "simulated": True},
+                          "kubernetes": {"enabled": True, "simulated": True}},
+            "observability": {"datadog": {"enabled": True, "simulated": True},
+                              "prometheus": {"enabled": True, "simulated": True}},
+            "incident": {"pagerduty": {"enabled": True, "simulated": True}},
+        })
+    elif args.template == "tpu":
+        config = Config.model_validate({
+            "llm": {"provider": "jax-tpu", "model": "llama3-8b-instruct",
+                    "dtype": "bfloat16"},
+            "providers": {"aws": {"enabled": True, "simulated": True},
+                          "kubernetes": {"enabled": True, "simulated": True}},
+            "incident": {"pagerduty": {"enabled": True, "simulated": True}},
+        })
+    save_config(config, target)
+    print(f"wrote {target} (template: {args.template})")
+    return 0
+
+
+def cmd_config(args) -> int:
+    config = _load(args)
+    if args.set:
+        for assignment in args.set:
+            if "=" not in assignment:
+                print(f"expected key=value, got {assignment!r}")
+                return 1
+            key, value = assignment.split("=", 1)
+            config = set_config_value(config, key.strip(), value.strip())
+        path = args.config or Path(".runbook") / "config.yaml"
+        save_config(config, path)
+        print(f"updated {path}")
+    if args.show or not args.set:
+        print(json.dumps(config.model_dump(mode="json"), indent=2))
+    return 0
+
+
+def cmd_knowledge(args) -> int:
+    config = _load(args)
+    from runbookai_tpu.knowledge.retriever import create_retriever
+
+    retriever = create_retriever(config)
+    if args.knowledge_cmd == "sync":
+        counts = retriever.sync(force=args.force)
+        for name, n in counts.items():
+            print(f"{name}: {n} documents synced")
+        print(json.dumps(retriever.stats(), indent=2, default=str))
+        return 0
+    if args.knowledge_cmd == "search":
+        hits = retriever.hybrid.search(args.query, limit=args.limit,
+                                       knowledge_type=args.type,
+                                       service=args.service)
+        for h in hits:
+            print(f"[{h.score:.4f}] ({h.doc.knowledge_type}) {h.doc.title} "
+                  f"§{h.chunk.section or '-'}")
+            print(f"    {h.chunk.content[:180]}")
+        if not hits:
+            print("(no results)")
+        return 0
+    if args.knowledge_cmd == "stats":
+        print(json.dumps(retriever.stats(), indent=2, default=str))
+        return 0
+    if args.knowledge_cmd == "add":
+        from runbookai_tpu.knowledge.chunker import document_from_markdown
+
+        path = Path(args.file)
+        doc = document_from_markdown(str(path), path.read_text(),
+                                     default_title=path.stem)
+        retriever.store.upsert_document(doc)
+        if retriever.hybrid.embedder and retriever.hybrid.vectors is not None:
+            embs = retriever.hybrid.embedder.embed_texts(
+                [c.content for c in doc.chunks])
+            retriever.hybrid.vectors.store_many([
+                (c.chunk_id, doc.doc_id, embs[i]) for i, c in enumerate(doc.chunks)])
+        print(f"added {doc.doc_id}: {doc.title} ({len(doc.chunks)} chunks)")
+        return 0
+    if args.knowledge_cmd == "validate":
+        problems = validate_config(config)
+        for p in problems:
+            print(f"- {p}")
+        print("ok" if not problems else f"{len(problems)} problem(s)")
+        return 0 if not problems else 1
+    print("unknown knowledge command")
+    return 1
+
+
+def cmd_checkpoint(args) -> int:
+    from runbookai_tpu.session.checkpoint import CheckpointStore
+
+    config = _load(args)
+    store = CheckpointStore(f"{config.runbook_dir}/checkpoints")
+    if args.checkpoint_cmd == "list":
+        metas = store.list(args.investigation)
+        for m in metas:
+            print(f"{m.checkpoint_id}  {m.investigation_id:14} {m.phase:12} {m.label}")
+        if not metas:
+            print("(no checkpoints)")
+        return 0
+    if args.checkpoint_cmd == "show":
+        data = store.show(args.checkpoint_id)
+        if data is None:
+            print("not found")
+            return 1
+        print(json.dumps(data, indent=2, default=str))
+        return 0
+    if args.checkpoint_cmd == "delete":
+        ok = store.delete(args.checkpoint_id)
+        print("deleted" if ok else "not found")
+        return 0 if ok else 1
+    return 1
+
+
+def cmd_eval(args) -> int:
+    from runbookai_tpu.evalsuite.runner import (
+        load_fixtures_file,
+        run_live,
+        run_offline,
+        write_reports,
+    )
+
+    cases = load_fixtures_file(args.fixtures)
+    if args.offline:
+        report = run_offline(cases, name=args.name)
+    else:
+        from runbookai_tpu.cli.runtime import build_runtime
+
+        config = _load(args)
+        runtime = build_runtime(config, interactive=False)
+        report = asyncio.run(run_live(
+            cases, lambda: runtime.llm, name=args.name,
+            concurrency=args.concurrency))
+    summary_path = write_reports([report], args.out)
+    print(json.dumps(report.to_dict() | {"summary_path": str(summary_path)},
+                     indent=2, default=str))
+    return 0 if report.pass_rate >= args.min_pass_rate else 1
+
+
+def cmd_bench(args) -> int:
+    import runpy
+
+    runpy.run_path(str(Path(__file__).resolve().parents[2] / "bench.py"),
+                   run_name="__main__")
+    return 0
+
+
+def cmd_mcp(args) -> int:
+    from runbookai_tpu.server.mcp import MCPServer, run_stdio_server
+
+    config = _load(args)
+    server = MCPServer.from_config(config)
+    if args.mcp_cmd == "tools":
+        for tool in server.list_tools():
+            print(f"{tool['name']}: {tool['description']}")
+        return 0
+    run_stdio_server(server)
+    return 0
+
+
+def cmd_webhook(args) -> int:
+    from runbookai_tpu.server.webhook import run_webhook_server
+
+    config = _load(args)
+    run_webhook_server(config, port=args.port)
+    return 0
+
+
+def cmd_slack_gateway(args) -> int:
+    from runbookai_tpu.server.slack_gateway import run_slack_gateway
+
+    config = _load(args)
+    run_slack_gateway(config, mode=args.mode, port=args.port)
+    return 0
+
+
+# --------------------------------------------------------------------------- #
+# parser                                                                      #
+# --------------------------------------------------------------------------- #
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="runbook",
+        description="TPU-native AI SRE agent: incident investigation served by "
+                    "an in-tree JAX inference engine.",
+    )
+    p.add_argument("--config", help="explicit config.yaml path")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ask = sub.add_parser("ask", help="one-shot question through the agent loop")
+    ask.add_argument("query")
+    ask.add_argument("--session", default=None)
+    ask.add_argument("--yes", action="store_true", help="non-interactive approvals")
+    ask.set_defaults(fn=cmd_ask)
+
+    chat = sub.add_parser("chat", help="interactive conversation")
+    chat.set_defaults(fn=cmd_chat)
+
+    inv = sub.add_parser("investigate", help="structured incident investigation")
+    inv.add_argument("incident_id")
+    inv.add_argument("--description", default="")
+    inv.add_argument("--execute", action="store_true",
+                     help="execute the remediation plan (approval-gated)")
+    inv.add_argument("--learn", action="store_true",
+                     help="run the learning loop afterwards")
+    inv.add_argument("--yes", action="store_true")
+    inv.set_defaults(fn=cmd_investigate)
+
+    demo = sub.add_parser("demo", help="scripted demo investigation (no model)")
+    demo.add_argument("--fast", action="store_true", help="3x speed")
+    demo.set_defaults(fn=cmd_demo)
+
+    status = sub.add_parser("status", help="config + provider status")
+    status.set_defaults(fn=cmd_status)
+
+    init = sub.add_parser("init", help="write a starter config")
+    init.add_argument("--template", choices=["minimal", "simulated", "tpu"],
+                      default="simulated")
+    init.add_argument("--dir", default=".")
+    init.add_argument("--force", action="store_true")
+    init.set_defaults(fn=cmd_init)
+
+    cfg = sub.add_parser("config", help="show or set config values")
+    cfg.add_argument("--set", action="append", metavar="a.b.c=value")
+    cfg.add_argument("--show", action="store_true")
+    cfg.set_defaults(fn=cmd_config)
+
+    kn = sub.add_parser("knowledge", help="knowledge base management")
+    kn_sub = kn.add_subparsers(dest="knowledge_cmd", required=True)
+    kn_sync = kn_sub.add_parser("sync")
+    kn_sync.add_argument("--force", action="store_true")
+    kn_search = kn_sub.add_parser("search")
+    kn_search.add_argument("query")
+    kn_search.add_argument("--type", default=None)
+    kn_search.add_argument("--service", default=None)
+    kn_search.add_argument("--limit", type=int, default=8)
+    kn_sub.add_parser("stats")
+    kn_add = kn_sub.add_parser("add")
+    kn_add.add_argument("file")
+    kn_sub.add_parser("validate")
+    kn.set_defaults(fn=cmd_knowledge)
+
+    cp = sub.add_parser("checkpoint", help="investigation checkpoints")
+    cp_sub = cp.add_subparsers(dest="checkpoint_cmd", required=True)
+    cp_list = cp_sub.add_parser("list")
+    cp_list.add_argument("--investigation", default=None)
+    cp_show = cp_sub.add_parser("show")
+    cp_show.add_argument("checkpoint_id")
+    cp_del = cp_sub.add_parser("delete")
+    cp_del.add_argument("checkpoint_id")
+    cp.set_defaults(fn=cmd_checkpoint)
+
+    ev = sub.add_parser("eval", help="run the investigation benchmark")
+    ev.add_argument("--fixtures",
+                    default="examples/evals/investigation-fixtures.sample.json")
+    ev.add_argument("--offline", action="store_true",
+                    help="score fixture mock_results without a model")
+    ev.add_argument("--name", default="investigation")
+    ev.add_argument("--out", default=".runbook/eval-reports")
+    ev.add_argument("--concurrency", type=int, default=4)
+    ev.add_argument("--min-pass-rate", type=float, default=0.0)
+    ev.set_defaults(fn=cmd_eval)
+
+    bench = sub.add_parser("bench", help="serving benchmark (one JSON line)")
+    bench.set_defaults(fn=cmd_bench)
+
+    mcp = sub.add_parser("mcp", help="MCP server over stdio")
+    mcp_sub = mcp.add_subparsers(dest="mcp_cmd", required=True)
+    mcp_sub.add_parser("serve")
+    mcp_sub.add_parser("tools")
+    mcp.set_defaults(fn=cmd_mcp)
+
+    wh = sub.add_parser("webhook", help="Slack approval webhook server")
+    wh.add_argument("--port", type=int, default=3939)
+    wh.set_defaults(fn=cmd_webhook)
+
+    sg = sub.add_parser("slack-gateway", help="Slack gateway (socket|http)")
+    sg.add_argument("--mode", choices=["socket", "http"], default="http")
+    sg.add_argument("--port", type=int, default=3940)
+    sg.set_defaults(fn=cmd_slack_gateway)
+
+    return p
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except KeyboardInterrupt:
+        print("\ninterrupted")
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""Runtime assembly: config → LLM client + gated tools + knowledge + safety.
+
+Parity target: reference ``createRuntimeAgent`` (cli.tsx:88-110) and the
+structured-investigation driver (cli.tsx:586-660): one place that builds the
+full stack for either reasoning path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from runbookai_tpu.agent.agent import Agent
+from runbookai_tpu.agent.orchestrator import InvestigationOrchestrator, ToolExecutor
+from runbookai_tpu.agent.safety import SafetyManager, make_cli_approval
+from runbookai_tpu.agent.state_machine import InvestigationStateMachine
+from runbookai_tpu.model.client import create_llm_client
+from runbookai_tpu.tools.registry import get_runtime_tools
+from runbookai_tpu.utils.config import Config
+
+
+@dataclass
+class Runtime:
+    config: Config
+    llm: Any
+    tools: list[Any]
+    knowledge: Optional[Any]
+    safety: SafetyManager
+
+
+def build_runtime(config: Config, interactive: bool = True,
+                  with_knowledge: bool = True) -> Runtime:
+    llm = create_llm_client(config)
+    knowledge = None
+    if with_knowledge and (config.knowledge.sources or _db_exists(config)):
+        from runbookai_tpu.knowledge.retriever import create_retriever
+
+        knowledge = create_retriever(config)
+    safety = SafetyManager(
+        require_approval=tuple(config.safety.require_approval),
+        auto_approve_low_risk=config.safety.auto_approve_low_risk,
+        max_mutations_per_session=config.safety.max_mutations_per_session,
+        cooldown_seconds=config.safety.cooldown_seconds,
+        audit_dir=f"{config.runbook_dir}/audit",
+        approval_callback=make_cli_approval() if interactive else None,
+    )
+    tools = get_runtime_tools(config, knowledge=knowledge, safety=safety)
+    return Runtime(config=config, llm=llm, tools=tools, knowledge=knowledge,
+                   safety=safety)
+
+
+def _db_exists(config: Config) -> bool:
+    from pathlib import Path
+
+    return Path(config.knowledge.db_path).is_file()
+
+
+def build_agent(runtime: Runtime) -> Agent:
+    acfg = runtime.config.agent
+    return Agent(
+        runtime.llm,
+        runtime.tools,
+        knowledge=runtime.knowledge,
+        max_iterations=acfg.max_iterations,
+        context_threshold_tokens=acfg.context_threshold_tokens,
+        explain_mode=acfg.explain_mode,
+        parallel_tools=acfg.parallel_tool_calls,
+        scratchpad_root=f"{runtime.config.runbook_dir}/scratchpad",
+        cache_ttl_seconds=acfg.tool_cache_ttl_seconds,
+        cache_size=acfg.tool_cache_size,
+    )
+
+
+def build_orchestrator(runtime: Runtime, incident_id: str = "",
+                       execute_remediation: bool = False,
+                       approval_callback=None) -> InvestigationOrchestrator:
+    acfg = runtime.config.agent
+    machine = InvestigationStateMachine(
+        incident_id=incident_id,
+        max_hypotheses=acfg.max_hypotheses,
+        max_depth=acfg.max_hypothesis_depth,
+        max_iterations=acfg.max_investigation_iterations,
+    )
+    executor = ToolExecutor({t.name: t for t in runtime.tools})
+    return InvestigationOrchestrator(
+        runtime.llm, executor, machine=machine, knowledge=runtime.knowledge,
+        approval_callback=approval_callback,
+        execute_remediation=execute_remediation,
+    )

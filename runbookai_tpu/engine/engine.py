@@ -61,6 +61,9 @@ class EngineConfig:
     # Reserve this many pages of headroom per admitted sequence so decode can
     # proceed a while before needing new allocations.
     admit_headroom_tokens: int = 64
+    # Max decode tokens sampled per device dispatch (amortizes the host sync;
+    # clamped to powers of two to bound compile count). Guided requests force 1.
+    decode_steps_per_dispatch: int = 8
 
 
 @partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages"), donate_argnums=(4, 5))
@@ -74,6 +77,40 @@ def _decode_step(
     )
     tok = sample_tokens(logits[:, -1], key, temps, top_ps, mask)
     return tok, logits[:, -1], kv_k, kv_v
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "page_size", "block_pages", "k_steps"),
+         donate_argnums=(4, 5))
+def _decode_multi(
+    params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
+    temps, top_ps, key, page_size: int, block_pages: int, k_steps: int,
+):
+    """K autoregressive decode steps in ONE dispatch (on-device sampling).
+
+    Host→device round trips dominate per-step latency on tunneled setups
+    (~70ms per sync observed), so the engine amortizes one token fetch over
+    ``k_steps`` tokens. Pages for ctx+K must be pre-allocated; per-sequence
+    stop conditions are applied host-side after the fetch (tokens past a stop
+    are discarded — their KV writes are position-addressed, so accepted tokens
+    simply overwrite them later).
+    """
+
+    def step(carry, _):
+        tokens, positions, kv_k, kv_v, ctx_lens, key = carry
+        logits, kv_k, kv_v = forward(
+            params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
+            page_size=page_size, block_pages=block_pages,
+        )
+        key, sub = jax.random.split(key)
+        tok = sample_tokens(logits[:, -1], sub, temps, top_ps, None)
+        carry = (tok[:, None], positions + 1, kv_k, kv_v, ctx_lens + 1, key)
+        return carry, tok
+
+    (_, _, kv_k, kv_v, _, _), toks = jax.lax.scan(
+        step, (tokens, positions, kv_k, kv_v, ctx_lens, key), None, length=k_steps
+    )
+    return toks.T, kv_k, kv_v  # [B, K]
 
 
 @partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages"), donate_argnums=(3, 4))
@@ -292,15 +329,38 @@ class EngineCore:
             if any(s in tail for s in req.sampling.stop_strings):
                 self._finish(req, FinishReason.STOP_STRING)
 
+    def _pick_k(self) -> int:
+        """Decode tokens per dispatch: 1 when any guided request needs
+        per-token masks, else the largest power of two ≤ config that fits
+        every sequence's remaining max_seq headroom."""
+        if any(r.sampling.guided for r in self.decoding):
+            return 1
+        k = max(1, self.ecfg.decode_steps_per_dispatch)
+        remaining = min(self.ecfg.max_seq_len - r.ctx_len for r in self.decoding)
+        while k > 1 and (k > remaining):
+            k //= 2
+        # power-of-two clamp bounds distinct compiled programs
+        p = 1
+        while p * 2 <= k:
+            p *= 2
+        return p
+
     def _run_decode(self) -> None:
         if not self.decoding:
             return
         t0 = time.perf_counter()
-        # Grow pages for every decoding sequence; preempt on pressure.
+        # Sequences at the context limit finish before K is chosen.
+        for req in list(self.decoding):
+            if req.ctx_len + 1 > self.ecfg.max_seq_len:
+                self._finish(req, FinishReason.MAX_TOKENS)
+        if not self.decoding:
+            return
+        k = self._pick_k()
+        # Grow pages to cover ctx + K for every sequence; preempt on pressure.
         for req in list(self.decoding):
             while (
                 req.state == RequestState.DECODE
-                and not self.kv.can_extend(req.request_id, req.ctx_len + 1)
+                and not self.kv.can_extend(req.request_id, req.ctx_len + k)
             ):
                 # _preempt_youngest may evict ``req`` itself — the state guard
                 # above then exits the loop.
@@ -308,10 +368,7 @@ class EngineCore:
                     self._finish(req, FinishReason.ABORTED)
                     break
             if req.state == RequestState.DECODE and req.request_id in self.kv.seqs:
-                if req.ctx_len + 1 > self.ecfg.max_seq_len:
-                    self._finish(req, FinishReason.MAX_TOKENS)
-                else:
-                    self.kv.extend(req.request_id, req.ctx_len + 1)
+                self.kv.extend(req.request_id, req.ctx_len + k)
         if not self.decoding:
             return
 
@@ -336,21 +393,36 @@ class EngineCore:
                     mask[i] = m
                     need_mask = True
         tables = self._tables_for(self._slots)
-
         self._key, sub = jax.random.split(self._key)
-        toks, _, self._kv_k, self._kv_v = _decode_step(
-            self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
-            self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
-            jnp.asarray(temps), jnp.asarray(top_ps), sub,
-            jnp.asarray(mask) if need_mask else None,
-            page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
-        )
-        toks_host = np.asarray(jax.device_get(toks))
-        n_active = len(self.decoding)
-        for req in list(self.decoding):
-            self._emit_token(req, int(toks_host[req.slot]))
-        self.metrics["decode_tokens"] += n_active
-        self.metrics["decode_steps"] += 1
+
+        if k == 1:
+            toks, _, self._kv_k, self._kv_v = _decode_step(
+                self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
+                self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
+                jnp.asarray(temps), jnp.asarray(top_ps), sub,
+                jnp.asarray(mask) if need_mask else None,
+                page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
+            )
+            toks_host = np.asarray(jax.device_get(toks))[:, None]  # [B, 1]
+        else:
+            toks, self._kv_k, self._kv_v = _decode_multi(
+                self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
+                self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
+                jnp.asarray(temps), jnp.asarray(top_ps), sub,
+                page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
+                k_steps=k,
+            )
+            toks_host = np.asarray(jax.device_get(toks))  # [B, K]
+
+        emitted = 0
+        snapshot = list(self.decoding)
+        for step_idx in range(toks_host.shape[1]):
+            for req in snapshot:
+                if req.state == RequestState.DECODE:
+                    self._emit_token(req, int(toks_host[req.slot, step_idx]))
+                    emitted += 1
+        self.metrics["decode_tokens"] += emitted
+        self.metrics["decode_steps"] += toks_host.shape[1]
         self.metrics["decode_time_s"] += time.perf_counter() - t0
 
     # ------------------------------------------------------------------ step

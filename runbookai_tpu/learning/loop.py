@@ -1,0 +1,90 @@
+"""Learning loop: post-investigation artifacts.
+
+Parity target: reference ``src/learning/loop.ts`` (``runLearningLoop`` :636) —
+generates a postmortem draft, ``knowledge-suggestions.json``, and runbook
+update proposals into ``.runbook/learning/<id>/`` from the investigation's
+events and conclusion.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any
+
+POSTMORTEM_PROMPT = """\
+Draft a concise postmortem in markdown from this investigation record.
+
+Root cause: {root_cause}
+Confidence: {confidence}
+Affected services: {services}
+Summary: {summary}
+Timeline of evidence:
+{timeline}
+
+Sections: Summary, Impact, Root Cause, Timeline, What went well,
+What went poorly, Action items (with owners as TODO).
+"""
+
+SUGGESTIONS_PROMPT = """\
+From this investigation, propose knowledge-base updates. Respond with ONLY a
+JSON object:
+{{"suggestions": [{{"type": "runbook|known-issue|architecture",
+   "title": "...", "reason": "...", "services": ["..."],
+   "outline": "..."}}]}}
+
+Root cause: {root_cause}
+Services: {services}
+Evidence highlights:
+{timeline}
+"""
+
+
+def _timeline(result) -> str:
+    lines = []
+    for ev in getattr(result, "events", [])[:40]:
+        if ev.kind in ("triage", "hypothesis_created", "hypothesis_updated",
+                       "evidence", "conclusion"):
+            lines.append(f"- [{ev.kind}] {json.dumps(ev.data, default=str)[:220]}")
+    return "\n".join(lines) or "(no recorded events)"
+
+
+async def run_learning_loop(llm, result, out_dir: str | Path = ".runbook/learning") -> Path:
+    """Generate artifacts for one investigation result; returns the dir."""
+    from runbookai_tpu.model.chat_template import extract_json
+
+    inv_id = result.summary.get("incident_id", f"inv-{int(time.time())}")
+    d = Path(out_dir) / inv_id
+    d.mkdir(parents=True, exist_ok=True)
+    timeline = _timeline(result)
+
+    postmortem = await llm.complete(POSTMORTEM_PROMPT.format(
+        root_cause=result.root_cause, confidence=result.confidence,
+        services=", ".join(result.affected_services),
+        summary=result.conclusion_summary, timeline=timeline,
+    ))
+    (d / "postmortem-draft.md").write_text(postmortem or "(empty draft)")
+
+    raw = await llm.complete(SUGGESTIONS_PROMPT.format(
+        root_cause=result.root_cause,
+        services=", ".join(result.affected_services), timeline=timeline,
+    ))
+    payload = extract_json(raw)
+    suggestions: list[dict[str, Any]] = []
+    if isinstance(payload, dict) and isinstance(payload.get("suggestions"), list):
+        suggestions = [s for s in payload["suggestions"] if isinstance(s, dict)]
+    (d / "knowledge-suggestions.json").write_text(json.dumps({
+        "investigation_id": inv_id,
+        "generated_at": time.time(),
+        "suggestions": suggestions,
+    }, indent=2))
+
+    (d / "record.json").write_text(json.dumps({
+        "summary": result.summary,
+        "root_cause": result.root_cause,
+        "confidence": result.confidence,
+        "affected_services": result.affected_services,
+        "remediation": result.remediation,
+    }, indent=2, default=str))
+    return d

@@ -74,6 +74,7 @@ class JaxTpuClient(BaseLLMClient):
             prefill_chunk=llm_cfg.prefill_chunk,
             max_seq_len=min(llm_cfg.max_seq_len, cfg.max_seq_len),
             kv_dtype=dtype,
+            decode_steps_per_dispatch=llm_cfg.decode_steps,
         )
         masker = JsonMaskProvider(tokenizer)
         core = EngineCore(

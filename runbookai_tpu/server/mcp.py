@@ -1,0 +1,167 @@
+"""MCP server: knowledge tools over stdio JSON-RPC (no SDK dependency).
+
+Parity target: reference ``src/mcp/server.ts`` — ``MCP_TOOLS`` (:75:
+search_runbooks, get_known_issues, search_postmortems, get_knowledge_stats,
+list_services), ``MCPServer`` (:386), stdio loop ``runStdioServer`` (:480).
+Hand-rolled JSON-RPC 2.0 speaking the MCP initialize/tools/resources subset.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Optional
+
+PROTOCOL_VERSION = "2024-11-05"
+
+
+class MCPServer:
+    def __init__(self, retriever, graph=None):
+        self.retriever = retriever
+        self.graph = graph
+
+    @classmethod
+    def from_config(cls, config) -> "MCPServer":
+        from runbookai_tpu.knowledge.retriever import create_retriever
+        from runbookai_tpu.knowledge.store.graph import ServiceGraph
+        from runbookai_tpu.utils.config import load_services
+
+        retriever = create_retriever(config)
+        graph = ServiceGraph.from_services_config(load_services())
+        return cls(retriever, graph)
+
+    # ----------------------------------------------------------------- tools
+
+    def list_tools(self) -> list[dict[str, Any]]:
+        def schema(props: dict, req: Optional[list] = None) -> dict:
+            s: dict[str, Any] = {"type": "object", "properties": props}
+            if req:
+                s["required"] = req
+            return s
+
+        q = {"query": {"type": "string"}}
+        return [
+            {"name": "search_runbooks",
+             "description": "Search operational runbooks and procedures.",
+             "inputSchema": schema({**q, "service": {"type": "string"}}, ["query"])},
+            {"name": "get_known_issues",
+             "description": "Find known issues matching symptoms or a service.",
+             "inputSchema": schema({**q, "service": {"type": "string"}})},
+            {"name": "search_postmortems",
+             "description": "Search past incident postmortems.",
+             "inputSchema": schema(q, ["query"])},
+            {"name": "get_knowledge_stats",
+             "description": "Knowledge base statistics.",
+             "inputSchema": schema({})},
+            {"name": "list_services",
+             "description": "List known services and their dependencies.",
+             "inputSchema": schema({"team": {"type": "string"}})},
+        ]
+
+    def call_tool(self, name: str, args: dict[str, Any]) -> Any:
+        if name == "search_runbooks":
+            return self._search(args, knowledge_type="runbook")
+        if name == "get_known_issues":
+            return self._search(args, knowledge_type="known-issue")
+        if name == "search_postmortems":
+            return self._search(args, knowledge_type="postmortem")
+        if name == "get_knowledge_stats":
+            return self.retriever.stats()
+        if name == "list_services":
+            if self.graph is None:
+                return {"services": []}
+            nodes = self.graph.filter(team=args.get("team"))
+            return {"services": [
+                {"name": n.name, "team": n.team, "tier": n.tier,
+                 "depends_on": self.graph.dependencies_of(n.name)}
+                for n in nodes
+            ]}
+        raise KeyError(f"unknown tool {name!r}")
+
+    def _search(self, args: dict[str, Any], knowledge_type: str) -> dict[str, Any]:
+        hits = self.retriever.hybrid.search(
+            str(args.get("query", "")), limit=int(args.get("limit", 6)),
+            knowledge_type=knowledge_type, service=args.get("service"))
+        return {"results": [
+            {"doc_id": h.doc.doc_id, "title": h.doc.title,
+             "section": h.chunk.section, "content": h.chunk.content[:1000],
+             "score": round(h.score, 4)}
+            for h in hits
+        ]}
+
+    # ------------------------------------------------------------- resources
+
+    def list_resources(self) -> list[dict[str, Any]]:
+        stats = self.retriever.stats()
+        return [{
+            "uri": "runbook://knowledge/stats",
+            "name": "knowledge-stats",
+            "description": f"{stats.get('documents', 0)} documents indexed",
+            "mimeType": "application/json",
+        }]
+
+    def read_resource(self, uri: str) -> dict[str, Any]:
+        if uri == "runbook://knowledge/stats":
+            return {"contents": [{"uri": uri, "mimeType": "application/json",
+                                  "text": json.dumps(self.retriever.stats(), default=str)}]}
+        raise KeyError(f"unknown resource {uri!r}")
+
+    # -------------------------------------------------------------- JSON-RPC
+
+    def handle(self, message: dict[str, Any]) -> Optional[dict[str, Any]]:
+        msg_id = message.get("id")
+        method = message.get("method", "")
+        params = message.get("params") or {}
+
+        def ok(result: Any) -> dict[str, Any]:
+            return {"jsonrpc": "2.0", "id": msg_id, "result": result}
+
+        def err(code: int, text: str) -> dict[str, Any]:
+            return {"jsonrpc": "2.0", "id": msg_id,
+                    "error": {"code": code, "message": text}}
+
+        try:
+            if method == "initialize":
+                return ok({
+                    "protocolVersion": PROTOCOL_VERSION,
+                    "capabilities": {"tools": {}, "resources": {}},
+                    "serverInfo": {"name": "runbookai-tpu", "version": "0.1.0"},
+                })
+            if method == "notifications/initialized":
+                return None  # notification, no response
+            if method == "tools/list":
+                return ok({"tools": self.list_tools()})
+            if method == "tools/call":
+                result = self.call_tool(params.get("name", ""),
+                                        params.get("arguments") or {})
+                return ok({"content": [{"type": "text",
+                                        "text": json.dumps(result, default=str)}]})
+            if method == "resources/list":
+                return ok({"resources": self.list_resources()})
+            if method == "resources/read":
+                return ok(self.read_resource(params.get("uri", "")))
+            if method == "ping":
+                return ok({})
+            return err(-32601, f"method not found: {method}")
+        except KeyError as exc:
+            return err(-32602, str(exc))
+        except Exception as exc:  # noqa: BLE001
+            return err(-32603, f"{type(exc).__name__}: {exc}")
+
+
+def run_stdio_server(server: MCPServer, stdin=None, stdout=None) -> None:
+    """Line-delimited JSON-RPC loop (reference runStdioServer :480)."""
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    for line in stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            message = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        response = server.handle(message)
+        if response is not None:
+            stdout.write(json.dumps(response) + "\n")
+            stdout.flush()

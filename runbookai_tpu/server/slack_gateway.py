@@ -1,0 +1,223 @@
+"""Slack gateway: mention commands → agent runs → thread replies.
+
+Parity target: reference ``src/slack/gateway.ts`` — mention command parser
+(:95 — ``@runbookAI <infra|knowledge|deploy|investigate> …``), authorization
+(channels/users/threaded :190), event dedupe cache (:70), request execution
+through the agent (:312), HTTP events mode with signature verification;
+``startSlackGateway`` (:531). Socket mode requires the Slack SDK (not baked
+in) and is gated with a clear error; HTTP events mode is stdlib-only.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+
+import urllib.request
+
+from runbookai_tpu.server.webhook import verify_slack_signature
+
+COMMANDS = ("infra", "knowledge", "deploy", "investigate", "help")
+
+
+@dataclass
+class SlackRequest:
+    command: str
+    text: str
+    channel: str
+    user: str
+    thread_ts: Optional[str] = None
+
+
+def parse_mention_command(text: str) -> Optional[tuple[str, str]]:
+    """'<@U123> investigate PD-1 …' -> ('investigate', 'PD-1 …')."""
+    words = [w for w in text.split() if not (w.startswith("<@") and w.endswith(">"))]
+    if not words:
+        return None
+    head = words[0].lower()
+    if head in COMMANDS:
+        return head, " ".join(words[1:])
+    # Bare questions default to the infra agent path.
+    return "infra", " ".join(words)
+
+
+class DedupeCache:
+    """Slack re-delivers events; remember recently seen ids (gateway.ts:70)."""
+
+    def __init__(self, ttl_s: float = 300.0, max_size: int = 500):
+        self.ttl = ttl_s
+        self.max_size = max_size
+        self._seen: dict[str, float] = {}
+
+    def seen(self, event_id: str) -> bool:
+        now = time.time()
+        if len(self._seen) > self.max_size:
+            self._seen = {k: v for k, v in self._seen.items() if now - v < self.ttl}
+        if event_id in self._seen and now - self._seen[event_id] < self.ttl:
+            return True
+        self._seen[event_id] = now
+        return False
+
+
+@dataclass
+class SlackGateway:
+    config: Any
+    run_request: Callable[[SlackRequest], Any]  # async: SlackRequest -> str
+    post_message: Optional[Callable[[str, str, Optional[str]], None]] = None
+    dedupe: DedupeCache = field(default_factory=DedupeCache)
+
+    # ----------------------------------------------------------------- authz
+
+    def authorized(self, channel: str, user: str, thread_ts: Optional[str]) -> Optional[str]:
+        slack = self.config.incident.slack
+        if slack.allowed_channels and channel not in slack.allowed_channels:
+            return f"channel {channel} not allowed"
+        if slack.allowed_users and user not in slack.allowed_users:
+            return f"user {user} not allowed"
+        if slack.require_thread and not thread_ts:
+            return "mention me in a thread"
+        return None
+
+    # ---------------------------------------------------------------- events
+
+    async def handle_event(self, event: dict[str, Any],
+                           event_id: str = "") -> Optional[str]:
+        if event_id and self.dedupe.seen(event_id):
+            return None
+        if event.get("type") != "app_mention":
+            return None
+        channel = event.get("channel", "")
+        user = event.get("user", "")
+        thread_ts = event.get("thread_ts") or event.get("ts")
+        denial = self.authorized(channel, user, event.get("thread_ts"))
+        if denial:
+            return self._reply(channel, f"Not authorized: {denial}", thread_ts)
+        parsed = parse_mention_command(event.get("text", ""))
+        if parsed is None:
+            return self._reply(channel, "Ask me something after the mention.",
+                               thread_ts)
+        command, text = parsed
+        if command == "help":
+            return self._reply(
+                channel,
+                "Commands: infra <question> | knowledge <query> | "
+                "investigate <incident-id> | deploy <service>", thread_ts)
+        request = SlackRequest(command=command, text=text, channel=channel,
+                               user=user, thread_ts=thread_ts)
+        answer = await self.run_request(request)
+        return self._reply(channel, answer, thread_ts)
+
+    def _reply(self, channel: str, text: str, thread_ts: Optional[str]) -> str:
+        if self.post_message is not None:
+            self.post_message(channel, text, thread_ts)
+        elif self.config.incident.slack.bot_token:
+            post_slack_message(self.config.incident.slack.bot_token,
+                               channel, text, thread_ts)
+        return text
+
+
+def post_slack_message(token: str, channel: str, text: str,
+                       thread_ts: Optional[str] = None) -> None:
+    body = {"channel": channel, "text": text[:39_000]}
+    if thread_ts:
+        body["thread_ts"] = thread_ts
+    req = urllib.request.Request(
+        "https://slack.com/api/chat.postMessage",
+        data=json.dumps(body).encode(),
+        headers={"Authorization": f"Bearer {token}",
+                 "Content-Type": "application/json"},
+    )
+    urllib.request.urlopen(req, timeout=15)
+
+
+# --------------------------------------------------------------------------- #
+# HTTP events mode                                                            #
+# --------------------------------------------------------------------------- #
+
+
+def make_http_handler(gateway: SlackGateway):
+    secret = gateway.config.incident.slack.signing_secret
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _send(self, code: int, payload: dict[str, Any]) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._send(200, {"status": "ok"})
+            else:
+                self._send(404, {})
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            if secret and not verify_slack_signature(
+                secret, self.headers.get("X-Slack-Request-Timestamp", ""),
+                body, self.headers.get("X-Slack-Signature", "")):
+                self._send(401, {"error": "invalid signature"})
+                return
+            try:
+                payload = json.loads(body)
+            except json.JSONDecodeError:
+                self._send(400, {"error": "bad json"})
+                return
+            if payload.get("type") == "url_verification":
+                self._send(200, {"challenge": payload.get("challenge", "")})
+                return
+            event = payload.get("event") or {}
+            # Ack immediately; process the mention in the background thread.
+            self._send(200, {"ok": True})
+            asyncio.run(gateway.handle_event(event,
+                                             payload.get("event_id", "")))
+
+    return Handler
+
+
+def run_slack_gateway(config, mode: str = "http", port: int = 3940) -> None:
+    if mode == "socket":
+        raise SystemExit(
+            "socket mode needs the slack_sdk package (not available in this "
+            "environment); use --mode http with an events subscription")
+
+    from runbookai_tpu.cli.runtime import build_agent, build_orchestrator, build_runtime
+
+    runtime = build_runtime(config, interactive=False)
+
+    async def run_request(request: SlackRequest) -> str:
+        if request.command == "investigate":
+            orch = build_orchestrator(runtime, incident_id=request.text.split()[0]
+                                      if request.text else "")
+            result = await orch.investigate(
+                request.text.split()[0] if request.text else "", request.text)
+            return (f"Root cause: {result.root_cause}\n"
+                    f"Confidence: {result.confidence}\n"
+                    f"Services: {', '.join(result.affected_services)}")
+        if request.command == "knowledge":
+            if runtime.knowledge is None:
+                return "No knowledge base configured."
+            hits = runtime.knowledge.hybrid.search(request.text, limit=5)
+            return "\n".join(f"• {h.doc.title} §{h.chunk.section or '-'}"
+                             for h in hits) or "No results."
+        agent = build_agent(runtime)
+        answer = ""
+        async for ev in agent.run(request.text):
+            if ev.kind == "answer":
+                answer = ev.data["text"]
+        return answer or "(no answer)"
+
+    gateway = SlackGateway(config=config, run_request=run_request)
+    server = ThreadingHTTPServer(("0.0.0.0", port), make_http_handler(gateway))
+    print(f"slack gateway (http events) on :{port}")
+    server.serve_forever()

@@ -1,0 +1,165 @@
+"""Slack approval webhook server.
+
+Parity target: reference ``src/webhooks/slack-webhook.ts`` — Slack signature
+verification, approve/reject button handling writing response files the
+approval flow polls, pending-approval list/cleanup (:322-349), ``/health``;
+``startWebhookServer`` (:278). stdlib ``http.server`` — no framework.
+
+Flow: the approval layer writes ``pending/<id>.json`` and polls
+``responses/<id>.json``; Slack button clicks POST here and produce the
+response file.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any, Optional
+
+PENDING_TTL_S = 3600.0
+
+
+class ApprovalFileStore:
+    """File-based pending/response exchange between webhook and approval flow."""
+
+    def __init__(self, root: str | Path = ".runbook/approvals"):
+        self.root = Path(root)
+        (self.root / "pending").mkdir(parents=True, exist_ok=True)
+        (self.root / "responses").mkdir(parents=True, exist_ok=True)
+
+    def create_pending(self, approval_id: str, payload: dict[str, Any]) -> Path:
+        path = self.root / "pending" / f"{approval_id}.json"
+        path.write_text(json.dumps({"created_at": time.time(), **payload}))
+        return path
+
+    def list_pending(self) -> list[str]:
+        self.cleanup()
+        return sorted(p.stem for p in (self.root / "pending").glob("*.json"))
+
+    def respond(self, approval_id: str, approved: bool, user: str = "") -> bool:
+        pending = self.root / "pending" / f"{approval_id}.json"
+        if not pending.is_file():
+            return False
+        (self.root / "responses" / f"{approval_id}.json").write_text(json.dumps({
+            "approved": approved, "user": user, "ts": time.time()}))
+        pending.unlink()
+        return True
+
+    def poll_response(self, approval_id: str) -> Optional[dict[str, Any]]:
+        path = self.root / "responses" / f"{approval_id}.json"
+        if path.is_file():
+            data = json.loads(path.read_text())
+            path.unlink()
+            return data
+        return None
+
+    def cleanup(self, ttl: float = PENDING_TTL_S) -> int:
+        removed = 0
+        now = time.time()
+        for p in (self.root / "pending").glob("*.json"):
+            try:
+                created = json.loads(p.read_text()).get("created_at", 0)
+            except json.JSONDecodeError:
+                created = 0
+            if now - created > ttl:
+                p.unlink()
+                removed += 1
+        return removed
+
+
+def verify_slack_signature(signing_secret: str, timestamp: str, body: bytes,
+                           signature: str, tolerance_s: float = 300.0) -> bool:
+    """Slack v0 signature scheme with replay-window check."""
+    try:
+        if abs(time.time() - float(timestamp)) > tolerance_s:
+            return False
+    except (TypeError, ValueError):
+        return False
+    base = f"v0:{timestamp}:".encode() + body
+    expected = "v0=" + hmac.new(signing_secret.encode(), base,
+                                hashlib.sha256).hexdigest()
+    return hmac.compare_digest(expected, signature or "")
+
+
+def make_handler(store: ApprovalFileStore, signing_secret: Optional[str]):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _send(self, code: int, payload: dict[str, Any]) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._send(200, {"status": "ok",
+                                 "pending": store.list_pending()})
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            if signing_secret:
+                ok = verify_slack_signature(
+                    signing_secret,
+                    self.headers.get("X-Slack-Request-Timestamp", ""),
+                    body,
+                    self.headers.get("X-Slack-Signature", ""),
+                )
+                if not ok:
+                    self._send(401, {"error": "invalid signature"})
+                    return
+            if self.path == "/slack/actions":
+                payload = self._parse_actions(body)
+                if payload is None:
+                    self._send(400, {"error": "bad payload"})
+                    return
+                action, approval_id, user = payload
+                handled = store.respond(approval_id, action == "approve", user)
+                self._send(200, {"ok": handled,
+                                 "text": f"{'Approved' if action == 'approve' else 'Rejected'}"
+                                         f" by {user}" if handled else
+                                         "approval not found or expired"})
+            else:
+                self._send(404, {"error": "not found"})
+
+        @staticmethod
+        def _parse_actions(body: bytes):
+            """Slack interactive payloads arrive form-encoded under payload=."""
+            try:
+                form = urllib.parse.parse_qs(body.decode())
+                payload = json.loads(form.get("payload", ["{}"])[0])
+                action = payload["actions"][0]
+                action_id = action.get("action_id", "")
+                approval_id = action.get("value", "")
+                user = payload.get("user", {}).get("username", "unknown")
+                if action_id not in ("approve", "reject"):
+                    return None
+                return action_id, approval_id, user
+            except (KeyError, IndexError, json.JSONDecodeError, UnicodeDecodeError):
+                return None
+
+    return Handler
+
+
+def make_server(config, port: int = 3939,
+                store: Optional[ApprovalFileStore] = None) -> ThreadingHTTPServer:
+    store = store or ApprovalFileStore(f"{config.runbook_dir}/approvals")
+    secret = config.incident.slack.signing_secret
+    return ThreadingHTTPServer(("0.0.0.0", port), make_handler(store, secret))
+
+
+def run_webhook_server(config, port: int = 3939) -> None:
+    server = make_server(config, port=port)
+    print(f"webhook server on :{port} (/health, /slack/actions)")
+    server.serve_forever()

@@ -1,0 +1,114 @@
+"""Investigation checkpoint store.
+
+Parity target: reference ``src/session/checkpoint.ts`` (``CheckpointStore``
+:133; metadata + snapshots :22-104; max 50 per investigation :127) with the
+CLI surface ``runbook checkpoint list/show/delete`` (cli.tsx:2353-2430).
+Snapshots capture the FSM state so investigations are resumable after a crash
+or preemption (SURVEY.md §5.3/5.4).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+MAX_CHECKPOINTS_PER_INVESTIGATION = 50
+
+
+@dataclass
+class CheckpointMeta:
+    checkpoint_id: str
+    investigation_id: str
+    phase: str
+    created_at: float
+    label: str = ""
+
+
+class CheckpointStore:
+    def __init__(self, root: str | Path = ".runbook/checkpoints"):
+        self.root = Path(root)
+
+    def _dir(self, investigation_id: str) -> Path:
+        return self.root / investigation_id
+
+    # ------------------------------------------------------------------ save
+
+    def save(self, investigation_id: str, snapshot: dict[str, Any],
+             phase: str = "", label: str = "") -> CheckpointMeta:
+        meta = CheckpointMeta(
+            checkpoint_id=f"cp-{int(time.time())}-{uuid.uuid4().hex[:6]}",
+            investigation_id=investigation_id,
+            phase=phase or str(snapshot.get("phase", "")),
+            created_at=time.time(),
+            label=label,
+        )
+        d = self._dir(investigation_id)
+        d.mkdir(parents=True, exist_ok=True)
+        (d / f"{meta.checkpoint_id}.json").write_text(json.dumps({
+            "meta": asdict(meta), "snapshot": snapshot,
+        }, indent=2, default=str))
+        self._prune(investigation_id)
+        return meta
+
+    def save_machine(self, machine, label: str = "") -> CheckpointMeta:
+        """Checkpoint an InvestigationStateMachine directly."""
+        snapshot = machine.get_summary()
+        snapshot["hypothesis_detail"] = {
+            hid: {
+                "statement": h.statement, "priority": h.priority, "depth": h.depth,
+                "parent_id": h.parent_id, "status": h.status,
+                "confidence": h.confidence, "children": h.children,
+                "evidence": h.evidence,
+            }
+            for hid, h in machine.hypotheses.items()
+        }
+        return self.save(machine.incident_id, snapshot,
+                         phase=machine.phase.value, label=label)
+
+    def _prune(self, investigation_id: str) -> None:
+        files = sorted(self._dir(investigation_id).glob("cp-*.json"))
+        while len(files) > MAX_CHECKPOINTS_PER_INVESTIGATION:
+            files.pop(0).unlink()
+
+    # ------------------------------------------------------------------ read
+
+    def list(self, investigation_id: Optional[str] = None) -> list[CheckpointMeta]:
+        metas: list[CheckpointMeta] = []
+        if not self.root.exists():
+            return metas
+        dirs = [self._dir(investigation_id)] if investigation_id else sorted(
+            p for p in self.root.iterdir() if p.is_dir())
+        for d in dirs:
+            for f in sorted(d.glob("cp-*.json")):
+                try:
+                    raw = json.loads(f.read_text())["meta"]
+                    metas.append(CheckpointMeta(**raw))
+                except (json.JSONDecodeError, KeyError, TypeError):
+                    continue
+        return metas
+
+    def show(self, checkpoint_id: str) -> Optional[dict[str, Any]]:
+        if not self.root.exists():
+            return None
+        for f in self.root.rglob(f"{checkpoint_id}.json"):
+            return json.loads(f.read_text())
+        return None
+
+    def delete(self, checkpoint_id: str) -> bool:
+        if not self.root.exists():
+            return False
+        for f in self.root.rglob(f"{checkpoint_id}.json"):
+            f.unlink()
+            return True
+        return False
+
+    def latest(self, investigation_id: str) -> Optional[dict[str, Any]]:
+        files = sorted(self._dir(investigation_id).glob("cp-*.json")) \
+            if self._dir(investigation_id).exists() else []
+        if not files:
+            return None
+        return json.loads(files[-1].read_text())

@@ -73,6 +73,7 @@ class LLMConfig(BaseModel):
     num_pages: int = 2048  # page pool size (static for XLA)
     max_batch_slots: int = 8  # concurrent sequences in the decode batch
     prefill_chunk: int = 512  # prefill processed in chunks of this many tokens
+    decode_steps: int = 8  # decode tokens per device dispatch (host-sync amortization)
     mesh: MeshConfig = Field(default_factory=MeshConfig)
     guided_json: bool = True  # token-level JSON grammar masks for complete()
 

@@ -1,0 +1,264 @@
+"""Surface layers: demo, CLI commands, checkpoints, MCP server, webhook,
+slack gateway, learning loop."""
+
+import io
+import json
+import urllib.parse
+
+import pytest
+
+from runbookai_tpu.demo.runner import render_event, run_demo
+from runbookai_tpu.session.checkpoint import CheckpointStore
+from runbookai_tpu.utils.config import Config
+
+
+def test_demo_script_plays_and_renders():
+    events = run_demo(sleep=lambda s: None)
+    kinds = [e.kind for e in events]
+    assert kinds[0] == "start" and kinds[-1] == "done"
+    assert kinds.count("hypothesis_created") == 4
+    assert "conclusion" in kinds
+    conclusion = next(e for e in events if e.kind == "conclusion")
+    assert "pool" in conclusion.data["root_cause"]
+    assert "┤" in conclusion.data["chart"]  # chart attached
+    rendered = [render_event(e) for e in events]
+    assert any("ROOT CAUSE" in r for r in rendered)
+    assert any("[CONFIRM" in r.upper() or "confirm" in r for r in rendered)
+
+
+def test_checkpoint_store_roundtrip(tmp_path):
+    from runbookai_tpu.agent.state_machine import InvestigationStateMachine
+
+    store = CheckpointStore(tmp_path)
+    m = InvestigationStateMachine(incident_id="PD-9")
+    m.add_hypothesis("h1", priority=0.7)
+    meta = store.save_machine(m, label="mid")
+    metas = store.list("PD-9")
+    assert len(metas) == 1 and metas[0].label == "mid"
+    shown = store.show(meta.checkpoint_id)
+    assert shown["snapshot"]["hypothesis_detail"]["H1"]["statement"] == "h1"
+    assert store.latest("PD-9")["meta"]["checkpoint_id"] == meta.checkpoint_id
+    assert store.delete(meta.checkpoint_id)
+    assert store.list("PD-9") == []
+
+
+def test_checkpoint_prune_cap(tmp_path):
+    import runbookai_tpu.session.checkpoint as cp
+
+    store = CheckpointStore(tmp_path)
+    orig = cp.MAX_CHECKPOINTS_PER_INVESTIGATION
+    cp.MAX_CHECKPOINTS_PER_INVESTIGATION = 3
+    try:
+        for i in range(5):
+            store.save("inv", {"phase": "x", "i": i})
+        assert len(store.list("inv")) == 3
+    finally:
+        cp.MAX_CHECKPOINTS_PER_INVESTIGATION = orig
+
+
+def test_cli_init_status_config(tmp_path, monkeypatch, capsys):
+    from runbookai_tpu.cli.main import main
+
+    monkeypatch.chdir(tmp_path)
+    assert main(["init", "--template", "simulated"]) == 0
+    assert (tmp_path / ".runbook" / "config.yaml").exists()
+    assert main(["status"]) == 0
+    out = capsys.readouterr().out
+    assert "aws (simulated)" in out
+    assert main(["config", "--set", "agent.max_iterations=4"]) == 0
+    assert main(["config", "--show"]) == 0
+    out = capsys.readouterr().out
+    assert '"max_iterations": 4' in out
+
+
+def test_cli_demo_and_eval_offline(tmp_path, monkeypatch, capsys, request):
+    from runbookai_tpu.cli.main import main
+
+    repo_fixtures = str(
+        (request.config.rootpath / "examples/evals/investigation-fixtures.sample.json")
+    )
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    assert main(["demo", "--fast"]) == 0
+    out = capsys.readouterr().out
+    assert "ROOT CAUSE" in out
+    monkeypatch.chdir(tmp_path)
+    code = main(["eval", "--offline", "--fixtures", repo_fixtures,
+                 "--out", str(tmp_path / "reports")])
+    assert code == 0
+    report = json.loads((tmp_path / "reports" / "investigation.json").read_text())
+    assert report["total"] == 3 and report["passed"] == 2
+
+
+def test_cli_knowledge_roundtrip(tmp_path, monkeypatch, capsys):
+    from runbookai_tpu.cli.main import main
+
+    monkeypatch.chdir(tmp_path)
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "r.md").write_text(
+        "---\ntype: runbook\nservices: [svc-a]\n---\n# Pool runbook\n\nCheck the pool.")
+    cfg_dir = tmp_path / ".runbook"
+    cfg_dir.mkdir()
+    (cfg_dir / "config.yaml").write_text(f"""
+knowledge:
+  db_path: {tmp_path}/kb.db
+  embedder: {{enabled: true, model: bge-test, max_length: 64}}
+  sources:
+    - {{type: filesystem, name: docs, path: {docs}}}
+""")
+    assert main(["knowledge", "sync"]) == 0
+    out = capsys.readouterr().out
+    assert "docs: 1 documents synced" in out
+    assert main(["knowledge", "search", "pool"]) == 0
+    out = capsys.readouterr().out
+    assert "Pool runbook" in out
+    assert main(["knowledge", "stats"]) == 0
+
+
+def test_cli_ask_with_mock_runtime(tmp_path, monkeypatch, capsys):
+    """`runbook ask` through build_runtime with mock provider + simulated tools."""
+    from runbookai_tpu.cli.main import main
+
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / ".runbook").mkdir()
+    (tmp_path / ".runbook" / "config.yaml").write_text("""
+llm: {provider: mock}
+providers:
+  aws: {enabled: true, simulated: true}
+""")
+    assert main(["ask", "what is on fire?", "--yes"]) == 0
+    out = capsys.readouterr().out
+    assert "done" in out
+
+
+def test_mcp_server_protocol(tmp_path):
+    from runbookai_tpu.knowledge.chunker import document_from_markdown
+    from runbookai_tpu.knowledge.retriever import HybridRetriever, KnowledgeRetriever
+    from runbookai_tpu.knowledge.store.sqlite_fts import KnowledgeStore
+    from runbookai_tpu.server.mcp import MCPServer, run_stdio_server
+
+    store = KnowledgeStore(":memory:")
+    store.upsert_document(document_from_markdown(
+        "r.md", "---\ntype: runbook\n---\n# Pool runbook\n\npool saturation steps"))
+    retriever = KnowledgeRetriever(store, HybridRetriever(store))
+    server = MCPServer(retriever)
+
+    init = server.handle({"jsonrpc": "2.0", "id": 1, "method": "initialize"})
+    assert init["result"]["serverInfo"]["name"] == "runbookai-tpu"
+    tools = server.handle({"jsonrpc": "2.0", "id": 2, "method": "tools/list"})
+    names = [t["name"] for t in tools["result"]["tools"]]
+    assert "search_runbooks" in names and "get_knowledge_stats" in names
+    call = server.handle({"jsonrpc": "2.0", "id": 3, "method": "tools/call",
+                          "params": {"name": "search_runbooks",
+                                     "arguments": {"query": "pool"}}})
+    payload = json.loads(call["result"]["content"][0]["text"])
+    assert payload["results"] and "Pool runbook" in payload["results"][0]["title"]
+    bad = server.handle({"jsonrpc": "2.0", "id": 4, "method": "nope"})
+    assert bad["error"]["code"] == -32601
+
+    # stdio loop
+    stdin = io.StringIO(json.dumps({"jsonrpc": "2.0", "id": 9,
+                                    "method": "tools/list"}) + "\n")
+    stdout = io.StringIO()
+    run_stdio_server(server, stdin=stdin, stdout=stdout)
+    reply = json.loads(stdout.getvalue())
+    assert reply["id"] == 9
+
+
+def test_webhook_signature_and_approval_flow(tmp_path):
+    from runbookai_tpu.server.webhook import (
+        ApprovalFileStore,
+        verify_slack_signature,
+    )
+    import hashlib
+    import hmac
+    import time as _time
+
+    secret = "s3cret"
+    ts = str(_time.time())
+    body = b"payload=%7B%7D"
+    sig = "v0=" + hmac.new(secret.encode(), f"v0:{ts}:".encode() + body,
+                           hashlib.sha256).hexdigest()
+    assert verify_slack_signature(secret, ts, body, sig)
+    assert not verify_slack_signature(secret, ts, body, "v0=bad")
+    assert not verify_slack_signature(secret, "123", body, sig)  # stale ts
+
+    store = ApprovalFileStore(tmp_path)
+    store.create_pending("ap-1", {"operation": "rollback"})
+    assert store.list_pending() == ["ap-1"]
+    assert store.poll_response("ap-1") is None
+    assert store.respond("ap-1", True, user="alice")
+    resp = store.poll_response("ap-1")
+    assert resp["approved"] is True and resp["user"] == "alice"
+    assert store.list_pending() == []
+    assert not store.respond("ap-404", True)
+
+
+async def test_slack_gateway_parse_authz_dedupe():
+    from runbookai_tpu.server.slack_gateway import (
+        DedupeCache,
+        SlackGateway,
+        parse_mention_command,
+    )
+
+    assert parse_mention_command("<@U1> investigate PD-1 now") == ("investigate", "PD-1 now")
+    assert parse_mention_command("<@U1> why is checkout slow") == ("infra", "why is checkout slow")
+    assert parse_mention_command("<@U1>") is None
+
+    config = Config.model_validate({
+        "incident": {"slack": {"enabled": True, "allowed_channels": ["C1"],
+                               "allowed_users": ["U-ok"]}}})
+    answered = []
+
+    async def run_request(req):
+        answered.append(req)
+        return f"answer to {req.text}"
+
+    posts = []
+    gw = SlackGateway(config=config, run_request=run_request,
+                      post_message=lambda c, t, th: posts.append((c, t, th)))
+    # unauthorized channel
+    out = await gw.handle_event({"type": "app_mention", "channel": "C2",
+                                 "user": "U-ok", "ts": "1", "text": "<@B> hi"})
+    assert "Not authorized" in out
+    # authorized
+    out = await gw.handle_event({"type": "app_mention", "channel": "C1",
+                                 "user": "U-ok", "ts": "2",
+                                 "text": "<@B> infra what broke"},
+                                event_id="ev1")
+    assert out == "answer to what broke"
+    assert posts[-1][0] == "C1"
+    # dedupe: same event id ignored
+    out2 = await gw.handle_event({"type": "app_mention", "channel": "C1",
+                                  "user": "U-ok", "ts": "2",
+                                  "text": "<@B> infra what broke"},
+                                 event_id="ev1")
+    assert out2 is None and len(answered) == 1
+    cache = DedupeCache(ttl_s=0.0)
+    assert not cache.seen("x")
+
+
+async def test_learning_loop_artifacts(tmp_path):
+    from runbookai_tpu.agent.orchestrator import OrchestratorResult
+    from runbookai_tpu.agent.types import AgentEvent
+    from runbookai_tpu.learning.loop import run_learning_loop
+    from runbookai_tpu.model.client import MockLLMClient
+
+    llm = MockLLMClient([
+        "# Postmortem\n\nPool exhausted.",
+        json.dumps({"suggestions": [{"type": "runbook", "title": "Pool saturation",
+                                     "reason": "recurring", "services": ["payment-api"],
+                                     "outline": "check pool"}]}),
+    ])
+    result = OrchestratorResult(
+        summary={"incident_id": "PD-7"},
+        root_cause="pool exhausted", confidence="high",
+        affected_services=["payment-api"],
+        conclusion_summary="pool too small",
+        events=[AgentEvent("conclusion", {"root_cause": "pool"})],
+    )
+    out = await run_learning_loop(llm, result, out_dir=tmp_path)
+    assert (out / "postmortem-draft.md").read_text().startswith("# Postmortem")
+    suggestions = json.loads((out / "knowledge-suggestions.json").read_text())
+    assert suggestions["suggestions"][0]["title"] == "Pool saturation"
+    assert json.loads((out / "record.json").read_text())["root_cause"] == "pool exhausted"
