@@ -44,7 +44,7 @@ def build_runtime(config: Config, interactive: bool = True,
         audit_dir=f"{config.runbook_dir}/audit",
         approval_callback=make_cli_approval() if interactive else None,
     )
-    tools = get_runtime_tools(config, knowledge=knowledge, safety=safety)
+    tools = get_runtime_tools(config, knowledge=knowledge, safety=safety, llm=llm)
     return Runtime(config=config, llm=llm, tools=tools, knowledge=knowledge,
                    safety=safety)
 

@@ -1,0 +1,165 @@
+"""Built-in skills (reference ``src/skills/builtin/`` — 8 workflows)."""
+
+from __future__ import annotations
+
+from runbookai_tpu.skills.types import SkillDefinition
+
+BUILTIN_SKILLS: list[dict] = [
+    {
+        "id": "investigate-incident",
+        "name": "Investigate incident",
+        "description": "Gather alarms, logs, and recent changes for an incident.",
+        "tags": ["incident", "investigation"],
+        "params": [
+            {"name": "incident_id", "required": True,
+             "description": "Incident id (PD-…)"},
+            {"name": "log_group", "default": "",
+             "description": "Primary log group to inspect"},
+        ],
+        "steps": [
+            {"id": "incident", "action": "pagerduty_get_incident",
+             "parameters": {"incident_id": "{{incident_id}}"},
+             "on_error": "continue"},
+            {"id": "alarms", "action": "cloudwatch_alarms",
+             "parameters": {"state": "ALARM"}, "on_error": "continue"},
+            {"id": "logs", "action": "cloudwatch_logs", "condition": "{{log_group}}",
+             "parameters": {"log_group": "{{log_group}}",
+                            "filter_pattern": "error"},
+             "on_error": "continue"},
+            {"id": "summary", "action": "prompt",
+             "prompt": "Summarize the incident evidence for {{incident_id}}: "
+                       "incident={{steps.incident}} alarms={{steps.alarms}} "
+                       "logs={{steps.logs}}"},
+        ],
+    },
+    {
+        "id": "deploy-service",
+        "name": "Deploy service",
+        "description": "Deploy a service revision with verification.",
+        "tags": ["deploy"],
+        "risk": "high",
+        "params": [
+            {"name": "service", "required": True},
+            {"name": "revision", "required": True},
+            {"name": "dry_run", "default": "false"},
+        ],
+        "steps": [
+            {"id": "pre", "action": "aws_query",
+             "parameters": {"service": "ecs"}, "on_error": "abort"},
+            {"id": "deploy", "action": "aws_mutate",
+             "condition": "{{dry_run}} != true",
+             "parameters": {"operation": "update_service",
+                            "service": "{{service}}",
+                            "params": {"revision": "{{revision}}"}},
+             "requires_approval": True, "on_error": "abort"},
+            {"id": "verify", "action": "aws_query",
+             "parameters": {"service": "ecs"}, "on_error": "continue"},
+        ],
+    },
+    {
+        "id": "scale-service",
+        "name": "Scale service",
+        "description": "Change desired count for a service.",
+        "tags": ["scale"],
+        "risk": "high",
+        "params": [
+            {"name": "service", "required": True},
+            {"name": "desired_count", "required": True, "type": "number"},
+        ],
+        "steps": [
+            {"id": "scale", "action": "aws_mutate",
+             "parameters": {"operation": "scale", "service": "{{service}}",
+                            "params": {"desired_count": "{{desired_count}}"}},
+             "requires_approval": True, "on_error": "abort"},
+            {"id": "verify", "action": "aws_query",
+             "parameters": {"service": "ecs"}, "on_error": "continue"},
+        ],
+    },
+    {
+        "id": "troubleshoot-service",
+        "name": "Troubleshoot service",
+        "description": "Standard triage for a degraded service.",
+        "tags": ["troubleshoot"],
+        "params": [
+            {"name": "service", "required": True},
+            {"name": "namespace", "default": "prod"},
+        ],
+        "steps": [
+            {"id": "pods", "action": "kubernetes_query",
+             "parameters": {"action": "pods", "namespace": "{{namespace}}"},
+             "on_error": "continue"},
+            {"id": "events", "action": "kubernetes_query",
+             "parameters": {"action": "events"}, "on_error": "continue"},
+            {"id": "alarms", "action": "cloudwatch_alarms",
+             "parameters": {"state": "ALARM"}, "on_error": "continue"},
+            {"id": "diagnose", "action": "prompt",
+             "prompt": "Diagnose {{service}} from pods={{steps.pods}} "
+                       "events={{steps.events}} alarms={{steps.alarms}}"},
+        ],
+    },
+    {
+        "id": "rollback-deployment",
+        "name": "Rollback deployment",
+        "description": "Roll a service back to its previous revision.",
+        "tags": ["deploy", "rollback"],
+        "risk": "high",
+        "params": [{"name": "service", "required": True}],
+        "steps": [
+            {"id": "rollback", "action": "aws_mutate",
+             "parameters": {"operation": "rollback", "service": "{{service}}"},
+             "requires_approval": True, "on_error": "retry", "max_retries": 1},
+            {"id": "verify", "action": "aws_query",
+             "parameters": {"service": "ecs"}, "on_error": "continue"},
+        ],
+    },
+    {
+        "id": "cost-analysis",
+        "name": "Cost analysis",
+        "description": "Inventory resources by service for cost review.",
+        "tags": ["cost"],
+        "params": [{"name": "service", "default": "all"}],
+        "steps": [
+            {"id": "inventory", "action": "aws_query",
+             "parameters": {"service": "{{service}}"}, "on_error": "continue"},
+            {"id": "report", "action": "prompt",
+             "prompt": "Review this inventory for cost hot-spots: {{steps.inventory}}"},
+        ],
+    },
+    {
+        "id": "investigate-cost-spike",
+        "name": "Investigate cost spike",
+        "description": "Correlate a cost spike with deploys and scaling events.",
+        "tags": ["cost", "investigation"],
+        "params": [{"name": "timeframe", "default": "7d"}],
+        "steps": [
+            {"id": "inventory", "action": "aws_query",
+             "parameters": {"service": "all"}, "on_error": "continue"},
+            {"id": "events", "action": "datadog",
+             "parameters": {"action": "events"}, "on_error": "continue"},
+            {"id": "analysis", "action": "prompt",
+             "prompt": "Find likely causes of a cost spike in the last "
+                       "{{timeframe}}: inventory={{steps.inventory}} "
+                       "events={{steps.events}}"},
+        ],
+    },
+    {
+        "id": "security-audit",
+        "name": "Security audit",
+        "description": "Read-only security posture sweep.",
+        "tags": ["security"],
+        "params": [],
+        "steps": [
+            {"id": "iam", "action": "aws_query",
+             "parameters": {"service": "iam"}, "on_error": "continue"},
+            {"id": "network", "action": "aws_query",
+             "parameters": {"service": "vpc"}, "on_error": "continue"},
+            {"id": "report", "action": "prompt",
+             "prompt": "Write a short security posture summary: "
+                       "iam={{steps.iam}} network={{steps.network}}"},
+        ],
+    },
+]
+
+
+def builtin_definitions() -> list[SkillDefinition]:
+    return [SkillDefinition.from_dict(raw) for raw in BUILTIN_SKILLS]

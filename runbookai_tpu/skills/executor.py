@@ -1,0 +1,146 @@
+"""Skill executor: param validation, templating, conditions, retries, approvals.
+
+Parity target: reference ``src/skills/executor.ts`` — ``execute`` (:46): param
+validation/defaults (:53-61), condition evaluation (:82), approval callback
+(:96-102), step execution with retry policy (:112-134). Steps resolve
+``{{param}}`` templates and call registry tools or the LLM.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Awaitable, Callable, Optional
+
+from runbookai_tpu.skills.types import (
+    SkillDefinition,
+    SkillResult,
+    SkillStep,
+    StepResult,
+)
+
+_TEMPLATE_RE = re.compile(r"\{\{\s*([\w.]+)\s*\}\}")
+
+
+def render_template(value: Any, params: dict[str, Any]) -> Any:
+    """Resolve {{param}} placeholders recursively. A string that is exactly
+    one placeholder keeps the parameter's native type."""
+    if isinstance(value, str):
+        exact = _TEMPLATE_RE.fullmatch(value.strip())
+        if exact:
+            return params.get(exact.group(1), value)
+        return _TEMPLATE_RE.sub(lambda m: str(params.get(m.group(1), "")), value)
+    if isinstance(value, dict):
+        return {k: render_template(v, params) for k, v in value.items()}
+    if isinstance(value, list):
+        return [render_template(v, params) for v in value]
+    return value
+
+
+def evaluate_condition(condition: Optional[str], params: dict[str, Any]) -> bool:
+    """Tiny condition language: '{{a}} == x', '{{a}} != x', or a bare
+    {{flag}} truthiness check. Malformed conditions default to True
+    (graceful-limits philosophy)."""
+    if not condition:
+        return True
+    rendered = render_template(condition, params)
+    if isinstance(rendered, bool):
+        return rendered
+    text = str(rendered).strip()
+    for op in ("==", "!="):
+        if op in text:
+            left, right = (part.strip().strip("'\"") for part in text.split(op, 1))
+            truthy = {"true": "true", "false": "false"}
+            left_n = truthy.get(left.lower(), left)
+            right_n = truthy.get(right.lower(), right)
+            return (left_n == right_n) if op == "==" else (left_n != right_n)
+    return text.lower() not in ("", "false", "none", "0")
+
+
+class SkillExecutor:
+    def __init__(
+        self,
+        tools: dict[str, Any],  # name -> Tool
+        llm=None,  # optional, for action == "prompt" steps
+        approval_callback: Optional[Callable[[SkillStep, dict], Awaitable[bool]]] = None,
+    ):
+        self.tools = tools
+        self.llm = llm
+        self.approval_callback = approval_callback
+
+    def validate_params(self, skill: SkillDefinition,
+                        args: dict[str, Any]) -> dict[str, Any]:
+        params: dict[str, Any] = {}
+        missing = []
+        for p in skill.params:
+            if p.name in args:
+                params[p.name] = args[p.name]
+            elif p.default is not None:
+                params[p.name] = p.default
+            elif p.required:
+                missing.append(p.name)
+        if missing:
+            raise ValueError(f"missing required params: {', '.join(missing)}")
+        # pass through extras
+        for k, v in args.items():
+            params.setdefault(k, v)
+        return params
+
+    async def execute(self, skill: SkillDefinition,
+                      args: Optional[dict[str, Any]] = None) -> SkillResult:
+        try:
+            params = self.validate_params(skill, args or {})
+        except ValueError as exc:
+            return SkillResult(skill_id=skill.id, status="failed", error=str(exc))
+
+        result = SkillResult(skill_id=skill.id, status="completed")
+        for step in skill.steps:
+            if not evaluate_condition(step.condition, params):
+                result.steps.append(StepResult(step_id=step.id, status="skipped"))
+                continue
+            if step.requires_approval and self.approval_callback is not None:
+                approved = await self.approval_callback(step, params)
+                if not approved:
+                    result.steps.append(StepResult(step_id=step.id, status="rejected"))
+                    if step.on_error == "abort":
+                        result.status = "aborted"
+                        return result
+                    continue
+
+            step_result = await self._run_step(step, params)
+            result.steps.append(step_result)
+            if step_result.status == "failed":
+                if step.on_error == "abort":
+                    result.status = "aborted"
+                    result.error = step_result.error
+                    return result
+                # on_error == continue: carry on
+            else:
+                # expose step output to later templates as {{steps.<id>}}
+                params[f"steps.{step.id}"] = step_result.result
+        return result
+
+    async def _run_step(self, step: SkillStep, params: dict[str, Any]) -> StepResult:
+        attempts = 0
+        max_attempts = 1 + (step.max_retries if step.on_error == "retry" else 0)
+        last_error: Optional[str] = None
+        while attempts < max_attempts:
+            attempts += 1
+            try:
+                if step.action == "prompt":
+                    if self.llm is None:
+                        raise RuntimeError("prompt step but no LLM configured")
+                    prompt = render_template(step.prompt or step.description, params)
+                    output = await self.llm.complete(str(prompt))
+                    return StepResult(step_id=step.id, status="executed",
+                                      result=output, attempts=attempts)
+                tool = self.tools.get(step.action)
+                if tool is None:
+                    raise KeyError(f"tool {step.action!r} not available")
+                rendered = render_template(step.parameters, params)
+                output = await tool.execute(rendered)
+                return StepResult(step_id=step.id, status="executed",
+                                  result=output, attempts=attempts)
+            except Exception as exc:  # noqa: BLE001 — step errors become results
+                last_error = f"{type(exc).__name__}: {exc}"
+        return StepResult(step_id=step.id, status="failed", error=last_error,
+                          attempts=attempts)

@@ -1,0 +1,112 @@
+"""Skill registry: builtin + user skills with lookup by id/tag/service.
+
+Parity target: reference ``src/skills/registry.ts`` — builtin registration,
+``loadUserSkills`` (:55 — YAML from ``.runbook/skills/``, user skills loaded
+first so they can shadow builtins), singleton accessor (:152).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+import yaml
+
+from runbookai_tpu.skills.builtin import builtin_definitions
+from runbookai_tpu.skills.types import SkillDefinition
+from runbookai_tpu.tools.registry import ToolRegistry, object_schema
+
+
+class SkillRegistry:
+    def __init__(self) -> None:
+        self._skills: dict[str, SkillDefinition] = {}
+        for skill in builtin_definitions():
+            self._skills[skill.id] = skill
+
+    def register(self, skill: SkillDefinition) -> None:
+        self._skills[skill.id] = skill  # user skills may shadow builtins
+
+    def load_user_skills(self, root: str | Path = ".runbook/skills") -> int:
+        loaded = 0
+        root = Path(root)
+        if not root.is_dir():
+            return 0
+        for f in sorted([*root.glob("*.yaml"), *root.glob("*.yml")]):
+            try:
+                raw = yaml.safe_load(f.read_text())
+            except yaml.YAMLError:
+                continue
+            if isinstance(raw, dict) and "id" in raw:
+                self.register(SkillDefinition.from_dict(raw))
+                loaded += 1
+        return loaded
+
+    def get(self, skill_id: str) -> Optional[SkillDefinition]:
+        return self._skills.get(skill_id)
+
+    def all(self) -> list[SkillDefinition]:
+        return list(self._skills.values())
+
+    def by_tag(self, tag: str) -> list[SkillDefinition]:
+        return [s for s in self._skills.values() if tag in s.tags]
+
+    def by_service(self, service: str) -> list[SkillDefinition]:
+        return [s for s in self._skills.values() if service in s.services]
+
+
+_singleton: Optional[SkillRegistry] = None
+
+
+def skill_registry() -> SkillRegistry:
+    global _singleton
+    if _singleton is None:
+        _singleton = SkillRegistry()
+    return _singleton
+
+
+def register_skill_tool(reg: ToolRegistry, registry: SkillRegistry,
+                        executor) -> None:
+    """The ``skill`` tool (reference registry.ts:1057): run a workflow."""
+
+    async def run_skill(args):
+        skill_id = str(args.get("skill_id", ""))
+        skill = registry.get(skill_id)
+        if skill is None:
+            return {"error": f"unknown skill {skill_id!r}",
+                    "available": [s.id for s in registry.all()]}
+        result = await executor.execute(skill, args.get("params") or {})
+        return {
+            "skill_id": result.skill_id,
+            "status": result.status,
+            "error": result.error,
+            "steps": [
+                {"id": s.step_id, "status": s.status, "error": s.error,
+                 "result": s.result if not isinstance(s.result, (dict, list))
+                 else s.result}
+                for s in result.steps
+            ],
+        }
+
+    async def list_skills(args):
+        return {"skills": [
+            {"id": s.id, "name": s.name, "description": s.description,
+             "tags": s.tags, "risk": s.risk,
+             "params": [{"name": p.name, "required": p.required,
+                         "default": p.default} for p in s.params]}
+            for s in registry.all()
+        ]}
+
+    reg.define(
+        "skill",
+        "Execute a predefined operational workflow (skill) by id with params. "
+        "Use list_skills to discover available skills.",
+        object_schema({"skill_id": {"type": "string"},
+                       "params": {"type": "object"}}, ["skill_id"]),
+        run_skill, category="skills",
+    )
+    reg.define(
+        "list_skills",
+        "List available operational workflows (skills).",
+        object_schema({}),
+        list_skills, category="skills",
+    )

@@ -1,0 +1,97 @@
+"""Skill definitions: declarative multi-step workflows.
+
+Parity target: reference ``src/skills/types.ts`` (:7-78) — ``SkillDefinition``
+(params, steps), ``SkillStep`` (action = tool name or ``prompt``, templated
+``parameters``, ``condition``, ``requiresApproval``, ``onError``
+continue|abort|retry + maxRetries), execution context/result types.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class SkillParam:
+    name: str
+    description: str = ""
+    required: bool = False
+    default: Any = None
+    type: str = "string"
+
+
+@dataclass
+class SkillStep:
+    id: str
+    action: str  # tool name, or "prompt" for an LLM step
+    description: str = ""
+    parameters: dict[str, Any] = field(default_factory=dict)  # {{param}} templates
+    condition: Optional[str] = None  # e.g. "{{dry_run}} != true"
+    requires_approval: bool = False
+    on_error: str = "abort"  # continue | abort | retry
+    max_retries: int = 2
+    prompt: Optional[str] = None  # for action == "prompt"
+
+
+@dataclass
+class SkillDefinition:
+    id: str
+    name: str
+    description: str = ""
+    tags: list[str] = field(default_factory=list)
+    services: list[str] = field(default_factory=list)
+    params: list[SkillParam] = field(default_factory=list)
+    steps: list[SkillStep] = field(default_factory=list)
+    risk: str = "low"
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "SkillDefinition":
+        return cls(
+            id=str(raw["id"]),
+            name=str(raw.get("name", raw["id"])),
+            description=str(raw.get("description", "")),
+            tags=[str(t) for t in raw.get("tags", [])],
+            services=[str(s) for s in raw.get("services", [])],
+            risk=str(raw.get("risk", "low")),
+            params=[
+                SkillParam(
+                    name=str(p["name"]), description=str(p.get("description", "")),
+                    required=bool(p.get("required", False)),
+                    default=p.get("default"), type=str(p.get("type", "string")),
+                )
+                for p in raw.get("params", [])
+            ],
+            steps=[
+                SkillStep(
+                    id=str(s.get("id", f"step-{i}")),
+                    action=str(s["action"]),
+                    description=str(s.get("description", "")),
+                    parameters=dict(s.get("parameters", {})),
+                    condition=s.get("condition"),
+                    requires_approval=bool(s.get("requires_approval",
+                                                 s.get("requiresApproval", False))),
+                    on_error=str(s.get("on_error", s.get("onError", "abort"))),
+                    max_retries=int(s.get("max_retries", s.get("maxRetries", 2))),
+                    prompt=s.get("prompt"),
+                )
+                for i, s in enumerate(raw.get("steps", []))
+            ],
+        )
+
+
+@dataclass
+class StepResult:
+    step_id: str
+    status: str  # executed | skipped | failed | rejected
+    result: Any = None
+    error: Optional[str] = None
+    attempts: int = 1
+
+
+@dataclass
+class SkillResult:
+    skill_id: str
+    status: str  # completed | aborted | failed
+    steps: list[StepResult] = field(default_factory=list)
+    error: Optional[str] = None

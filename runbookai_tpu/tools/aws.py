@@ -1,0 +1,410 @@
+"""Real AWS tools: declarative service catalog + generic boto3 executor.
+
+Parity target: reference ``src/providers/aws/services.ts`` (49 service
+definitions across 10 categories, each declaring sdk package, client class,
+list/describe operations, pagination, formatter) + ``executor.ts`` (dynamic
+import with cache :12-29, ``executeListOperation`` :98,
+``executeMultiServiceQuery`` :195 parallel fan-out) + ``client.ts``
+(credentials via profile/role/env, multi-region). boto3 replaces the
+per-service SDK packages: one client factory, the catalog keeps the same
+declarative shape. Gated: without boto3/credentials every call returns a
+structured error instead of raising.
+
+Also includes the ``aws_cli`` escape hatch (reference registry.ts:1534) with
+the shell-operator rejection and read-only operation allowlist, and
+``aws_mutate`` (registry.ts:542) risk-gated through the safety manager.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+import shutil
+import subprocess
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from runbookai_tpu.agent.types import RiskLevel
+from runbookai_tpu.tools.registry import ToolRegistry, object_schema
+
+
+@dataclass
+class AWSServiceDef:
+    service_id: str
+    category: str
+    client: str  # boto3 client name
+    list_op: str  # python method name
+    result_key: str
+    name_keys: tuple[str, ...] = ("Name",)
+    params: dict[str, Any] = field(default_factory=dict)
+
+
+def _svc(sid, cat, client, op, key, names=("Name",), **params) -> AWSServiceDef:
+    return AWSServiceDef(sid, cat, client, op, key, tuple(names), dict(params))
+
+
+# The 49-service catalog (categories follow the reference's grouping).
+AWS_SERVICES: list[AWSServiceDef] = [
+    # compute
+    _svc("ec2", "compute", "ec2", "describe_instances", "Reservations", ("InstanceId",)),
+    _svc("ecs", "compute", "ecs", "list_clusters", "clusterArns", ()),
+    _svc("eks", "compute", "eks", "list_clusters", "clusters", ()),
+    _svc("lambda", "compute", "lambda", "list_functions", "Functions", ("FunctionName",)),
+    _svc("lightsail", "compute", "lightsail", "get_instances", "instances", ("name",)),
+    _svc("apprunner", "compute", "apprunner", "list_services", "ServiceSummaryList", ("ServiceName",)),
+    _svc("amplify", "compute", "amplify", "list_apps", "apps", ("name",)),
+    _svc("batch", "compute", "batch", "describe_job_queues", "jobQueues", ("jobQueueName",)),
+    _svc("ecr", "compute", "ecr", "describe_repositories", "repositories", ("repositoryName",)),
+    # database
+    _svc("rds", "database", "rds", "describe_db_instances", "DBInstances", ("DBInstanceIdentifier",)),
+    _svc("dynamodb", "database", "dynamodb", "list_tables", "TableNames", ()),
+    _svc("elasticache", "database", "elasticache", "describe_cache_clusters", "CacheClusters", ("CacheClusterId",)),
+    _svc("docdb", "database", "docdb", "describe_db_clusters", "DBClusters", ("DBClusterIdentifier",)),
+    _svc("neptune", "database", "neptune", "describe_db_clusters", "DBClusters", ("DBClusterIdentifier",)),
+    _svc("redshift", "database", "redshift", "describe_clusters", "Clusters", ("ClusterIdentifier",)),
+    _svc("memorydb", "database", "memorydb", "describe_clusters", "Clusters", ("Name",)),
+    # storage
+    _svc("s3", "storage", "s3", "list_buckets", "Buckets", ("Name",)),
+    _svc("efs", "storage", "efs", "describe_file_systems", "FileSystems", ("FileSystemId",)),
+    _svc("fsx", "storage", "fsx", "describe_file_systems", "FileSystems", ("FileSystemId",)),
+    _svc("backup", "storage", "backup", "list_backup_vaults", "BackupVaultList", ("BackupVaultName",)),
+    # network
+    _svc("vpc", "network", "ec2", "describe_vpcs", "Vpcs", ("VpcId",)),
+    _svc("elb", "network", "elbv2", "describe_load_balancers", "LoadBalancers", ("LoadBalancerName",)),
+    _svc("cloudfront", "network", "cloudfront", "list_distributions", "DistributionList", ("Id",)),
+    _svc("route53", "network", "route53", "list_hosted_zones", "HostedZones", ("Name",)),
+    _svc("apigateway", "network", "apigateway", "get_rest_apis", "items", ("name",)),
+    _svc("apigwv2", "network", "apigatewayv2", "get_apis", "Items", ("Name",)),
+    # security
+    _svc("iam", "security", "iam", "list_roles", "Roles", ("RoleName",)),
+    _svc("secretsmanager", "security", "secretsmanager", "list_secrets", "SecretList", ("Name",)),
+    _svc("kms", "security", "kms", "list_keys", "Keys", ("KeyId",)),
+    _svc("acm", "security", "acm", "list_certificates", "CertificateSummaryList", ("DomainName",)),
+    _svc("waf", "security", "wafv2", "list_web_acls", "WebACLs", ("Name",), Scope="REGIONAL"),
+    # messaging
+    _svc("sqs", "messaging", "sqs", "list_queues", "QueueUrls", ()),
+    _svc("sns", "messaging", "sns", "list_topics", "Topics", ("TopicArn",)),
+    _svc("eventbridge", "messaging", "events", "list_rules", "Rules", ("Name",)),
+    _svc("stepfunctions", "messaging", "stepfunctions", "list_state_machines", "stateMachines", ("name",)),
+    _svc("kinesis", "messaging", "kinesis", "list_streams", "StreamNames", ()),
+    # observability
+    _svc("cloudwatch", "observability", "cloudwatch", "describe_alarms", "MetricAlarms", ("AlarmName",)),
+    _svc("logs", "observability", "logs", "describe_log_groups", "logGroups", ("logGroupName",)),
+    _svc("ssm", "observability", "ssm", "describe_instance_information", "InstanceInformationList", ("InstanceId",)),
+    # devops
+    _svc("cloudformation", "devops", "cloudformation", "describe_stacks", "Stacks", ("StackName",)),
+    _svc("codepipeline", "devops", "codepipeline", "list_pipelines", "pipelines", ("name",)),
+    _svc("codebuild", "devops", "codebuild", "list_projects", "projects", ()),
+    _svc("codecommit", "devops", "codecommit", "list_repositories", "repositories", ("repositoryName",)),
+    # analytics
+    _svc("athena", "analytics", "athena", "list_work_groups", "WorkGroups", ("Name",)),
+    _svc("glue", "analytics", "glue", "get_databases", "DatabaseList", ("Name",)),
+    _svc("opensearch", "analytics", "opensearch", "list_domain_names", "DomainNames", ("DomainName",)),
+    # ml
+    _svc("sagemaker", "ml", "sagemaker", "list_endpoints", "Endpoints", ("EndpointName",)),
+    _svc("bedrock", "ml", "bedrock", "list_foundation_models", "modelSummaries", ("modelId",)),
+    _svc("comprehend", "ml", "comprehend", "list_entity_recognizers", "EntityRecognizerPropertiesList", ()),
+]
+
+SERVICES_BY_ID = {s.service_id: s for s in AWS_SERVICES}
+CATEGORIES = sorted({s.category for s in AWS_SERVICES})
+
+
+class AWSClientManager:
+    """boto3 client cache with profile / role-assumption / region handling."""
+
+    def __init__(self, profile: Optional[str] = None, role_arn: Optional[str] = None,
+                 region: str = "us-east-1"):
+        self.profile = profile
+        self.role_arn = role_arn
+        self.region = region
+        self._session = None
+        self._clients: dict[tuple[str, str], Any] = {}
+
+    def available(self) -> bool:
+        try:
+            import boto3  # noqa: F401
+
+            return True
+        except ImportError:
+            return False
+
+    def _get_session(self):
+        import boto3
+
+        if self._session is None:
+            session = boto3.Session(profile_name=self.profile) if self.profile \
+                else boto3.Session()
+            if self.role_arn:
+                sts = session.client("sts")
+                creds = sts.assume_role(
+                    RoleArn=self.role_arn, RoleSessionName="runbookai-tpu"
+                )["Credentials"]
+                session = boto3.Session(
+                    aws_access_key_id=creds["AccessKeyId"],
+                    aws_secret_access_key=creds["SecretAccessKey"],
+                    aws_session_token=creds["SessionToken"],
+                )
+            self._session = session
+        return self._session
+
+    def client(self, name: str, region: Optional[str] = None):
+        key = (name, region or self.region)
+        if key not in self._clients:
+            self._clients[key] = self._get_session().client(
+                name, region_name=region or self.region)
+        return self._clients[key]
+
+
+def _format_resources(defn: AWSServiceDef, payload: Any) -> list[Any]:
+    items = payload.get(defn.result_key, []) if isinstance(payload, dict) else []
+    if defn.service_id == "ec2":  # Reservations nest Instances
+        items = [i for r in items for i in r.get("Instances", [])]
+    if defn.service_id == "cloudfront" and isinstance(items, dict):
+        items = items.get("Items", [])
+    return items
+
+
+async def execute_list_operation(
+    manager: AWSClientManager, defn: AWSServiceDef, region: Optional[str] = None,
+    max_items: int = 100,
+) -> dict[str, Any]:
+    """Generic paginated list with uniform formatting (executor.ts:98)."""
+
+    def call() -> dict[str, Any]:
+        client = manager.client(defn.client, region)
+        items: list[Any] = []
+        try:
+            paginator = client.get_paginator(defn.list_op)
+            for page in paginator.paginate(**defn.params):
+                items.extend(_format_resources(defn, page))
+                if len(items) >= max_items:
+                    break
+        except Exception:  # noqa: BLE001 — not all ops are paginatable
+            payload = getattr(client, defn.list_op)(**defn.params)
+            items = _format_resources(defn, payload)
+        return {"service": defn.service_id, "category": defn.category,
+                "count": len(items), "resources": items[:max_items]}
+
+    return await asyncio.to_thread(call)
+
+
+async def execute_multi_service_query(
+    manager: AWSClientManager, service: Optional[str] = None,
+    category: Optional[str] = None, region: Optional[str] = None,
+) -> dict[str, Any]:
+    """Service / category / all fan-out, concurrent (executor.ts:195)."""
+    if service and service != "all":
+        defn = SERVICES_BY_ID.get(service)
+        if defn is None:
+            return {"error": f"unknown AWS service {service!r}",
+                    "available": sorted(SERVICES_BY_ID)}
+        targets = [defn]
+    elif category:
+        targets = [s for s in AWS_SERVICES if s.category == category]
+        if not targets:
+            return {"error": f"unknown category {category!r}", "available": CATEGORIES}
+    else:
+        targets = AWS_SERVICES
+
+    async def one(defn: AWSServiceDef) -> tuple[str, Any]:
+        try:
+            return defn.service_id, await execute_list_operation(manager, defn, region)
+        except Exception as exc:  # noqa: BLE001 — per-service failures isolate
+            return defn.service_id, {"error": f"{type(exc).__name__}: {exc}"}
+
+    results = await asyncio.gather(*(one(d) for d in targets))
+    return {sid: payload for sid, payload in results}
+
+
+# --------------------------------------------------------------------------- #
+# aws_cli escape hatch                                                        #
+# --------------------------------------------------------------------------- #
+
+_SHELL_OPERATORS = re.compile(r"[|&;<>`$(){}\\]")
+# Read-only operation prefixes (reference registry.ts:1515 allowlist spirit).
+_READONLY_PREFIXES = ("describe", "get", "list", "lookup", "search", "scan",
+                      "query", "head", "batch-get", "test")
+
+
+def validate_aws_cli_args(args: list[str]) -> Optional[str]:
+    """Reject shell metacharacters and non-read-only operations."""
+    for arg in args:
+        if _SHELL_OPERATORS.search(arg):
+            return f"shell operators are not allowed: {arg!r}"
+    if len(args) < 2:
+        return "expected: <service> <operation> [flags]"
+    op = args[1]
+    if not any(op.startswith(p) for p in _READONLY_PREFIXES):
+        return (f"operation {op!r} is not read-only; use aws_mutate for "
+                "mutations (approval-gated)")
+    return None
+
+
+async def run_aws_cli(args: list[str], timeout: float = 60.0) -> dict[str, Any]:
+    problem = validate_aws_cli_args(args)
+    if problem:
+        return {"error": problem}
+    if shutil.which("aws") is None:
+        return {"error": "aws CLI not installed in this environment"}
+
+    def call() -> dict[str, Any]:
+        proc = subprocess.run(
+            ["aws", *args, "--output", "json"],
+            capture_output=True, text=True, timeout=timeout,
+        )
+        if proc.returncode != 0:
+            return {"error": proc.stderr.strip()[:2000]}
+        return {"output": proc.stdout[:20000]}
+
+    return await asyncio.to_thread(call)
+
+
+# --------------------------------------------------------------------------- #
+# registration                                                                #
+# --------------------------------------------------------------------------- #
+
+
+def register(reg: ToolRegistry, config, safety=None) -> None:
+    aws_cfg = config.providers.aws
+    manager = AWSClientManager(
+        profile=aws_cfg.profile, role_arn=aws_cfg.role_arn,
+        region=aws_cfg.regions[0] if aws_cfg.regions else "us-east-1",
+    )
+
+    async def aws_query(args):
+        if not manager.available():
+            return {"error": "boto3 is not installed; enable simulated mode "
+                             "(providers.aws.simulated: true) or install boto3"}
+        return await execute_multi_service_query(
+            manager, service=args.get("service"), category=args.get("category"),
+            region=args.get("region"))
+
+    async def aws_mutate(args):
+        operation = str(args.get("operation", ""))
+        if safety is not None:
+            from runbookai_tpu.agent.safety import ApprovalRequest, classify_risk
+
+            decision = await safety.gate(ApprovalRequest(
+                operation=operation, risk=classify_risk(operation),
+                description=f"AWS mutation on {args.get('service')}",
+                params=args.get("params") or {},
+                rollback_hint=args.get("rollback"),
+            ))
+            if not decision.approved:
+                return {"status": "rejected", "reason": decision.reason}
+        if not manager.available():
+            return {"error": "boto3 is not installed"}
+
+        def call() -> dict[str, Any]:
+            service = str(args.get("service", ""))
+            params = args.get("params") or {}
+            if operation in ("scale", "update_service"):
+                client = manager.client("ecs")
+                return client.update_service(
+                    cluster=params.get("cluster", "default"), service=service,
+                    **{k: v for k, v in params.items() if k not in ("cluster",)})
+            if operation in ("reboot", "start", "stop"):
+                client = manager.client("ec2")
+                method = {"reboot": "reboot_instances", "start": "start_instances",
+                          "stop": "stop_instances"}[operation]
+                return getattr(client, method)(InstanceIds=params.get("instance_ids", []))
+            if operation == "update_function_configuration":
+                client = manager.client("lambda")
+                return client.update_function_configuration(
+                    FunctionName=service, **params)
+            raise ValueError(f"unsupported operation {operation!r}")
+
+        try:
+            result = await asyncio.to_thread(call)
+            return {"status": "applied", "result": str(result)[:2000]}
+        except Exception as exc:  # noqa: BLE001
+            return {"status": "failed", "error": f"{type(exc).__name__}: {exc}"}
+
+    async def cloudwatch_alarms(args):
+        if not manager.available():
+            return {"error": "boto3 is not installed"}
+
+        def call():
+            client = manager.client("cloudwatch")
+            kwargs = {}
+            if args.get("state"):
+                kwargs["StateValue"] = str(args["state"]).upper()
+            payload = client.describe_alarms(**kwargs)
+            return {"alarms": [
+                {"alarmName": a.get("AlarmName"), "state": a.get("StateValue"),
+                 "metric": a.get("MetricName"), "threshold": a.get("Threshold"),
+                 "reason": a.get("StateReason", "")[:300]}
+                for a in payload.get("MetricAlarms", [])
+            ]}
+
+        return await asyncio.to_thread(call)
+
+    async def cloudwatch_logs(args):
+        if not manager.available():
+            return {"error": "boto3 is not installed"}
+
+        def call():
+            import time as _time
+
+            client = manager.client("logs")
+            minutes = float(args.get("minutes_back", 30))
+            kwargs: dict[str, Any] = {
+                "logGroupName": str(args.get("log_group", "")),
+                "startTime": int((_time.time() - minutes * 60) * 1000),
+                "limit": int(args.get("limit", 100)),
+            }
+            if args.get("filter_pattern"):
+                kwargs["filterPattern"] = str(args["filter_pattern"])
+            payload = client.filter_log_events(**kwargs)
+            return {"events": [
+                {"ts": e.get("timestamp"), "message": e.get("message", "")[:500]}
+                for e in payload.get("events", [])
+            ]}
+
+        return await asyncio.to_thread(call)
+
+    async def aws_cli(args):
+        return await run_aws_cli([str(a) for a in args.get("args", [])])
+
+    reg.define(
+        "aws_query",
+        "Query AWS resources. service: one of the 49 catalog ids or 'all'; "
+        f"category: one of {CATEGORIES}.",
+        object_schema({"service": {"type": "string"},
+                       "category": {"type": "string"},
+                       "region": {"type": "string"}}),
+        aws_query, category="aws",
+    )
+    reg.define(
+        "aws_mutate",
+        "Mutate AWS resources (ECS update/scale, EC2 reboot/start/stop, Lambda "
+        "config). Approval-gated by risk.",
+        object_schema({"operation": {"type": "string"},
+                       "service": {"type": "string"},
+                       "params": {"type": "object"},
+                       "rollback": {"type": "string"}}, ["operation"]),
+        aws_mutate, category="aws", risk=RiskLevel.HIGH,
+    )
+    reg.define(
+        "cloudwatch_alarms",
+        "List CloudWatch alarms, optionally by state.",
+        object_schema({"state": {"type": "string"}}),
+        cloudwatch_alarms, category="aws",
+    )
+    reg.define(
+        "cloudwatch_logs",
+        "Filter CloudWatch log events from a log group.",
+        object_schema({"log_group": {"type": "string"},
+                       "filter_pattern": {"type": "string"},
+                       "minutes_back": {"type": "number"},
+                       "limit": {"type": "number"}}, ["log_group"]),
+        cloudwatch_logs, category="aws",
+    )
+    reg.define(
+        "aws_cli",
+        "Read-only AWS CLI escape hatch: args = ['<service>', '<operation>', "
+        "...flags]. Shell operators rejected; mutations rejected.",
+        object_schema({"args": {"type": "array"}}, ["args"]),
+        aws_cli, category="aws",
+    )

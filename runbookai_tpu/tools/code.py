@@ -1,0 +1,151 @@
+"""Code provider tools: GitHub / GitLab queries including fix-candidate
+retrieval for remediation.
+
+Parity targets: reference ``src/tools/code/github.ts`` (:284) and
+``gitlab.ts`` (:348) — recent PR/MR and commit queries plus the
+``fix_candidates`` action used by the orchestrator's remediation phase
+(investigation-orchestrator.ts:551-628).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Optional
+
+from runbookai_tpu.tools.registry import ToolRegistry, object_schema
+
+
+def _get(url: str, headers: dict[str, str], params: Optional[dict] = None,
+         timeout: float = 20.0) -> Any:
+    import requests
+
+    resp = requests.get(url, headers=headers, params=params or {}, timeout=timeout)
+    resp.raise_for_status()
+    return resp.json()
+
+
+class GitHubClient:
+    def __init__(self, token: str, base_url: Optional[str] = None):
+        self.base = (base_url or "https://api.github.com").rstrip("/")
+        self.headers = {"Authorization": f"Bearer {token}",
+                        "Accept": "application/vnd.github+json"}
+
+    async def recent_prs(self, repo: str, state: str = "closed",
+                         limit: int = 10) -> list[dict[str, Any]]:
+        data = await asyncio.to_thread(
+            _get, f"{self.base}/repos/{repo}/pulls", self.headers,
+            {"state": state, "sort": "updated", "direction": "desc",
+             "per_page": limit})
+        return [{"number": p["number"], "title": p["title"],
+                 "merged_at": p.get("merged_at"), "user": p["user"]["login"],
+                 "url": p["html_url"]} for p in data]
+
+    async def recent_commits(self, repo: str, limit: int = 10) -> list[dict[str, Any]]:
+        data = await asyncio.to_thread(
+            _get, f"{self.base}/repos/{repo}/commits", self.headers,
+            {"per_page": limit})
+        return [{"sha": c["sha"][:10],
+                 "message": c["commit"]["message"].splitlines()[0][:120],
+                 "author": c["commit"]["author"]["name"],
+                 "date": c["commit"]["author"]["date"]} for c in data]
+
+    async def fix_candidates(self, repo: str, keywords: list[str],
+                             limit: int = 5) -> list[dict[str, Any]]:
+        """Recently merged PRs whose titles match incident keywords — the
+        rollback/fix candidates for remediation."""
+        prs = await self.recent_prs(repo, state="closed", limit=30)
+        scored = []
+        for pr in prs:
+            title = pr["title"].lower()
+            hits = sum(1 for k in keywords if k.lower() in title)
+            if pr.get("merged_at"):
+                scored.append((hits, pr))
+        scored.sort(key=lambda t: (t[0], t[1].get("merged_at") or ""), reverse=True)
+        return [{"relevance": hits, **pr} for hits, pr in scored[:limit]]
+
+
+class GitLabClient:
+    def __init__(self, token: str, base_url: Optional[str] = None):
+        self.base = (base_url or "https://gitlab.com").rstrip("/") + "/api/v4"
+        self.headers = {"PRIVATE-TOKEN": token}
+
+    @staticmethod
+    def _project_id(repo: str) -> str:
+        import urllib.parse
+
+        return urllib.parse.quote(repo, safe="")
+
+    async def recent_mrs(self, repo: str, state: str = "merged",
+                         limit: int = 10) -> list[dict[str, Any]]:
+        data = await asyncio.to_thread(
+            _get, f"{self.base}/projects/{self._project_id(repo)}/merge_requests",
+            self.headers, {"state": state, "order_by": "updated_at",
+                           "per_page": limit})
+        return [{"number": m["iid"], "title": m["title"],
+                 "merged_at": m.get("merged_at"), "user": m["author"]["username"],
+                 "url": m["web_url"]} for m in data]
+
+    async def fix_candidates(self, repo: str, keywords: list[str],
+                             limit: int = 5) -> list[dict[str, Any]]:
+        mrs = await self.recent_mrs(repo, state="merged", limit=30)
+        scored = []
+        for mr in mrs:
+            hits = sum(1 for k in keywords if k.lower() in mr["title"].lower())
+            scored.append((hits, mr))
+        scored.sort(key=lambda t: (t[0], t[1].get("merged_at") or ""), reverse=True)
+        return [{"relevance": hits, **mr} for hits, mr in scored[:limit]]
+
+
+def _make_query(client, repos: list[str], kind: str):
+    async def query(args):
+        action = str(args.get("action", "recent_prs"))
+        repo = str(args.get("repo") or (repos[0] if repos else ""))
+        if not repo:
+            return {"error": f"no {kind} repo configured or provided"}
+        try:
+            if action in ("recent_prs", "recent_mrs"):
+                fn = getattr(client, "recent_prs", None) or client.recent_mrs
+                return {"items": await fn(repo, limit=int(args.get("limit", 10)))}
+            if action == "recent_commits" and hasattr(client, "recent_commits"):
+                return {"items": await client.recent_commits(
+                    repo, limit=int(args.get("limit", 10)))}
+            if action == "fix_candidates":
+                keywords = [str(k) for k in args.get("keywords", [])]
+                service = str(args.get("service", ""))
+                if service:
+                    keywords.append(service)
+                return {"candidates": await client.fix_candidates(repo, keywords)}
+            return {"error": f"unknown action {action!r}",
+                    "available": ["recent_prs", "recent_commits", "fix_candidates"]}
+        except Exception as exc:  # noqa: BLE001
+            return {"error": f"{type(exc).__name__}: {exc}"}
+
+    return query
+
+
+def register(reg: ToolRegistry, config) -> None:
+    gh_cfg = config.providers.github
+    gl_cfg = config.providers.gitlab
+    if gh_cfg.enabled:
+        gh = GitHubClient(gh_cfg.token or "", gh_cfg.base_url)
+        reg.define(
+            "github_query",
+            "GitHub queries. action: recent_prs|recent_commits|fix_candidates "
+            "(fix_candidates finds merged PRs matching incident keywords).",
+            object_schema({"action": {"type": "string"}, "repo": {"type": "string"},
+                           "keywords": {"type": "array"},
+                           "service": {"type": "string"},
+                           "limit": {"type": "number"}}, ["action"]),
+            _make_query(gh, gh_cfg.repos, "github"), category="code",
+        )
+    if gl_cfg.enabled:
+        gl = GitLabClient(gl_cfg.token or "", gl_cfg.base_url)
+        reg.define(
+            "gitlab_query",
+            "GitLab queries. action: recent_mrs|fix_candidates.",
+            object_schema({"action": {"type": "string"}, "repo": {"type": "string"},
+                           "keywords": {"type": "array"},
+                           "service": {"type": "string"},
+                           "limit": {"type": "number"}}, ["action"]),
+            _make_query(gl, gl_cfg.repos, "gitlab"), category="code",
+        )

@@ -1,0 +1,298 @@
+"""Incident tools: PagerDuty, Opsgenie, Slack (REST).
+
+Parity targets: reference ``src/tools/incident/pagerduty.ts`` (:145-313),
+``opsgenie.ts`` (:88-263 — get/list alert, get/list incident, add note, ack,
+close), ``slack.ts`` (:72+ Block Kit posts: updates, root-cause summaries,
+thread reads).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Optional
+
+from runbookai_tpu.agent.types import RiskLevel
+from runbookai_tpu.tools.registry import ToolRegistry, object_schema
+
+
+def _request(method: str, url: str, headers: dict[str, str],
+             json_body: Optional[dict] = None, params: Optional[dict] = None,
+             timeout: float = 20.0) -> Any:
+    import requests
+
+    resp = requests.request(method, url, headers=headers, json=json_body,
+                            params=params, timeout=timeout)
+    resp.raise_for_status()
+    return resp.json() if resp.content else {}
+
+
+class PagerDutyClient:
+    def __init__(self, api_key: str):
+        self.headers = {"Authorization": f"Token token={api_key}",
+                        "Content-Type": "application/json"}
+        self.base = "https://api.pagerduty.com"
+
+    async def get_incident(self, incident_id: str) -> Any:
+        return await asyncio.to_thread(
+            _request, "GET", f"{self.base}/incidents/{incident_id}", self.headers)
+
+    async def list_incidents(self, status: Optional[str] = None) -> Any:
+        params = {"statuses[]": status} if status else {}
+        return await asyncio.to_thread(
+            _request, "GET", f"{self.base}/incidents", self.headers, None, params)
+
+    async def add_note(self, incident_id: str, content: str, email: str) -> Any:
+        return await asyncio.to_thread(
+            _request, "POST", f"{self.base}/incidents/{incident_id}/notes",
+            {**self.headers, "From": email},
+            {"note": {"content": content}})
+
+
+class OpsgenieClient:
+    def __init__(self, api_key: str):
+        self.headers = {"Authorization": f"GenieKey {api_key}",
+                        "Content-Type": "application/json"}
+        self.base = "https://api.opsgenie.com/v2"
+        self.base_v1 = "https://api.opsgenie.com/v1"
+
+    async def get_alert(self, alert_id: str) -> Any:
+        return await asyncio.to_thread(
+            _request, "GET", f"{self.base}/alerts/{alert_id}", self.headers)
+
+    async def list_alerts(self, query: str = "") -> Any:
+        return await asyncio.to_thread(
+            _request, "GET", f"{self.base}/alerts", self.headers, None,
+            {"query": query} if query else {})
+
+    async def get_incident(self, incident_id: str) -> Any:
+        return await asyncio.to_thread(
+            _request, "GET", f"{self.base_v1}/incidents/{incident_id}", self.headers)
+
+    async def list_incidents(self, query: str = "") -> Any:
+        return await asyncio.to_thread(
+            _request, "GET", f"{self.base_v1}/incidents", self.headers, None,
+            {"query": query} if query else {})
+
+    async def add_note(self, alert_id: str, note: str) -> Any:
+        return await asyncio.to_thread(
+            _request, "POST", f"{self.base}/alerts/{alert_id}/notes",
+            self.headers, {"note": note})
+
+    async def acknowledge(self, alert_id: str) -> Any:
+        return await asyncio.to_thread(
+            _request, "POST", f"{self.base}/alerts/{alert_id}/acknowledge",
+            self.headers, {})
+
+    async def close(self, alert_id: str) -> Any:
+        return await asyncio.to_thread(
+            _request, "POST", f"{self.base}/alerts/{alert_id}/close",
+            self.headers, {})
+
+
+class SlackClient:
+    def __init__(self, bot_token: str):
+        self.headers = {"Authorization": f"Bearer {bot_token}",
+                        "Content-Type": "application/json"}
+        self.base = "https://slack.com/api"
+
+    async def post_message(self, channel: str, text: str,
+                           blocks: Optional[list] = None,
+                           thread_ts: Optional[str] = None) -> Any:
+        body: dict[str, Any] = {"channel": channel, "text": text[:39_000]}
+        if blocks:
+            body["blocks"] = blocks
+        if thread_ts:
+            body["thread_ts"] = thread_ts
+        return await asyncio.to_thread(
+            _request, "POST", f"{self.base}/chat.postMessage", self.headers, body)
+
+    async def read_thread(self, channel: str, thread_ts: str) -> Any:
+        return await asyncio.to_thread(
+            _request, "GET", f"{self.base}/conversations.replies", self.headers,
+            None, {"channel": channel, "ts": thread_ts})
+
+
+def incident_update_blocks(title: str, status: str, details: str) -> list[dict]:
+    """Block Kit incident update (reference slack.ts:126+)."""
+    return [
+        {"type": "header", "text": {"type": "plain_text", "text": title[:150]}},
+        {"type": "section", "fields": [
+            {"type": "mrkdwn", "text": f"*Status:*\n{status}"},
+        ]},
+        {"type": "section", "text": {"type": "mrkdwn", "text": details[:2900]}},
+    ]
+
+
+def root_cause_blocks(root_cause: str, confidence: str, services: list[str],
+                      remediation: list[str]) -> list[dict]:
+    blocks = [
+        {"type": "header", "text": {"type": "plain_text", "text": "Root cause identified"}},
+        {"type": "section", "text": {"type": "mrkdwn",
+                                     "text": f"*Root cause:* {root_cause[:2800]}"}},
+        {"type": "section", "fields": [
+            {"type": "mrkdwn", "text": f"*Confidence:*\n{confidence}"},
+            {"type": "mrkdwn", "text": f"*Services:*\n{', '.join(services)[:500]}"},
+        ]},
+    ]
+    if remediation:
+        steps = "\n".join(f"{i+1}. {s}" for i, s in enumerate(remediation[:8]))
+        blocks.append({"type": "section",
+                       "text": {"type": "mrkdwn",
+                                "text": f"*Remediation:*\n{steps[:2900]}"}})
+    return blocks
+
+
+def register(reg: ToolRegistry, config) -> None:
+    inc = config.incident
+
+    if inc.pagerduty.enabled and not inc.pagerduty.simulated:
+        pd = PagerDutyClient(inc.pagerduty.api_key or "")
+
+        async def pd_get(args):
+            try:
+                return await pd.get_incident(str(args.get("incident_id", "")))
+            except Exception as exc:  # noqa: BLE001
+                return {"error": f"{type(exc).__name__}: {exc}"}
+
+        async def pd_list(args):
+            try:
+                return await pd.list_incidents(args.get("status"))
+            except Exception as exc:  # noqa: BLE001
+                return {"error": f"{type(exc).__name__}: {exc}"}
+
+        async def pd_note(args):
+            try:
+                return await pd.add_note(str(args.get("incident_id", "")),
+                                         str(args.get("content", "")),
+                                         str(args.get("from_email", "runbook@local")))
+            except Exception as exc:  # noqa: BLE001
+                return {"error": f"{type(exc).__name__}: {exc}"}
+
+        reg.define("pagerduty_get_incident", "Fetch a PagerDuty incident by id.",
+                   object_schema({"incident_id": {"type": "string"}}, ["incident_id"]),
+                   pd_get, category="incident")
+        reg.define("pagerduty_list_incidents",
+                   "List PagerDuty incidents (status: triggered|acknowledged|resolved).",
+                   object_schema({"status": {"type": "string"}}),
+                   pd_list, category="incident")
+        reg.define("pagerduty_add_note", "Add a note to a PagerDuty incident.",
+                   object_schema({"incident_id": {"type": "string"},
+                                  "content": {"type": "string"}},
+                                 ["incident_id", "content"]),
+                   pd_note, category="incident", risk=RiskLevel.LOW)
+
+    if inc.opsgenie.enabled and not inc.opsgenie.simulated:
+        og = OpsgenieClient(inc.opsgenie.api_key or "")
+
+        def wrap(coro_fn):
+            async def inner(args):
+                try:
+                    return await coro_fn(args)
+                except Exception as exc:  # noqa: BLE001
+                    return {"error": f"{type(exc).__name__}: {exc}"}
+
+            return inner
+
+        reg.define("opsgenie_get_alert", "Fetch an Opsgenie alert by id.",
+                   object_schema({"alert_id": {"type": "string"}}, ["alert_id"]),
+                   wrap(lambda a: og.get_alert(str(a.get("alert_id", "")))),
+                   category="incident")
+        reg.define("opsgenie_list_alerts", "List Opsgenie alerts (optional query).",
+                   object_schema({"query": {"type": "string"}}),
+                   wrap(lambda a: og.list_alerts(str(a.get("query", "")))),
+                   category="incident")
+        reg.define("opsgenie_get_incident", "Fetch an Opsgenie incident by id.",
+                   object_schema({"incident_id": {"type": "string"}}, ["incident_id"]),
+                   wrap(lambda a: og.get_incident(str(a.get("incident_id", "")))),
+                   category="incident")
+        reg.define("opsgenie_list_incidents", "List Opsgenie incidents.",
+                   object_schema({"query": {"type": "string"}}),
+                   wrap(lambda a: og.list_incidents(str(a.get("query", "")))),
+                   category="incident")
+        reg.define("opsgenie_add_note", "Add a note to an Opsgenie alert.",
+                   object_schema({"alert_id": {"type": "string"},
+                                  "note": {"type": "string"}}, ["alert_id", "note"]),
+                   wrap(lambda a: og.add_note(str(a.get("alert_id", "")),
+                                              str(a.get("note", "")))),
+                   category="incident", risk=RiskLevel.LOW)
+        reg.define("opsgenie_acknowledge_alert", "Acknowledge an Opsgenie alert.",
+                   object_schema({"alert_id": {"type": "string"}}, ["alert_id"]),
+                   wrap(lambda a: og.acknowledge(str(a.get("alert_id", "")))),
+                   category="incident", risk=RiskLevel.LOW)
+        reg.define("opsgenie_close_alert", "Close an Opsgenie alert.",
+                   object_schema({"alert_id": {"type": "string"}}, ["alert_id"]),
+                   wrap(lambda a: og.close(str(a.get("alert_id", "")))),
+                   category="incident", risk=RiskLevel.HIGH)
+
+    if inc.slack.enabled and inc.slack.bot_token:
+        slack = SlackClient(inc.slack.bot_token)
+        default_channel = inc.slack.default_channel or ""
+
+        async def slack_post_update(args):
+            try:
+                return await slack.post_message(
+                    str(args.get("channel") or default_channel),
+                    str(args.get("text", "")),
+                    blocks=incident_update_blocks(
+                        str(args.get("title", "Incident update")),
+                        str(args.get("status", "investigating")),
+                        str(args.get("text", ""))),
+                    thread_ts=args.get("thread_ts"))
+            except Exception as exc:  # noqa: BLE001
+                return {"error": f"{type(exc).__name__}: {exc}"}
+
+        async def slack_post_root_cause(args):
+            try:
+                return await slack.post_message(
+                    str(args.get("channel") or default_channel),
+                    f"Root cause: {args.get('root_cause', '')}",
+                    blocks=root_cause_blocks(
+                        str(args.get("root_cause", "")),
+                        str(args.get("confidence", "medium")),
+                        [str(s) for s in args.get("services", [])],
+                        [str(s) for s in args.get("remediation", [])]),
+                    thread_ts=args.get("thread_ts"))
+            except Exception as exc:  # noqa: BLE001
+                return {"error": f"{type(exc).__name__}: {exc}"}
+
+        async def slack_read_thread(args):
+            try:
+                return await slack.read_thread(str(args.get("channel", "")),
+                                               str(args.get("thread_ts", "")))
+            except Exception as exc:  # noqa: BLE001
+                return {"error": f"{type(exc).__name__}: {exc}"}
+
+        async def slack_message(args):
+            try:
+                return await slack.post_message(
+                    str(args.get("channel") or default_channel),
+                    str(args.get("text", "")), thread_ts=args.get("thread_ts"))
+            except Exception as exc:  # noqa: BLE001
+                return {"error": f"{type(exc).__name__}: {exc}"}
+
+        reg.define("slack_post_update", "Post a formatted incident update to Slack.",
+                   object_schema({"channel": {"type": "string"},
+                                  "title": {"type": "string"},
+                                  "status": {"type": "string"},
+                                  "text": {"type": "string"},
+                                  "thread_ts": {"type": "string"}}, ["text"]),
+                   slack_post_update, category="incident", risk=RiskLevel.LOW)
+        reg.define("slack_post_root_cause",
+                   "Post a root-cause summary with remediation to Slack.",
+                   object_schema({"channel": {"type": "string"},
+                                  "root_cause": {"type": "string"},
+                                  "confidence": {"type": "string"},
+                                  "services": {"type": "array"},
+                                  "remediation": {"type": "array"},
+                                  "thread_ts": {"type": "string"}}, ["root_cause"]),
+                   slack_post_root_cause, category="incident", risk=RiskLevel.LOW)
+        reg.define("slack_read_thread", "Read a Slack thread's messages.",
+                   object_schema({"channel": {"type": "string"},
+                                  "thread_ts": {"type": "string"}},
+                                 ["channel", "thread_ts"]),
+                   slack_read_thread, category="incident")
+        reg.define("slack_message", "Post a plain message to Slack.",
+                   object_schema({"channel": {"type": "string"},
+                                  "text": {"type": "string"},
+                                  "thread_ts": {"type": "string"}}, ["text"]),
+                   slack_message, category="incident", risk=RiskLevel.LOW)

@@ -1,0 +1,227 @@
+"""Kubernetes tools: kubectl subprocess wrapper, read-only surface exposed.
+
+Parity target: reference ``src/providers/kubernetes/client.ts`` (756 LoC
+kubectl wrapper: spawn with ``-o json``, multi-context; read-only actions
+exposed via ``kubernetes_query`` registry.ts:1696 — status/contexts/
+namespaces/pods/deployments/nodes/events/top_pods/top_nodes; mutating methods
+exist on the client but are not registry-exposed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import shutil
+import subprocess
+from typing import Any, Optional
+
+from runbookai_tpu.tools.registry import ToolRegistry, object_schema
+
+
+class KubernetesClient:
+    def __init__(self, context: Optional[str] = None, timeout: float = 30.0,
+                 kubectl: str = "kubectl"):
+        self.context = context
+        self.timeout = timeout
+        self.kubectl = kubectl
+
+    def available(self) -> bool:
+        return shutil.which(self.kubectl) is not None
+
+    async def _run(self, args: list[str], parse_json: bool = True) -> Any:
+        cmd = [self.kubectl]
+        if self.context:
+            cmd += ["--context", self.context]
+        cmd += args
+        if parse_json:
+            cmd += ["-o", "json"]
+
+        def call():
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=self.timeout)
+            if proc.returncode != 0:
+                raise RuntimeError(proc.stderr.strip()[:1000])
+            return json.loads(proc.stdout) if parse_json else proc.stdout
+
+        return await asyncio.to_thread(call)
+
+    # ------------------------------------------------------------ read-only
+
+    async def contexts(self) -> list[str]:
+        out = await self._run(["config", "get-contexts", "-o", "name"],
+                              parse_json=False)
+        return [l for l in out.splitlines() if l.strip()]
+
+    async def namespaces(self) -> list[str]:
+        data = await self._run(["get", "namespaces"])
+        return [i["metadata"]["name"] for i in data.get("items", [])]
+
+    async def pods(self, namespace: Optional[str] = None) -> list[dict[str, Any]]:
+        args = ["get", "pods"]
+        args += ["-n", namespace] if namespace else ["--all-namespaces"]
+        data = await self._run(args)
+        out = []
+        for item in data.get("items", []):
+            statuses = item.get("status", {}).get("containerStatuses", [])
+            restarts = sum(c.get("restartCount", 0) for c in statuses)
+            out.append({
+                "name": item["metadata"]["name"],
+                "namespace": item["metadata"].get("namespace"),
+                "status": item.get("status", {}).get("phase"),
+                "restarts": restarts,
+                "containers": [
+                    {"name": c.get("name"), "ready": c.get("ready", False),
+                     "state": next(iter(c.get("state", {})), "unknown")}
+                    for c in statuses
+                ],
+            })
+        return out
+
+    async def deployments(self, namespace: Optional[str] = None) -> list[dict[str, Any]]:
+        args = ["get", "deployments"]
+        args += ["-n", namespace] if namespace else ["--all-namespaces"]
+        data = await self._run(args)
+        return [{
+            "name": i["metadata"]["name"],
+            "namespace": i["metadata"].get("namespace"),
+            "replicas": f"{i.get('status', {}).get('readyReplicas', 0)}/"
+                        f"{i.get('spec', {}).get('replicas', 0)}",
+            "images": [c.get("image") for c in
+                       i.get("spec", {}).get("template", {}).get("spec", {})
+                       .get("containers", [])],
+        } for i in data.get("items", [])]
+
+    async def nodes(self) -> list[dict[str, Any]]:
+        data = await self._run(["get", "nodes"])
+        out = []
+        for item in data.get("items", []):
+            conditions = {c["type"]: c["status"]
+                         for c in item.get("status", {}).get("conditions", [])}
+            out.append({
+                "name": item["metadata"]["name"],
+                "status": "Ready" if conditions.get("Ready") == "True" else "NotReady",
+                "conditions": conditions,
+            })
+        return out
+
+    async def events(self, namespace: Optional[str] = None) -> list[dict[str, Any]]:
+        args = ["get", "events", "--sort-by=.lastTimestamp"]
+        args += ["-n", namespace] if namespace else ["--all-namespaces"]
+        data = await self._run(args)
+        return [{
+            "ts": i.get("lastTimestamp"), "type": i.get("type"),
+            "reason": i.get("reason"),
+            "object": f"{i.get('involvedObject', {}).get('kind', '?')}/"
+                      f"{i.get('involvedObject', {}).get('name', '?')}",
+            "message": i.get("message", "")[:300],
+        } for i in data.get("items", [])[-50:]]
+
+    async def logs(self, pod: str, namespace: str = "default",
+                   container: Optional[str] = None, tail: int = 100) -> str:
+        args = ["logs", pod, "-n", namespace, f"--tail={tail}"]
+        if container:
+            args += ["-c", container]
+        return await self._run(args, parse_json=False)
+
+    async def describe(self, kind: str, name: str, namespace: str = "default") -> str:
+        return await self._run(["describe", kind, name, "-n", namespace],
+                               parse_json=False)
+
+    async def top_pods(self, namespace: Optional[str] = None) -> str:
+        args = ["top", "pods"]
+        args += ["-n", namespace] if namespace else ["--all-namespaces"]
+        return await self._run(args, parse_json=False)
+
+    async def top_nodes(self) -> str:
+        return await self._run(["top", "nodes"], parse_json=False)
+
+    async def cluster_info(self) -> str:
+        return await self._run(["cluster-info"], parse_json=False)
+
+    # --------------------------------------------- mutations (NOT registry-exposed)
+
+    async def scale(self, deployment: str, replicas: int,
+                    namespace: str = "default") -> str:
+        return await self._run(
+            ["scale", "deployment", deployment, f"--replicas={replicas}",
+             "-n", namespace], parse_json=False)
+
+    async def rollout_restart(self, deployment: str, namespace: str = "default") -> str:
+        return await self._run(
+            ["rollout", "restart", f"deployment/{deployment}", "-n", namespace],
+            parse_json=False)
+
+    async def rollout_undo(self, deployment: str, namespace: str = "default") -> str:
+        return await self._run(
+            ["rollout", "undo", f"deployment/{deployment}", "-n", namespace],
+            parse_json=False)
+
+    async def rollout_status(self, deployment: str, namespace: str = "default") -> str:
+        return await self._run(
+            ["rollout", "status", f"deployment/{deployment}", "-n", namespace],
+            parse_json=False)
+
+    async def delete_pod(self, pod: str, namespace: str = "default") -> str:
+        return await self._run(["delete", "pod", pod, "-n", namespace],
+                               parse_json=False)
+
+
+def register(reg: ToolRegistry, config) -> None:
+    contexts = config.providers.kubernetes.contexts
+    client = KubernetesClient(context=contexts[0] if contexts else None)
+
+    async def kubernetes_query(args):
+        if not client.available():
+            return {"error": "kubectl not installed; enable simulated mode "
+                             "(providers.kubernetes.simulated: true)"}
+        action = str(args.get("action", "pods"))
+        ns = args.get("namespace")
+        c = KubernetesClient(context=args.get("context") or client.context) \
+            if args.get("context") else client
+        try:
+            if action == "status" or action == "cluster-info":
+                return {"info": await c.cluster_info()}
+            if action == "contexts":
+                return {"contexts": await c.contexts()}
+            if action == "namespaces":
+                return {"namespaces": await c.namespaces()}
+            if action == "pods":
+                return {"pods": await c.pods(ns)}
+            if action == "deployments":
+                return {"deployments": await c.deployments(ns)}
+            if action == "nodes":
+                return {"nodes": await c.nodes()}
+            if action == "events":
+                return {"events": await c.events(ns)}
+            if action == "logs":
+                return {"logs": await c.logs(str(args.get("pod", "")),
+                                             ns or "default",
+                                             args.get("container"),
+                                             int(args.get("tail", 100)))}
+            if action == "describe":
+                return {"description": await c.describe(
+                    str(args.get("kind", "pod")), str(args.get("name", "")),
+                    ns or "default")}
+            if action == "top_pods":
+                return {"top": await c.top_pods(ns)}
+            if action == "top_nodes":
+                return {"top": await c.top_nodes()}
+            return {"error": f"unknown action {action!r}",
+                    "available": ["status", "contexts", "namespaces", "pods",
+                                  "deployments", "nodes", "events", "logs",
+                                  "describe", "top_pods", "top_nodes"]}
+        except Exception as exc:  # noqa: BLE001
+            return {"error": f"{type(exc).__name__}: {exc}"}
+
+    reg.define(
+        "kubernetes_query",
+        "Read-only Kubernetes queries via kubectl. action: status|contexts|"
+        "namespaces|pods|deployments|nodes|events|logs|describe|top_pods|top_nodes.",
+        object_schema({"action": {"type": "string"},
+                       "namespace": {"type": "string"},
+                       "context": {"type": "string"},
+                       "pod": {"type": "string"}, "name": {"type": "string"},
+                       "kind": {"type": "string"}, "container": {"type": "string"},
+                       "tail": {"type": "number"}}, ["action"]),
+        kubernetes_query, category="kubernetes",
+    )

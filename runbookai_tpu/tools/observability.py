@@ -1,0 +1,191 @@
+"""Observability tools: Datadog and Prometheus HTTP clients.
+
+Parity targets: reference ``src/tools/observability/datadog.ts`` (:93-560 —
+action-dispatch tool: metrics, logs, traces, monitors, events, services) and
+``prometheus.ts`` (:116-315 — instant/range PromQL, firing alerts, target
+health, quick health check, COMMON_QUERIES).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Optional
+
+from runbookai_tpu.tools.registry import ToolRegistry, object_schema
+
+
+def _http_get(url: str, headers: dict[str, str], params: dict[str, Any],
+              timeout: float = 20.0) -> Any:
+    import requests
+
+    resp = requests.get(url, headers=headers, params=params, timeout=timeout)
+    resp.raise_for_status()
+    return resp.json()
+
+
+class DatadogClient:
+    def __init__(self, api_key: str, app_key: str, site: str = "datadoghq.com"):
+        self.base = f"https://api.{site}/api"
+        self.headers = {"DD-API-KEY": api_key, "DD-APPLICATION-KEY": app_key}
+
+    async def _get(self, path: str, params: dict[str, Any]) -> Any:
+        return await asyncio.to_thread(
+            _http_get, f"{self.base}{path}", self.headers, params)
+
+    async def metrics(self, query: str, minutes_back: float = 60) -> Any:
+        now = int(time.time())
+        return await self._get("/v1/query", {
+            "query": query, "from": now - int(minutes_back * 60), "to": now})
+
+    async def logs(self, query: str, minutes_back: float = 60, limit: int = 50) -> Any:
+        import requests
+
+        def call():
+            resp = requests.post(
+                f"{self.base}/v2/logs/events/search",
+                headers={**self.headers, "Content-Type": "application/json"},
+                json={"filter": {"query": query,
+                                 "from": f"now-{int(minutes_back)}m", "to": "now"},
+                      "page": {"limit": limit}},
+                timeout=20)
+            resp.raise_for_status()
+            return resp.json()
+
+        return await asyncio.to_thread(call)
+
+    async def monitors(self) -> Any:
+        return await self._get("/v1/monitor", {})
+
+    async def events(self, minutes_back: float = 120) -> Any:
+        now = int(time.time())
+        return await self._get("/v1/events", {
+            "start": now - int(minutes_back * 60), "end": now})
+
+    async def traces(self, query: str, minutes_back: float = 60) -> Any:
+        return await self._get("/v2/spans/events", {
+            "filter[query]": query, "filter[from]": f"now-{int(minutes_back)}m",
+            "filter[to]": "now", "page[limit]": 25})
+
+    async def services(self) -> Any:
+        return await self._get("/v2/services/definitions", {})
+
+
+# Useful canned PromQL (reference prometheus.ts COMMON_QUERIES).
+PROM_COMMON_QUERIES = {
+    "cpu": 'sum(rate(container_cpu_usage_seconds_total[5m])) by (pod)',
+    "memory": 'sum(container_memory_working_set_bytes) by (pod)',
+    "error_rate": 'sum(rate(http_requests_total{status=~"5.."}[5m])) by (service)',
+    "p99_latency": 'histogram_quantile(0.99, sum(rate(http_request_duration_seconds_bucket[5m])) by (le, service))',
+    "up": "up",
+}
+
+
+class PrometheusClient:
+    def __init__(self, base_url: str):
+        self.base = base_url.rstrip("/")
+
+    async def _get(self, path: str, params: dict[str, Any]) -> Any:
+        return await asyncio.to_thread(_http_get, f"{self.base}{path}", {}, params)
+
+    async def query(self, promql: str) -> Any:
+        return await self._get("/api/v1/query", {"query": promql})
+
+    async def query_range(self, promql: str, minutes_back: float = 60,
+                          step: str = "60s") -> Any:
+        now = time.time()
+        return await self._get("/api/v1/query_range", {
+            "query": promql, "start": now - minutes_back * 60, "end": now,
+            "step": step})
+
+    async def alerts(self) -> Any:
+        return await self._get("/api/v1/alerts", {})
+
+    async def targets(self) -> Any:
+        return await self._get("/api/v1/targets", {"state": "active"})
+
+    async def health_check(self) -> dict[str, Any]:
+        """Quick health: firing alerts + down targets (prometheus.ts)."""
+        alerts = await self.alerts()
+        targets = await self.targets()
+        firing = [a for a in alerts.get("data", {}).get("alerts", [])
+                  if a.get("state") == "firing"]
+        down = [t for t in targets.get("data", {}).get("activeTargets", [])
+                if t.get("health") != "up"]
+        return {"firing_alerts": len(firing), "down_targets": len(down),
+                "alerts": firing[:10], "targets_down": down[:10]}
+
+
+def register(reg: ToolRegistry, config) -> None:
+    obs = config.observability
+    if obs.datadog.enabled and not obs.datadog.simulated:
+        dd = DatadogClient(obs.datadog.api_key or "", obs.datadog.app_key or "",
+                           obs.datadog.site)
+
+        async def datadog(args):
+            action = str(args.get("action", "metrics"))
+            try:
+                if action == "metrics":
+                    return await dd.metrics(str(args.get("query", "")),
+                                            float(args.get("minutes_back", 60)))
+                if action == "logs":
+                    return await dd.logs(str(args.get("query", "")),
+                                         float(args.get("minutes_back", 60)))
+                if action == "monitors":
+                    return await dd.monitors()
+                if action == "events":
+                    return await dd.events(float(args.get("minutes_back", 120)))
+                if action == "traces":
+                    return await dd.traces(str(args.get("query", "")))
+                if action == "services":
+                    return await dd.services()
+                return {"error": f"unknown action {action!r}",
+                        "available": ["metrics", "logs", "monitors", "events",
+                                      "traces", "services"]}
+            except Exception as exc:  # noqa: BLE001
+                return {"error": f"{type(exc).__name__}: {exc}"}
+
+        reg.define(
+            "datadog",
+            "Datadog queries. action: metrics|logs|monitors|events|traces|services.",
+            object_schema({"action": {"type": "string"},
+                           "query": {"type": "string"},
+                           "minutes_back": {"type": "number"}}, ["action"]),
+            datadog, category="observability",
+        )
+
+    if obs.prometheus.enabled and not obs.prometheus.simulated:
+        prom = PrometheusClient(obs.prometheus.base_url or "http://localhost:9090")
+
+        async def prometheus(args):
+            action = str(args.get("action", "query"))
+            q = str(args.get("query", ""))
+            q = PROM_COMMON_QUERIES.get(q, q)
+            try:
+                if action == "query":
+                    return await prom.query(q)
+                if action == "query_range":
+                    return await prom.query_range(
+                        q, float(args.get("minutes_back", 60)))
+                if action == "alerts":
+                    return await prom.alerts()
+                if action == "targets":
+                    return await prom.targets()
+                if action == "health":
+                    return await prom.health_check()
+                return {"error": f"unknown action {action!r}",
+                        "available": ["query", "query_range", "alerts",
+                                      "targets", "health"],
+                        "common_queries": sorted(PROM_COMMON_QUERIES)}
+            except Exception as exc:  # noqa: BLE001
+                return {"error": f"{type(exc).__name__}: {exc}"}
+
+        reg.define(
+            "prometheus",
+            "Prometheus queries. action: query|query_range|alerts|targets|health; "
+            f"query accepts PromQL or a common-query name {sorted(PROM_COMMON_QUERIES)}.",
+            object_schema({"action": {"type": "string"},
+                           "query": {"type": "string"},
+                           "minutes_back": {"type": "number"}}, ["action"]),
+            prometheus, category="observability",
+        )

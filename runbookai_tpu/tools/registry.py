@@ -68,7 +68,7 @@ def object_schema(properties: dict[str, Any], required: Optional[list[str]] = No
 
 
 def get_runtime_tools(config, registry: Optional[ToolRegistry] = None,
-                      knowledge=None, safety=None) -> list[Tool]:
+                      knowledge=None, safety=None, llm=None) -> list[Tool]:
     """Build the gated tool list for one agent run from config.
 
     Mirrors ``getRuntimeTools`` (runtime-tools.ts:19): each provider block's
@@ -129,4 +129,29 @@ def get_runtime_tools(config, registry: Optional[ToolRegistry] = None,
         from runbookai_tpu.tools import knowledge_tool
 
         knowledge_tool.register(reg, knowledge)
+
+    # Skills last: the executor closes over the fully-populated tool set.
+    from runbookai_tpu.skills.executor import SkillExecutor
+    from runbookai_tpu.skills.registry import SkillRegistry, register_skill_tool
+
+    skills = SkillRegistry()
+    skills.load_user_skills(f"{getattr(config, 'runbook_dir', '.runbook')}/skills")
+    tool_map = {t.name: t for t in reg.all()}
+
+    approval = None
+    if safety is not None:
+        from runbookai_tpu.agent.safety import ApprovalRequest, RiskLevel
+        from runbookai_tpu.agent.safety import classify_risk as _classify
+
+        async def approval(step, params):  # noqa: F811 — skill approval seam
+            decision = await safety.gate(ApprovalRequest(
+                operation=step.action or step.id,
+                risk=_classify(step.action or step.id, default=RiskLevel.HIGH),
+                description=step.description or f"skill step {step.id}",
+                params=params,
+            ))
+            return decision.approved
+
+    executor = SkillExecutor(tool_map, llm=llm, approval_callback=approval)
+    register_skill_tool(reg, skills, executor)
     return reg.all()

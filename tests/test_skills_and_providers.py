@@ -1,0 +1,231 @@
+"""Skills subsystem + real provider tool plumbing (mocked externals)."""
+
+import json
+
+import pytest
+
+from runbookai_tpu.agent.types import Tool
+from runbookai_tpu.model.client import MockLLMClient
+from runbookai_tpu.skills.executor import (
+    SkillExecutor,
+    evaluate_condition,
+    render_template,
+)
+from runbookai_tpu.skills.registry import SkillRegistry, register_skill_tool
+from runbookai_tpu.skills.types import SkillDefinition
+from runbookai_tpu.tools.registry import ToolRegistry
+
+
+def _tool(name, fn=None, calls=None):
+    async def run(args):
+        if calls is not None:
+            calls.append((name, args))
+        if fn:
+            return fn(args)
+        return {"ok": name}
+
+    return Tool(name=name, description="", parameters={}, execute=run)
+
+
+def test_render_template_types_and_nesting():
+    params = {"service": "payment-api", "count": 4, "steps.pre": {"x": 1}}
+    assert render_template("{{service}}", params) == "payment-api"
+    assert render_template("{{count}}", params) == 4  # native type preserved
+    assert render_template("scale {{service}} to {{count}}", params) == \
+        "scale payment-api to 4"
+    assert render_template({"a": ["{{service}}"], "b": "{{steps.pre}}"}, params) == \
+        {"a": ["payment-api"], "b": {"x": 1}}
+    assert render_template("{{missing}} here", params) == " here"
+
+
+def test_evaluate_condition():
+    assert evaluate_condition(None, {})
+    assert evaluate_condition("{{dry_run}} != true", {"dry_run": "false"})
+    assert not evaluate_condition("{{dry_run}} != true", {"dry_run": True})
+    assert evaluate_condition("{{flag}}", {"flag": "yes"})
+    assert not evaluate_condition("{{flag}}", {"flag": ""})
+
+
+async def test_executor_full_flow_with_retry_and_condition():
+    calls = []
+    attempts = {"n": 0}
+
+    def flaky(args):
+        attempts["n"] += 1
+        if attempts["n"] < 2:
+            raise RuntimeError("transient")
+        return {"recovered": True}
+
+    async def flaky_exec(args):
+        calls.append(("flaky", args))
+        return flaky(args)
+
+    tools = {
+        "a": _tool("a", calls=calls),
+        "flaky": Tool(name="flaky", description="", parameters={}, execute=flaky_exec),
+    }
+    skill = SkillDefinition.from_dict({
+        "id": "s", "name": "s",
+        "params": [{"name": "svc", "required": True},
+                   {"name": "skip_it", "default": "true"}],
+        "steps": [
+            {"id": "one", "action": "a", "parameters": {"service": "{{svc}}"}},
+            {"id": "skipped", "action": "a", "condition": "{{skip_it}} == false"},
+            {"id": "retry", "action": "flaky", "on_error": "retry", "max_retries": 2},
+            {"id": "llm", "action": "prompt", "prompt": "summarize {{steps.one}}"},
+        ],
+    })
+    llm = MockLLMClient(["summary text"])
+    ex = SkillExecutor(tools, llm=llm)
+    result = await ex.execute(skill, {"svc": "payment-api"})
+    assert result.status == "completed"
+    statuses = {s.step_id: s.status for s in result.steps}
+    assert statuses == {"one": "executed", "skipped": "skipped",
+                        "retry": "executed", "llm": "executed"}
+    assert result.steps[2].attempts == 2
+    assert calls[0] == ("a", {"service": "payment-api"})
+    assert "ok" in llm.calls[0]["user"]  # step output templated into prompt
+
+
+async def test_executor_missing_param_and_abort():
+    skill = SkillDefinition.from_dict({
+        "id": "s", "name": "s",
+        "params": [{"name": "must", "required": True}],
+        "steps": [{"id": "x", "action": "nope"}],
+    })
+    ex = SkillExecutor({})
+    res = await ex.execute(skill, {})
+    assert res.status == "failed" and "must" in res.error
+    res2 = await ex.execute(skill, {"must": 1})
+    assert res2.status == "aborted"  # unknown tool aborts by default
+
+
+async def test_executor_approval_rejection():
+    async def deny(step, params):
+        return False
+
+    skill = SkillDefinition.from_dict({
+        "id": "s", "name": "s",
+        "steps": [{"id": "danger", "action": "a", "requires_approval": True,
+                   "on_error": "abort"}],
+    })
+    ex = SkillExecutor({"a": _tool("a")}, approval_callback=deny)
+    res = await ex.execute(skill)
+    assert res.status == "aborted"
+    assert res.steps[0].status == "rejected"
+
+
+def test_registry_builtins_and_user_shadow(tmp_path):
+    reg = SkillRegistry()
+    ids = {s.id for s in reg.all()}
+    assert {"investigate-incident", "deploy-service", "scale-service",
+            "troubleshoot-service", "rollback-deployment", "cost-analysis",
+            "investigate-cost-spike", "security-audit"} <= ids
+    (tmp_path / "custom.yaml").write_text(json.dumps({
+        "id": "deploy-service", "name": "My deploy",
+        "steps": [{"id": "only", "action": "aws_query"}],
+    }))
+    assert reg.load_user_skills(tmp_path) == 1
+    assert reg.get("deploy-service").name == "My deploy"  # user shadows builtin
+    assert reg.by_tag("cost") and reg.get("nope") is None
+
+
+async def test_skill_tool_runs_builtin():
+    reg = ToolRegistry()
+    calls = []
+    for name in ("pagerduty_get_incident", "cloudwatch_alarms", "cloudwatch_logs"):
+        reg.register(_tool(name, calls=calls))
+    skills = SkillRegistry()
+    llm = MockLLMClient(["incident summary"])
+    executor = SkillExecutor({t.name: t for t in reg.all()}, llm=llm)
+    register_skill_tool(reg, skills, executor)
+
+    skill_tool = reg.get("skill")
+    out = await skill_tool.execute({"skill_id": "investigate-incident",
+                                   "params": {"incident_id": "PD-1"}})
+    assert out["status"] == "completed"
+    by_id = {s["id"]: s for s in out["steps"]}
+    assert by_id["incident"]["status"] == "executed"
+    assert by_id["logs"]["status"] == "skipped"  # no log_group param
+    assert by_id["summary"]["result"] == "incident summary"
+    listing = await reg.get("list_skills").execute({})
+    assert len(listing["skills"]) >= 8
+    missing = await skill_tool.execute({"skill_id": "nope"})
+    assert "unknown skill" in missing["error"]
+
+
+def test_aws_catalog_shape():
+    from runbookai_tpu.tools.aws import AWS_SERVICES, CATEGORIES, SERVICES_BY_ID
+
+    assert len(AWS_SERVICES) == 49
+    assert {"compute", "database", "storage", "network", "security",
+            "messaging", "observability", "devops", "analytics", "ml"} == set(CATEGORIES)
+    assert SERVICES_BY_ID["rds"].client == "rds"
+    assert SERVICES_BY_ID["vpc"].client == "ec2"  # vpc rides the ec2 client
+
+
+def test_aws_cli_guardrails():
+    from runbookai_tpu.tools.aws import validate_aws_cli_args
+
+    assert validate_aws_cli_args(["ec2", "describe-instances"]) is None
+    assert "shell operators" in validate_aws_cli_args(["ec2", "describe; rm -rf /"])
+    assert "not read-only" in validate_aws_cli_args(["ec2", "terminate-instances"])
+    assert validate_aws_cli_args(["s3"])  # too short
+
+
+async def test_aws_query_without_boto3():
+    from runbookai_tpu.tools.registry import ToolRegistry
+    from runbookai_tpu.tools import aws as aws_tools
+    from runbookai_tpu.utils.config import Config
+
+    reg = ToolRegistry()
+    cfg = Config.model_validate({"providers": {"aws": {"enabled": True}}})
+    aws_tools.register(reg, cfg)
+    out = await reg.get("aws_query").execute({"service": "rds"})
+    assert "boto3" in out["error"]  # graceful gating, no crash
+
+
+async def test_kubernetes_query_parses_kubectl_json(monkeypatch):
+    from runbookai_tpu.tools.kubernetes import KubernetesClient
+
+    client = KubernetesClient()
+    canned = {
+        "items": [{
+            "metadata": {"name": "pod-1", "namespace": "prod"},
+            "status": {"phase": "Running", "containerStatuses": [
+                {"name": "app", "ready": True, "restartCount": 3,
+                 "state": {"running": {}}}]},
+        }]
+    }
+
+    async def fake_run(args, parse_json=True):
+        assert args[:2] == ["get", "pods"]
+        return canned
+
+    monkeypatch.setattr(client, "_run", fake_run)
+    pods = await client.pods("prod")
+    assert pods == [{"name": "pod-1", "namespace": "prod", "status": "Running",
+                     "restarts": 3,
+                     "containers": [{"name": "app", "ready": True,
+                                     "state": "running"}]}]
+
+
+async def test_github_fix_candidates_ranking(monkeypatch):
+    from runbookai_tpu.tools.code import GitHubClient
+
+    gh = GitHubClient("tok")
+
+    async def fake_prs(repo, state="closed", limit=10):
+        return [
+            {"number": 1, "title": "Tune DB pool settings", "merged_at": "2026-07-01",
+             "user": "a", "url": "u1"},
+            {"number": 2, "title": "Unrelated docs", "merged_at": "2026-07-02",
+             "user": "b", "url": "u2"},
+            {"number": 3, "title": "Fix pool leak in payment-api",
+             "merged_at": "2026-07-03", "user": "c", "url": "u3"},
+        ]
+
+    monkeypatch.setattr(gh, "recent_prs", fake_prs)
+    candidates = await gh.fix_candidates("org/repo", ["pool", "payment-api"])
+    assert candidates[0]["number"] == 3 and candidates[0]["relevance"] == 2
+    assert candidates[1]["number"] == 1
