@@ -1,0 +1,56 @@
+"""Infra context manager: optional pre-discovery of inventory/health/alarms.
+
+Parity target: reference ``src/agent/infra-context.ts`` (:119 class, :597
+factory) — before the loop starts, snapshot AWS inventory, firing alarms, and
+cluster health into a system-prompt block so early iterations skip discovery
+queries.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+
+class InfraContextManager:
+    def __init__(self, executor, max_chars: int = 3000):
+        # executor: ToolExecutor-like (execute(name, params), available())
+        self.executor = executor
+        self.max_chars = max_chars
+        self._block: str = ""
+
+    async def discover(self) -> str:
+        sections: list[str] = []
+
+        async def sample(tool: str, params: dict[str, Any], label: str) -> None:
+            if tool not in self.executor.available():
+                return
+            try:
+                result = await self.executor.execute(tool, params)
+            except Exception:  # noqa: BLE001 — discovery is best-effort
+                return
+            text = json.dumps(result, default=str)
+            if len(text) > 5:
+                sections.append(f"## {label}\n{text[:900]}")
+
+        await sample("cloudwatch_alarms", {"state": "ALARM"}, "Firing alarms")
+        await sample("aws_query", {"service": "ecs"}, "ECS services")
+        await sample("kubernetes_query", {"action": "status"}, "Cluster status")
+        await sample("kubernetes_query", {"action": "deployments"}, "Deployments")
+
+        if sections:
+            self._block = ("# Pre-discovered infrastructure state\n"
+                           + "\n".join(sections))[: self.max_chars]
+        return self._block
+
+    def system_prompt_block(self) -> str:
+        return self._block
+
+
+async def create_infra_context(executor, enabled: bool = True) -> Optional[InfraContextManager]:
+    """Factory (reference infra-context.ts:597): discover up-front or skip."""
+    if not enabled:
+        return None
+    manager = InfraContextManager(executor)
+    await manager.discover()
+    return manager

@@ -343,6 +343,71 @@ def cmd_slack_gateway(args) -> int:
 # --------------------------------------------------------------------------- #
 
 
+def cmd_integrations(args) -> int:
+    from runbookai_tpu.integrations.claude_hooks import (
+        hooks_status,
+        install_hooks,
+        uninstall_hooks,
+    )
+
+    settings = Path(args.settings).expanduser()
+    if args.integrations_cmd == "enable":
+        install_hooks(settings)
+        print(f"hooks installed into {settings}")
+        return 0
+    if args.integrations_cmd == "status":
+        status = hooks_status(settings)
+        for event, on in status.items():
+            print(f"{event:18} {'enabled' if on else '-'}")
+        return 0
+    if args.integrations_cmd == "disable":
+        removed = uninstall_hooks(settings)
+        print("hooks removed" if removed else "no hooks found")
+        return 0
+    return 1
+
+
+def cmd_hook(args) -> int:
+    """Hidden hook entrypoint (reference cli.tsx:1667-1889 `runbook hook`)."""
+    from runbookai_tpu.integrations.claude_hooks import HookHandlers, run_hook_stdin
+    from runbookai_tpu.integrations.session_store import create_session_store
+
+    config = _load(args)
+    retriever = None
+    if Path(config.knowledge.db_path).is_file():
+        from runbookai_tpu.knowledge.retriever import create_retriever
+
+        retriever = create_retriever(config)
+    handlers = HookHandlers(retriever=retriever,
+                            session_store=create_session_store(config))
+    return run_hook_stdin(args.event, handlers)
+
+
+def cmd_operability(args) -> int:
+    config = _load(args)
+    from runbookai_tpu.integrations.operability_ingestion import IngestionClient
+    from runbookai_tpu.integrations.session_store import create_session_store
+    from runbookai_tpu.providers.operability import create_adapter
+
+    adapter = create_adapter(config)
+    client = IngestionClient(adapter,
+                             spool_dir=f"{config.runbook_dir}/operability-spool")
+    if args.operability_cmd == "status":
+        print(json.dumps(client.status(), indent=2))
+        return 0
+    if args.operability_cmd == "replay":
+        print(json.dumps(asyncio.run(client.replay()), indent=2))
+        return 0
+    if args.operability_cmd == "ingest":
+        store = create_session_store(config)
+        events = []
+        for session_id in store.list_sessions():
+            events.extend(store.read(session_id))
+        print(json.dumps(asyncio.run(client.ingest(events)), indent=2))
+        return 0
+    return 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="runbook",
@@ -443,6 +508,25 @@ def build_parser() -> argparse.ArgumentParser:
     sg.add_argument("--mode", choices=["socket", "http"], default="http")
     sg.add_argument("--port", type=int, default=3940)
     sg.set_defaults(fn=cmd_slack_gateway)
+
+    integ = sub.add_parser("integrations", help="editor/agent integrations")
+    integ_sub = integ.add_subparsers(dest="integration", required=True)
+    claude = integ_sub.add_parser("claude")
+    claude_sub = claude.add_subparsers(dest="integrations_cmd", required=True)
+    for name in ("enable", "status", "disable"):
+        c = claude_sub.add_parser(name)
+        c.add_argument("--settings", default="~/.claude/settings.json")
+    integ.set_defaults(fn=cmd_integrations)
+
+    hook = sub.add_parser("hook")  # hidden hook entrypoint (stdin protocol)
+    hook.add_argument("event")
+    hook.set_defaults(fn=cmd_hook)
+
+    op = sub.add_parser("operability", help="operability-context ingestion")
+    op_sub = op.add_subparsers(dest="operability_cmd", required=True)
+    for name in ("ingest", "replay", "status"):
+        op_sub.add_parser(name)
+    op.set_defaults(fn=cmd_operability)
 
     return p
 

@@ -1,0 +1,195 @@
+"""Claude Code hooks integration: install/uninstall + stdin event handlers.
+
+Parity targets: reference ``src/integrations/claude-hooks.ts`` (8 hook events
+:13-21; settings.json install/uninstall/status :306-343) and
+``hook-handlers.ts`` (``handleSessionStart`` :244, ``handleUserPromptSubmit``
+:288 — detect services/symptoms in prompts and inject matching runbooks/known
+issues; ``handlePreToolUse`` :380 — block dangerous commands;
+``handlePostToolUse`` :423; dispatcher :455; stdin JSON protocol :481).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Any, Optional
+
+HOOK_EVENTS = (
+    "SessionStart", "UserPromptSubmit", "PreToolUse", "PostToolUse",
+    "Notification", "Stop", "SubagentStop", "PreCompact",
+)
+
+# Dangerous command patterns blocked by PreToolUse (hook-handlers.ts:380).
+DANGEROUS_PATTERNS = [
+    re.compile(r"\brm\s+(-\w*[rf]\w*\s+)+"),
+    re.compile(r"\bkubectl\s+delete\b"),
+    re.compile(r"\bterraform\s+(destroy|apply)\b"),
+    re.compile(r"\baws\s+\S*\s*(terminate|delete)-"),
+    re.compile(r"\bdrop\s+(table|database)\b", re.IGNORECASE),
+    re.compile(r"\bmkfs\b|\bdd\s+if="),
+    re.compile(r":\s*\(\)\s*\{.*\};\s*:"),  # fork bomb
+]
+
+
+def install_hooks(settings_path: str | Path, command: str = "runbook hook") -> dict[str, Any]:
+    """Add our hook entries to a Claude settings.json (merge-preserving)."""
+    path = Path(settings_path)
+    settings: dict[str, Any] = {}
+    if path.is_file():
+        try:
+            settings = json.loads(path.read_text())
+        except json.JSONDecodeError:
+            settings = {}
+    hooks = settings.setdefault("hooks", {})
+    for event in HOOK_EVENTS:
+        entries = hooks.setdefault(event, [])
+        already = any(
+            h.get("command", "").startswith(command)
+            for entry in entries for h in entry.get("hooks", [])
+        )
+        if not already:
+            entries.append({"hooks": [{"type": "command",
+                                       "command": f"{command} {event}"}]})
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(settings, indent=2))
+    return settings
+
+
+def uninstall_hooks(settings_path: str | Path, command: str = "runbook hook") -> bool:
+    path = Path(settings_path)
+    if not path.is_file():
+        return False
+    try:
+        settings = json.loads(path.read_text())
+    except json.JSONDecodeError:
+        return False
+    hooks = settings.get("hooks", {})
+    changed = False
+    for event in list(hooks):
+        kept = []
+        for entry in hooks[event]:
+            inner = [h for h in entry.get("hooks", [])
+                     if not h.get("command", "").startswith(command)]
+            if inner:
+                entry["hooks"] = inner
+                kept.append(entry)
+            else:
+                changed = True
+        hooks[event] = kept
+        if not kept:
+            del hooks[event]
+    if changed:
+        path.write_text(json.dumps(settings, indent=2))
+    return changed
+
+
+def hooks_status(settings_path: str | Path, command: str = "runbook hook") -> dict[str, bool]:
+    path = Path(settings_path)
+    status = {event: False for event in HOOK_EVENTS}
+    if not path.is_file():
+        return status
+    try:
+        settings = json.loads(path.read_text())
+    except json.JSONDecodeError:
+        return status
+    for event, entries in settings.get("hooks", {}).items():
+        if event in status:
+            status[event] = any(
+                h.get("command", "").startswith(command)
+                for entry in entries for h in entry.get("hooks", []))
+    return status
+
+
+class HookHandlers:
+    def __init__(self, retriever=None, session_store=None):
+        self.retriever = retriever
+        self.session_store = session_store
+
+    # ------------------------------------------------------------- handlers
+
+    def handle_session_start(self, payload: dict[str, Any]) -> dict[str, Any]:
+        self._record("SessionStart", payload)
+        return {"continue": True,
+                "systemMessage": "RunbookAI knowledge hooks active."}
+
+    def handle_user_prompt_submit(self, payload: dict[str, Any]) -> dict[str, Any]:
+        """Inject matching runbooks/known issues for services/symptoms
+        detected in the prompt (hook-handlers.ts:288)."""
+        self._record("UserPromptSubmit", payload)
+        prompt = str(payload.get("prompt", ""))
+        if self.retriever is None or not prompt:
+            return {"continue": True}
+        from runbookai_tpu.agent.memory import extract_services, extract_symptoms
+
+        terms = extract_services(prompt) + extract_symptoms(prompt)
+        if not terms:
+            return {"continue": True}
+        hits = self.retriever.hybrid.search(" ".join(terms[:6]), limit=3)
+        if not hits:
+            return {"continue": True}
+        context = "\n".join(
+            f"- [{h.doc.doc_id}] {h.doc.title} ({h.doc.knowledge_type}): "
+            f"{h.chunk.content[:200]}"
+            for h in hits)
+        return {"continue": True,
+                "hookSpecificOutput": {
+                    "hookEventName": "UserPromptSubmit",
+                    "additionalContext":
+                        f"Relevant operational knowledge:\n{context}"}}
+
+    def handle_pre_tool_use(self, payload: dict[str, Any]) -> dict[str, Any]:
+        """Block dangerous commands (hook-handlers.ts:380)."""
+        self._record("PreToolUse", payload)
+        tool_input = payload.get("tool_input") or {}
+        command = str(tool_input.get("command", ""))
+        for pattern in DANGEROUS_PATTERNS:
+            if pattern.search(command):
+                return {"decision": "block",
+                        "reason": f"runbookai safety: command matches dangerous "
+                                  f"pattern {pattern.pattern!r}"}
+        return {"continue": True}
+
+    def handle_post_tool_use(self, payload: dict[str, Any]) -> dict[str, Any]:
+        self._record("PostToolUse", payload)
+        return {"continue": True}
+
+    def handle_default(self, event: str, payload: dict[str, Any]) -> dict[str, Any]:
+        self._record(event, payload)
+        return {"continue": True}
+
+    def _record(self, event: str, payload: dict[str, Any]) -> None:
+        if self.session_store is not None:
+            self.session_store.append(payload.get("session_id", "unknown"),
+                                      {"event": event, **payload})
+
+    # ----------------------------------------------------------- dispatcher
+
+    def handle_hook_event(self, event: str, payload: dict[str, Any]) -> dict[str, Any]:
+        handlers = {
+            "SessionStart": self.handle_session_start,
+            "UserPromptSubmit": self.handle_user_prompt_submit,
+            "PreToolUse": self.handle_pre_tool_use,
+            "PostToolUse": self.handle_post_tool_use,
+        }
+        handler = handlers.get(event)
+        if handler is None:
+            return self.handle_default(event, payload)
+        return handler(payload)
+
+
+def run_hook_stdin(event: str, handlers: HookHandlers,
+                   stdin=None, stdout=None) -> int:
+    """stdin JSON protocol entrypoint (hook-handlers.ts:481)."""
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    try:
+        payload = json.loads(stdin.read() or "{}")
+    except json.JSONDecodeError:
+        payload = {}
+    result = handlers.handle_hook_event(event, payload)
+    stdout.write(json.dumps(result))
+    stdout.flush()
+    # Exit code 2 signals a block to Claude Code.
+    return 2 if result.get("decision") == "block" else 0

@@ -1,0 +1,110 @@
+"""Operability-context ingestion: spool hook events, replay to the backend.
+
+Parity target: reference ``src/integrations/operability-context-ingestion.ts``
+(client :344 with local spool + replay; claim building from hook payloads
+:293). Events spool locally when the backend is unreachable and replay later
+— ``runbook operability ingest/replay/status`` surface.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from pathlib import Path
+from typing import Any, Optional
+
+from runbookai_tpu.providers.operability import ContextClaim, Provenance
+
+
+def build_claims_from_hook_event(event: dict[str, Any]) -> list[ContextClaim]:
+    """Derive environment claims from a hook payload (ingestion :293)."""
+    from runbookai_tpu.agent.memory import extract_services
+
+    claims: list[ContextClaim] = []
+    tool = str(event.get("tool_name", ""))
+    command = str((event.get("tool_input") or {}).get("command", ""))
+    text = f"{tool} {command} {json.dumps(event.get('tool_response', ''))[:500]}"
+    services = extract_services(text)
+    predicate = None
+    low = command.lower()
+    if any(w in low for w in ("deploy", "rollout", "apply")):
+        predicate = "deployed"
+    elif any(w in low for w in ("scale", "replicas")):
+        predicate = "scaled"
+    elif any(w in low for w in ("config", "env", "secret")):
+        predicate = "config_changed"
+    elif tool:
+        predicate = "inspected"
+    if predicate:
+        for svc in services[:3]:
+            claims.append(ContextClaim(
+                subject=svc, predicate=predicate,
+                value={"tool": tool, "command": command[:200]},
+                confidence=0.6 if predicate != "inspected" else 0.3,
+                provenance=Provenance(source="claude-hooks"),
+            ))
+    return claims
+
+
+class IngestionClient:
+    def __init__(self, adapter=None, spool_dir: str | Path = ".runbook/operability-spool"):
+        self.adapter = adapter  # OperabilityAdapter with session_ingest
+        self.spool = Path(spool_dir)
+
+    # ------------------------------------------------------------------ send
+
+    async def ingest(self, events: list[dict[str, Any]]) -> dict[str, Any]:
+        """Try the backend; on failure spool to disk for later replay."""
+        if self.adapter is not None and self.adapter.supports("session_ingest"):
+            try:
+                result = await self.adapter.ingest_session(events)
+                return {"status": "sent", "count": len(events), "result": result}
+            except Exception as exc:  # noqa: BLE001 — spool on any failure
+                self._spool(events)
+                return {"status": "spooled", "count": len(events),
+                        "reason": f"{type(exc).__name__}: {exc}"}
+        self._spool(events)
+        return {"status": "spooled", "count": len(events),
+                "reason": "no backend with session_ingest"}
+
+    def _spool(self, events: list[dict[str, Any]]) -> Path:
+        self.spool.mkdir(parents=True, exist_ok=True)
+        path = self.spool / f"batch-{int(time.time())}-{uuid.uuid4().hex[:6]}.json"
+        path.write_text(json.dumps({"spooled_at": time.time(), "events": events},
+                                   default=str))
+        return path
+
+    # ---------------------------------------------------------------- replay
+
+    async def replay(self) -> dict[str, Any]:
+        replayed, failed = 0, 0
+        if not self.spool.is_dir():
+            return {"replayed": 0, "failed": 0}
+        for batch in sorted(self.spool.glob("batch-*.json")):
+            try:
+                events = json.loads(batch.read_text()).get("events", [])
+            except json.JSONDecodeError:
+                batch.unlink()
+                continue
+            if self.adapter is None or not self.adapter.supports("session_ingest"):
+                failed += 1
+                continue
+            try:
+                await self.adapter.ingest_session(events)
+                batch.unlink()
+                replayed += 1
+            except Exception:  # noqa: BLE001
+                failed += 1
+        return {"replayed": replayed, "failed": failed}
+
+    def status(self) -> dict[str, Any]:
+        batches = sorted(self.spool.glob("batch-*.json")) if self.spool.is_dir() else []
+        pending_events = 0
+        for b in batches:
+            try:
+                pending_events += len(json.loads(b.read_text()).get("events", []))
+            except json.JSONDecodeError:
+                continue
+        return {"spooled_batches": len(batches), "pending_events": pending_events,
+                "backend": getattr(self.adapter, "name", None)}

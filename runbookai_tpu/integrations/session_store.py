@@ -1,0 +1,115 @@
+"""Claude session store: persists hook event streams for learning ingestion.
+
+Parity target: reference ``src/integrations/claude-session-store.ts`` — local
+or S3 backends with optional mirroring; factory (:345). Events stream into
+per-session JSONL; the learning loop ingests them later
+(``learning/claude-session-ingestion.ts`` equivalent: :func:`ingest_sessions`).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+
+class LocalSessionStore:
+    def __init__(self, root: str | Path = ".runbook/claude-sessions"):
+        self.root = Path(root)
+
+    def _path(self, session_id: str) -> Path:
+        safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in session_id)
+        return self.root / f"{safe}.jsonl"
+
+    def append(self, session_id: str, event: dict[str, Any]) -> None:
+        self.root.mkdir(parents=True, exist_ok=True)
+        with self._path(session_id).open("a") as f:
+            f.write(json.dumps({"ts": time.time(), **event}, default=str) + "\n")
+
+    def read(self, session_id: str) -> list[dict[str, Any]]:
+        path = self._path(session_id)
+        if not path.is_file():
+            return []
+        out = []
+        for line in path.read_text().splitlines():
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+        return out
+
+    def list_sessions(self) -> list[str]:
+        if not self.root.is_dir():
+            return []
+        return sorted(p.stem for p in self.root.glob("*.jsonl"))
+
+
+class S3SessionStore:
+    """S3 backend; requires boto3. Mirrors to a local store when given."""
+
+    def __init__(self, bucket: str, prefix: str = "claude-sessions/",
+                 mirror: Optional[LocalSessionStore] = None):
+        self.bucket = bucket
+        self.prefix = prefix
+        self.mirror = mirror
+
+    def append(self, session_id: str, event: dict[str, Any]) -> None:
+        if self.mirror is not None:
+            self.mirror.append(session_id, event)
+        try:
+            import boto3
+
+            s3 = boto3.client("s3")
+            key = f"{self.prefix}{session_id}/{int(time.time() * 1000)}.json"
+            s3.put_object(Bucket=self.bucket, Key=key,
+                          Body=json.dumps(event, default=str).encode())
+        except Exception:  # noqa: BLE001 — mirroring keeps the local copy
+            pass
+
+    def read(self, session_id: str) -> list[dict[str, Any]]:
+        if self.mirror is not None:
+            return self.mirror.read(session_id)
+        return []
+
+    def list_sessions(self) -> list[str]:
+        if self.mirror is not None:
+            return self.mirror.list_sessions()
+        return []
+
+
+def create_session_store(config):
+    """Factory (claude-session-store.ts:345)."""
+    claude = config.integrations.claude
+    local = LocalSessionStore(claude.session_store_path)
+    if claude.session_store == "s3" and claude.s3_bucket:
+        return S3SessionStore(claude.s3_bucket, mirror=local)
+    return local
+
+
+def ingest_sessions(store, retriever=None) -> dict[str, Any]:
+    """Summarize stored sessions into learning signals: tool usage counts,
+    services touched, blocked commands (claude-session-ingestion.ts)."""
+    from runbookai_tpu.agent.memory import extract_services
+
+    summary: dict[str, Any] = {"sessions": 0, "events": 0,
+                               "tool_counts": {}, "services": {},
+                               "blocked_commands": []}
+    for session_id in store.list_sessions():
+        events = store.read(session_id)
+        if not events:
+            continue
+        summary["sessions"] += 1
+        summary["events"] += len(events)
+        for ev in events:
+            tool = (ev.get("tool_name") or
+                    (ev.get("tool_input") or {}).get("tool"))
+            if tool:
+                summary["tool_counts"][tool] = summary["tool_counts"].get(tool, 0) + 1
+            text = json.dumps(ev, default=str)
+            for svc in extract_services(text[:2000]):
+                summary["services"][svc] = summary["services"].get(svc, 0) + 1
+            if ev.get("decision") == "block":
+                summary["blocked_commands"].append(
+                    str((ev.get("tool_input") or {}).get("command", ""))[:200])
+    return summary

@@ -1,0 +1,239 @@
+"""Operability-context providers: pluggable external context backends.
+
+Parity target: reference ``src/providers/operability-context/`` — ``types.ts``
+(355 LoC: provider-agnostic contract with capabilities :23-32, confidence
+scores, provenance, change claims), ``adapters/http.ts`` (413 LoC generic HTTP
+adapter), named adapters (sourcegraph / entireio / runbook-context / custom),
+``factory.ts``, ``registry.ts``, ``reconcile.ts``. Config-driven selection
+(utils/config.ts:66-73 equivalent: ``providers.operability_context``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+CAPABILITIES = (
+    "session_ingest",  # accept tool/session event streams
+    "blast_radius",  # service impact estimation
+    "similar_incidents",  # retrieval of alike past incidents
+    "change_claims",  # recent-change claims about services
+    "fact_lookup",  # service facts (owners, endpoints, configs)
+)
+
+
+@dataclass
+class Provenance:
+    source: str
+    retrieved_at: float = field(default_factory=time.time)
+    url: Optional[str] = None
+
+
+@dataclass
+class ContextClaim:
+    """One claim about the environment, with confidence + provenance."""
+
+    subject: str  # service / resource name
+    predicate: str  # e.g. "deployed", "config_changed", "scaled"
+    value: Any = None
+    confidence: float = 0.5
+    provenance: Optional[Provenance] = None
+    ts: float = field(default_factory=time.time)
+
+
+@dataclass
+class SimilarIncident:
+    incident_id: str
+    title: str
+    similarity: float
+    root_cause: Optional[str] = None
+
+
+class OperabilityAdapter:
+    """Provider-agnostic contract. Adapters override what they support."""
+
+    name = "base"
+    capabilities: tuple[str, ...] = ()
+
+    def supports(self, capability: str) -> bool:
+        return capability in self.capabilities
+
+    async def ingest_session(self, events: list[dict[str, Any]]) -> dict[str, Any]:
+        raise NotImplementedError
+
+    async def blast_radius(self, service: str) -> list[str]:
+        raise NotImplementedError
+
+    async def similar_incidents(self, description: str) -> list[SimilarIncident]:
+        raise NotImplementedError
+
+    async def change_claims(self, service: str) -> list[ContextClaim]:
+        raise NotImplementedError
+
+    async def fact_lookup(self, service: str) -> dict[str, Any]:
+        raise NotImplementedError
+
+
+class HTTPAdapter(OperabilityAdapter):
+    """Generic REST adapter (reference adapters/http.ts): capability routes
+    are conventional paths under a base URL."""
+
+    name = "http"
+
+    def __init__(self, base_url: str, token: Optional[str] = None,
+                 capabilities: Optional[list[str]] = None, name: str = "http"):
+        self.base = base_url.rstrip("/")
+        self.token = token
+        self.name = name
+        self.capabilities = tuple(capabilities or CAPABILITIES)
+
+    async def _request(self, method: str, path: str,
+                       payload: Optional[dict] = None) -> Any:
+        import requests
+
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+
+        def call():
+            resp = requests.request(method, f"{self.base}{path}",
+                                    headers=headers, json=payload, timeout=20)
+            resp.raise_for_status()
+            return resp.json() if resp.content else {}
+
+        return await asyncio.to_thread(call)
+
+    async def ingest_session(self, events):
+        return await self._request("POST", "/v1/sessions/ingest",
+                                   {"events": events})
+
+    async def blast_radius(self, service):
+        data = await self._request("GET", f"/v1/services/{service}/blast-radius")
+        return [str(s) for s in data.get("services", [])]
+
+    async def similar_incidents(self, description):
+        data = await self._request("POST", "/v1/incidents/similar",
+                                   {"description": description})
+        return [SimilarIncident(
+            incident_id=str(i.get("id", "")), title=str(i.get("title", "")),
+            similarity=float(i.get("similarity", 0)),
+            root_cause=i.get("root_cause"),
+        ) for i in data.get("incidents", [])]
+
+    async def change_claims(self, service):
+        data = await self._request("GET", f"/v1/services/{service}/changes")
+        return [ContextClaim(
+            subject=service, predicate=str(c.get("type", "changed")),
+            value=c.get("detail"), confidence=float(c.get("confidence", 0.5)),
+            provenance=Provenance(source=self.name, url=c.get("url")),
+        ) for c in data.get("changes", [])]
+
+    async def fact_lookup(self, service):
+        return await self._request("GET", f"/v1/services/{service}")
+
+
+class SourcegraphAdapter(HTTPAdapter):
+    """Code-search backend: change claims from recent commits/diffs."""
+
+    def __init__(self, base_url: str, token: Optional[str] = None):
+        super().__init__(base_url, token, ["change_claims", "fact_lookup"],
+                         name="sourcegraph")
+
+
+class EntireIOAdapter(HTTPAdapter):
+    def __init__(self, base_url: str, token: Optional[str] = None):
+        super().__init__(base_url, token,
+                         ["session_ingest", "similar_incidents", "blast_radius"],
+                         name="entireio")
+
+
+class RunbookContextAdapter(HTTPAdapter):
+    def __init__(self, base_url: str, token: Optional[str] = None):
+        super().__init__(base_url, token, list(CAPABILITIES),
+                         name="runbook-context")
+
+
+class LocalGraphAdapter(OperabilityAdapter):
+    """In-process fallback over the local service graph + knowledge store —
+    gives blast_radius / similar_incidents without any external backend."""
+
+    name = "local"
+    capabilities = ("blast_radius", "similar_incidents", "fact_lookup")
+
+    def __init__(self, graph=None, retriever=None):
+        self.graph = graph
+        self.retriever = retriever
+
+    async def blast_radius(self, service):
+        if self.graph is None:
+            return []
+        return self.graph.downstream_impact(service)
+
+    async def similar_incidents(self, description):
+        if self.retriever is None:
+            return []
+        hits = self.retriever.hybrid.search(description, limit=5,
+                                            knowledge_type="postmortem")
+        return [SimilarIncident(
+            incident_id=h.doc.doc_id, title=h.doc.title,
+            similarity=min(1.0, h.score), root_cause=None,
+        ) for h in hits]
+
+    async def fact_lookup(self, service):
+        if self.graph is None or service not in self.graph.nodes:
+            return {}
+        node = self.graph.nodes[service]
+        return {"name": node.name, "team": node.team, "tier": node.tier,
+                "tags": node.tags,
+                "depends_on": self.graph.dependencies_of(service)}
+
+
+def create_adapter(config, graph=None, retriever=None) -> Optional[OperabilityAdapter]:
+    """Factory (reference factory.ts): config-driven adapter selection."""
+    oc = config.providers.operability_context
+    if not oc.enabled:
+        return None
+    if oc.adapter == "sourcegraph" and oc.base_url:
+        return SourcegraphAdapter(oc.base_url, oc.token)
+    if oc.adapter == "entireio" and oc.base_url:
+        return EntireIOAdapter(oc.base_url, oc.token)
+    if oc.adapter == "runbook-context" and oc.base_url:
+        return RunbookContextAdapter(oc.base_url, oc.token)
+    if oc.adapter in ("http", "custom") and oc.base_url:
+        return HTTPAdapter(oc.base_url, oc.token,
+                           oc.capabilities or None, name=oc.adapter)
+    return LocalGraphAdapter(graph=graph, retriever=retriever)
+
+
+class AdapterRegistry:
+    """Multiple adapters with capability routing (reference registry.ts)."""
+
+    def __init__(self) -> None:
+        self._adapters: list[OperabilityAdapter] = []
+
+    def register(self, adapter: OperabilityAdapter) -> None:
+        self._adapters.append(adapter)
+
+    def for_capability(self, capability: str) -> list[OperabilityAdapter]:
+        return [a for a in self._adapters if a.supports(capability)]
+
+
+def reconcile_claims(claims: list[ContextClaim],
+                     min_confidence: float = 0.3) -> list[ContextClaim]:
+    """Merge duplicate (subject, predicate) claims (reference reconcile.ts):
+    keep the highest-confidence instance, boost confidence when independent
+    sources agree, drop below-threshold leftovers."""
+    grouped: dict[tuple[str, str], list[ContextClaim]] = {}
+    for claim in claims:
+        grouped.setdefault((claim.subject, claim.predicate), []).append(claim)
+    out: list[ContextClaim] = []
+    for group in grouped.values():
+        best = max(group, key=lambda c: c.confidence)
+        sources = {c.provenance.source for c in group if c.provenance}
+        if len(sources) > 1:
+            best.confidence = min(1.0, best.confidence + 0.15 * (len(sources) - 1))
+        if best.confidence >= min_confidence:
+            out.append(best)
+    return sorted(out, key=lambda c: c.confidence, reverse=True)

@@ -1,0 +1,230 @@
+"""Context managers, operability providers, claude-hooks integrations."""
+
+import io
+import json
+
+import pytest
+
+from runbookai_tpu.agent.infra_context import create_infra_context
+from runbookai_tpu.agent.knowledge_context import KnowledgeContextManager
+from runbookai_tpu.agent.orchestrator import ToolExecutor
+from runbookai_tpu.agent.service_context import ServiceContextManager
+from runbookai_tpu.agent.types import KnowledgeResult, RetrievedKnowledge
+from runbookai_tpu.integrations.claude_hooks import (
+    HookHandlers,
+    hooks_status,
+    install_hooks,
+    run_hook_stdin,
+    uninstall_hooks,
+)
+from runbookai_tpu.integrations.operability_ingestion import (
+    IngestionClient,
+    build_claims_from_hook_event,
+)
+from runbookai_tpu.integrations.session_store import (
+    LocalSessionStore,
+    ingest_sessions,
+)
+from runbookai_tpu.knowledge.store.graph import ServiceGraph
+from runbookai_tpu.providers.operability import (
+    ContextClaim,
+    HTTPAdapter,
+    LocalGraphAdapter,
+    Provenance,
+    create_adapter,
+    reconcile_claims,
+)
+from runbookai_tpu.utils.config import Config
+
+
+class StubRetriever:
+    def __init__(self):
+        self.queries = []
+
+    async def retrieve(self, query, services=None):
+        self.queries.append(query)
+        if "payment" in query:
+            return RetrievedKnowledge(runbooks=[KnowledgeResult(
+                doc_id="rb-1", title="Payment runbook", knowledge_type="runbook",
+                content="steps")])
+        return RetrievedKnowledge()
+
+
+async def test_knowledge_context_manager_primes_and_requeries():
+    mgr = KnowledgeContextManager(StubRetriever())
+    await mgr.prime("payment latency")
+    block = mgr.system_prompt_block()
+    assert "[rb-1] Payment runbook (runbook)" in block
+    # already-seen terms don't requery
+    assert await mgr.observe_terms(["payment"]) is None
+    # new terms that match knowledge do
+    result = await mgr.observe_terms(["payment-gateway"])
+    assert result is not None and not result.empty
+
+
+def test_service_context_manager_block():
+    g = ServiceGraph()
+    g.add_dependency("checkout-web", "payment-api")
+    g.add_dependency("payment-api", "payments-db")
+    g.add_service("payment-api", team="payments", tier=1)
+    mgr = ServiceContextManager(g)
+    added = mgr.observe_services(["payment-api", "unknown-svc"])
+    assert added == ["payment-api"]
+    block = mgr.system_prompt_block()
+    assert "depends on: payments-db" in block
+    assert "blast radius if degraded: checkout-web" in block
+
+
+async def test_infra_context_discovery():
+    from runbookai_tpu.tools import simulated as sim
+    from runbookai_tpu.tools.registry import ToolRegistry
+
+    reg = ToolRegistry()
+    cloud = sim.SimulatedCloud()
+    sim.register_aws(reg, cloud)
+    sim.register_kubernetes(reg, cloud)
+    executor = ToolExecutor({t.name: t for t in reg.all()})
+    mgr = await create_infra_context(executor)
+    block = mgr.system_prompt_block()
+    assert "Firing alarms" in block and "payment-api" in block
+    assert await create_infra_context(executor, enabled=False) is None
+
+
+def test_reconcile_claims_merging():
+    claims = [
+        ContextClaim("payment-api", "deployed", confidence=0.5,
+                     provenance=Provenance(source="a")),
+        ContextClaim("payment-api", "deployed", confidence=0.6,
+                     provenance=Provenance(source="b")),
+        ContextClaim("payment-api", "scaled", confidence=0.1),
+    ]
+    merged = reconcile_claims(claims)
+    assert len(merged) == 1  # low-confidence scaled dropped
+    assert merged[0].predicate == "deployed"
+    assert merged[0].confidence == pytest.approx(0.75)  # multi-source boost
+
+
+async def test_local_graph_adapter_and_factory():
+    g = ServiceGraph()
+    g.add_dependency("a-svc", "b-svc")
+    adapter = LocalGraphAdapter(graph=g)
+    assert await adapter.blast_radius("b-svc") == ["a-svc"]
+    facts = await adapter.fact_lookup("a-svc")
+    assert facts["depends_on"] == ["b-svc"]
+
+    cfg = Config.model_validate({"providers": {"operability_context": {
+        "enabled": True, "adapter": "http", "base_url": "http://x"}}})
+    assert isinstance(create_adapter(cfg), HTTPAdapter)
+    cfg2 = Config.model_validate({"providers": {"operability_context": {
+        "enabled": True, "adapter": "custom"}}})  # no base_url -> local fallback
+    assert isinstance(create_adapter(cfg2, graph=g), LocalGraphAdapter)
+    cfg3 = Config()
+    assert create_adapter(cfg3) is None
+
+
+def test_install_uninstall_hooks(tmp_path):
+    settings = tmp_path / "settings.json"
+    settings.write_text(json.dumps({"model": "opus", "hooks": {
+        "PreToolUse": [{"hooks": [{"type": "command", "command": "other-tool"}]}]}}))
+    install_hooks(settings)
+    status = hooks_status(settings)
+    assert all(status.values())
+    data = json.loads(settings.read_text())
+    assert data["model"] == "opus"  # preserved
+    # other tool's hook preserved alongside ours
+    pre = data["hooks"]["PreToolUse"]
+    commands = [h["command"] for e in pre for h in e["hooks"]]
+    assert "other-tool" in commands and any("runbook hook" in c for c in commands)
+    # idempotent
+    install_hooks(settings)
+    assert json.loads(settings.read_text())["hooks"]["PreToolUse"] == pre
+    assert uninstall_hooks(settings)
+    status2 = hooks_status(settings)
+    assert not any(status2.values())
+    assert "other-tool" in json.dumps(json.loads(settings.read_text()))
+
+
+def test_pre_tool_use_blocks_dangerous(tmp_path):
+    handlers = HookHandlers(session_store=LocalSessionStore(tmp_path))
+    blocked = handlers.handle_pre_tool_use(
+        {"session_id": "s1", "tool_input": {"command": "kubectl delete pod x -n prod"}})
+    assert blocked["decision"] == "block"
+    ok = handlers.handle_pre_tool_use(
+        {"session_id": "s1", "tool_input": {"command": "kubectl get pods"}})
+    assert ok.get("continue") is True
+    # rm -rf variants
+    assert handlers.handle_pre_tool_use(
+        {"tool_input": {"command": "rm -rf /data"}})["decision"] == "block"
+    # stdin protocol: block -> exit code 2
+    stdin = io.StringIO(json.dumps({"tool_input": {"command": "terraform destroy"}}))
+    stdout = io.StringIO()
+    code = run_hook_stdin("PreToolUse", handlers, stdin=stdin, stdout=stdout)
+    assert code == 2 and json.loads(stdout.getvalue())["decision"] == "block"
+
+
+def test_user_prompt_submit_injects_knowledge(tmp_path):
+    from runbookai_tpu.knowledge.chunker import document_from_markdown
+    from runbookai_tpu.knowledge.retriever import HybridRetriever, KnowledgeRetriever
+    from runbookai_tpu.knowledge.store.sqlite_fts import KnowledgeStore
+
+    store = KnowledgeStore(":memory:")
+    store.upsert_document(document_from_markdown(
+        "r.md", "---\ntype: known-issue\nservices: [payment-api]\n---\n"
+                "# Pool exhaustion\n\npayment-api pool saturates under latency."))
+    retriever = KnowledgeRetriever(store, HybridRetriever(store))
+    handlers = HookHandlers(retriever=retriever)
+    out = handlers.handle_user_prompt_submit(
+        {"prompt": "why is payment-api latency so high?"})
+    extra = out["hookSpecificOutput"]["additionalContext"]
+    assert "Pool exhaustion" in extra
+    # no terms -> no injection
+    out2 = handlers.handle_user_prompt_submit({"prompt": "hello"})
+    assert "hookSpecificOutput" not in out2
+
+
+def test_session_store_and_ingestion(tmp_path):
+    store = LocalSessionStore(tmp_path)
+    store.append("sess/1", {"event": "PreToolUse", "tool_name": "Bash",
+                            "tool_input": {"command": "kubectl get pods payment-api"}})
+    store.append("sess/1", {"event": "PreToolUse", "decision": "block",
+                            "tool_input": {"command": "rm -rf /"}})
+    assert store.list_sessions() == ["sess_1"]
+    assert len(store.read("sess/1")) == 2
+    summary = ingest_sessions(store)
+    assert summary["sessions"] == 1 and summary["events"] == 2
+    assert summary["tool_counts"]["Bash"] == 1
+    assert summary["blocked_commands"] == ["rm -rf /"]
+
+
+def test_build_claims_and_spool(tmp_path):
+    claims = build_claims_from_hook_event({
+        "tool_name": "Bash",
+        "tool_input": {"command": "kubectl rollout restart deployment/payment-api"},
+    })
+    assert claims and claims[0].predicate == "deployed"
+    assert claims[0].subject == "payment-api"
+
+
+async def test_ingestion_client_spool_and_replay(tmp_path):
+    class FlakyAdapter:
+        name = "flaky"
+        capabilities = ("session_ingest",)
+        fail = True
+
+        def supports(self, c):
+            return c in self.capabilities
+
+        async def ingest_session(self, events):
+            if self.fail:
+                raise ConnectionError("down")
+            return {"ok": len(events)}
+
+    adapter = FlakyAdapter()
+    client = IngestionClient(adapter, spool_dir=tmp_path)
+    out = await client.ingest([{"e": 1}])
+    assert out["status"] == "spooled"
+    assert client.status()["spooled_batches"] == 1
+    adapter.fail = False
+    replay = await client.replay()
+    assert replay == {"replayed": 1, "failed": 0}
+    assert client.status()["spooled_batches"] == 0
