@@ -45,7 +45,7 @@ from runbookai_tpu.engine.request import (
     FinishReason,
     RequestState,
 )
-from runbookai_tpu.models.llama import LlamaConfig, forward
+from runbookai_tpu.models.llama import LlamaConfig, forward_impl
 from runbookai_tpu.ops.sampling import sample_tokens
 
 
@@ -64,27 +64,32 @@ class EngineConfig:
     # Max decode tokens sampled per device dispatch (amortizes the host sync;
     # clamped to powers of two to bound compile count). Guided requests force 1.
     decode_steps_per_dispatch: int = 8
+    # Decode attention implementation: "xla" (portable) | "pallas" (TPU kernel).
+    attn_impl: str = "xla"
 
 
-@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages"), donate_argnums=(4, 5))
+@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl"),
+         donate_argnums=(4, 5))
 def _decode_step(
     params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
     temps, top_ps, key, mask, page_size: int, block_pages: int,
+    attn_impl: str = "xla",
 ):
-    logits, kv_k, kv_v = forward(
+    logits, kv_k, kv_v = forward_impl(
         params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
-        page_size=page_size, block_pages=block_pages,
+        page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
     )
     tok = sample_tokens(logits[:, -1], key, temps, top_ps, mask)
     return tok, logits[:, -1], kv_k, kv_v
 
 
 @partial(jax.jit,
-         static_argnames=("cfg", "page_size", "block_pages", "k_steps"),
+         static_argnames=("cfg", "page_size", "block_pages", "k_steps", "attn_impl"),
          donate_argnums=(4, 5))
 def _decode_multi(
     params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
     temps, top_ps, key, page_size: int, block_pages: int, k_steps: int,
+    attn_impl: str = "xla",
 ):
     """K autoregressive decode steps in ONE dispatch (on-device sampling).
 
@@ -98,9 +103,9 @@ def _decode_multi(
 
     def step(carry, _):
         tokens, positions, kv_k, kv_v, ctx_lens, key = carry
-        logits, kv_k, kv_v = forward(
+        logits, kv_k, kv_v = forward_impl(
             params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
-            page_size=page_size, block_pages=block_pages,
+            page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
         )
         key, sub = jax.random.split(key)
         tok = sample_tokens(logits[:, -1], sub, temps, top_ps, None)
@@ -118,7 +123,7 @@ def _prefill_step(
     params, cfg: LlamaConfig, tokens, kv_k, kv_v, positions, tables, ctx_lens,
     last_idx, page_size: int, block_pages: int,
 ):
-    logits, kv_k, kv_v = forward(
+    logits, kv_k, kv_v = forward_impl(
         params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
         page_size=page_size, block_pages=block_pages,
     )
@@ -402,6 +407,7 @@ class EngineCore:
                 jnp.asarray(temps), jnp.asarray(top_ps), sub,
                 jnp.asarray(mask) if need_mask else None,
                 page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
+                attn_impl=self.ecfg.attn_impl,
             )
             toks_host = np.asarray(jax.device_get(toks))[:, None]  # [B, 1]
         else:
@@ -410,7 +416,7 @@ class EngineCore:
                 self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
                 jnp.asarray(temps), jnp.asarray(top_ps), sub,
                 page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
-                k_steps=k,
+                k_steps=k, attn_impl=self.ecfg.attn_impl,
             )
             toks_host = np.asarray(jax.device_get(toks))  # [B, K]
 

@@ -112,8 +112,7 @@ def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
     return (norm * weight).astype(x.dtype)
 
 
-@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages"))
-def forward(
+def forward_impl(
     params: Params,
     cfg: LlamaConfig,
     tokens: jnp.ndarray,  # [B, T] int32 token ids for the current chunk
@@ -124,10 +123,15 @@ def forward(
     ctx_lens: jnp.ndarray,  # [B] cache length AFTER this chunk
     page_size: int,
     block_pages: int = 32,
+    attn_impl: str = "xla",
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward chunk. Returns (logits [B, T, vocab] f32, kv_k', kv_v').
 
-    Donate ``kv_k``/``kv_v`` at the jit call site for in-place page updates.
+    Raw (un-jitted) implementation so callers can inline it inside their own
+    compiled step functions — nested jit inside lax.scan hangs some remote
+    compile backends. ``attn_impl="pallas"`` selects the Pallas ragged paged
+    decode kernel when T == 1. Donate ``kv_k``/``kv_v`` at the jit call site
+    for in-place page updates.
     """
     b, t = tokens.shape
     hd, n_kv = cfg.head_dim, cfg.n_kv_heads
@@ -152,10 +156,20 @@ def forward(
             k_pages = write_seq(k_pages, k[i], positions[i], page_tables[i])
             v_pages = write_seq(v_pages, v[i], positions[i], page_tables[i])
 
-        attn = paged_attention(
-            q, k_pages, v_pages, page_tables, ctx_lens, positions,
-            page_size=page_size, block_pages=block_pages,
-        )
+        if attn_impl == "pallas" and t == 1:
+            from runbookai_tpu.ops.paged_attention_pallas import (
+                paged_decode_attention,
+            )
+
+            attn = paged_decode_attention(
+                q[:, 0], k_pages, v_pages, page_tables, ctx_lens,
+                page_size=page_size,
+            )[:, None]
+        else:
+            attn = paged_attention(
+                q, k_pages, v_pages, page_tables, ctx_lens, positions,
+                page_size=page_size, block_pages=block_pages,
+            )
         hidden = hidden + attn.reshape(b, t, cfg.n_heads * hd) @ lp["wo"]
 
         y = rms_norm(hidden, lp["mlp_norm"], cfg.norm_eps)
@@ -170,6 +184,10 @@ def forward(
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = (h @ head).astype(jnp.float32)
     return logits, kv_k_new, kv_v_new
+
+
+forward = partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages",
+                                            "attn_impl"))(forward_impl)
 
 
 def forward_train(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:

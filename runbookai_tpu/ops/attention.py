@@ -21,8 +21,6 @@ APIs (SURVEY.md §2.9).
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 
@@ -43,7 +41,6 @@ def write_kv_pages(
     return kv_flat.at[dest].set(new_kv.astype(kv_flat.dtype))
 
 
-@partial(jax.jit, static_argnames=("page_size", "block_pages"))
 def paged_attention(
     q: jnp.ndarray,  # [B, T, n_q, head_dim]
     k_flat: jnp.ndarray,  # [num_pages * page_size, n_kv, head_dim]

@@ -1,0 +1,158 @@
+"""Pallas TPU kernel: ragged paged attention for the decode hot loop.
+
+SURVEY.md §7 names this "the single riskiest piece of device code": the XLA
+fallback (:mod:`runbookai_tpu.ops.attention`) re-gathers KV through the page
+table every step; this kernel instead drives the page-table indirection with
+**scalar prefetch** — the grid's K/V block index_maps read the prefetched page
+table, so Mosaic pipelines exactly the pages each sequence owns from HBM into
+VMEM (double-buffered) and flash-accumulates in VMEM scratch.
+
+Pattern per PAPERS.md "Ragged Paged Attention" + the pallas guide
+(PrefetchScalarGridSpec): grid = (batch, pages); for a fixed sequence the page
+axis iterates sequentially, carrying (m, l, acc) scratch; the output block is
+written on the sequence's last page step. Decode-shaped (T = 1).
+
+Selected by ``EngineConfig.attn_impl = "pallas"``; interpret mode keeps it
+testable on CPU meshes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch:
+    page_tables_ref,  # [B, P] int32 (SMEM)
+    ctx_lens_ref,  # [B] int32 (SMEM)
+    # blocks:
+    q_ref,  # [1, n_q, hd]
+    k_ref,  # [1, page_size, n_kv, hd]
+    v_ref,  # [1, page_size, n_kv, hd]
+    o_ref,  # [1, n_q, hd]
+    # scratch:
+    m_ref,  # [n_q, 128] f32
+    l_ref,  # [n_q, 128] f32
+    acc_ref,  # [n_q, hd] f32
+    *,
+    page_size: int,
+    n_kv: int,
+    group: int,
+    pages_per_seq: int,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ctx = ctx_lens_ref[b]
+    base = p * page_size
+
+    @pl.when(base < ctx)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)  # [n_q, hd]
+        hd = q.shape[-1]
+        scale = 1.0 / (hd ** 0.5)
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        valid = pos < ctx  # [1, page_size]
+
+        m_prev = m_ref[:, :1]  # [n_q, 1]
+        l_prev = l_ref[:, :1]
+        acc_prev = acc_ref[:]
+
+        # Per-kv-head score blocks (n_kv is small and static -> unrolled).
+        s_rows = []
+        v_heads = []
+        for h in range(n_kv):
+            k_h = k_ref[0, :, h, :].astype(jnp.float32)  # [ps, hd]
+            q_h = q[h * group : (h + 1) * group]  # [group, hd]
+            s_h = jax.lax.dot_general(
+                q_h * scale, k_h, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [group, ps]
+            s_rows.append(jnp.where(valid, s_h, NEG_INF))
+            v_heads.append(v_ref[0, :, h, :].astype(jnp.float32))  # [ps, hd]
+        s = jnp.concatenate(s_rows, axis=0)  # [n_q, ps] (kv-major head order)
+
+        m_blk = jnp.max(s, axis=1, keepdims=True)  # [n_q, 1]
+        m_new = jnp.maximum(m_prev, m_blk)
+        alpha = jnp.exp(m_prev - m_new)
+        p_blk = jnp.exp(s - m_new)  # [n_q, ps]
+        l_new = l_prev * alpha + jnp.sum(p_blk, axis=1, keepdims=True)
+
+        pv_rows = []
+        for h in range(n_kv):
+            p_h = p_blk[h * group : (h + 1) * group]  # [group, ps]
+            pv_rows.append(jax.lax.dot_general(
+                p_h, v_heads[h], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ))  # [group, hd]
+        pv = jnp.concatenate(pv_rows, axis=0)  # [n_q, hd]
+
+        acc_ref[:] = acc_prev * alpha + pv
+        m_ref[:, :1] = m_new
+        l_ref[:, :1] = l_new
+
+    @pl.when(p == pages_per_seq - 1)
+    def _finalize():
+        l_final = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_final).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, n_q, hd]
+    k_flat: jnp.ndarray,  # [num_pages * page_size, n_kv, hd]
+    v_flat: jnp.ndarray,  # same
+    page_tables: jnp.ndarray,  # [B, P] int32 (physical page ids; 0 = null)
+    ctx_lens: jnp.ndarray,  # [B] int32
+    page_size: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Ragged paged attention for decode (one query token per sequence)."""
+    b, n_q, hd = q.shape
+    n_kv = k_flat.shape[1]
+    group = n_q // n_kv
+    pages_per_seq = page_tables.shape[1]
+    k_pages = k_flat.reshape(-1, page_size, n_kv, hd)
+    v_pages = v_flat.reshape(-1, page_size, n_kv, hd)
+
+    # Query head order for the kernel is kv-major ([kv0 g0..gN, kv1 g0..], the
+    # same grouping the model's reshape uses) — no permutation needed.
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, n_q, hd), lambda b_, p_, pt, cl: (b_, 0, 0)),
+            pl.BlockSpec((1, page_size, n_kv, hd),
+                         lambda b_, p_, pt, cl: (pt[b_, p_], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, n_kv, hd),
+                         lambda b_, p_, pt, cl: (pt[b_, p_], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, n_q, hd), lambda b_, p_, pt, cl: (b_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n_q, 128), jnp.float32),  # m
+            pltpu.VMEM((n_q, 128), jnp.float32),  # l
+            pltpu.VMEM((n_q, hd), jnp.float32),  # acc
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, page_size=page_size, n_kv=n_kv, group=group,
+        pages_per_seq=pages_per_seq,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_q, hd), q.dtype),
+        interpret=interpret,
+    )(page_tables, ctx_lens, q, k_pages, v_pages)
