@@ -190,18 +190,40 @@ forward = partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages",
                                             "attn_impl"))(forward_impl)
 
 
-def forward_train(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+def forward_train(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,
+    positions: Optional[jnp.ndarray] = None,  # [B, T] absolute positions
+    attn_fn=None,  # (q [B,T,n_q,hd], k [B,T,n_kv,hd], v) -> [B,T,n_q,hd]
+) -> jnp.ndarray:
     """Training-mode forward: dense causal attention over [B, T], no KV cache.
 
     Used by the fine-tuning path and the multi-chip dry-run; shares every
     parameter and norm with the serving forward, differing only in attention
     materialization (XLA fuses the masked softmax; sequence fits in one pass).
+    ``attn_fn`` swaps the attention implementation while keeping the rest of
+    the layer identical — the sequence-parallel path passes ring attention
+    here (``parallel/sequence_parallel.py``) so the two forwards cannot drift.
     """
     b, t = tokens.shape
     hd, n_kv, n_q = cfg.head_dim, cfg.n_kv_heads, cfg.n_heads
-    group = n_q // n_kv
-    positions = jnp.arange(t, dtype=jnp.int32)[None, :]
-    causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+    if positions is None:
+        positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, (b, t))
+
+    if attn_fn is None:
+        group = n_q // n_kv
+        causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+
+        def attn_fn(q, k, v):
+            qg = (q * (1.0 / jnp.sqrt(jnp.float32(hd)))).reshape(b, t, n_kv, group, hd)
+            scores = jnp.einsum("btkgd,bskd->btkgs", qg.astype(jnp.float32),
+                                k.astype(jnp.float32))
+            scores = jnp.where(causal[None, :, None, None, :], scores, -1e30)
+            attn = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+            return jnp.einsum("btkgs,bskd->btkgd", attn, v).reshape(b, t, n_q, hd)
+
     h = params["embed"][tokens]
 
     def layer_step(hidden, lp):
@@ -209,12 +231,7 @@ def forward_train(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.
         q = apply_rope((x @ lp["wq"]).reshape(b, t, n_q, hd), positions, cfg.rope_theta)
         k = apply_rope((x @ lp["wk"]).reshape(b, t, n_kv, hd), positions, cfg.rope_theta)
         v = (x @ lp["wv"]).reshape(b, t, n_kv, hd)
-        qg = (q * (1.0 / jnp.sqrt(jnp.float32(hd)))).reshape(b, t, n_kv, group, hd)
-        scores = jnp.einsum("btkgd,bskd->btkgs", qg.astype(jnp.float32),
-                            k.astype(jnp.float32))
-        scores = jnp.where(causal[None, :, None, None, :], scores, -1e30)
-        attn = jax.nn.softmax(scores, axis=-1).astype(hidden.dtype)
-        ctx = jnp.einsum("btkgs,bskd->btkgd", attn, v).reshape(b, t, n_q * hd)
+        ctx = attn_fn(q, k, v).reshape(b, t, n_q * hd)
         hidden = hidden + ctx @ lp["wo"]
         y = rms_norm(hidden, lp["mlp_norm"], cfg.norm_eps)
         hidden = hidden + (jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"])) @ lp["w_down"]

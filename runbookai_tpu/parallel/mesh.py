@@ -6,6 +6,9 @@ expressed through ``jax.sharding.Mesh`` + ``NamedSharding``. This module is
 the single place device topology is defined:
 
 - ``data`` axis — batches independent sequences / eval cases (DP).
+- ``seq`` axis — shards the sequence dimension for long-context ring
+  attention (``parallel/ring_attention.py``); K/V shards rotate around this
+  axis's ICI ring via ``ppermute``.
 - ``model`` axis — shards attention heads, MLP, vocab (Megatron TP); psum /
   all-gather reductions ride ICI inside compiled programs.
 
@@ -22,34 +25,38 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
 
 def build_mesh(
     data: int = 1,
     model: int = 1,
+    seq: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a (data, model) mesh over the first ``data*model`` devices.
+    """Build a (data, seq, model) mesh over the first ``data*seq*model`` devices.
 
     Uses ``mesh_utils.create_device_mesh`` when the whole device set is used
-    (it picks an ICI-friendly physical layout); falls back to a simple reshape
-    for subsets (tests, single-chip).
+    (it picks an ICI-friendly physical layout — the ``seq`` axis lands on a
+    ring so ppermute hops are nearest-neighbor); falls back to a simple
+    reshape for subsets (tests, single-chip).
     """
     devices = list(devices if devices is not None else jax.devices())
-    need = data * model
+    need = data * seq * model
     if need > len(devices):
-        raise ValueError(f"mesh {data}x{model} needs {need} devices, have {len(devices)}")
+        raise ValueError(
+            f"mesh {data}x{seq}x{model} needs {need} devices, have {len(devices)}")
     if need == len(devices):
         try:
             from jax.experimental import mesh_utils
 
-            arr = mesh_utils.create_device_mesh((data, model), devices=devices)
-            return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+            arr = mesh_utils.create_device_mesh((data, seq, model), devices=devices)
+            return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
         except Exception:
             pass
-    arr = np.asarray(devices[:need]).reshape(data, model)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+    arr = np.asarray(devices[:need]).reshape(data, seq, model)
+    return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def single_device_mesh() -> Mesh:
