@@ -190,6 +190,45 @@ forward = partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages",
                                             "attn_impl"))(forward_impl)
 
 
+def dense_causal_attention(cfg: LlamaConfig, b: int, t: int):
+    """Default training attention: materialized causal softmax over [T, T]."""
+    hd, n_kv, n_q = cfg.head_dim, cfg.n_kv_heads, cfg.n_heads
+    group = n_q // n_kv
+    causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+
+    def attn_fn(q, k, v):
+        qg = (q * (1.0 / jnp.sqrt(jnp.float32(hd)))).reshape(b, t, n_kv, group, hd)
+        scores = jnp.einsum("btkgd,bskd->btkgs", qg.astype(jnp.float32),
+                            k.astype(jnp.float32))
+        scores = jnp.where(causal[None, :, None, None, :], scores, -1e30)
+        attn = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        return jnp.einsum("btkgs,bskd->btkgd", attn, v).reshape(b, t, n_q, hd)
+
+    return attn_fn
+
+
+def transformer_layer(hidden, lp, cfg: LlamaConfig, positions, attn_fn):
+    """One pre-norm attention + SwiGLU block — shared by every forward path
+    (dense training, sequence-parallel ring, pipeline stages)."""
+    b, t = hidden.shape[:2]
+    hd, n_kv, n_q = cfg.head_dim, cfg.n_kv_heads, cfg.n_heads
+    x = rms_norm(hidden, lp["attn_norm"], cfg.norm_eps)
+    q = apply_rope((x @ lp["wq"]).reshape(b, t, n_q, hd), positions, cfg.rope_theta)
+    k = apply_rope((x @ lp["wk"]).reshape(b, t, n_kv, hd), positions, cfg.rope_theta)
+    v = (x @ lp["wv"]).reshape(b, t, n_kv, hd)
+    ctx = attn_fn(q, k, v).reshape(b, t, n_q * hd)
+    hidden = hidden + ctx @ lp["wo"]
+    y = rms_norm(hidden, lp["mlp_norm"], cfg.norm_eps)
+    return hidden + (jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"])) @ lp["w_down"]
+
+
+def lm_head_logits(params: Params, cfg: LlamaConfig, hidden) -> jnp.ndarray:
+    """Final norm + (tied or untied) LM head, float32 logits."""
+    h = rms_norm(hidden, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return (h @ head).astype(jnp.float32)
+
+
 def forward_train(
     params: Params,
     cfg: LlamaConfig,
@@ -207,37 +246,16 @@ def forward_train(
     here (``parallel/sequence_parallel.py``) so the two forwards cannot drift.
     """
     b, t = tokens.shape
-    hd, n_kv, n_q = cfg.head_dim, cfg.n_kv_heads, cfg.n_heads
     if positions is None:
         positions = jnp.arange(t, dtype=jnp.int32)[None, :]
     positions = jnp.broadcast_to(positions, (b, t))
-
     if attn_fn is None:
-        group = n_q // n_kv
-        causal = jnp.tril(jnp.ones((t, t), dtype=bool))
-
-        def attn_fn(q, k, v):
-            qg = (q * (1.0 / jnp.sqrt(jnp.float32(hd)))).reshape(b, t, n_kv, group, hd)
-            scores = jnp.einsum("btkgd,bskd->btkgs", qg.astype(jnp.float32),
-                                k.astype(jnp.float32))
-            scores = jnp.where(causal[None, :, None, None, :], scores, -1e30)
-            attn = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-            return jnp.einsum("btkgs,bskd->btkgd", attn, v).reshape(b, t, n_q, hd)
+        attn_fn = dense_causal_attention(cfg, b, t)
 
     h = params["embed"][tokens]
 
     def layer_step(hidden, lp):
-        x = rms_norm(hidden, lp["attn_norm"], cfg.norm_eps)
-        q = apply_rope((x @ lp["wq"]).reshape(b, t, n_q, hd), positions, cfg.rope_theta)
-        k = apply_rope((x @ lp["wk"]).reshape(b, t, n_kv, hd), positions, cfg.rope_theta)
-        v = (x @ lp["wv"]).reshape(b, t, n_kv, hd)
-        ctx = attn_fn(q, k, v).reshape(b, t, n_q * hd)
-        hidden = hidden + ctx @ lp["wo"]
-        y = rms_norm(hidden, lp["mlp_norm"], cfg.norm_eps)
-        hidden = hidden + (jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"])) @ lp["w_down"]
-        return hidden, None
+        return transformer_layer(hidden, lp, cfg, positions, attn_fn), None
 
     h, _ = jax.lax.scan(layer_step, h, params["layers"])
-    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return (h @ head).astype(jnp.float32)
+    return lm_head_logits(params, cfg, h)

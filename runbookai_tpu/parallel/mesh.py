@@ -6,6 +6,9 @@ expressed through ``jax.sharding.Mesh`` + ``NamedSharding``. This module is
 the single place device topology is defined:
 
 - ``data`` axis — batches independent sequences / eval cases (DP).
+- ``pipe`` axis — pipeline stages: the scan-stacked layer dimension is
+  partitioned across this axis and activations flow stage-to-stage via
+  ``ppermute`` (``parallel/pipeline.py``).
 - ``seq`` axis — shards the sequence dimension for long-context ring
   attention (``parallel/ring_attention.py``); K/V shards rotate around this
   axis's ICI ring via ``ppermute``.
@@ -25,38 +28,43 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
+
+AXIS_ORDER = (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 
 def build_mesh(
     data: int = 1,
     model: int = 1,
     seq: int = 1,
+    pipe: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a (data, seq, model) mesh over the first ``data*seq*model`` devices.
+    """Build a (data, pipe, seq, model) mesh over the first N needed devices.
 
     Uses ``mesh_utils.create_device_mesh`` when the whole device set is used
-    (it picks an ICI-friendly physical layout — the ``seq`` axis lands on a
-    ring so ppermute hops are nearest-neighbor); falls back to a simple
+    (it picks an ICI-friendly physical layout — the ``seq``/``pipe`` axes land
+    on rings so ppermute hops are nearest-neighbor); falls back to a simple
     reshape for subsets (tests, single-chip).
     """
     devices = list(devices if devices is not None else jax.devices())
-    need = data * seq * model
+    shape = (data, pipe, seq, model)
+    need = data * pipe * seq * model
     if need > len(devices):
         raise ValueError(
-            f"mesh {data}x{seq}x{model} needs {need} devices, have {len(devices)}")
+            f"mesh {'x'.join(map(str, shape))} needs {need} devices, have {len(devices)}")
     if need == len(devices):
         try:
             from jax.experimental import mesh_utils
 
-            arr = mesh_utils.create_device_mesh((data, seq, model), devices=devices)
-            return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+            arr = mesh_utils.create_device_mesh(shape, devices=devices)
+            return Mesh(arr, AXIS_ORDER)
         except Exception:
             pass
-    arr = np.asarray(devices[:need]).reshape(data, seq, model)
-    return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+    arr = np.asarray(devices[:need]).reshape(shape)
+    return Mesh(arr, AXIS_ORDER)
 
 
 def single_device_mesh() -> Mesh:

@@ -15,7 +15,7 @@ CFG = CONFIGS["llama3-test"]
 
 def test_mesh_shapes():
     mesh = build_mesh(2, 4)
-    assert mesh.shape == {"data": 2, "seq": 1, "model": 4}
+    assert mesh.shape == {"data": 2, "pipe": 1, "seq": 1, "model": 4}
     with pytest.raises(ValueError):
         build_mesh(4, 4)  # 16 > 8 devices
 
