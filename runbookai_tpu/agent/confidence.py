@@ -1,0 +1,206 @@
+"""Evidence-strength classification and multi-factor confidence scoring.
+
+Parity target: reference ``src/agent/confidence.ts`` — factor-weighted score
+(`calculateConfidence` :22-46: chain depth, corroboration, contradiction,
+temporal, historical, direct; high >=70, medium >=40), classification prompt
+(:51) with tolerant fallback parsing (:91), temporal correlation check (:123),
+and the confidence display/aggregation utilities (:159-307) used by the
+terminal UI and markdown reports.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass
+
+HIGH_THRESHOLD = 70.0
+MEDIUM_THRESHOLD = 40.0
+
+CONFIDENCE_DESCRIPTIONS = {
+    "high": "High confidence - Strong evidence chain with corroborating signals",
+    "medium": ("Medium confidence - Evidence supports this conclusion but some "
+               "uncertainty remains"),
+    "low": "Low confidence - Limited evidence, consider additional investigation",
+}
+
+
+@dataclass(frozen=True)
+class ConfidenceFactors:
+    """Signals gathered while evaluating a hypothesis."""
+
+    evidence_chain_depth: int = 0
+    corroborating_signals: int = 0
+    contradicting_signals: int = 0
+    temporal_correlation: bool = False
+    historical_pattern_match: bool = False
+    direct_evidence: bool = False
+
+
+def confidence_score(factors: ConfidenceFactors) -> float:
+    """Weighted 0-100+ score (confidence.ts:22-46 weights)."""
+    score = 0.0
+    score += min(factors.evidence_chain_depth * 15, 30)
+    score += min(factors.corroborating_signals * 20, 40)
+    score -= factors.contradicting_signals * 25
+    if factors.temporal_correlation:
+        score += 15
+    if factors.historical_pattern_match:
+        score += 15
+    if factors.direct_evidence:
+        score += 20
+    return score
+
+
+def calculate_confidence(factors: ConfidenceFactors) -> str:
+    return level_from_value(confidence_score(factors))
+
+
+def level_from_value(value: float, high: float = HIGH_THRESHOLD,
+                     medium: float = MEDIUM_THRESHOLD) -> str:
+    if value >= high:
+        return "high"
+    if value >= medium:
+        return "medium"
+    return "low"
+
+
+EVIDENCE_CLASSIFICATION_PROMPT = """\
+You are evaluating evidence for a hypothesis about an incident.
+
+Given:
+- Hypothesis: {hypothesis}
+- Query executed: {query}
+- Query result: {result}
+
+Classify the evidence strength:
+
+STRONG: The data directly supports this hypothesis with clear, unambiguous
+signals (error rate spiked at the incident time, connection pool at 100%,
+OOM killer events, service returning 503s).
+
+WEAK: The data somewhat supports the hypothesis but could have other
+explanations (metrics slightly elevated but within normal range, low-volume
+errors, timing approximately but not exactly aligned).
+
+NONE: The data does not support this hypothesis or actively contradicts it
+(all metrics normal, no relevant errors, timeline mismatch, different
+service affected).
+
+Respond with JSON:
+{{
+  "strength": "strong" | "weak" | "none",
+  "reasoning": "Brief explanation of why this evidence supports or refutes the hypothesis"
+}}
+"""
+
+
+def parse_evidence_classification(response: str) -> tuple[str, str]:
+    """(strength, reasoning) with keyword fallback (confidence.ts:91-118)."""
+    match = re.search(r"\{[\s\S]*\}", response)
+    if match:
+        try:
+            parsed = json.loads(match.group(0))
+            strength = str(parsed.get("strength", "")).lower()
+            if strength in ("strong", "weak", "none"):
+                return strength, parsed.get("reasoning") or "No reasoning provided"
+        except (json.JSONDecodeError, AttributeError, TypeError):
+            pass
+    lower = response.lower()
+    if "strong" in lower:
+        return "strong", response
+    if "weak" in lower:
+        return "weak", response
+    return "none", response
+
+
+def has_temporal_correlation(incident_ts: float, event_ts: float,
+                             tolerance_minutes: float = 5.0) -> bool:
+    """Events align in time within tolerance (confidence.ts:123-131)."""
+    return abs(incident_ts - event_ts) <= tolerance_minutes * 60.0
+
+
+def format_confidence_text(value: float, width: int = 10,
+                           show_label: bool = True,
+                           show_percentage: bool = True) -> str:
+    """Text bar for non-TTY output, e.g. ``████████░░ 82% (High)``."""
+    clamped = max(0.0, min(100.0, value))
+    filled = round(clamped / 100.0 * width)
+    bar = "█" * filled + "░" * (width - filled)
+    parts = [bar]
+    if show_percentage:
+        parts.append(f"{clamped:.0f}%")
+    if show_label:
+        parts.append(f"({level_from_value(clamped).capitalize()})")
+    return " ".join(parts)
+
+
+def format_confidence_badge(value: float) -> str:
+    return f"{level_from_value(value).capitalize()} ({value:.0f}%)"
+
+
+def format_confidence_markdown(value: float, width: int = 10) -> str:
+    clamped = max(0.0, min(100.0, value))
+    filled = round(clamped / 100.0 * width)
+    bar = "█" * filled + "░" * (width - filled)
+    return f"**{level_from_value(clamped).capitalize()}** ({clamped:.0f}%) {bar}"
+
+
+def confidence_color(value: float) -> str:
+    return {"high": "green", "medium": "yellow", "low": "red"}[
+        level_from_value(value)]
+
+
+def parse_confidence_value(text: str) -> float | None:
+    """Parse '85%', '85', 'high', 'High (85%)' → numeric (confidence.ts:272)."""
+    match = re.search(r"(\d+)%?", text)
+    if match:
+        value = int(match.group(1))
+        if 0 <= value <= 100:
+            return float(value)
+    lower = text.lower()
+    if "high" in lower:
+        return 85.0
+    if "medium" in lower:
+        return 55.0
+    if "low" in lower:
+        return 25.0
+    return None
+
+
+def aggregate_confidence(values: list[float],
+                         weights: list[float] | None = None) -> float:
+    if not values:
+        return 0.0
+    if weights and len(weights) == len(values):
+        total = sum(weights)
+        if total == 0:
+            return 0.0
+        return round(sum(v * w for v, w in zip(values, weights)) / total)
+    return round(sum(values) / len(values))
+
+
+_CONTEXT_DESCRIPTIONS = {
+    "investigation": {
+        "high": ("Strong evidence supports this conclusion. Multiple data "
+                 "points corroborate the finding."),
+        "medium": ("Evidence supports this conclusion with some uncertainty. "
+                   "Additional validation recommended."),
+        "low": ("Limited evidence available. This is a preliminary assessment "
+                "that requires further investigation."),
+    },
+    "hypothesis": {
+        "high": "This hypothesis is well-supported by gathered evidence.",
+        "medium": "This hypothesis has partial support. Some evidence is inconclusive.",
+        "low": "This hypothesis needs more evidence to be confirmed or refuted.",
+    },
+    "general": {
+        "high": "High confidence in this result.",
+        "medium": "Moderate confidence in this result.",
+        "low": "Low confidence in this result.",
+    },
+}
+
+
+def describe_confidence(value: float, context: str = "general") -> str:
+    return _CONTEXT_DESCRIPTIONS[context][level_from_value(value)]

@@ -1,0 +1,124 @@
+"""Terminal hypothesis-tree views.
+
+Parity target: reference ``src/cli/components/hypothesis-tree.tsx`` — status
+icons (:33), box-drawing tree (:67-160) with per-node confidence percentage,
+pruned-node toggle, ``HypothesisCompact`` one-liners (:223) and
+``HypothesisSummary`` stats footer (:240-300). Renders plain ANSI strings
+over the FSM's hypothesis set (``agent/state_machine.py``) so the live
+investigate view and the final report share one renderer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+RESET = "\x1b[0m"
+_COLORS = {"green": "\x1b[32m", "yellow": "\x1b[33m", "red": "\x1b[31m",
+           "cyan": "\x1b[36m", "dim": "\x1b[2m"}
+
+STATUS_ICONS = {
+    "open": ("○", "dim"),
+    "investigating": ("◐", "cyan"),
+    "confirmed": ("●", "green"),
+    "pruned": ("✗", "red"),
+}
+
+_BRANCH, _LAST, _VERT = "├─", "└─", "│"
+
+
+def _paint(text: str, color_name: str, color: bool) -> str:
+    if not color:
+        return text
+    return _COLORS.get(color_name, "") + text + RESET
+
+
+def _icon(status: str, color: bool) -> str:
+    icon, color_name = STATUS_ICONS.get(status, ("?", "dim"))
+    return _paint(icon, color_name, color)
+
+
+def _pct(confidence: float) -> float:
+    """The FSM stores the LLM's 0.0-1.0 confidence; display as 0-100%."""
+    return confidence * 100.0 if confidence <= 1.0 else confidence
+
+
+def _node_line(h: Any, color: bool) -> str:
+    pct = (f" {_pct(h.confidence):.0f}%" if getattr(h, "confidence", 0) else "")
+    evidence = f" [{len(h.evidence)} evidence]" if getattr(h, "evidence", None) else ""
+    line = f"{_icon(h.status, color)} {h.statement}{pct}{evidence}"
+    if h.status == "pruned":
+        line = _paint(line, "dim", color) if color else line + " (pruned)"
+    return line
+
+
+def render_tree(hypotheses: Iterable[Any], show_pruned: bool = True,
+                color: bool = True) -> str:
+    """Box-drawing tree over FSMHypothesis nodes (parent_id/children links)."""
+    nodes = {h.id: h for h in hypotheses}
+    roots = [h for h in nodes.values()
+             if h.parent_id is None or h.parent_id not in nodes]
+    lines: list[str] = []
+
+    def visible_children(h: Any) -> list[Any]:
+        kids = [nodes[c] for c in getattr(h, "children", []) if c in nodes]
+        if not show_pruned:
+            kids = [k for k in kids if k.status != "pruned"]
+        return kids
+
+    def walk(h: Any, prefix: str, is_last: bool, is_root: bool) -> None:
+        if not show_pruned and h.status == "pruned":
+            return
+        if is_root:
+            lines.append(_node_line(h, color))
+            child_prefix = ""
+        else:
+            connector = _LAST if is_last else _BRANCH
+            lines.append(f"{prefix}{connector} {_node_line(h, color)}")
+            child_prefix = prefix + ("   " if is_last else f"{_VERT}  ")
+        kids = visible_children(h)
+        for i, kid in enumerate(kids):
+            walk(kid, child_prefix, i == len(kids) - 1, False)
+
+    for i, root in enumerate(roots):
+        walk(root, "", i == len(roots) - 1, True)
+    return "\n".join(lines)
+
+
+def render_compact(h: Any, color: bool = True) -> str:
+    """One-liner per hypothesis (HypothesisCompact, :223)."""
+    return _node_line(h, color)
+
+
+def count_statuses(hypotheses: Iterable[Any]) -> dict[str, int]:
+    counts = {"open": 0, "investigating": 0, "confirmed": 0, "pruned": 0}
+    for h in hypotheses:
+        counts[h.status] = counts.get(h.status, 0) + 1
+    counts["total"] = sum(counts.values())
+    return counts
+
+
+def find_confirmed(hypotheses: Iterable[Any]) -> Optional[Any]:
+    best = None
+    for h in hypotheses:
+        if h.status == "confirmed" and (
+                best is None or h.confidence > best.confidence):
+            best = h
+    return best
+
+
+def render_summary(hypotheses: Iterable[Any], color: bool = True) -> str:
+    """Stats footer + confirmed root cause (HypothesisSummary, :240-300)."""
+    items = list(hypotheses)
+    counts = count_statuses(items)
+    confirmed = find_confirmed(items)
+    lines = [
+        f"Hypotheses: {counts['total']} total — "
+        f"{counts['confirmed']} confirmed, {counts['investigating']} active, "
+        f"{counts['open']} open, {counts['pruned']} pruned"
+    ]
+    if confirmed is not None:
+        label = _paint("Root cause:", "green", color)
+        pct = (f" ({_pct(confirmed.confidence):.0f}%)"
+               if confirmed.confidence else "")
+        lines.append(f"{label} {confirmed.statement}{pct}")
+    return "\n".join(lines)

@@ -103,6 +103,15 @@ def cmd_investigate(args) -> int:
     result = asyncio.run(orch.investigate(args.incident_id, args.description or ""))
     store = CheckpointStore(f"{config.runbook_dir}/checkpoints")
     store.save_machine(orch.machine, label="final")
+    hypotheses = list(orch.machine.hypotheses.values())
+    if hypotheses:
+        import sys
+
+        from runbookai_tpu.cli.hypothesis_view import render_summary, render_tree
+
+        color = sys.stdout.isatty()
+        print("\n" + render_tree(hypotheses, color=color))
+        print(render_summary(hypotheses, color=color))
     print(f"\nroot cause: {result.root_cause}")
     print(f"confidence: {result.confidence}")
     print(f"services:   {', '.join(result.affected_services)}")
@@ -158,9 +167,22 @@ def cmd_status(args) -> int:
 
 def cmd_init(args) -> int:
     target = Path(args.dir or ".") / ".runbook" / "config.yaml"
-    if target.exists() and not args.force:
+    if target.exists() and not args.force and not args.interactive:
         print(f"{target} already exists (use --force to overwrite)")
         return 1
+    if args.interactive:
+        from runbookai_tpu.cli.wizard import (
+            hydrate_answers,
+            run_wizard,
+            save_wizard_configs,
+        )
+
+        base = hydrate_answers(target.parent) if target.exists() else None
+        answers = run_wizard(base=base)
+        config_path, services_path = save_wizard_configs(
+            answers, config_dir=target.parent)
+        print(f"wrote {config_path} and {services_path}")
+        return 0
     config = Config()
     if args.template == "simulated":
         config = Config.model_validate({
@@ -203,6 +225,29 @@ def cmd_config(args) -> int:
 
 def cmd_knowledge(args) -> int:
     config = _load(args)
+    if args.knowledge_cmd == "auth":
+        # `runbook knowledge auth google` (reference cli.tsx:1450, google-auth.ts)
+        import os
+
+        from runbookai_tpu.knowledge.sources.google_auth import (
+            TokenStore,
+            authorization_url,
+            exchange_code,
+        )
+
+        client_id = os.environ.get("GOOGLE_CLIENT_ID", "")
+        client_secret = os.environ.get("GOOGLE_CLIENT_SECRET", "")
+        if not client_id or not client_secret:
+            print("set GOOGLE_CLIENT_ID and GOOGLE_CLIENT_SECRET first")
+            return 1
+        print("Open this URL, authorize, and paste the code:")
+        print(f"  {authorization_url(client_id)}")
+        code = input("code> ").strip()
+        tokens = exchange_code(client_id, client_secret, code)
+        TokenStore().save(tokens)
+        print("tokens saved to .runbook/google-tokens.json")
+        return 0
+
     from runbookai_tpu.knowledge.retriever import create_retriever
 
     retriever = create_retriever(config)
@@ -284,6 +329,23 @@ def cmd_eval(args) -> int:
         write_reports,
     )
 
+    if args.run_all:
+        from runbookai_tpu.evalsuite.run_all import run_all_benchmarks
+
+        runner = None
+        if not args.offline:
+            from runbookai_tpu.cli.runtime import build_runtime
+
+            runtime = build_runtime(_load(args), interactive=False)
+            runner = lambda cases: asyncio.run(run_live(  # noqa: E731
+                cases, lambda: runtime.llm, concurrency=args.concurrency))
+        aggregate = run_all_benchmarks(
+            datasets_root=args.datasets_root, out_dir=args.out,
+            runner=runner, min_pass_rate=args.min_pass_rate,
+            setup=args.setup_datasets)
+        print(json.dumps(aggregate, indent=2, default=str))
+        return 0 if aggregate["failed"] == 0 else 1
+
     cases = load_fixtures_file(args.fixtures)
     if args.offline:
         report = run_offline(cases, name=args.name)
@@ -334,7 +396,8 @@ def cmd_slack_gateway(args) -> int:
     from runbookai_tpu.server.slack_gateway import run_slack_gateway
 
     config = _load(args)
-    run_slack_gateway(config, mode=args.mode, port=args.port)
+    run_slack_gateway(config, mode=args.mode or config.incident.slack.mode,
+                      port=args.port)
     return 0
 
 
@@ -363,6 +426,25 @@ def cmd_integrations(args) -> int:
     if args.integrations_cmd == "disable":
         removed = uninstall_hooks(settings)
         print("hooks removed" if removed else "no hooks found")
+        return 0
+    if args.integrations_cmd == "learn":
+        # reference `runbook integrations claude learn` (cli.tsx:1667+)
+        from runbookai_tpu.cli.runtime import build_runtime
+        from runbookai_tpu.integrations.session_store import create_session_store
+        from runbookai_tpu.learning.claude_session import run_learning_from_session
+
+        config = _load(args)
+        store = create_session_store(config)
+        session_ids = [args.session_id] if args.session_id else store.list_sessions()
+        if not session_ids:
+            print("no captured sessions")
+            return 1
+        runtime = build_runtime(config, interactive=False)
+        for sid in session_ids:
+            out = asyncio.run(run_learning_from_session(
+                runtime.llm, sid, store=store,
+                out_dir=f"{config.runbook_dir}/learning"))
+            print(f"{sid}: artifacts in {out}")
         return 0
     return 1
 
@@ -448,6 +530,8 @@ def build_parser() -> argparse.ArgumentParser:
                       default="simulated")
     init.add_argument("--dir", default=".")
     init.add_argument("--force", action="store_true")
+    init.add_argument("--interactive", "-i", action="store_true",
+                      help="guided setup wizard (hydrates an existing config)")
     init.set_defaults(fn=cmd_init)
 
     cfg = sub.add_parser("config", help="show or set config values")
@@ -468,6 +552,8 @@ def build_parser() -> argparse.ArgumentParser:
     kn_add = kn_sub.add_parser("add")
     kn_add.add_argument("file")
     kn_sub.add_parser("validate")
+    kn_auth = kn_sub.add_parser("auth")
+    kn_auth.add_argument("provider", choices=["google"])
     kn.set_defaults(fn=cmd_knowledge)
 
     cp = sub.add_parser("checkpoint", help="investigation checkpoints")
@@ -489,6 +575,11 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--out", default=".runbook/eval-reports")
     ev.add_argument("--concurrency", type=int, default=4)
     ev.add_argument("--min-pass-rate", type=float, default=0.0)
+    ev.add_argument("--all", action="store_true", dest="run_all",
+                    help="run every public benchmark (rcaeval/rootly/tracerca)")
+    ev.add_argument("--datasets-root", default="examples/evals/datasets")
+    ev.add_argument("--setup-datasets", action="store_true",
+                    help="git-clone missing dataset repos first")
     ev.set_defaults(fn=cmd_eval)
 
     bench = sub.add_parser("bench", help="serving benchmark (one JSON line)")
@@ -505,7 +596,8 @@ def build_parser() -> argparse.ArgumentParser:
     wh.set_defaults(fn=cmd_webhook)
 
     sg = sub.add_parser("slack-gateway", help="Slack gateway (socket|http)")
-    sg.add_argument("--mode", choices=["socket", "http"], default="http")
+    sg.add_argument("--mode", choices=["socket", "http"], default=None,
+                    help="default: incident.slack.mode from config")
     sg.add_argument("--port", type=int, default=3940)
     sg.set_defaults(fn=cmd_slack_gateway)
 
@@ -516,6 +608,9 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("enable", "status", "disable"):
         c = claude_sub.add_parser(name)
         c.add_argument("--settings", default="~/.claude/settings.json")
+    learn = claude_sub.add_parser("learn")
+    learn.add_argument("--session-id", default=None)
+    learn.add_argument("--settings", default="~/.claude/settings.json")
     integ.set_defaults(fn=cmd_integrations)
 
     hook = sub.add_parser("hook")  # hidden hook entrypoint (stdin protocol)

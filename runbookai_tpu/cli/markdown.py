@@ -1,0 +1,216 @@
+"""ANSI terminal markdown renderer.
+
+Parity target: reference ``src/cli/components/markdown.tsx`` — block parser
+(:51: fenced code, headers, blockquotes, tables, lists, hr, paragraphs) and
+per-block renderers (:195-241) that the Ink UI uses to print agent answers.
+Here the render target is a plain string with ANSI escapes (no React), which
+both the CLI and the chat loop print directly.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+RESET = "\x1b[0m"
+BOLD = "\x1b[1m"
+DIM = "\x1b[2m"
+ITALIC = "\x1b[3m"
+UNDERLINE = "\x1b[4m"
+CYAN = "\x1b[36m"
+YELLOW = "\x1b[33m"
+GREEN = "\x1b[32m"
+MAGENTA = "\x1b[35m"
+
+_HEADER_RE = re.compile(r"^(#{1,6})\s+(.+)$")
+_LIST_RE = re.compile(r"^(\s*)([-*]|\d+\.)\s+(.*)$")
+_HR_RE = re.compile(r"^\s*(-{3,}|\*{3,}|_{3,})\s*$")
+
+
+@dataclass
+class Block:
+    kind: str  # header | code | table | blockquote | hr | list | paragraph
+    content: str = ""
+    level: int = 0
+    language: str = ""
+    items: list[tuple[int, str, str]] | None = None  # (indent, marker, text)
+    rows: list[list[str]] | None = None
+
+
+def parse_blocks(content: str) -> list[Block]:
+    blocks: list[Block] = []
+    lines = content.split("\n")
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+
+        if line.startswith("```"):
+            language = line[3:].strip()
+            code: list[str] = []
+            i += 1
+            while i < len(lines) and not lines[i].startswith("```"):
+                code.append(lines[i])
+                i += 1
+            i += 1  # closing fence
+            blocks.append(Block("code", "\n".join(code), language=language))
+            continue
+
+        header = _HEADER_RE.match(line)
+        if header:
+            blocks.append(Block("header", header.group(2),
+                                level=len(header.group(1))))
+            i += 1
+            continue
+
+        if line.lstrip().startswith(">"):
+            quote: list[str] = []
+            while i < len(lines) and lines[i].lstrip().startswith(">"):
+                quote.append(lines[i].lstrip()[1:].lstrip())
+                i += 1
+            blocks.append(Block("blockquote", "\n".join(quote)))
+            continue
+
+        if line.lstrip().startswith("|"):
+            table: list[str] = []
+            while i < len(lines) and lines[i].lstrip().startswith("|"):
+                table.append(lines[i].strip())
+                i += 1
+            rows = []
+            for raw in table:
+                cells = [c.strip() for c in raw.strip().strip("|").split("|")]
+                if all(re.fullmatch(r":?-{2,}:?", c) for c in cells if c):
+                    continue  # separator row
+                rows.append(cells)
+            blocks.append(Block("table", rows=rows))
+            continue
+
+        if _HR_RE.match(line) and not _LIST_RE.match(line):
+            blocks.append(Block("hr"))
+            i += 1
+            continue
+
+        if _LIST_RE.match(line):
+            items: list[tuple[int, str, str]] = []
+            while i < len(lines):
+                m = _LIST_RE.match(lines[i])
+                if not m:
+                    break
+                items.append((len(m.group(1)), m.group(2), m.group(3)))
+                i += 1
+            blocks.append(Block("list", items=items))
+            continue
+
+        if not line.strip():
+            i += 1
+            continue
+
+        paragraph: list[str] = []
+        while i < len(lines) and lines[i].strip() and not (
+            lines[i].startswith("```") or _HEADER_RE.match(lines[i])
+            or lines[i].lstrip().startswith((">", "|")) or _LIST_RE.match(lines[i])
+        ):
+            paragraph.append(lines[i].strip())
+            i += 1
+        blocks.append(Block("paragraph", " ".join(paragraph)))
+    return blocks
+
+
+def render_inline(text: str, color: bool = True) -> str:
+    """Bold / italic / inline-code / links → ANSI."""
+    if not color:
+        text = re.sub(r"\*\*([^*]+)\*\*", r"\1", text)
+        text = re.sub(r"(?<!\*)\*([^*]+)\*(?!\*)", r"\1", text)
+        text = re.sub(r"`([^`]+)`", r"\1", text)
+        text = re.sub(r"\[([^\]]+)\]\(([^)]+)\)", r"\1 <\2>", text)
+        return text
+    text = re.sub(r"\*\*([^*]+)\*\*", BOLD + r"\1" + RESET, text)
+    text = re.sub(r"(?<!\*)\*([^*]+)\*(?!\*)", ITALIC + r"\1" + RESET, text)
+    text = re.sub(r"`([^`]+)`", CYAN + r"\1" + RESET, text)
+    text = re.sub(r"\[([^\]]+)\]\(([^)]+)\)",
+                  UNDERLINE + r"\1" + RESET + DIM + r" (\2)" + RESET, text)
+    return text
+
+
+def _wrap(text: str, width: int) -> list[str]:
+    words = text.split()
+    lines: list[str] = []
+    cur = ""
+    for word in words:
+        visible = re.sub(r"\x1b\[[0-9;]*m", "", cur)
+        if visible and len(visible) + 1 + len(re.sub(r"\x1b\[[0-9;]*m", "", word)) > width:
+            lines.append(cur)
+            cur = word
+        else:
+            cur = f"{cur} {word}" if cur else word
+    if cur:
+        lines.append(cur)
+    return lines or [""]
+
+
+def render_markdown(content: str, width: int = 88, color: bool = True) -> str:
+    out: list[str] = []
+    for block in parse_blocks(content):
+        if block.kind == "header":
+            text = render_inline(block.content, color)
+            if color:
+                prefix = {1: BOLD + MAGENTA, 2: BOLD + CYAN}.get(
+                    block.level, BOLD)
+                out.append(f"{prefix}{'#' * block.level} {text}{RESET}")
+            else:
+                out.append(f"{'#' * block.level} {block.content}")
+            out.append("")
+        elif block.kind == "code":
+            body = block.content.split("\n")
+            lang = f" {block.language}" if block.language else ""
+            if color:
+                out.append(DIM + "┌──" + lang + RESET)
+                out += [DIM + "│ " + RESET + GREEN + ln + RESET for ln in body]
+                out.append(DIM + "└──" + RESET)
+            else:
+                out.append(f"┌──{lang}")
+                out += ["│ " + ln for ln in body]
+                out.append("└──")
+            out.append("")
+        elif block.kind == "blockquote":
+            for ln in block.content.split("\n"):
+                rendered = render_inline(ln, color)
+                out.append((DIM if color else "") + "▌ " + rendered
+                           + (RESET if color else ""))
+            out.append("")
+        elif block.kind == "table" and block.rows:
+            widths = [0] * max(len(r) for r in block.rows)
+            plain = [[render_inline(c, False) for c in r] for r in block.rows]
+            for row in plain:
+                for j, cell in enumerate(row):
+                    widths[j] = max(widths[j], len(cell))
+            for idx, row in enumerate(plain):
+                padded = [cell.ljust(widths[j]) for j, cell in enumerate(row)]
+                line = "│ " + " │ ".join(padded) + " │"
+                if idx == 0 and color:
+                    line = BOLD + line + RESET
+                out.append(line)
+                if idx == 0:
+                    out.append("├" + "┼".join("─" * (w + 2) for w in widths) + "┤")
+            out.append("")
+        elif block.kind == "hr":
+            out.append(("─" * width))
+            out.append("")
+        elif block.kind == "list" and block.items:
+            number = 0
+            for indent, marker, text in block.items:
+                pad = " " * indent
+                if marker in ("-", "*"):
+                    bullet = "•"
+                else:
+                    number += 1
+                    bullet = f"{number}."
+                for k, ln in enumerate(_wrap(render_inline(text, color),
+                                             width - indent - 2)):
+                    out.append(f"{pad}{bullet if k == 0 else ' ' * len(bullet)} {ln}")
+            out.append("")
+        elif block.kind == "paragraph":
+            out += _wrap(render_inline(block.content, color), width)
+            out.append("")
+    while out and out[-1] == "":
+        out.pop()
+    return "\n".join(out)

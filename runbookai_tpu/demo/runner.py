@@ -49,6 +49,13 @@ def render_event(ev: AgentEvent) -> str:
     """Terminal line renderer shared by demo and live CLI output."""
     d = ev.data
     k = ev.kind
+    if k == "answer":
+        from runbookai_tpu.cli.markdown import render_markdown
+
+        import sys
+
+        return "\n" + render_markdown(d.get("text", ""),
+                                      color=sys.stdout.isatty())
     if k == "start":
         inc = d.get("incident", {})
         title = inc.get("title") or d.get("query", "")
@@ -93,8 +100,6 @@ def render_event(ev: AgentEvent) -> str:
         return f"  ⚲ knowledge retrieved {d.get('counts', d.get('trigger', ''))}"
     if k == "iteration":
         return f"\n-- iteration {d.get('n')} --"
-    if k == "answer":
-        return f"\n{d.get('text', '')}"
     if k == "done":
         return "\n✔ done"
     if k == "error":

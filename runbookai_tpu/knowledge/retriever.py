@@ -237,8 +237,8 @@ def create_retriever(config, embedder: Optional[Any] = None) -> KnowledgeRetriev
         store, vectors=vectors, embedder=embedder,
         rrf_k=kcfg.rrf_k, fts_weight=kcfg.fts_weight, vector_weight=kcfg.vector_weight,
     )
-    sources = []
-    for src in kcfg.sources:
-        if src.type == "filesystem" and src.path:
-            sources.append(FilesystemSource(src.path, name=src.name))
+    from runbookai_tpu.knowledge.sources import build_source
+
+    sources = [s for s in (build_source(src) for src in kcfg.sources)
+               if s is not None]
     return KnowledgeRetriever(store, hybrid, sources=sources)

@@ -1,0 +1,72 @@
+"""Knowledge source loaders + dispatcher.
+
+Parity target: reference ``src/knowledge/sources/index.ts`` —
+``loadFromSource`` (:19) routes a per-source config union to the right
+loader (filesystem | confluence | google-drive). Each loader returns
+``KnowledgeDocument``s with chunks; incremental sync is expressed by the
+``since`` epoch argument (reference ``lastSyncTime``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from runbookai_tpu.knowledge.types import KnowledgeDocument
+
+
+def build_source(src_config: Any, fetch: Any = None) -> Optional[Any]:
+    """Config row → source object with a ``load(since)`` method."""
+    kind = getattr(src_config, "type", "filesystem")
+    if kind == "filesystem" and getattr(src_config, "path", None):
+        from runbookai_tpu.knowledge.retriever import FilesystemSource
+
+        return FilesystemSource(src_config.path, name=src_config.name)
+    if kind == "confluence" and getattr(src_config, "base_url", None):
+        from runbookai_tpu.knowledge.sources.confluence import (
+            ConfluenceSource,
+            default_fetch,
+        )
+
+        return ConfluenceSource(
+            base_url=src_config.base_url,
+            space_key=src_config.space or "",
+            email=os.environ.get("CONFLUENCE_EMAIL", ""),
+            api_token=src_config.token or os.environ.get("CONFLUENCE_API_TOKEN", ""),
+            labels=list(src_config.labels),
+            name=src_config.name,
+            fetch=fetch or default_fetch,
+        )
+    if kind == "google-drive" and getattr(src_config, "folder_id", None):
+        from runbookai_tpu.knowledge.sources.confluence import default_fetch
+        from runbookai_tpu.knowledge.sources.google_auth import (
+            TokenStore,
+            valid_access_token,
+        )
+        from runbookai_tpu.knowledge.sources.google_drive import GoogleDriveSource
+
+        token = src_config.token
+        if not token:
+            try:
+                token = valid_access_token(
+                    TokenStore(),
+                    os.environ.get("GOOGLE_CLIENT_ID", ""),
+                    os.environ.get("GOOGLE_CLIENT_SECRET", ""),
+                )
+            except RuntimeError:
+                token = None  # refresh failed (revoked/offline)
+        if not token:
+            return None  # auth not completed; sync skips this source
+        return GoogleDriveSource(
+            folder_ids=[src_config.folder_id],
+            access_token=token,
+            name=src_config.name,
+            fetch=fetch or default_fetch,
+        )
+    return None
+
+
+def load_from_source(src_config: Any, since: Optional[float] = None,
+                     fetch: Any = None) -> list[KnowledgeDocument]:
+    source = build_source(src_config, fetch=fetch)
+    return source.load(since) if source is not None else []

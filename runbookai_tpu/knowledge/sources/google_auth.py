@@ -1,0 +1,143 @@
+"""Google OAuth2 token management for the Drive knowledge source.
+
+Parity target: reference ``src/knowledge/sources/google-auth.ts`` —
+authorization-URL construction (:38), code→token exchange (:179), refresh
+(:224), and token persistence used by ``runbook knowledge auth google``.
+The local-callback-server browser flow (:107) is collapsed to a paste-the-code
+flow here (terminal-first; no browser automation in this environment); the
+exchange/refresh HTTP goes through the injectable ``fetch`` contract.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Optional
+
+Fetch = Callable[[str, dict[str, str], bytes], tuple[int, bytes]]
+
+AUTH_ENDPOINT = "https://accounts.google.com/o/oauth2/v2/auth"
+TOKEN_ENDPOINT = "https://oauth2.googleapis.com/token"
+SCOPE = "https://www.googleapis.com/auth/drive.readonly"
+OOB_REDIRECT = "urn:ietf:wg:oauth:2.0:oob"
+
+
+def default_post(url: str, headers: dict[str, str], body: bytes) -> tuple[int, bytes]:
+    import urllib.request
+
+    req = urllib.request.Request(url, data=body, headers=headers, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:  # pragma: no cover
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as err:  # pragma: no cover - network path
+        return err.code, err.read()
+
+
+@dataclass
+class GoogleTokens:
+    access_token: str = ""
+    refresh_token: str = ""
+    expires_at: float = 0.0
+    token_type: str = "Bearer"
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def expired(self) -> bool:
+        return bool(self.access_token) and time.time() >= self.expires_at - 60
+
+    def to_dict(self) -> dict:
+        return {"access_token": self.access_token,
+                "refresh_token": self.refresh_token,
+                "expires_at": self.expires_at,
+                "token_type": self.token_type}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "GoogleTokens":
+        return cls(access_token=data.get("access_token", ""),
+                   refresh_token=data.get("refresh_token", ""),
+                   expires_at=float(data.get("expires_at", 0)),
+                   token_type=data.get("token_type", "Bearer"))
+
+
+def authorization_url(client_id: str, redirect_uri: str = OOB_REDIRECT) -> str:
+    params = {
+        "client_id": client_id,
+        "redirect_uri": redirect_uri,
+        "response_type": "code",
+        "scope": SCOPE,
+        "access_type": "offline",
+        "prompt": "consent",
+    }
+    return f"{AUTH_ENDPOINT}?{urllib.parse.urlencode(params)}"
+
+
+def _token_request(params: dict[str, str], post: Fetch) -> GoogleTokens:
+    body = urllib.parse.urlencode(params).encode()
+    status, resp = post(TOKEN_ENDPOINT,
+                        {"Content-Type": "application/x-www-form-urlencoded"},
+                        body)
+    if status != 200:
+        raise RuntimeError(f"google token endpoint: HTTP {status}: "
+                           f"{resp.decode(errors='replace')[:200]}")
+    data = json.loads(resp.decode())
+    return GoogleTokens(
+        access_token=data.get("access_token", ""),
+        refresh_token=data.get("refresh_token", params.get("refresh_token", "")),
+        expires_at=time.time() + float(data.get("expires_in", 3600)),
+        token_type=data.get("token_type", "Bearer"),
+        extra=data,
+    )
+
+
+def exchange_code(client_id: str, client_secret: str, code: str,
+                  redirect_uri: str = OOB_REDIRECT,
+                  post: Fetch = default_post) -> GoogleTokens:
+    return _token_request({
+        "client_id": client_id, "client_secret": client_secret,
+        "code": code, "grant_type": "authorization_code",
+        "redirect_uri": redirect_uri,
+    }, post)
+
+
+def refresh_tokens(client_id: str, client_secret: str, refresh_token: str,
+                   post: Fetch = default_post) -> GoogleTokens:
+    return _token_request({
+        "client_id": client_id, "client_secret": client_secret,
+        "refresh_token": refresh_token, "grant_type": "refresh_token",
+    }, post)
+
+
+class TokenStore:
+    """Persist tokens under ``.runbook/google-tokens.json`` (0600)."""
+
+    def __init__(self, path: str | Path = ".runbook/google-tokens.json"):
+        self.path = Path(path)
+
+    def load(self) -> Optional[GoogleTokens]:
+        if not self.path.exists():
+            return None
+        try:
+            return GoogleTokens.from_dict(json.loads(self.path.read_text()))
+        except (json.JSONDecodeError, OSError):
+            return None
+
+    def save(self, tokens: GoogleTokens) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.path.write_text(json.dumps(tokens.to_dict(), indent=2))
+        self.path.chmod(0o600)
+
+
+def valid_access_token(store: TokenStore, client_id: str, client_secret: str,
+                       post: Fetch = default_post) -> Optional[str]:
+    """Stored token, refreshed if expired; None if auth never completed."""
+    tokens = store.load()
+    if tokens is None or not tokens.access_token:
+        return None
+    if tokens.expired and tokens.refresh_token:
+        tokens = refresh_tokens(client_id, client_secret,
+                                tokens.refresh_token, post=post)
+        store.save(tokens)
+    return tokens.access_token

@@ -1,0 +1,145 @@
+"""Learning from captured Claude Code sessions.
+
+Parity target: reference ``src/learning/claude-session-ingestion.ts`` —
+convert stored hook-event streams into learning-loop events
+(`convertClaudeSessionToLearningEvents` :72), synthesize an
+investigation-result shell from them (`synthesizeInvestigationResultFromClaudeSession`
+:141: inferred query/services/root-cause/duration, confidence medium when >=8
+events), and feed the standard learning loop
+(`runLearningLoopFromClaudeSession` :167). Event records come from the
+session store (``integrations/session_store.py``); the loop itself is
+``learning/loop.py``.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Optional
+
+from runbookai_tpu.agent.orchestrator import OrchestratorResult
+from runbookai_tpu.agent.types import AgentEvent
+from runbookai_tpu.learning.loop import run_learning_loop
+
+
+def _as_str(value: Any) -> str:
+    if not isinstance(value, str):
+        return ""
+    return value.strip()
+
+
+def _truncate(value: str, n: int) -> str:
+    return value if len(value) <= n else value[: n - 3] + "..."
+
+
+def describe_event(event: dict[str, Any]) -> str:
+    """Compact human summary of one hook event (ingestion.ts:40-70)."""
+    payload = event.get("payload") or event
+    name = str(event.get("event_name") or event.get("eventName")
+               or event.get("hook_event_name") or "event")
+    details: list[str] = []
+    prompt = _as_str(payload.get("prompt"))
+    if prompt:
+        details.append(f'prompt="{_truncate(" ".join(prompt.split()), 140)}"')
+    tool = (_as_str(payload.get("tool_name")) or _as_str(payload.get("toolName"))
+            or _as_str(payload.get("tool")))
+    if tool:
+        details.append(f"tool={tool}")
+    status = _as_str(payload.get("status"))
+    if status:
+        details.append(f"status={status}")
+    error = _as_str(payload.get("error"))
+    if error:
+        details.append(f'error="{_truncate(error, 120)}"')
+    return f"Claude {name}: {' | '.join(details)}" if details else f"Claude event {name}"
+
+
+def _phase_for(name: str) -> str:
+    if "Tool" in name:
+        return "tool"
+    if name in ("Stop", "SubagentStop"):
+        return "conclude"
+    return "investigate"
+
+
+def convert_session_to_events(session_events: list[dict[str, Any]]) -> list[AgentEvent]:
+    """Hook records → agent-event timeline the learning loop consumes."""
+    ordered = sorted(session_events,
+                     key=lambda e: str(e.get("observed_at") or e.get("ts") or ""))
+    events = []
+    for record in ordered:
+        name = str(record.get("event_name") or record.get("eventName")
+                   or record.get("hook_event_name") or "event")
+        events.append(AgentEvent("evidence", {
+            "phase": _phase_for(name),
+            "type": f"claude_{name.lower()}",
+            "summary": describe_event(record),
+            "session_id": record.get("session_id") or record.get("sessionId"),
+        }))
+    return events
+
+
+def infer_query(session_events: list[dict[str, Any]], fallback: str) -> str:
+    for record in session_events:
+        prompt = _as_str((record.get("payload") or record).get("prompt"))
+        if prompt:
+            return prompt
+    return fallback
+
+
+def infer_services(session_events: list[dict[str, Any]]) -> list[str]:
+    services: list[str] = []
+    for record in session_events:
+        payload = record.get("payload") or record
+        single = _as_str(payload.get("service"))
+        if single and single.lower() not in services:
+            services.append(single.lower())
+        for item in payload.get("services") or []:
+            name = _as_str(item).lower()
+            if name and name not in services:
+                services.append(name)
+    return services
+
+
+def infer_root_cause(session_events: list[dict[str, Any]]) -> str:
+    for record in reversed(session_events):
+        payload = record.get("payload") or record
+        cause = _as_str(payload.get("root_cause")) or _as_str(payload.get("rootCause"))
+        if cause:
+            return cause
+    return ""
+
+
+def synthesize_result(session_id: str, session_events: list[dict[str, Any]],
+                      query: str = "") -> OrchestratorResult:
+    """Investigation-result shell for the learning loop (ingestion.ts:141)."""
+    fallback = (f"Analyze Claude session {session_id} and generate incident "
+                "learnings.")
+    count = len(session_events)
+    return OrchestratorResult(
+        summary={"incident_id": f"claude-{session_id}",
+                 "query": query or infer_query(session_events, fallback),
+                 "iterations": count},
+        root_cause=infer_root_cause(session_events),
+        confidence="medium" if count >= 8 else "low",
+        affected_services=infer_services(session_events),
+        conclusion_summary=(f"Synthesized from Claude session {session_id} "
+                            f"({count} captured hook events)."),
+        events=convert_session_to_events(session_events),
+    )
+
+
+async def run_learning_from_session(
+    llm: Any,
+    session_id: str,
+    session_events: Optional[list[dict[str, Any]]] = None,
+    store: Any = None,
+    query: str = "",
+    out_dir: str | Path = ".runbook/learning",
+) -> Path:
+    """Full pipeline: store/read → synthesize → learning loop artifacts."""
+    if session_events is None:
+        if store is None:
+            raise ValueError("pass session_events or a session store")
+        session_events = store.read(session_id)
+    result = synthesize_result(session_id, session_events, query=query)
+    return await run_learning_loop(llm, result, out_dir=out_dir)

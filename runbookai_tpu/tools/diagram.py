@@ -216,6 +216,13 @@ def register(reg: ToolRegistry) -> None:
                       ["actors"]),
         generate_sequence_diagram, category="diagram",
     )
+    async def render_mermaid(args):
+        from runbookai_tpu.tools.mermaid import detect_diagram_type, mermaid_to_ascii
+
+        code = str(args.get("code", ""))
+        return {"type": detect_diagram_type(code),
+                "diagram": mermaid_to_ascii(code)}
+
     reg.define(
         "generate_architecture_diagram",
         "Render a service architecture diagram. services: string[]; "
@@ -223,4 +230,11 @@ def register(reg: ToolRegistry) -> None:
         object_schema({"services": {"type": "array"},
                        "dependencies": {"type": "array"}}, ["services"]),
         generate_architecture_diagram, category="diagram",
+    )
+    reg.define(
+        "render_mermaid",
+        "Render mermaid source (graph/flowchart, sequenceDiagram, "
+        "stateDiagram) as an ASCII terminal diagram.",
+        object_schema({"code": {"type": "string"}}, ["code"]),
+        render_mermaid, category="diagram",
     )

@@ -1,0 +1,179 @@
+"""Mermaid-source parsing → ASCII rendering for the ``render_mermaid`` tool.
+
+Parity target: reference ``src/tools/diagram/mermaid.ts`` — diagram-type
+detection (:51), flowchart/sequence/state parsers (:70/:149/:200), and the
+``mermaidToASCII`` dispatcher (:516) behind the ``render_mermaid`` registry
+tool (registry.ts:3648). Rendering reuses the box/lifeline renderers in
+``tools/diagram.py``; the parsers accept the mermaid subset the agent emits
+(graph/flowchart TD|LR, sequenceDiagram, stateDiagram-v2).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any
+
+_DECOR = r"(?:\[[^\]]+\]|\{[^}]+\}|\(\([^)]+\)\)|\(\[[^\]]+\]\))?"
+_EDGE_RE = re.compile(
+    rf"^(\w+{_DECOR})\s*(-\.+-?[>ox]?|-{{1,2}}[>ox]?|={{2,}}[>ox]?|\.{{2,}}[>ox]?)"
+    rf"\s*(?:\|([^|]+)\|)?\s*(\w+{_DECOR})$")
+_NODE_RE = re.compile(
+    r"^(\w+)(\[([^\]]+)\]|\{([^}]+)\}|\(\(([^)]+)\)\)|\(\[([^\]]+)\]\))?$")
+_PARTICIPANT_RE = re.compile(r"^participant\s+(\w+)(?:\s+as\s+(.+))?$", re.I)
+_MESSAGE_RE = re.compile(r"^(\w+)\s*(-{1,2}>>?|\.{2,}>>?)\s*(\w+)\s*:\s*(.+)$")
+_TRANSITION_RE = re.compile(r"^(\[\*\]|\w+)\s*-->\s*(\[\*\]|\w+)(?:\s*:\s*(.+))?$")
+
+
+@dataclass
+class Flowchart:
+    direction: str = "TD"
+    nodes: dict[str, dict[str, str]] = field(default_factory=dict)
+    edges: list[dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class SequenceDiagram:
+    participants: list[str] = field(default_factory=list)
+    messages: list[dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class StateDiagram:
+    states: list[str] = field(default_factory=list)
+    transitions: list[dict[str, Any]] = field(default_factory=list)
+
+
+def detect_diagram_type(code: str) -> str:
+    first = code.strip().split("\n", 1)[0].strip().lower()
+    if first.startswith(("graph", "flowchart")):
+        return "flowchart"
+    if first.startswith("sequencediagram"):
+        return "sequence"
+    if first.startswith("statediagram"):
+        return "state"
+    return "unknown"
+
+
+def is_mermaid_code(code: str) -> bool:
+    return detect_diagram_type(code) != "unknown"
+
+
+def _body_lines(code: str) -> list[str]:
+    lines = code.strip().split("\n")[1:]
+    return [ln.strip() for ln in lines
+            if ln.strip() and not ln.strip().startswith("%%")]
+
+
+def parse_flowchart(code: str) -> Flowchart:
+    chart = Flowchart()
+    first = code.strip().split("\n", 1)[0].lower()
+    for d in ("lr", "bt", "rl"):
+        if first.endswith(" " + d):
+            chart.direction = d.upper()
+    def define_node(text: str) -> str:
+        """Parse ``A`` / ``A[Label]`` / ``A{X}`` / ``A((X))`` / ``A([X])``."""
+        node = _NODE_RE.match(text)
+        if not node:
+            return text
+        nid, decor, rect, diamond, circle, stadium = node.groups()
+        label, shape = nid, "rect"
+        if rect:
+            label = rect
+        elif diamond:
+            label, shape = diamond, "diamond"
+        elif circle:
+            label, shape = circle, "circle"
+        elif stadium:
+            label, shape = stadium, "stadium"
+        if decor or nid not in chart.nodes:
+            chart.nodes[nid] = {"id": nid, "label": label, "shape": shape}
+        return nid
+
+    for line in _body_lines(code):
+        edge = _EDGE_RE.match(line)
+        if edge:
+            src_text, connector, label, dst_text = edge.groups()
+            style = ("dotted" if "." in connector
+                     else "thick" if "=" in connector else "solid")
+            arrow = ("x" if "x" in connector
+                     else "normal" if ">" in connector else "none")
+            chart.edges.append({"from": define_node(src_text),
+                                "to": define_node(dst_text),
+                                "label": label or "",
+                                "style": style, "arrow": arrow})
+            continue
+        define_node(line)
+    return chart
+
+
+def parse_sequence(code: str) -> SequenceDiagram:
+    diagram = SequenceDiagram()
+    seen: set[str] = set()
+
+    def add(pid: str) -> None:
+        if pid not in seen:
+            seen.add(pid)
+            diagram.participants.append(pid)
+
+    for line in _body_lines(code):
+        participant = _PARTICIPANT_RE.match(line)
+        if participant:
+            add(participant.group(1))
+            continue
+        message = _MESSAGE_RE.match(line)
+        if message:
+            src, connector, dst, text = message.groups()
+            add(src)
+            add(dst)
+            kind = ("dotted" if ".." in connector
+                    else "async" if "--" in connector else "solid")
+            diagram.messages.append({"from": src, "to": dst, "label": text,
+                                     "type": kind})
+    return diagram
+
+
+def parse_state(code: str) -> StateDiagram:
+    diagram = StateDiagram()
+    seen: set[str] = set()
+    for line in _body_lines(code):
+        transition = _TRANSITION_RE.match(line)
+        if not transition:
+            continue
+        src, dst, label = transition.groups()
+        for state in (src, dst):
+            if state != "[*]" and state not in seen:
+                seen.add(state)
+                diagram.states.append(state)
+        diagram.transitions.append({"from": src, "to": dst,
+                                    "label": label or ""})
+    return diagram
+
+
+def render_state_ascii(diagram: StateDiagram) -> str:
+    lines = ["State diagram:", ""]
+    for state in diagram.states:
+        lines.append(f"  ( {state} )")
+    lines.append("")
+    for t in diagram.transitions:
+        src = "●" if t["from"] == "[*]" else t["from"]
+        dst = "◉" if t["to"] == "[*]" else t["to"]
+        label = f" : {t['label']}" if t["label"] else ""
+        lines.append(f"  {src} ──▶ {dst}{label}")
+    return "\n".join(lines)
+
+
+def mermaid_to_ascii(code: str) -> str:
+    """Dispatch on diagram type (mermaid.ts:516-538)."""
+    from runbookai_tpu.tools.diagram import render_flowchart, render_sequence
+
+    kind = detect_diagram_type(code)
+    if kind == "flowchart":
+        chart = parse_flowchart(code)
+        return render_flowchart(list(chart.nodes.values()), chart.edges)
+    if kind == "sequence":
+        diagram = parse_sequence(code)
+        return render_sequence(diagram.participants, diagram.messages)
+    if kind == "state":
+        return render_state_ascii(parse_state(code))
+    return f"(unsupported mermaid diagram; first line: {code.strip().splitlines()[0] if code.strip() else ''!r})"

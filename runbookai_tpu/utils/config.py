@@ -139,6 +139,7 @@ class OpsgenieConfig(BaseModel):
 
 class SlackConfig(BaseModel):
     enabled: bool = False
+    mode: Literal["socket", "http"] = "socket"  # gateway transport
     bot_token: Optional[str] = None
     signing_secret: Optional[str] = None
     app_token: Optional[str] = None

@@ -186,3 +186,66 @@ def test_compactor_plan_tiers(tmp_path):
     assert list(plan.values()).count("full") == 4
     # the old-but-error-laden result survives at full tier despite age
     assert plan["r1"] == "full"
+
+
+# ---------------------------------------------------------------------------
+# confidence module (reference src/agent/confidence.ts)
+
+def test_confidence_factor_weights_and_thresholds():
+    from runbookai_tpu.agent.confidence import (
+        ConfidenceFactors,
+        calculate_confidence,
+        confidence_score,
+    )
+
+    # Depth capped at 30, corroboration capped at 40.
+    deep = ConfidenceFactors(evidence_chain_depth=10, corroborating_signals=10)
+    assert confidence_score(deep) == 70
+    assert calculate_confidence(deep) == "high"
+
+    contradicted = ConfidenceFactors(
+        evidence_chain_depth=2, corroborating_signals=2,
+        contradicting_signals=2)
+    assert confidence_score(contradicted) == 20
+    assert calculate_confidence(contradicted) == "low"
+
+    boosted = ConfidenceFactors(
+        evidence_chain_depth=1, temporal_correlation=True,
+        historical_pattern_match=True, direct_evidence=True)
+    assert confidence_score(boosted) == 65
+    assert calculate_confidence(boosted) == "medium"
+
+
+def test_evidence_classification_parse_json_and_fallback():
+    from runbookai_tpu.agent.confidence import parse_evidence_classification
+
+    strength, reasoning = parse_evidence_classification(
+        'Here you go: {"strength": "strong", "reasoning": "OOM at 12:01"}')
+    assert strength == "strong" and reasoning == "OOM at 12:01"
+
+    strength, _ = parse_evidence_classification("the evidence is WEAK at best")
+    assert strength == "weak"
+    strength, _ = parse_evidence_classification("metrics all normal")
+    assert strength == "none"
+
+
+def test_confidence_formatting_and_aggregation():
+    from runbookai_tpu.agent.confidence import (
+        aggregate_confidence,
+        confidence_color,
+        format_confidence_badge,
+        format_confidence_text,
+        has_temporal_correlation,
+        parse_confidence_value,
+    )
+
+    text = format_confidence_text(82)
+    assert "82%" in text and "(High)" in text and "█" in text
+    assert format_confidence_badge(55) == "Medium (55%)"
+    assert confidence_color(25) == "red"
+    assert parse_confidence_value("High (85%)") == 85
+    assert parse_confidence_value("medium") == 55
+    assert parse_confidence_value("nonsense") is None
+    assert aggregate_confidence([80, 40], [3, 1]) == 70
+    assert has_temporal_correlation(1000.0, 1240.0)
+    assert not has_temporal_correlation(1000.0, 1400.0)

@@ -228,3 +228,63 @@ async def test_ingestion_client_spool_and_replay(tmp_path):
     replay = await client.replay()
     assert replay == {"replayed": 1, "failed": 0}
     assert client.status()["spooled_batches"] == 0
+
+
+# ---------------------------------------------------------------------------
+# claude session → learning pipeline (reference learning/claude-session-ingestion.ts)
+
+def _session_events():
+    return [
+        {"event_name": "UserPromptSubmit", "observed_at": "2026-03-01T10:00:00Z",
+         "session_id": "s1",
+         "payload": {"prompt": "payments api is throwing 503s"}},
+        {"event_name": "PreToolUse", "observed_at": "2026-03-01T10:00:05Z",
+         "session_id": "s1",
+         "payload": {"tool_name": "Bash", "services": ["payments-api"]}},
+        {"event_name": "PostToolUse", "observed_at": "2026-03-01T10:00:09Z",
+         "session_id": "s1",
+         "payload": {"tool_name": "Bash", "status": "ok",
+                     "root_cause": "connection pool exhausted"}},
+        {"event_name": "Stop", "observed_at": "2026-03-01T10:01:00Z",
+         "session_id": "s1", "payload": {}},
+    ]
+
+
+def test_synthesize_result_from_session():
+    from runbookai_tpu.learning.claude_session import (
+        convert_session_to_events,
+        describe_event,
+        synthesize_result,
+    )
+
+    events = _session_events()
+    result = synthesize_result("s1", events)
+    assert result.summary["incident_id"] == "claude-s1"
+    assert result.summary["query"] == "payments api is throwing 503s"
+    assert result.root_cause == "connection pool exhausted"
+    assert result.affected_services == ["payments-api"]
+    assert result.confidence == "low"  # < 8 events
+    timeline = convert_session_to_events(events)
+    assert timeline[0].data["type"] == "claude_userpromptsubmit"
+    assert timeline[1].data["phase"] == "tool"
+    assert timeline[-1].data["phase"] == "conclude"
+    assert "tool=Bash" in describe_event(events[1])
+
+
+async def test_run_learning_from_session(tmp_path):
+    from runbookai_tpu.learning.claude_session import run_learning_from_session
+
+    class FakeLLM:
+        async def complete(self, prompt):
+            if "postmortem" in prompt.lower():
+                return "# Postmortem\nit broke"
+            return ('{"suggestions": [{"type": "runbook", "title": "Pool '
+                    'exhaustion", "reason": "recurs", "services": '
+                    '["payments-api"], "outline": "check pool"}]}')
+
+    out = await run_learning_from_session(
+        FakeLLM(), "s1", session_events=_session_events(), out_dir=tmp_path)
+    assert (out / "postmortem-draft.md").exists()
+    import json as _json
+    suggestions = _json.loads((out / "knowledge-suggestions.json").read_text())
+    assert suggestions["suggestions"][0]["title"] == "Pool exhaustion"

@@ -142,3 +142,67 @@ def test_csv_and_tsv_rows(tmp_path):
     fx = rootly_to_fixtures(src)
     assert fx[0]["expected_services"] == ["edge-proxy"]
     assert "certificate" in fx[0]["root_cause_keywords"]
+
+
+# ---------------------------------------------------------------------------
+# run-all-benchmarks driver (reference src/eval/run-all-benchmarks.ts)
+
+def test_run_all_skips_missing_and_runs_present(tmp_path):
+    import json as _json
+
+    from runbookai_tpu.evalsuite.run_all import run_all_benchmarks
+
+    datasets = tmp_path / "datasets"
+    (datasets / "rcaeval").mkdir(parents=True)
+    rows = [{"case": "c1", "system": "online-boutique",
+             "root_cause_service": "cartservice", "fault_type": "cpu hog"}]
+    (datasets / "rcaeval" / "cases.json").write_text(_json.dumps(rows))
+
+    out = tmp_path / "reports"
+    aggregate = run_all_benchmarks(datasets_root=datasets, out_dir=out)
+    by_name = {r["benchmark"]: r for r in aggregate["results"]}
+    # offline runner with no mock_result → cases skipped, pass_rate 0 but
+    # benchmark itself completed (status governed by min_pass_rate=0)
+    assert by_name["rcaeval"]["status"] == "passed"
+    assert by_name["rcaeval"]["case_count"] == 1
+    assert by_name["rootly"]["status"] == "skipped"
+    assert by_name["tracerca"]["status"] == "skipped"
+    assert (out / "run-all.json").exists()
+    assert (out / "rcaeval-fixtures.json").exists()
+    assert (out / "summary.json").exists()
+
+
+def test_run_all_custom_runner_and_threshold(tmp_path):
+    import json as _json
+
+    from runbookai_tpu.evalsuite.run_all import run_single_benchmark
+    from runbookai_tpu.evalsuite.runner import BenchmarkReport
+
+    datasets = tmp_path / "d"
+    (datasets / "tracerca").mkdir(parents=True)
+    (datasets / "tracerca" / "cases.csv").write_text(
+        "trace_id,root_cause,anomaly_type\nt1,payments,latency\n")
+
+    def failing_runner(cases):
+        report = BenchmarkReport(name="x")
+        report.cases = [{"case_id": c.case_id, "passed": False} for c in cases]
+        return report
+
+    run = run_single_benchmark("tracerca", datasets, tmp_path / "out",
+                               runner=failing_runner, min_pass_rate=0.5)
+    assert run.status == "failed"
+    assert run.case_count == 1
+
+
+def test_setup_datasets_gracefully_fails_offline(tmp_path, monkeypatch):
+    from runbookai_tpu.evalsuite import run_all as ra
+
+    def fake_run(cmd, **kw):
+        class P:
+            returncode = 128
+            stderr = "could not resolve host"
+        return P()
+
+    monkeypatch.setattr(ra.subprocess, "run", fake_run)
+    statuses = ra.setup_datasets(tmp_path, ["rcaeval"])
+    assert statuses["rcaeval"].startswith("failed")

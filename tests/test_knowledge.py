@@ -202,3 +202,183 @@ def test_service_graph_persistence(tmp_path):
     g2 = ServiceGraph.load(path)
     assert g2.dependencies_of("a-svc") == ["b-svc"]
     assert g2.edges[0].kind == "async"
+
+
+# ---------------------------------------------------------------------------
+# remote sources: html→markdown, confluence, google drive, dispatcher
+# (reference src/knowledge/sources/{confluence,google-drive,index}.ts)
+
+def test_html_to_markdown_structures():
+    from runbookai_tpu.knowledge.sources.html_markdown import html_to_markdown
+
+    html = """
+    <h1>Payments Runbook</h1>
+    <p>Check the <strong>error rate</strong> first.</p>
+    <ul><li>step one</li><li>step two</li></ul>
+    <pre>kubectl get pods</pre>
+    <table><tr><th>svc</th><th>tier</th></tr>
+    <tr><td>payments</td><td>1</td></tr></table>
+    """
+    md = html_to_markdown(html)
+    assert "# Payments Runbook" in md
+    assert "**error rate**" in md
+    assert "- step one" in md
+    assert "```" in md and "kubectl get pods" in md
+    assert "| svc | tier |" in md and "| payments | 1 |" in md
+
+
+def _confluence_fetch(pages_v2=None, v1_pages=None):
+    import json as _json
+
+    def fetch(url, headers):
+        assert headers["Authorization"].startswith("Basic ")
+        if "/wiki/api/v2/" in url:
+            if pages_v2 is None:
+                return 404, b"{}"
+            return 200, _json.dumps({"results": pages_v2, "_links": {}}).encode()
+        if "/wiki/rest/api/content" in url:
+            return 200, _json.dumps({"results": v1_pages or []}).encode()
+        raise AssertionError(f"unexpected url {url}")
+
+    return fetch
+
+
+def test_confluence_v2_labels_and_incremental():
+    from runbookai_tpu.knowledge.sources.confluence import ConfluenceSource
+
+    pages = [
+        {"id": "101", "title": "DB failover runbook",
+         "version": {"createdAt": "2026-01-02T00:00:00.000Z"},
+         "body": {"storage": {"value": "<h2>Steps</h2><p>promote replica</p>"}},
+         "metadata": {"labels": {"results": [
+             {"name": "runbook"}, {"name": "service:payments-db"}]}}},
+        {"id": "102", "title": "Old page",
+         "version": {"createdAt": "2020-01-01T00:00:00.000Z"},
+         "body": {"storage": {"value": "<p>stale</p>"}},
+         "metadata": {"labels": {"results": [{"name": "runbook"}]}}},
+    ]
+    src = ConfluenceSource("https://x.atlassian.net", "OPS", "me@x.io", "tok",
+                           fetch=_confluence_fetch(pages_v2=pages))
+    docs = src.load(since=time.mktime((2021, 1, 1, 0, 0, 0, 0, 0, 0)))
+    assert len(docs) == 1
+    doc = docs[0]
+    assert doc.knowledge_type == "runbook"
+    assert doc.services == ["payments-db"]
+    assert "promote replica" in doc.content
+    assert doc.chunks and doc.source_ref == "OPS/101"
+
+
+def test_confluence_v1_fallback():
+    from runbookai_tpu.knowledge.sources.confluence import ConfluenceSource
+
+    v1 = [{"id": "7", "title": "Postmortem 2026-01",
+           "version": {"when": "2026-01-05T10:00:00Z"},
+           "body": {"storage": {"value": "<p>root cause: OOM</p>"}},
+           "metadata": {"labels": {"results": [{"name": "postmortem"}]}}}]
+    src = ConfluenceSource("https://x.atlassian.net", "OPS", "me@x.io", "tok",
+                           fetch=_confluence_fetch(pages_v2=None, v1_pages=v1))
+    docs = src.load()
+    assert len(docs) == 1 and docs[0].knowledge_type == "postmortem"
+
+
+def test_google_drive_listing_docs_sheets(tmp_path):
+    import json as _json
+
+    from runbookai_tpu.knowledge.sources.google_drive import GoogleDriveSource
+
+    def fetch(url, headers):
+        assert headers["Authorization"] == "Bearer tok"
+        if "/files?" in url:
+            if "root-folder" in url:
+                return 200, _json.dumps({"files": [
+                    {"id": "sub", "mimeType": "application/vnd.google-apps.folder",
+                     "name": "sub"},
+                    {"id": "doc1", "mimeType": "application/vnd.google-apps.document",
+                     "name": "Oncall guide", "modifiedTime": "2026-02-01T00:00:00Z"},
+                ]}).encode()
+            return 200, _json.dumps({"files": [
+                {"id": "sheet1",
+                 "mimeType": "application/vnd.google-apps.spreadsheet",
+                 "name": "Service owners",
+                 "modifiedTime": "2026-02-02T00:00:00Z"},
+            ]}).encode()
+        if "doc1/export" in url:
+            return 200, b"# Oncall\ncall the primary"
+        if "sheet1/export" in url:
+            return 200, b"service,owner\npayments,alice"
+        raise AssertionError(url)
+
+    src = GoogleDriveSource(["root-folder"], "tok", fetch=fetch)
+    docs = src.load()
+    titles = {d.title for d in docs}
+    assert titles == {"Oncall guide", "Service owners"}
+    sheet = next(d for d in docs if d.title == "Service owners")
+    assert "| service | owner |" in sheet.content
+    assert "| payments | alice |" in sheet.content
+
+
+def test_google_auth_refresh_and_store(tmp_path):
+    import json as _json
+
+    from runbookai_tpu.knowledge.sources.google_auth import (
+        GoogleTokens,
+        TokenStore,
+        authorization_url,
+        valid_access_token,
+    )
+
+    assert "client_id=cid" in authorization_url("cid")
+
+    store = TokenStore(tmp_path / "tokens.json")
+    store.save(GoogleTokens(access_token="old", refresh_token="r1",
+                            expires_at=time.time() - 10))
+
+    def post(url, headers, body):
+        assert b"grant_type=refresh_token" in body
+        return 200, _json.dumps({"access_token": "new", "expires_in": 3600}).encode()
+
+    token = valid_access_token(store, "cid", "secret", post=post)
+    assert token == "new"
+    assert store.load().access_token == "new"
+    assert store.load().refresh_token == "r1"  # preserved across refresh
+
+
+def test_source_dispatcher(tmp_path):
+    from runbookai_tpu.knowledge.sources import load_from_source
+    from runbookai_tpu.utils.config import KnowledgeSourceConfig
+
+    (tmp_path / "a.md").write_text("---\ntype: runbook\n---\n# A\nbody")
+    docs = load_from_source(
+        KnowledgeSourceConfig(type="filesystem", path=str(tmp_path)))
+    assert len(docs) == 1 and docs[0].knowledge_type == "runbook"
+    # google-drive without token → skipped, not an error
+    assert load_from_source(
+        KnowledgeSourceConfig(type="google-drive", folder_id="x")) == []
+
+
+def test_confluence_v2_fetches_labels_endpoint():
+    import json as _json
+
+    from runbookai_tpu.knowledge.sources.confluence import ConfluenceSource
+
+    calls = []
+
+    def fetch(url, headers):
+        calls.append(url)
+        if "/labels" in url:
+            return 200, _json.dumps({"results": [
+                {"name": "runbook"}, {"name": "service:payments"}]}).encode()
+        if "/wiki/api/v2/spaces/" in url:
+            return 200, _json.dumps({"results": [
+                {"id": "9", "title": "P",
+                 "version": {"createdAt": "2026-01-01T00:00:00Z"},
+                 "body": {"storage": {"value": "<p>x</p>"}}}],
+                "_links": {}}).encode()
+        raise AssertionError(url)
+
+    src = ConfluenceSource("https://x.atlassian.net", "OPS", "a@b.c", "t",
+                           fetch=fetch)
+    docs = src.load()
+    assert any("/pages/9/labels" in u for u in calls)
+    assert docs[0].knowledge_type == "runbook"
+    assert docs[0].services == ["payments"]

@@ -229,3 +229,66 @@ async def test_github_fix_candidates_ranking(monkeypatch):
     candidates = await gh.fix_candidates("org/repo", ["pool", "payment-api"])
     assert candidates[0]["number"] == 3 and candidates[0]["relevance"] == 2
     assert candidates[1]["number"] == 1
+
+
+# ---------------------------------------------------------------------------
+# mermaid parsing + render_mermaid tool (reference tools/diagram/mermaid.ts)
+
+def test_mermaid_flowchart_parse_and_render():
+    from runbookai_tpu.tools.mermaid import (
+        detect_diagram_type,
+        mermaid_to_ascii,
+        parse_flowchart,
+    )
+
+    code = """graph LR
+    A[API Gateway] --> B{Healthy?}
+    B -->|yes| C((Serve))
+    B -.->|no| D([Fallback])
+    """
+    assert detect_diagram_type(code) == "flowchart"
+    chart = parse_flowchart(code)
+    assert chart.direction == "LR"
+    assert chart.nodes["A"]["label"] == "API Gateway"
+    assert chart.nodes["B"]["shape"] == "diamond"
+    assert chart.nodes["D"]["shape"] == "stadium"
+    styles = {(e["from"], e["to"]): e["style"] for e in chart.edges}
+    assert styles[("B", "D")] == "dotted"
+    art = mermaid_to_ascii(code)
+    assert "API Gateway" in art and "Fallback" in art
+
+
+def test_mermaid_sequence_and_state():
+    from runbookai_tpu.tools.mermaid import mermaid_to_ascii, parse_sequence, parse_state
+
+    seq = """sequenceDiagram
+    participant U as User
+    U->>S: request
+    S-->>U: async reply
+    """
+    parsed = parse_sequence(seq)
+    assert parsed.participants == ["U", "S"]
+    assert parsed.messages[1]["type"] == "async"
+    assert "request" in mermaid_to_ascii(seq)
+
+    state = """stateDiagram-v2
+    [*] --> Triage
+    Triage --> Investigate : hypotheses
+    Investigate --> [*]
+    """
+    parsed_state = parse_state(state)
+    assert parsed_state.states == ["Triage", "Investigate"]
+    assert parsed_state.transitions[0]["from"] == "[*]"
+    assert "Triage" in mermaid_to_ascii(state)
+
+
+async def test_render_mermaid_tool_registered():
+    from runbookai_tpu.tools import diagram as diagram_tools
+    from runbookai_tpu.tools.registry import ToolRegistry
+
+    reg = ToolRegistry()
+    diagram_tools.register(reg)
+    tool = reg.get("render_mermaid")
+    out = await tool.execute({"code": "graph TD\n  A --> B"})
+    assert out["type"] == "flowchart"
+    assert "A" in out["diagram"]

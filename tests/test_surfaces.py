@@ -262,3 +262,146 @@ async def test_learning_loop_artifacts(tmp_path):
     suggestions = json.loads((out / "knowledge-suggestions.json").read_text())
     assert suggestions["suggestions"][0]["title"] == "Pool saturation"
     assert json.loads((out / "record.json").read_text())["root_cause"] == "pool exhausted"
+
+
+# ---------------------------------------------------------------------------
+# terminal UI components (reference src/cli/components/*.tsx) + setup wizard
+
+def test_markdown_renderer_blocks():
+    from runbookai_tpu.cli.markdown import parse_blocks, render_markdown
+
+    md = """# Incident report
+
+Root cause was a **bad deploy** touching `payments`.
+
+- first item
+- second item
+
+```bash
+kubectl rollout undo deploy/payments
+```
+
+| svc | status |
+|-----|--------|
+| payments | degraded |
+
+> quote line
+"""
+    kinds = [b.kind for b in parse_blocks(md)]
+    assert kinds == ["header", "paragraph", "list", "code", "table", "blockquote"]
+
+    plain = render_markdown(md, color=False)
+    assert "# Incident report" in plain
+    assert "bad deploy" in plain and "**" not in plain
+    assert "• first item" in plain
+    assert "kubectl rollout undo" in plain
+    assert "│ payments" in plain
+
+    ansi = render_markdown(md, color=True)
+    assert "\x1b[1m" in ansi  # bold somewhere
+
+
+def test_markdown_ordered_list_and_links():
+    from runbookai_tpu.cli.markdown import render_markdown
+
+    md = "1. step one\n2. step two\n\nsee [runbook](https://kb/x)"
+    plain = render_markdown(md, color=False)
+    assert "1. step one" in plain and "2. step two" in plain
+    assert "runbook <https://kb/x>" in plain
+
+
+def test_hypothesis_tree_rendering():
+    from runbookai_tpu.agent.state_machine import FSMHypothesis
+    from runbookai_tpu.cli.hypothesis_view import (
+        count_statuses,
+        render_summary,
+        render_tree,
+    )
+
+    nodes = [
+        FSMHypothesis(id="h1", statement="bad deploy", status="confirmed",
+                      confidence=85.0, children=["h2", "h3"]),
+        FSMHypothesis(id="h2", statement="config drift", parent_id="h1",
+                      status="pruned", depth=1),
+        FSMHypothesis(id="h3", statement="pool exhaustion", parent_id="h1",
+                      status="investigating", depth=1,
+                      evidence=[{"summary": "x"}]),
+    ]
+    tree = render_tree(nodes, color=False)
+    assert "● bad deploy 85%" in tree
+    assert "├─" in tree and "└─" in tree
+    assert "config drift" in tree
+    hidden = render_tree(nodes, show_pruned=False, color=False)
+    assert "config drift" not in hidden
+    assert "[1 evidence]" in tree
+
+    counts = count_statuses(nodes)
+    assert counts["confirmed"] == 1 and counts["pruned"] == 1
+    summary = render_summary(nodes, color=False)
+    assert "Root cause: bad deploy (85%)" in summary
+
+
+def test_wizard_scripted_flow_and_save(tmp_path):
+    from runbookai_tpu.cli.wizard import (
+        OnboardingAnswers,
+        generate_configs,
+        hydrate_answers,
+        run_wizard,
+        save_wizard_configs,
+    )
+
+    answers_script = iter([
+        "custom",            # template
+        "jax-tpu", "llama3-8b-instruct",
+        "multi", "prod,staging", "us-east-1,eu-west-1",
+        "ecs,eks", "rds",
+        "y",                  # kubernetes
+        "pagerduty",
+        "n",                  # slack
+        "./docs/runbooks",
+    ])
+    answers = run_wizard(ask=lambda q, d: next(answers_script))
+    assert answers.account_names == ["prod", "staging"]
+    assert answers.compute_services == ["ecs", "eks"]
+    assert answers.use_kubernetes
+
+    config_path, services_path = save_wizard_configs(answers, tmp_path)
+    assert config_path.exists() and services_path.exists()
+
+    config, services = generate_configs(answers)
+    assert config.llm.provider == "jax-tpu"
+    assert config.providers.kubernetes.enabled  # eks implies k8s
+    assert config.incident.pagerduty.enabled
+    assert len(services.accounts) == 2
+    assert {s.type for s in services.services} == {"ecs", "eks", "rds"}
+
+    # hydration round-trip picks the saved answers back up
+    hydrated = hydrate_answers(tmp_path)
+    assert hydrated.account_setup == "multi"
+    assert hydrated.compute_services == ["ecs", "eks"]
+    assert hydrated.incident_provider == "pagerduty"
+
+
+def test_wizard_quick_template():
+    from runbookai_tpu.cli.wizard import run_wizard
+
+    answers = run_wizard(ask=lambda q, d: "kubernetes")
+    assert answers.use_kubernetes and answers.compute_services == ["eks"]
+
+
+def test_markdown_unterminated_table_does_not_hang():
+    from runbookai_tpu.cli.markdown import parse_blocks, render_markdown
+
+    blocks = parse_blocks("| a | b")  # no trailing pipe — must still terminate
+    assert [b.kind for b in blocks] == ["table"]
+    assert "a" in render_markdown("| a | b\nplain text after", color=False)
+
+
+def test_hypothesis_confidence_fraction_scaling():
+    from runbookai_tpu.agent.state_machine import FSMHypothesis
+    from runbookai_tpu.cli.hypothesis_view import render_summary, render_tree
+
+    nodes = [FSMHypothesis(id="h", statement="bad deploy",
+                           status="confirmed", confidence=0.85)]
+    assert "85%" in render_tree(nodes, color=False)
+    assert "(85%)" in render_summary(nodes, color=False)
