@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from runbookai_tpu.engine.kv_cache import KVCacheManager
+from runbookai_tpu.engine.kv_cache import KVCacheManager, hash_blocks
 from runbookai_tpu.engine.request import (
     EngineOutput,
     EngineRequest,
@@ -174,7 +174,8 @@ class EngineCore:
         self._last_token: dict[str, int] = {}
         # Serving metrics (BASELINE.md contract: TTFT + tokens/sec/chip).
         self.metrics = {"decode_tokens": 0, "decode_steps": 0, "prefill_tokens": 0,
-                        "preemptions": 0, "decode_time_s": 0.0, "prefill_time_s": 0.0}
+                        "preemptions": 0, "decode_time_s": 0.0, "prefill_time_s": 0.0,
+                        "cached_prefix_tokens": 0}
 
     # ------------------------------------------------------------------ API
 
@@ -216,12 +217,22 @@ class EngineCore:
             headroom = min(self.ecfg.admit_headroom_tokens, req.sampling.max_new_tokens)
             if not (self.prefilling or self.decoding) and in_flight == 0:
                 headroom = 0
-            if not self.kv.can_admit(len(req.prompt_ids), headroom):
+            if req.block_hashes is None:
+                req.block_hashes = hash_blocks(req.prompt_ids, self.ecfg.page_size)
+            ok, matched = self.kv.probe_admit(req.prompt_ids, headroom,
+                                              hashes=req.block_hashes)
+            if not ok:
                 break
             self.waiting.pop(0)
-            self.kv.add_sequence(req.request_id)
+            # Reuse resident pages for the shared prompt prefix (same system
+            # prompt across agent iterations): prefill resumes at the first
+            # novel token.
+            cached = self.kv.add_sequence(req.request_id, req.prompt_ids,
+                                          hashes=req.block_hashes,
+                                          matched=matched)
             req.state = RequestState.PREFILL
-            req.prefill_pos = 0
+            req.prefill_pos = cached
+            self.metrics["cached_prefix_tokens"] += cached
             self.prefilling.append(req)
             in_flight += 1
 
@@ -234,13 +245,33 @@ class EngineCore:
         if victim.slot is not None:
             self._slots[victim.slot] = None
             victim.slot = None
-        self.kv.release(victim.request_id)
+        # Publish the victim's full pages before freeing: re-admission will
+        # match its own prefix and recompute only the tail.
+        self.kv.release(victim.request_id, token_ids=self._kv_valid_tokens(victim))
+        # Fold generated tokens into the prompt for recompute. They move to
+        # folded_out_ids (not out_ids) so ctx_len never double-counts them and
+        # the output/budget accounting still sees every generated token.
         victim.prompt_ids = victim.prompt_ids + victim.out_ids
+        victim.folded_out_ids = victim.folded_out_ids + victim.out_ids
+        victim.out_ids = []
+        victim.block_hashes = None
         victim.prefill_pos = 0
         victim.state = RequestState.WAITING
         self.waiting.insert(0, victim)
         self.metrics["preemptions"] += 1
         return True
+
+    def _kv_valid_tokens(self, req: EngineRequest) -> list[int]:
+        """Tokens whose K/V has actually been written to the pool.
+
+        Prefilled prompt tokens plus every generated token that was fed back
+        (all but the last emitted one — its KV write happens on the *next*
+        decode dispatch, which never runs for a finishing sequence).
+        """
+        valid = req.prompt_ids[: req.prefill_pos]
+        if req.out_ids:
+            valid = valid + req.out_ids[:-1]
+        return valid
 
     def _finish(self, req: EngineRequest, reason: FinishReason) -> None:
         req.state = RequestState.FINISHED
@@ -252,7 +283,7 @@ class EngineCore:
             self.decoding.remove(req)
         if req in self.prefilling:
             self.prefilling.remove(req)
-        self.kv.release(req.request_id)
+        self.kv.release(req.request_id, token_ids=self._kv_valid_tokens(req))
         self._last_token.pop(req.request_id, None)
         self.finished.append(req)
         if req.done_event is not None:
@@ -292,6 +323,10 @@ class EngineCore:
         self.metrics["prefill_tokens"] += chunk_len
 
         if req.prefill_pos >= len(req.prompt_ids):
+            # Publish the prompt's full pages so concurrent/following requests
+            # with the same prefix skip their prefill.
+            self.kv.register_prefix(req.request_id, req.prompt_ids,
+                                    hashes=req.block_hashes)
             # Prompt fully cached: sample the first output token from the last
             # chunk's final logits, then move to a decode slot.
             self._key, sub = jax.random.split(self._key)
@@ -308,7 +343,8 @@ class EngineCore:
             self._slots[slot] = req
             req.slot = slot
             req.state = RequestState.DECODE
-            req.first_token_time = time.perf_counter()
+            if req.first_token_time is None:  # preserve true TTFT across preemption
+                req.first_token_time = time.perf_counter()
             self.decoding.append(req)
             self._emit_token(req, first)
         self.metrics["prefill_time_s"] += time.perf_counter() - t0
@@ -327,10 +363,12 @@ class EngineCore:
             self._finish(req, FinishReason.STOP_TOKEN)
         elif grammar_done:
             self._finish(req, FinishReason.GRAMMAR_END)
-        elif len(req.out_ids) >= req.sampling.max_new_tokens:
+        elif req.num_generated >= req.sampling.max_new_tokens:
             self._finish(req, FinishReason.MAX_TOKENS)
         elif req.sampling.stop_strings:
-            tail = self.tokenizer.decode(req.out_ids[-32:])
+            # Tail-only slices: all_out_ids would copy O(N) per emitted token.
+            tail = self.tokenizer.decode(
+                (req.folded_out_ids[-32:] + req.out_ids[-32:])[-32:])
             if any(s in tail for s in req.sampling.stop_strings):
                 self._finish(req, FinishReason.STOP_STRING)
 
@@ -452,16 +490,15 @@ class EngineCore:
 
     def output_for(self, req: EngineRequest) -> EngineOutput:
         # Strip the stop token from the visible text.
-        ids = req.out_ids
+        ids = req.all_out_ids  # includes tokens folded by preemption
         stop_ids = set(req.sampling.stop_token_ids) | {self.tokenizer.eos_id, self.tokenizer.eot_id}
-        if ids and ids[-1] in stop_ids:
-            ids = ids[:-1]
+        text_ids = ids[:-1] if ids and ids[-1] in stop_ids else ids
         return EngineOutput(
             request_id=req.request_id,
-            token_ids=list(req.out_ids),
-            text=self.tokenizer.decode(ids),
+            token_ids=list(ids),
+            text=self.tokenizer.decode(text_ids),
             finish_reason=req.finish_reason or FinishReason.ABORTED,
             ttft_ms=req.ttft_ms,
-            decode_tokens=len(req.out_ids),
+            decode_tokens=req.num_generated,
             elapsed_s=time.perf_counter() - req.arrival_time,
         )

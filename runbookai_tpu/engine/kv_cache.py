@@ -1,17 +1,31 @@
-"""Paged KV cache: device page pool + host-side page allocator.
+"""Paged KV cache: device page pool + host-side allocator with prefix reuse.
 
 The pool is a pair of arrays ``[n_layers, num_pages * page_size, n_kv_heads,
 head_dim]`` — fully static shapes so every engine step hits the same compiled
 program. Logical→physical mapping lives in per-slot page tables (int32), and
-the free list is host-side (a C++ allocator can swap in behind the same
-interface; the Python one is O(1) per op and not a bottleneck at v1 scale).
+the free list is host-side.
+
+Prefix caching (automatic, vLLM-style): full pages are content-addressed by a
+hash chain over their token ids. When a new request's prompt shares a
+page-aligned prefix with pages still resident in HBM — the same system prompt
+re-sent by every agent iteration — those pages are reused (refcounted,
+copy-on-write-free: shared pages are never written, because decode only ever
+writes the *last, unshared* page of a sequence) and prefill skips straight to
+the first novel token. Pages whose last reference drops move to an LRU of
+retired-but-resident pages and are only truly recycled under pool pressure.
+
+Two interchangeable backends implement the allocator+index: pure Python here,
+and the C++ one in :mod:`runbookai_tpu.native` (selected automatically when
+the compiled library is available; ``RUNBOOKAI_NATIVE=0`` disables).
 
 No reference counterpart (SURVEY.md §2.9 item 2 — green-field requirement).
 """
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -45,11 +59,48 @@ class PagePool:
         )
 
 
+def hash_blocks(token_ids: Sequence[int], page_size: int,
+                max_blocks: Optional[int] = None) -> list[int]:
+    """FNV-1a hash chain over full pages of ``token_ids``.
+
+    Block i's hash folds in block i-1's, so equal hashes imply equal full
+    prefixes (up to hash collisions), never equal pages at different depths.
+    Dispatches to the C++ implementation when the native library is built.
+    """
+    from runbookai_tpu import native
+
+    if native.available():
+        out = native.hash_blocks_native(token_ids, page_size, max_blocks)
+        if out is not None:
+            return out
+    n_full = len(token_ids) // page_size
+    if max_blocks is not None:
+        n_full = min(n_full, max_blocks)
+    out: list[int] = []
+    h = 0xCBF29CE484222325
+    for b in range(n_full):
+        for t in token_ids[b * page_size : (b + 1) * page_size]:
+            h ^= (t + 1) & 0xFFFFFFFFFFFFFFFF
+            h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+        out.append(h)
+    return out
+
+
 class PageAllocator:
-    """Host-side free-list allocator over physical page ids.
+    """Host-side allocator over physical page ids with a prefix-cache index.
 
     Page 0 is reserved as the "null" page that padding/unused page-table slots
     point at, so garbage gathers stay in-bounds and get masked downstream.
+
+    Page lifecycle::
+
+        free ──alloc──▶ referenced (ref ≥ 1, owned by live sequences)
+          ▲                │ decref→0, has content hash
+          │                ▼
+          └──evict──── retired LRU (resident, matchable, recyclable)
+
+    ``alloc`` prefers the free list and falls back to evicting the
+    least-recently-retired cached page (its hash entry is invalidated).
     """
 
     NULL_PAGE = 0
@@ -59,20 +110,83 @@ class PageAllocator:
             raise ValueError("need at least 2 pages (one reserved null page)")
         self.num_pages = num_pages
         self._free: list[int] = list(range(num_pages - 1, 0, -1))  # stack; 0 reserved
+        self._ref: dict[int, int] = {}
+        self._retired: OrderedDict[int, None] = OrderedDict()  # LRU, ref == 0
+        self._hash_to_page: dict[int, int] = {}
+        self._page_to_hash: dict[int, int] = {}
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        """Pages allocatable right now (free + evictable retired)."""
+        return len(self._free) + len(self._retired)
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._retired)
 
     def alloc(self, n: int) -> list[int]:
-        if n > len(self._free):
-            raise MemoryError(f"KV page pool exhausted: want {n}, have {len(self._free)}")
-        return [self._free.pop() for _ in range(n)]
+        if n > self.free_pages:
+            raise MemoryError(
+                f"KV page pool exhausted: want {n}, have {self.free_pages}")
+        out: list[int] = []
+        for _ in range(n):
+            if self._free:
+                p = self._free.pop()
+            else:
+                p, _ = self._retired.popitem(last=False)  # oldest retired
+                self._invalidate(p)
+            self._ref[p] = 1
+            out.append(p)
+        return out
 
-    def free(self, pages: list[int]) -> None:
+    def free(self, pages: Sequence[int]) -> None:
+        """Decref each page; unreferenced pages retire (if hashed) or free."""
         for p in pages:
-            if p != self.NULL_PAGE:
+            if p == self.NULL_PAGE:
+                continue
+            r = self._ref.get(p, 0) - 1
+            if r > 0:
+                self._ref[p] = r
+                continue
+            self._ref.pop(p, None)
+            if p in self._page_to_hash:
+                self._retired[p] = None
+                self._retired.move_to_end(p)
+            else:
                 self._free.append(p)
+
+    # ------------------------------------------------------------ prefix cache
+
+    def register(self, page: int, block_hash: int) -> None:
+        """Publish a full page's content hash so future prompts can match it."""
+        if page == self.NULL_PAGE or block_hash in self._hash_to_page:
+            return  # first writer wins; duplicates keep their private copy
+        old = self._page_to_hash.get(page)
+        if old is not None:
+            self._hash_to_page.pop(old, None)
+        self._page_to_hash[page] = block_hash
+        self._hash_to_page[block_hash] = page
+
+    def lookup(self, block_hash: int) -> Optional[int]:
+        return self._hash_to_page.get(block_hash)
+
+    def acquire(self, page: int) -> None:
+        """Take a reference on a matched page (reviving it if retired)."""
+        if page in self._retired:
+            del self._retired[page]
+            self._ref[page] = 1
+        else:
+            self._ref[page] = self._ref.get(page, 0) + 1
+
+    def is_retired(self, page: int) -> bool:
+        """True when the page is resident but unreferenced (counts toward
+        ``free_pages``; acquiring it consumes allocatable capacity)."""
+        return page in self._retired
+
+    def _invalidate(self, page: int) -> None:
+        h = self._page_to_hash.pop(page, None)
+        if h is not None and self._hash_to_page.get(h) == page:
+            del self._hash_to_page[h]
 
 
 @dataclass
@@ -81,6 +195,7 @@ class SequenceAllocation:
 
     pages: list[int] = field(default_factory=list)
     ctx_len: int = 0  # tokens currently cached
+    registered_blocks: int = 0  # full pages whose hashes are published
 
     def pages_needed(self, new_len: int, page_size: int) -> int:
         have = len(self.pages)
@@ -100,15 +215,119 @@ class KVCacheManager:
         head_dim: int,
         max_seq_len: int,
         dtype=jnp.bfloat16,
+        allocator: Optional[PageAllocator] = None,
     ):
         self.pool = PagePool.create(n_layers, num_pages, page_size, n_kv_heads, head_dim, dtype)
-        self.allocator = PageAllocator(num_pages)
+        if allocator is None:
+            from runbookai_tpu.native import make_page_allocator
+
+            allocator = make_page_allocator(num_pages)
+        self.allocator = allocator
         self.page_size = page_size
         self.max_pages_per_seq = (max_seq_len + page_size - 1) // page_size
         self.seqs: dict[str, SequenceAllocation] = {}
+        # Token ids actually stored in each published page — matches are
+        # verified against these so a 64-bit hash collision can never serve
+        # another request's KV (cross-request leakage). Bounded by num_pages.
+        self._page_tokens: dict[int, tuple[int, ...]] = {}
 
-    def add_sequence(self, seq_id: str) -> None:
-        self.seqs[seq_id] = SequenceAllocation()
+    # ----------------------------------------------------------- prefix reuse
+
+    def _prompt_hashes(self, prompt_ids: Sequence[int],
+                       hashes: Optional[list[int]]) -> list[int]:
+        """Hash chain for matching: capped below ``len(prompt_ids)`` so at
+        least one prompt token is always prefilled (the engine needs its
+        logits to sample from). ``hashes`` may be a memoized full chain."""
+        max_blocks = (len(prompt_ids) - 1) // self.page_size
+        if hashes is not None:
+            return hashes[:max_blocks]
+        return hash_blocks(prompt_ids, self.page_size, max_blocks)
+
+    def _match_pages(self, prompt_ids: Sequence[int],
+                     hashes: Optional[list[int]]) -> list[int]:
+        """Resident pages holding the prompt's leading full blocks, verified
+        token-by-token (a bare hash hit is never trusted)."""
+        matched: list[int] = []
+        for b, h in enumerate(self._prompt_hashes(prompt_ids, hashes)):
+            page = self.allocator.lookup(h)
+            if page is None:
+                break
+            blk = tuple(prompt_ids[b * self.page_size : (b + 1) * self.page_size])
+            if self._page_tokens.get(page) != blk:
+                break  # hash collision or stale publish — treat as a miss
+            matched.append(page)
+        return matched
+
+    def match_prefix(self, prompt_ids: Sequence[int],
+                     hashes: Optional[list[int]] = None) -> int:
+        """Longest reusable page-aligned prefix length (read-only probe)."""
+        return len(self._match_pages(prompt_ids, hashes)) * self.page_size
+
+    def probe_admit(self, prompt_ids: Sequence[int], headroom_tokens: int = 0,
+                    hashes: Optional[list[int]] = None,
+                    ) -> tuple[bool, list[int]]:
+        """Admission check honoring prefix reuse: ``(fits, matched_pages)``.
+
+        Matched *retired* pages are about to be revived by ``add_sequence`` —
+        they both reduce the pages to allocate and consume allocatable
+        capacity, so they must be subtracted from ``free_pages`` too (a plain
+        ``can_admit(cached_len=...)`` would double-count them). The matched
+        pages are returned so ``add_sequence(matched=...)`` needn't re-walk
+        the chain (valid only until the next alloc/release).
+        """
+        matched = self._match_pages(prompt_ids, hashes)
+        cached = len(matched) * self.page_size
+        reserved = sum(1 for p in matched if self.allocator.is_retired(p))
+        need = self.add_pages_needed(len(prompt_ids), cached, headroom_tokens)
+        return need <= self.allocator.free_pages - reserved, matched
+
+    def add_sequence(self, seq_id: str, prompt_ids: Optional[Sequence[int]] = None,
+                     hashes: Optional[list[int]] = None,
+                     matched: Optional[list[int]] = None) -> int:
+        """Register a sequence, reusing cached prefix pages. Returns the
+        number of prompt tokens whose KV is already resident. ``matched``
+        short-circuits the chain walk with pages a just-run ``probe_admit``
+        already verified."""
+        alloc = SequenceAllocation()
+        cached = 0
+        if prompt_ids:
+            pages = matched if matched is not None else self._match_pages(prompt_ids, hashes)
+            for page in pages:
+                self.allocator.acquire(page)
+                alloc.pages.append(page)
+                cached += self.page_size
+            alloc.ctx_len = cached
+            alloc.registered_blocks = len(alloc.pages)
+        self.seqs[seq_id] = alloc
+        return cached
+
+    def register_prefix(self, seq_id: str, token_ids: Sequence[int],
+                        hashes: Optional[list[int]] = None) -> None:
+        """Publish hashes for this sequence's newly completed full pages.
+
+        ``token_ids`` must be the tokens whose KV the pages actually hold
+        (prompt plus any generated tokens already fed back).
+        """
+        alloc = self.seqs.get(seq_id)
+        if alloc is None:
+            return
+        max_blocks = min(len(token_ids) // self.page_size, len(alloc.pages))
+        if hashes is None or len(hashes) < max_blocks:
+            hashes = hash_blocks(token_ids, self.page_size, max_blocks)
+        for b in range(alloc.registered_blocks, max_blocks):
+            page = alloc.pages[b]
+            self.allocator.register(page, hashes[b])
+            if self.allocator.lookup(hashes[b]) == page:  # publish took effect
+                self._page_tokens[page] = tuple(
+                    token_ids[b * self.page_size : (b + 1) * self.page_size])
+        alloc.registered_blocks = max(alloc.registered_blocks, max_blocks)
+
+    # -------------------------------------------------------------- lifecycle
+
+    def add_pages_needed(self, prompt_len: int, cached_len: int = 0,
+                         headroom_tokens: int = 0) -> int:
+        total = (prompt_len + headroom_tokens + self.page_size - 1) // self.page_size
+        return max(0, total - cached_len // self.page_size)
 
     def extend(self, seq_id: str, new_ctx_len: int) -> None:
         """Ensure pages exist to hold ``new_ctx_len`` tokens."""
@@ -126,14 +345,24 @@ class KVCacheManager:
             return False
         return alloc.pages_needed(new_ctx_len, self.page_size) <= self.allocator.free_pages
 
-    def can_admit(self, prompt_len: int, headroom_tokens: int = 0) -> bool:
-        need = (prompt_len + headroom_tokens + self.page_size - 1) // self.page_size
+    def can_admit(self, prompt_len: int, headroom_tokens: int = 0,
+                  cached_len: int = 0) -> bool:
+        need = self.add_pages_needed(prompt_len, cached_len, headroom_tokens)
         return need <= self.allocator.free_pages
 
-    def release(self, seq_id: str) -> None:
-        alloc = self.seqs.pop(seq_id, None)
-        if alloc:
-            self.allocator.free(alloc.pages)
+    def release(self, seq_id: str, token_ids: Optional[Sequence[int]] = None) -> None:
+        """Drop a sequence's references. When ``token_ids`` is given, full
+        pages are published to the prefix cache first so the next request
+        with the same prefix rides them."""
+        alloc = self.seqs.get(seq_id)
+        if alloc is None:
+            return
+        if token_ids is not None:
+            self.register_prefix(seq_id, token_ids)
+        del self.seqs[seq_id]
+        self.allocator.free(alloc.pages)
+
+    # ------------------------------------------------------------ page tables
 
     def page_table_row(self, seq_id: str) -> np.ndarray:
         """Padded int32 row of physical page ids for one sequence."""

@@ -50,6 +50,11 @@ class EngineRequest:
     state: RequestState = RequestState.WAITING
     prefill_pos: int = 0  # tokens of the prompt already processed
     out_ids: list[int] = field(default_factory=list)
+    # Generated tokens folded into prompt_ids by preemption-by-recompute.
+    # Logical output = folded_out_ids + out_ids; ctx_len must not double-count.
+    folded_out_ids: list[int] = field(default_factory=list)
+    # Memoized full-page hash chain over prompt_ids (admission hot path).
+    block_hashes: Optional[list[int]] = None
     slot: Optional[int] = None  # decode batch slot index
     first_token_time: Optional[float] = None  # TTFT measurement
     finish_reason: Optional[FinishReason] = None
@@ -60,6 +65,15 @@ class EngineRequest:
     @property
     def ctx_len(self) -> int:
         return self.prefill_pos + len(self.out_ids)
+
+    @property
+    def all_out_ids(self) -> list[int]:
+        """Every generated token, including ones folded by preemption."""
+        return self.folded_out_ids + self.out_ids
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.folded_out_ids) + len(self.out_ids)
 
     @property
     def ttft_ms(self) -> Optional[float]:

@@ -159,7 +159,7 @@ def run_all_benchmarks(
                                  min_pass_rate=min_pass_rate) for n in names]
     reports = [r.report for r in runs if r.report is not None]
     out = Path(out_dir)
-    summary_path = write_reports(reports, out) if reports else out / "summary.json"
+    summary_path = write_reports(reports, out) if reports else None
     aggregate = {
         "generated_at": time.time(),
         "results": [r.to_dict() for r in runs],
@@ -169,5 +169,6 @@ def run_all_benchmarks(
     }
     out.mkdir(parents=True, exist_ok=True)
     (out / "run-all.json").write_text(json.dumps(aggregate, indent=2))
-    aggregate["summary_path"] = str(summary_path)
+    # None when every benchmark was skipped and no summary file was written.
+    aggregate["summary_path"] = None if summary_path is None else str(summary_path)
     return aggregate

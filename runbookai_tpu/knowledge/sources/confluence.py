@@ -16,10 +16,11 @@ from __future__ import annotations
 
 import base64
 import json
+import re
 import time
 import urllib.parse
 import urllib.request
-from calendar import timegm
+from datetime import datetime, timezone
 from typing import Any, Callable, Optional
 
 from runbookai_tpu.knowledge.chunker import chunk_markdown
@@ -42,19 +43,25 @@ def default_fetch(url: str, headers: dict[str, str]) -> tuple[int, bytes]:
 
 
 def _parse_iso(ts: str) -> float:
-    """ISO-8601 → epoch seconds (Confluence returns e.g. 2024-05-01T12:00:00.000Z)."""
+    """ISO-8601 → epoch seconds. Confluence Cloud returns
+    ``2024-05-01T12:00:00.000Z``; Server/DC returns local offsets like
+    ``...+1000``, which ``fromisoformat`` handles. Naive timestamps are UTC."""
     ts = ts.strip()
     if not ts:
         return 0.0
-    ts = ts.replace("Z", "+0000")
-    for fmt in ("%Y-%m-%dT%H:%M:%S.%f%z", "%Y-%m-%dT%H:%M:%S%z",
-                "%Y-%m-%dT%H:%M:%S.%f", "%Y-%m-%dT%H:%M:%S"):
-        try:
-            parsed = time.strptime(ts.replace("+0000", ""), fmt.replace("%z", ""))
-            return float(timegm(parsed))
-        except ValueError:
-            continue
-    return 0.0
+    ts = ts.replace("Z", "+00:00")
+    # Python 3.10's fromisoformat only accepts ±HH:MM offsets; normalize the
+    # colon-less ±HHMM form Confluence Server/DC emits.
+    m = re.search(r"([+-]\d{2})(\d{2})$", ts)
+    if m and ":" not in ts[m.start():]:
+        ts = ts[: m.start()] + m.group(1) + ":" + m.group(2)
+    try:
+        dt = datetime.fromisoformat(ts)
+    except ValueError:
+        return 0.0
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.timestamp()
 
 
 def infer_type_from_labels(labels: list[str]) -> str:

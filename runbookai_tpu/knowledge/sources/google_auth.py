@@ -11,6 +11,7 @@ exchange/refresh HTTP goes through the injectable ``fetch`` contract.
 from __future__ import annotations
 
 import json
+import os
 import time
 import urllib.parse
 from dataclasses import dataclass, field
@@ -126,8 +127,11 @@ class TokenStore:
 
     def save(self, tokens: GoogleTokens) -> None:
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        self.path.write_text(json.dumps(tokens.to_dict(), indent=2))
-        self.path.chmod(0o600)
+        # Create with the final 0600 mode — never world-readable, even briefly.
+        fd = os.open(self.path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(json.dumps(tokens.to_dict(), indent=2))
+        self.path.chmod(0o600)  # repair pre-existing files too
 
 
 def valid_access_token(store: TokenStore, client_id: str, client_secret: str,

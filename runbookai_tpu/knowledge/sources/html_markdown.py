@@ -32,10 +32,12 @@ class _Converter(HTMLParser):
 
     # -- helpers ---------------------------------------------------------
     def _emit(self, text: str) -> None:
-        if self._cell_buf is not None:
-            self._cell_buf.append(text)
-        elif self._link_text is not None and self._href is not None:
+        # An open link captures text first — even inside a table cell — so
+        # </a> can rebuild [text](href) into whatever encloses the link.
+        if self._href is not None:
             self._link_text.append(text)
+        elif self._cell_buf is not None:
+            self._cell_buf.append(text)
         else:
             self.out.append(text)
 
@@ -45,10 +47,10 @@ class _Converter(HTMLParser):
         self.out.append("\n" * n)
 
     def _buf(self) -> list[str]:
-        if self._cell_buf is not None:
-            return self._cell_buf
         if self._href is not None:
             return self._link_text
+        if self._cell_buf is not None:
+            return self._cell_buf
         return self.out
 
     def _close_inline(self, marker: str) -> None:
@@ -138,10 +140,11 @@ class _Converter(HTMLParser):
             href = self._href or ""
             self._href = None
             self._link_text = []
+            target = self._cell_buf if self._cell_buf is not None else self.out
             if text and href and not href.startswith("#"):
-                self.out.append(f"[{text}]({href})")
+                target.append(f"[{text}]({href})")
             else:
-                self.out.append(text)
+                target.append(text)
         elif tag in ("td", "th"):
             self._row.append(" ".join("".join(self._cell_buf or []).split()))
             self._cell_buf = None

@@ -1,0 +1,183 @@
+// Native host-runtime components for the TPU serving engine.
+//
+// The continuous-batching hot path does per-step page-table bookkeeping and,
+// on every admission, a hash-chain probe over up-to-max_seq_len/page_size
+// blocks. This file implements the page allocator + prefix-cache index and
+// the FNV-1a block hasher behind a C ABI consumed via ctypes
+// (runbookai_tpu/native/__init__.py). Semantics are bit-identical to the
+// pure-Python PageAllocator/hash_blocks in engine/kv_cache.py — the test
+// suite runs both backends through randomized op sequences and diffs state.
+//
+// No reference counterpart: the reference (RunbookAI) has no model runtime at
+// all (SURVEY.md §2.9) — its only native dependency is better-sqlite3.
+
+#include <cstddef>
+#include <cstdint>
+#include <list>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kNullPage = 0;
+
+struct Allocator {
+  int64_t num_pages;
+  std::vector<int64_t> free_stack;               // pop_back == Python list.pop()
+  std::unordered_map<int64_t, int64_t> ref;      // page -> live refcount
+  std::list<int64_t> retired_lru;                // front = oldest retired
+  std::unordered_map<int64_t, std::list<int64_t>::iterator> retired_pos;
+  std::unordered_map<uint64_t, int64_t> hash_to_page;
+  std::unordered_map<int64_t, uint64_t> page_to_hash;
+
+  explicit Allocator(int64_t n) : num_pages(n) {
+    free_stack.reserve(static_cast<size_t>(n - 1));
+    for (int64_t p = n - 1; p >= 1; --p) free_stack.push_back(p);
+  }
+
+  int64_t free_pages() const {
+    return static_cast<int64_t>(free_stack.size() + retired_lru.size());
+  }
+
+  void invalidate(int64_t page) {
+    auto it = page_to_hash.find(page);
+    if (it == page_to_hash.end()) return;
+    auto h = hash_to_page.find(it->second);
+    if (h != hash_to_page.end() && h->second == page) hash_to_page.erase(h);
+    page_to_hash.erase(it);
+  }
+
+  // Returns 0 on success, -1 when the pool is exhausted (nothing mutated).
+  int alloc(int64_t n, int64_t* out) {
+    if (n > free_pages()) return -1;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t p;
+      if (!free_stack.empty()) {
+        p = free_stack.back();
+        free_stack.pop_back();
+      } else {
+        p = retired_lru.front();
+        retired_lru.pop_front();
+        retired_pos.erase(p);
+        invalidate(p);
+      }
+      ref[p] = 1;
+      out[i] = p;
+    }
+    return 0;
+  }
+
+  void release(const int64_t* pages, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t p = pages[i];
+      if (p == kNullPage) continue;
+      auto rp = retired_pos.find(p);
+      if (rp != retired_pos.end()) {
+        // Double-free of a retired page: Python's OrderedDict assignment +
+        // move_to_end dedups but refreshes LRU position — mirror that.
+        retired_lru.erase(rp->second);
+        retired_lru.push_back(p);
+        rp->second = std::prev(retired_lru.end());
+        continue;
+      }
+      auto it = ref.find(p);
+      int64_t r = (it == ref.end() ? 0 : it->second) - 1;
+      if (r > 0) {
+        it->second = r;
+        continue;
+      }
+      if (it != ref.end()) ref.erase(it);
+      if (page_to_hash.count(p)) {
+        retired_lru.push_back(p);
+        retired_pos[p] = std::prev(retired_lru.end());
+      } else {
+        free_stack.push_back(p);
+      }
+    }
+  }
+
+  void register_hash(int64_t page, uint64_t h) {
+    if (page == kNullPage || hash_to_page.count(h)) return;  // first writer wins
+    invalidate(page);
+    page_to_hash[page] = h;
+    hash_to_page[h] = page;
+  }
+
+  int64_t lookup(uint64_t h) const {
+    auto it = hash_to_page.find(h);
+    return it == hash_to_page.end() ? -1 : it->second;
+  }
+
+  void acquire(int64_t page) {
+    auto it = retired_pos.find(page);
+    if (it != retired_pos.end()) {
+      retired_lru.erase(it->second);
+      retired_pos.erase(it);
+      ref[page] = 1;
+    } else {
+      ref[page] += 1;  // value-initialized to 0 when absent
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rk_alloc_create(int64_t num_pages) {
+  if (num_pages < 2) return nullptr;
+  return new Allocator(num_pages);
+}
+
+void rk_alloc_destroy(void* a) { delete static_cast<Allocator*>(a); }
+
+int64_t rk_alloc_free_pages(void* a) {
+  return static_cast<Allocator*>(a)->free_pages();
+}
+
+int64_t rk_alloc_cached_pages(void* a) {
+  return static_cast<int64_t>(static_cast<Allocator*>(a)->retired_lru.size());
+}
+
+int rk_alloc_alloc(void* a, int64_t n, int64_t* out) {
+  return static_cast<Allocator*>(a)->alloc(n, out);
+}
+
+void rk_alloc_release(void* a, const int64_t* pages, int64_t n) {
+  static_cast<Allocator*>(a)->release(pages, n);
+}
+
+void rk_alloc_register(void* a, int64_t page, uint64_t hash) {
+  static_cast<Allocator*>(a)->register_hash(page, hash);
+}
+
+int64_t rk_alloc_lookup(void* a, uint64_t hash) {
+  return static_cast<Allocator*>(a)->lookup(hash);
+}
+
+void rk_alloc_acquire(void* a, int64_t page) {
+  static_cast<Allocator*>(a)->acquire(page);
+}
+
+int rk_alloc_is_retired(void* a, int64_t page) {
+  return static_cast<Allocator*>(a)->retired_pos.count(page) ? 1 : 0;
+}
+
+// FNV-1a hash chain over full pages of token ids; returns the block count.
+// Mirrors hash_blocks() in engine/kv_cache.py exactly.
+int64_t rk_hash_blocks(const int32_t* tokens, int64_t n_tokens,
+                       int64_t page_size, int64_t max_blocks, uint64_t* out) {
+  int64_t n_full = n_tokens / page_size;
+  if (max_blocks >= 0 && max_blocks < n_full) n_full = max_blocks;
+  uint64_t h = 0xCBF29CE484222325ULL;
+  for (int64_t b = 0; b < n_full; ++b) {
+    for (int64_t i = b * page_size; i < (b + 1) * page_size; ++i) {
+      h ^= static_cast<uint64_t>(static_cast<int64_t>(tokens[i]) + 1);
+      h *= 0x100000001B3ULL;
+    }
+    out[b] = h;
+  }
+  return n_full;
+}
+
+}  // extern "C"

@@ -139,7 +139,10 @@ class OpsgenieConfig(BaseModel):
 
 class SlackConfig(BaseModel):
     enabled: bool = False
-    mode: Literal["socket", "http"] = "socket"  # gateway transport
+    # Gateway transport. http is the default: socket mode needs slack_sdk
+    # (an app-level token + websocket), which this build gates at startup —
+    # defaulting to socket would make bare `slack-gateway` invocations exit.
+    mode: Literal["socket", "http"] = "http"
     bot_token: Optional[str] = None
     signing_secret: Optional[str] = None
     app_token: Optional[str] = None

@@ -119,9 +119,34 @@ def test_preemption_under_page_pressure(setup):
     core.run_until_idle()
     for r, solo in zip(reqs, solos):
         assert r.finish_reason == FinishReason.MAX_TOKENS
-        assert r.out_ids == solo
+        assert r.all_out_ids == solo
     # pages all returned
     assert core.kv.allocator.free_pages == 20 - 1  # minus reserved null page
+
+
+def test_forced_preemption_mid_decode(setup):
+    """Preemption of a request that already generated tokens: fold-to-prompt
+    recompute must preserve positions/ctx accounting so the final output still
+    matches solo greedy decode (regression: out_ids double-counted in ctx_len)."""
+    tok, params = setup
+    prompts = [tok.encode("a" * 21), tok.encode("b" * 21)]
+    solos = [greedy_reference(params, tok, p, 40) for p in prompts]
+    # 19 usable pages: one sequence at full length needs 16, two need 32 —
+    # they can only run together until the pool forces an eviction.
+    core = make_core(tok, params, num_pages=20, max_batch_slots=2)
+    core.ecfg.decode_steps_per_dispatch = 1
+    core.ecfg.admit_headroom_tokens = 8
+    reqs = [
+        EngineRequest(prompt_ids=p, sampling=SamplingParams(max_new_tokens=40))
+        for p in prompts
+    ]
+    for r in reqs:
+        core.submit(r)
+    core.run_until_idle()
+    assert core.metrics["preemptions"] >= 1, "scenario must actually preempt"
+    for r, solo in zip(reqs, solos):
+        assert r.all_out_ids == solo
+    assert core.kv.allocator.free_pages == 20 - 1
 
 
 def test_stop_string(setup):

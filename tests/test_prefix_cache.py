@@ -1,0 +1,335 @@
+"""Prefix caching + native (C++) allocator backend.
+
+Covers what SURVEY.md §4 calls the engine tests the reference never needed:
+content-addressed KV page reuse across requests, refcounted sharing, LRU
+recycling under pool pressure, and bit-equivalence between the pure-Python
+allocator and the ctypes/C++ one in ``runbookai_tpu/native``.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from runbookai_tpu import native
+from runbookai_tpu.engine.engine import EngineConfig, EngineCore
+from runbookai_tpu.engine.kv_cache import (
+    KVCacheManager,
+    PageAllocator,
+    hash_blocks,
+)
+from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+from runbookai_tpu.models.llama import CONFIGS, init_params
+from runbookai_tpu.utils.tokens import ByteTokenizer
+
+CFG = CONFIGS["llama3-test"]
+
+
+def _py_hash_blocks(token_ids, page_size, max_blocks=None):
+    """The reference Python implementation, bypassing native dispatch."""
+    n_full = len(token_ids) // page_size
+    if max_blocks is not None:
+        n_full = min(n_full, max_blocks)
+    out = []
+    h = 0xCBF29CE484222325
+    for b in range(n_full):
+        for t in token_ids[b * page_size : (b + 1) * page_size]:
+            h ^= (t + 1) & 0xFFFFFFFFFFFFFFFF
+            h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+        out.append(h)
+    return out
+
+
+# --------------------------------------------------------------------- hashes
+
+
+def test_hash_chain_prefix_property():
+    a = list(range(40))
+    b = list(range(40))
+    b[37] = 999  # differs only in the last block
+    ha, hb = _py_hash_blocks(a, 8), _py_hash_blocks(b, 8)
+    assert ha[:4] == hb[:4] and ha[4] != hb[4]
+    # Same tokens at a different depth hash differently (chain, not content).
+    c = a[8:16] + a[8:16]
+    hc = _py_hash_blocks(c, 8)
+    assert hc[0] != ha[1]
+
+
+def test_native_hash_matches_python():
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = random.Random(7)
+    for trial in range(20):
+        n = rng.randrange(0, 200)
+        toks = [rng.randrange(0, 130_000) for _ in range(n)]
+        ps = rng.choice([1, 4, 16])
+        mb = rng.choice([None, 0, 2, 100])
+        assert native.hash_blocks_native(toks, ps, mb) == _py_hash_blocks(toks, ps, mb)
+
+
+# ----------------------------------------------------- allocator equivalence
+
+
+def test_native_allocator_matches_python_randomized():
+    """Drive both backends through the same randomized op sequence and demand
+    identical observable behavior (returned pages, counters, lookups)."""
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = random.Random(42)
+    py = PageAllocator(64)
+    cc = native.NativePageAllocator(64)
+    held: list[list[int]] = []  # allocations not yet freed
+    known_hashes: list[int] = []
+    freed_hashed: list[int] = []  # pages freed while hashed (likely retired)
+    for step in range(3000):
+        op = rng.random()
+        if op < 0.03 and freed_hashed:
+            # Double-free of a (possibly) retired page must behave identically.
+            p = rng.choice(freed_hashed)
+            if py.is_retired(p):
+                assert cc.is_retired(p)
+                py.free([p])
+                cc.free([p])
+        if op < 0.4:
+            n = rng.randrange(1, 5)
+            if n > py.free_pages:
+                with pytest.raises(MemoryError):
+                    py.alloc(n)
+                with pytest.raises(MemoryError):
+                    cc.alloc(n)
+            else:
+                a, b = py.alloc(n), cc.alloc(n)
+                assert a == b
+                held.append(a)
+        elif op < 0.6 and held:
+            pages = held.pop(rng.randrange(len(held)))
+            # Sometimes publish hashes first so pages retire instead of free.
+            if rng.random() < 0.6:
+                for p in pages:
+                    h = rng.getrandbits(64)
+                    py.register(p, h)
+                    cc.register(p, h)
+                    known_hashes.append(h)
+                freed_hashed.extend(pages)
+            py.free(pages)
+            cc.free(pages)
+        elif op < 0.75 and known_hashes:
+            h = rng.choice(known_hashes)
+            assert py.lookup(h) == cc.lookup(h)
+        elif op < 0.9 and known_hashes:
+            h = rng.choice(known_hashes)
+            p1, p2 = py.lookup(h), cc.lookup(h)
+            assert p1 == p2
+            if p1 is not None:
+                py.acquire(p1)
+                cc.acquire(p2)
+                held.append([p1])
+        assert py.free_pages == cc.free_pages
+        assert py.cached_pages == cc.cached_pages
+
+
+def test_allocator_retire_then_recycle():
+    alloc = PageAllocator(4)  # pages 1..3 usable
+    pages = alloc.alloc(3)
+    alloc.register(pages[0], 111)
+    alloc.free(pages)
+    # Hashed page retired (still matchable); others free.
+    assert alloc.cached_pages == 1 and alloc.free_pages == 3
+    assert alloc.lookup(111) == pages[0]
+    # Exhausting the pool recycles the retired page and drops its hash.
+    got = alloc.alloc(3)
+    assert sorted(got) == sorted(pages)
+    assert alloc.lookup(111) is None
+
+
+def test_allocator_refcount_sharing():
+    alloc = PageAllocator(8)
+    (p,) = alloc.alloc(1)
+    alloc.register(p, 42)
+    alloc.acquire(p)  # second owner
+    alloc.free([p])  # first owner drops
+    assert alloc.lookup(42) == p and alloc.cached_pages == 0
+    alloc.free([p])  # last owner drops -> retires
+    assert alloc.cached_pages == 1
+    # Revive from retired via acquire.
+    alloc.acquire(p)
+    assert alloc.cached_pages == 0
+
+
+# ------------------------------------------------------------ KVCacheManager
+
+
+def make_kv(num_pages=32, page_size=4, max_seq=64, allocator=None):
+    return KVCacheManager(
+        n_layers=CFG.n_layers, num_pages=num_pages, page_size=page_size,
+        n_kv_heads=CFG.n_kv_heads, head_dim=CFG.head_dim, max_seq_len=max_seq,
+        dtype=jnp.float32,
+        allocator=allocator or PageAllocator(num_pages),
+    )
+
+
+def test_kv_match_prefix_roundtrip():
+    kv = make_kv()
+    prompt = list(range(18))  # 4 full pages + 2 tokens
+    assert kv.match_prefix(prompt) == 0
+    cached = kv.add_sequence("a", prompt)
+    assert cached == 0
+    kv.extend("a", len(prompt))
+    kv.register_prefix("a", prompt)
+    # Full pages published; an identical prompt matches all 4 full pages.
+    assert kv.match_prefix(prompt) == 16
+    b_cached = kv.add_sequence("b", prompt)
+    assert b_cached == 16
+    assert kv.seqs["b"].pages == kv.seqs["a"].pages[:4]
+    # Page-aligned prompts never match fully (one token must prefill).
+    aligned = list(range(16))
+    assert kv.match_prefix(aligned) <= 12
+    kv.release("a", prompt)
+    kv.release("b", prompt)
+
+
+def test_kv_exact_page_multiple_prompt_keeps_one_block():
+    kv = make_kv()
+    prompt = list(range(16))  # exactly 4 pages
+    kv.add_sequence("a", prompt)
+    kv.extend("a", 16)
+    kv.register_prefix("a", prompt)
+    kv.release("a", prompt)
+    assert kv.match_prefix(prompt) == 12  # capped below the full prompt
+
+
+def test_kv_hash_collision_rejected_by_token_check():
+    """A forged/colliding hash entry must not serve another prompt's pages."""
+    kv = make_kv()
+    prompt_a = list(range(8))
+    kv.add_sequence("a", prompt_a)
+    kv.extend("a", 8)
+    kv.register_prefix("a", prompt_a)
+    page_a = kv.seqs["a"].pages[0]
+    # Simulate a 64-bit collision: prompt_b's first-block hash resolves to
+    # page_a even though the tokens differ.
+    assert kv.match_prefix(prompt_a) == 4  # sanity: genuine owner matches
+    prompt_b = [100 + t for t in prompt_a]
+    # (re-registering page_a under prompt_b's hash displaces its old hash —
+    # the allocator keeps one hash per page — so only prompt_b's chain now
+    # resolves to page_a, exactly what a real 64-bit collision looks like)
+    kv.allocator.register(page_a, hash_blocks(prompt_b, 4)[0])
+    assert kv.match_prefix(prompt_b) == 0  # token verification rejects it
+
+
+def test_kv_release_retires_and_next_request_reuses():
+    kv = make_kv()
+    prompt = list(range(13))
+    kv.add_sequence("s1", prompt)
+    kv.extend("s1", len(prompt))
+    pages1 = list(kv.seqs["s1"].pages)
+    kv.release("s1", prompt)  # publishes 3 full pages
+    cached = kv.add_sequence("s2", prompt)
+    assert cached == 12
+    assert kv.seqs["s2"].pages == pages1[:3]
+
+
+# -------------------------------------------------------------- engine level
+
+
+@pytest.fixture(scope="module")
+def setup():
+    tok = ByteTokenizer()
+    params = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+    return tok, params
+
+
+def make_core(tok, params, **kw):
+    defaults = dict(
+        page_size=4, num_pages=64, max_batch_slots=4, prefill_chunk=8,
+        max_seq_len=128, block_pages=4, kv_dtype=jnp.float32,
+    )
+    defaults.update(kw)
+    return EngineCore(CFG, params, tok, EngineConfig(**defaults))
+
+
+def run_one(core, prompt, n=6):
+    req = EngineRequest(prompt_ids=list(prompt),
+                        sampling=SamplingParams(temperature=0.0, max_new_tokens=n))
+    core.submit(req)
+    core.run_until_idle()
+    return req
+
+
+def test_engine_prefix_cache_hit_and_identical_output(setup):
+    tok, params = setup
+    core = make_core(tok, params)
+    prompt = tok.encode("system: you are an SRE agent. user: checkout is slow")
+    r1 = run_one(core, prompt)
+    assert core.metrics["cached_prefix_tokens"] == 0
+    r2 = run_one(core, prompt)
+    # Second identical prompt rides resident pages...
+    expect = (len(prompt) - 1) // 4 * 4
+    assert core.metrics["cached_prefix_tokens"] == expect
+    # ...and still produces the exact same greedy continuation.
+    assert r2.out_ids == r1.out_ids
+
+
+def test_engine_shared_prefix_different_tails(setup):
+    tok, params = setup
+    core = make_core(tok, params)
+    system = "system: investigate production incidents methodically. "
+    p1 = tok.encode(system + "user: api errors")
+    p2 = tok.encode(system + "user: db latency")
+    fresh1 = run_one(make_core(tok, params), p1).out_ids
+    fresh2 = run_one(make_core(tok, params), p2).out_ids
+    r1 = run_one(core, p1)
+    r2 = run_one(core, p2)
+    shared_pages = len(system.encode()) // 4  # bytes == byte-tokenizer tokens
+    assert core.metrics["cached_prefix_tokens"] >= (shared_pages - 1) * 4 > 0
+    assert r1.out_ids == fresh1 and r2.out_ids == fresh2
+
+
+def test_engine_cache_eviction_under_pressure(setup):
+    """A tiny pool forces retired pages to be recycled; outputs stay correct."""
+    tok, params = setup
+    core = make_core(tok, params, num_pages=24, max_batch_slots=2)
+    prompts = [tok.encode(f"incident {i}: " + "pad" * 6) for i in range(6)]
+    fresh = [run_one(make_core(tok, params), p, 4).out_ids for p in prompts]
+    outs = [run_one(core, p, 4).out_ids for p in prompts]
+    assert outs == fresh
+    # Pool fully recoverable afterwards.
+    assert core.kv.allocator.free_pages == 24 - 1
+
+
+def test_engine_concurrent_identical_prompts(setup):
+    """Same prompt submitted twice concurrently: the later admission may share
+    the earlier one's pages while both are still live; outputs match solo."""
+    tok, params = setup
+    solo_core = make_core(tok, params)
+    prompt = tok.encode("concurrent identical prompt " * 2)
+    solo = run_one(solo_core, prompt, 5).out_ids
+    core = make_core(tok, params)
+    reqs = [
+        EngineRequest(prompt_ids=list(prompt),
+                      sampling=SamplingParams(temperature=0.0, max_new_tokens=5))
+        for _ in range(3)
+    ]
+    for r in reqs:
+        core.submit(r)
+    core.run_until_idle()
+    for r in reqs:
+        assert r.out_ids == solo
+
+
+def test_engine_native_backend_end_to_end(setup):
+    """Full engine run on the C++ allocator matches the Python allocator."""
+    if not native.available():
+        pytest.skip("native library unavailable")
+    tok, params = setup
+    prompt = tok.encode("native allocator end to end")
+
+    core_py = make_core(tok, params)
+    core_py.kv.allocator = PageAllocator(64)
+    out_py = run_one(core_py, prompt).out_ids
+
+    core_cc = make_core(tok, params)
+    core_cc.kv.allocator = native.NativePageAllocator(64)
+    out_cc = run_one(core_cc, prompt).out_ids
+    assert out_py == out_cc
