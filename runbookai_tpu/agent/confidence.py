@@ -109,7 +109,10 @@ def parse_evidence_classification(response: str) -> tuple[str, str]:
     lower = response.lower()
     # Negations first: "no strong evidence" / "not strong" must not inflate
     # confidence via the bare "strong" substring.
-    if re.search(r"\b(no|not|without|lacks?|lacking)\s+(\w+\s+){0,3}strong", lower):
+    # Contrast markers (but/yet/however) break the negation scope, so
+    # "not weak but strong" still classifies as strong.
+    if re.search(r"\b(no|not|without|lacks?|lacking)\s+"
+                 r"((?!(?:but|yet|however)\b)\w+\s+){0,3}strong", lower):
         return ("weak", response) if "weak" in lower else ("none", response)
     if "strong" in lower:
         return "strong", response

@@ -214,7 +214,11 @@ class EngineCore:
             # an otherwise-idle engine admits with zero headroom so a request
             # that only fits exactly still makes progress (preemption has
             # nothing to evict in that case anyway).
-            headroom = min(self.ecfg.admit_headroom_tokens, req.sampling.max_new_tokens)
+            # Remaining budget, not the full one: a preempted request that
+            # already generated most of its tokens must not head-of-line
+            # block admission reserving headroom it can never use.
+            headroom = min(self.ecfg.admit_headroom_tokens,
+                           max(req.sampling.max_new_tokens - req.num_generated, 0))
             if not (self.prefilling or self.decoding) and in_flight == 0:
                 headroom = 0
             if req.block_hashes is None:

@@ -55,7 +55,9 @@ class JaxTpuClient(BaseLLMClient):
         mesh = None
         shardings = None
         model_cfg_name = llm_cfg.model
-        dtype = jnp.bfloat16 if llm_cfg.dtype == "bfloat16" else jnp.float32
+        # int8 = weight-only quantization; activations and KV stay bf16.
+        quantize = llm_cfg.dtype == "int8"
+        dtype = jnp.float32 if llm_cfg.dtype == "float32" else jnp.bfloat16
         if llm_cfg.mesh.device_count > 1:
             from runbookai_tpu.models.llama import CONFIGS
             from runbookai_tpu.parallel.mesh import build_mesh
@@ -64,8 +66,13 @@ class JaxTpuClient(BaseLLMClient):
             mesh = build_mesh(llm_cfg.mesh.data, llm_cfg.mesh.model)
             if model_cfg_name in CONFIGS:
                 shardings = param_shardings(CONFIGS[model_cfg_name], mesh)
+                if quantize:
+                    from runbookai_tpu.models.quant import shardings_with_quant
+
+                    shardings = shardings_with_quant(shardings)
         cfg, params = load_or_init(
-            model_cfg_name, llm_cfg.model_path, dtype=dtype, shardings=shardings
+            model_cfg_name, llm_cfg.model_path, dtype=dtype, shardings=shardings,
+            quantize_int8=quantize,
         )
         ecfg = EngineConfig(
             page_size=llm_cfg.page_size,

@@ -97,13 +97,19 @@ def load_params(
     cfg: Optional[LlamaConfig] = None,
     dtype=jnp.bfloat16,
     shardings: Optional[dict[str, Any]] = None,
+    quantize_int8: bool = False,
 ) -> tuple[LlamaConfig, Any]:
     """Load stacked params from an HF Llama directory.
 
     ``shardings``, when given, is a pytree-shaped dict matching the params
     structure whose leaves are ``NamedSharding``s (see
-    :func:`runbookai_tpu.parallel.sharding.param_shardings`).
+    :func:`runbookai_tpu.parallel.sharding.param_shardings`; pass it through
+    :func:`runbookai_tpu.models.quant.shardings_with_quant` when quantizing).
+    ``quantize_int8`` converts the big layer matrices to int8 on the host so
+    the bf16 tensors never reach device HBM (70B must load this way on v5e).
     """
+    from runbookai_tpu.models.quant import LAYER_QUANT_KEYS, quantize_array_np
+
     model_dir = Path(model_dir)
     cfg = cfg or config_from_hf(model_dir)
     idx = _ShardIndex(model_dir)
@@ -128,6 +134,16 @@ def load_params(
             w = idx.get(tmpl.format(i=i))
             mats.append(w.T if transpose else w)
         stacked = np.stack(mats)
+        if quantize_int8 and leaf in LAYER_QUANT_KEYS:
+            q, s = quantize_array_np(stacked)
+            leaf_sh = shard_of("layers", leaf)
+            if not isinstance(leaf_sh, dict):
+                leaf_sh = {"q": leaf_sh, "s": None}
+            layers[leaf] = {
+                "q": _put(q, jnp.int8, leaf_sh.get("q")),
+                "s": _put(s, jnp.float32, leaf_sh.get("s")),
+            }
+            continue
         leaf_dtype = jnp.float32 if leaf.endswith("norm") else dtype
         layers[leaf] = _put(stacked, leaf_dtype, shard_of("layers", leaf))
     params["layers"] = layers
@@ -145,6 +161,7 @@ def load_or_init(
     dtype=jnp.bfloat16,
     shardings: Optional[dict[str, Any]] = None,
     seed: int = 0,
+    quantize_int8: bool = False,
 ) -> tuple[LlamaConfig, Any]:
     """Load from ``model_path`` when present, else random-init ``model_name``.
 
@@ -153,9 +170,14 @@ def load_or_init(
     """
     if model_path and Path(model_path).exists():
         cfg = config_from_hf(model_path, name=model_name)
-        return load_params(model_path, cfg, dtype=dtype, shardings=shardings)
+        return load_params(model_path, cfg, dtype=dtype, shardings=shardings,
+                           quantize_int8=quantize_int8)
     cfg = CONFIGS[model_name] if model_name in CONFIGS else CONFIGS["llama3-test"]
     params = init_params(jax.random.PRNGKey(seed), cfg, dtype=dtype)
+    if quantize_int8:
+        from runbookai_tpu.models.quant import quantize_params
+
+        params = quantize_params(params)
     if shardings:
         params = jax.tree.map(
             lambda x, s: jax.device_put(x, s) if s is not None else x,

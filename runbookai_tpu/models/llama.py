@@ -106,6 +106,20 @@ def init_params(key: jax.Array, cfg: LlamaConfig, dtype=jnp.bfloat16) -> Params:
     return params
 
 
+def qmm(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """Matmul that accepts int8 weight-only quantized weights.
+
+    Quantized leaves are ``{"q": int8 [.., in, out], "s": f32 [.., 1, out]}``
+    (:mod:`runbookai_tpu.models.quant`). The matmul runs on the MXU in the
+    activation dtype (int8→bf16 cast is exact) and the per-output-channel
+    scale applies to the result — identical math to dequantize-first, since
+    the scale is constant along the contraction.
+    """
+    if isinstance(w, dict):
+        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w
+
+
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
     xf = x.astype(jnp.float32)
     norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
@@ -140,9 +154,9 @@ def forward_impl(
     def layer_step(hidden, layer_in):
         lp, k_pages, v_pages = layer_in
         x = rms_norm(hidden, lp["attn_norm"], cfg.norm_eps)
-        q = (x @ lp["wq"]).reshape(b, t, cfg.n_heads, hd)
-        k = (x @ lp["wk"]).reshape(b, t, n_kv, hd)
-        v = (x @ lp["wv"]).reshape(b, t, n_kv, hd)
+        q = qmm(x, lp["wq"]).reshape(b, t, cfg.n_heads, hd)
+        k = qmm(x, lp["wk"]).reshape(b, t, n_kv, hd)
+        v = qmm(x, lp["wv"]).reshape(b, t, n_kv, hd)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
 
@@ -170,11 +184,11 @@ def forward_impl(
                 q, k_pages, v_pages, page_tables, ctx_lens, positions,
                 page_size=page_size, block_pages=block_pages,
             )
-        hidden = hidden + attn.reshape(b, t, cfg.n_heads * hd) @ lp["wo"]
+        hidden = hidden + qmm(attn.reshape(b, t, cfg.n_heads * hd), lp["wo"])
 
         y = rms_norm(hidden, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(y @ lp["w_gate"])
-        hidden = hidden + (gate * (y @ lp["w_up"])) @ lp["w_down"]
+        gate = jax.nn.silu(qmm(y, lp["w_gate"]))
+        hidden = hidden + qmm(gate * qmm(y, lp["w_up"]), lp["w_down"])
         return hidden, (k_pages, v_pages)
 
     h, (kv_k_new, kv_v_new) = jax.lax.scan(
@@ -213,13 +227,13 @@ def transformer_layer(hidden, lp, cfg: LlamaConfig, positions, attn_fn):
     b, t = hidden.shape[:2]
     hd, n_kv, n_q = cfg.head_dim, cfg.n_kv_heads, cfg.n_heads
     x = rms_norm(hidden, lp["attn_norm"], cfg.norm_eps)
-    q = apply_rope((x @ lp["wq"]).reshape(b, t, n_q, hd), positions, cfg.rope_theta)
-    k = apply_rope((x @ lp["wk"]).reshape(b, t, n_kv, hd), positions, cfg.rope_theta)
-    v = (x @ lp["wv"]).reshape(b, t, n_kv, hd)
+    q = apply_rope(qmm(x, lp["wq"]).reshape(b, t, n_q, hd), positions, cfg.rope_theta)
+    k = apply_rope(qmm(x, lp["wk"]).reshape(b, t, n_kv, hd), positions, cfg.rope_theta)
+    v = qmm(x, lp["wv"]).reshape(b, t, n_kv, hd)
     ctx = attn_fn(q, k, v).reshape(b, t, n_q * hd)
-    hidden = hidden + ctx @ lp["wo"]
+    hidden = hidden + qmm(ctx, lp["wo"])
     y = rms_norm(hidden, lp["mlp_norm"], cfg.norm_eps)
-    return hidden + (jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"])) @ lp["w_down"]
+    return hidden + qmm(jax.nn.silu(qmm(y, lp["w_gate"])) * qmm(y, lp["w_up"]), lp["w_down"])
 
 
 def lm_head_logits(params: Params, cfg: LlamaConfig, hidden) -> jnp.ndarray:

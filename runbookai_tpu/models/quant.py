@@ -1,0 +1,111 @@
+"""Int8 weight-only quantization for serving (SURVEY.md §7 hard part 4).
+
+bf16 Llama-3-70B is ~140GB — it cannot fit 16 v5e chips (16GB HBM each) with
+any KV headroom. Per-output-channel symmetric int8 halves the weight bytes
+(~72GB sharded → ~4.5GB/chip + bf16 embeddings/head), leaving page-pool room.
+
+Scheme: for a weight ``w [.., in, out]``, ``scale = max|w| / 127`` over the
+input axis (one scale per output channel) and ``q = round(w / scale)``. The
+matmul then runs on the MXU in bf16 (int8→bf16 cast is exact for |q| ≤ 127)
+and the per-channel scale is applied to the *output* — mathematically
+identical to dequantize-then-matmul because the scale is constant along the
+contraction:  sum_i x_i·q_io·s_o == s_o·sum_i x_i·q_io.
+
+Quantized leaves are plain pytrees ``{"q": int8, "s": float32}``, so they
+flow through ``lax.scan`` layer stacking, ``jax.device_put`` sharding, and
+checkpointing unchanged. Norms, embeddings, and the LM head stay bf16
+(< 3% of 70B bytes; quality-critical).
+
+No reference counterpart: RunbookAI calls hosted LLM APIs (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+Params = dict[str, Any]
+
+# Stacked layer matrices that dominate the byte budget.
+LAYER_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def quantize_array_np(w, in_axis: int = -2):
+    """Host-side (numpy) quantization for the weight-loading path — the full
+    bf16 tensor never reaches device HBM. Returns ``(q int8, s f32)``."""
+    import numpy as np
+
+    wf = np.asarray(w, dtype=np.float32)
+    s = np.abs(wf).max(axis=in_axis, keepdims=True) / 127.0
+    s = np.maximum(s, 1e-8)
+    q = np.clip(np.round(wf / s), -127, 127).astype(np.int8)
+    return q, s.astype(np.float32)
+
+
+def quantize_tensor(w: jnp.ndarray, in_axis: int = -2) -> dict[str, jnp.ndarray]:
+    """Symmetric per-output-channel int8: ``{"q": int8, "s": f32 keepdims}``."""
+    wf = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(wf), axis=in_axis, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-8)  # all-zero channels
+    q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def dequantize_tensor(w: dict[str, jnp.ndarray], dtype=jnp.float32) -> jnp.ndarray:
+    return (w["q"].astype(jnp.float32) * w["s"]).astype(dtype)
+
+
+def quantize_params(params: Params) -> Params:
+    """Serving transform: quantize the seven stacked layer matrices."""
+    out = dict(params)
+    out["layers"] = {
+        k: quantize_tensor(v) if k in LAYER_QUANT_KEYS else v
+        for k, v in params["layers"].items()
+    }
+    return out
+
+
+def dequantize_params(params: Params, dtype=jnp.bfloat16) -> Params:
+    out = dict(params)
+    out["layers"] = {
+        k: dequantize_tensor(v, dtype) if is_quantized(v) else v
+        for k, v in params["layers"].items()
+    }
+    return out
+
+
+def shardings_with_quant(shardings: Params, params: Optional[Params] = None,
+                         keys=LAYER_QUANT_KEYS) -> Params:
+    """Expand a ``param_shardings`` tree to match quantized param structure.
+
+    ``q`` keeps the original weight's spec. ``s [L, 1, out]`` follows the
+    output axis: column-parallel weights shard their scales the same way;
+    row-parallel weights (contraction sharded) replicate them — the scale
+    multiplies the *partial sums' combined* output, and XLA applies it after
+    its inserted psum. With ``params`` given, only leaves actually quantized
+    there are expanded; otherwise every key in ``keys`` is.
+    """
+    if params is not None:
+        keys = [k for k, v in params["layers"].items() if is_quantized(v)]
+    out = dict(shardings)
+    layers = dict(shardings["layers"])
+    for k in keys:
+        base: NamedSharding = shardings["layers"][k]
+        spec = tuple(base.spec) + (None,) * (3 - len(tuple(base.spec)))
+        s_spec = P(None, None, spec[2]) if spec[2] is not None else P()
+        layers[k] = {"q": base, "s": NamedSharding(base.mesh, s_spec)}
+    out["layers"] = layers
+    return out
+
+
+def weight_bytes(params: Params) -> int:
+    """Total bytes across all weight leaves (quantized or not)."""
+    import jax
+
+    return sum(x.nbytes for x in jax.tree.leaves(params))

@@ -1,0 +1,107 @@
+"""Int8 weight-only quantization: numerics, serving, and TP sharding.
+
+SURVEY.md §7 hard part 4: bf16 70B doesn't fit v5e-16; int8 weight-only is
+the memory path. These tests pin the scheme's invariants on the tiny config.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from runbookai_tpu.engine.engine import EngineConfig, EngineCore
+from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+from runbookai_tpu.models.llama import CONFIGS, forward_train, init_params
+from runbookai_tpu.models.quant import (
+    LAYER_QUANT_KEYS,
+    dequantize_params,
+    dequantize_tensor,
+    is_quantized,
+    quantize_array_np,
+    quantize_params,
+    quantize_tensor,
+    shardings_with_quant,
+)
+from runbookai_tpu.parallel.mesh import build_mesh
+from runbookai_tpu.parallel.sharding import param_shardings
+from runbookai_tpu.utils.tokens import ByteTokenizer
+
+CFG = CONFIGS["llama3-test"]
+
+
+def test_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 48), dtype=jnp.float32)
+    qt = quantize_tensor(w)
+    assert qt["q"].dtype == jnp.int8 and qt["s"].shape == (2, 1, 48)
+    back = dequantize_tensor(qt)
+    # Symmetric rounding error is at most half a quantization step per element.
+    assert np.all(np.abs(np.asarray(back - w)) <= np.asarray(qt["s"]) / 2 + 1e-7)
+
+
+def test_numpy_and_jax_quantizers_agree():
+    w = np.random.default_rng(0).normal(size=(3, 16, 8)).astype(np.float32)
+    q_np, s_np = quantize_array_np(w)
+    qt = quantize_tensor(jnp.asarray(w))
+    np.testing.assert_array_equal(q_np, np.asarray(qt["q"]))
+    np.testing.assert_allclose(s_np, np.asarray(qt["s"]), rtol=1e-6)
+
+
+def test_quantize_params_structure_and_bytes():
+    params = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+    qp = quantize_params(params)
+    for k in LAYER_QUANT_KEYS:
+        assert is_quantized(qp["layers"][k])
+        # int8 payload is 1/4 the float32 bytes.
+        assert qp["layers"][k]["q"].nbytes == params["layers"][k].nbytes // 4
+    for k in ("attn_norm", "mlp_norm"):
+        assert not is_quantized(qp["layers"][k])
+    assert not is_quantized(qp["embed"])
+
+
+def test_scale_after_matmul_equals_dequant_first():
+    """(x @ q) * s must equal x @ (q * s) — the qmm identity."""
+    params = init_params(jax.random.PRNGKey(1), CFG, dtype=jnp.float32)
+    qp = quantize_params(params)
+    deq = dequantize_params(qp, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 12), 1, CFG.vocab_size)
+    out_q = forward_train(qp, CFG, tokens)
+    out_d = forward_train(deq, CFG, tokens)
+    np.testing.assert_allclose(np.asarray(out_q), np.asarray(out_d),
+                               atol=5e-3, rtol=5e-3)
+
+
+def test_quantized_close_to_full_precision():
+    params = init_params(jax.random.PRNGKey(1), CFG, dtype=jnp.float32)
+    qp = quantize_params(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (1, 16), 1, CFG.vocab_size)
+    full = np.asarray(forward_train(params, CFG, tokens)).ravel()
+    quant = np.asarray(forward_train(qp, CFG, tokens)).ravel()
+    cos = float(np.dot(full, quant) / (np.linalg.norm(full) * np.linalg.norm(quant)))
+    assert cos > 0.99, f"quantized logits diverged: cos={cos:.4f}"
+
+
+def test_engine_serves_quantized_params():
+    tok = ByteTokenizer()
+    params = quantize_params(init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32))
+    core = EngineCore(CFG, params, tok, EngineConfig(
+        page_size=4, num_pages=64, max_batch_slots=2, prefill_chunk=8,
+        max_seq_len=128, block_pages=4, kv_dtype=jnp.float32))
+    req = EngineRequest(prompt_ids=tok.encode("quantized serving"),
+                        sampling=SamplingParams(temperature=0.0, max_new_tokens=6))
+    core.submit(req)
+    core.run_until_idle()
+    assert req.finish_reason is not None and len(req.all_out_ids) >= 1
+
+
+def test_tp_sharded_quantized_forward_matches():
+    """Quantized forward over a (data=2, model=2) mesh == single-device."""
+    params = quantize_params(init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32))
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 8), 1, CFG.vocab_size)
+    ref = forward_train(params, CFG, tokens)
+
+    mesh = build_mesh(2, 2)
+    sh = shardings_with_quant(param_shardings(CFG, mesh), params)
+    assert isinstance(sh["layers"]["wq"], dict)
+    sharded = jax.tree.map(jax.device_put, params, sh)
+    out = forward_train(sharded, CFG, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-3, rtol=5e-3)
