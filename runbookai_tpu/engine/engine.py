@@ -66,6 +66,14 @@ class EngineConfig:
     decode_steps_per_dispatch: int = 8
     # Decode attention implementation: "xla" (portable) | "pallas" (TPU kernel).
     attn_impl: str = "xla"
+    # Prompt-lookup speculative decoding (greedy requests): draft the tokens
+    # that followed the last occurrence of the trailing n-gram in the
+    # sequence's own history, verify all of them in ONE T=K forward (a
+    # parallel MXU matmul instead of K sequential decode steps). Agent
+    # workloads repeat heavily (tool names, JSON keys, service ids), so
+    # acceptance rates are high; a miss still yields one token per dispatch.
+    speculative: bool = True
+    spec_ngram: int = 3
 
 
 @partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl"),
@@ -116,6 +124,32 @@ def _decode_multi(
         step, (tokens, positions, kv_k, kv_v, ctx_lens, key), None, length=k_steps
     )
     return toks.T, kv_k, kv_v  # [B, K]
+
+
+@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl"),
+         donate_argnums=(4, 5))
+def _decode_spec(
+    params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
+    page_size: int, block_pages: int, attn_impl: str = "xla",
+):
+    """Verify a speculated chunk: one T=K forward, greedy argmax per position.
+
+    ``tokens[:, 0]`` is each sequence's real last sampled token; the rest are
+    drafts. Causal masking inside :func:`forward_impl` makes position i's
+    logits depend only on tokens ≤ i, so the host can accept the longest
+    prefix where the model's own argmax agrees with the draft. Rejected
+    positions leave garbage K/V exactly like multi-step decode does —
+    position-addressed writes are overwritten when the real tokens arrive.
+
+    With ``attn_impl="pallas"`` the T>1 verify forward takes forward_impl's
+    XLA fallback (the Pallas kernel is decode/T=1 only) — the same kernel
+    mix chunked prefill already has.
+    """
+    logits, kv_k, kv_v = forward_impl(
+        params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
+        page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
+    )
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv_k, kv_v  # [B, K]
 
 
 @partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages"), donate_argnums=(3, 4))
@@ -175,7 +209,7 @@ class EngineCore:
         # Serving metrics (BASELINE.md contract: TTFT + tokens/sec/chip).
         self.metrics = {"decode_tokens": 0, "decode_steps": 0, "prefill_tokens": 0,
                         "preemptions": 0, "decode_time_s": 0.0, "prefill_time_s": 0.0,
-                        "cached_prefix_tokens": 0}
+                        "cached_prefix_tokens": 0, "spec_drafted": 0, "spec_accepted": 0}
 
     # ------------------------------------------------------------------ API
 
@@ -392,18 +426,27 @@ class EngineCore:
             p *= 2
         return p
 
-    def _run_decode(self) -> None:
-        if not self.decoding:
-            return
-        t0 = time.perf_counter()
-        # Sequences at the context limit finish before K is chosen.
-        for req in list(self.decoding):
-            if req.ctx_len + 1 > self.ecfg.max_seq_len:
-                self._finish(req, FinishReason.MAX_TOKENS)
-        if not self.decoding:
-            return
-        k = self._pick_k()
-        # Grow pages to cover ctx + K for every sequence; preempt on pressure.
+    def _draft_for(self, req: EngineRequest, max_draft: int) -> list[int]:
+        """Prompt-lookup draft: tokens that followed the most recent earlier
+        occurrence of the sequence's trailing n-gram (vectorized search)."""
+        n = self.ecfg.spec_ngram
+        hist = req.prompt_ids[: req.prefill_pos] + req.out_ids
+        if max_draft < 1 or len(hist) <= n:
+            return []
+        # Cap the lookback so per-dispatch host cost stays bounded on long
+        # agent contexts; recent repeats dominate acceptance anyway.
+        arr = np.asarray(hist[-2048:], dtype=np.int64)
+        tail = arr[-n:]
+        windows = np.lib.stride_tricks.sliding_window_view(arr[:-1], n)
+        hits = np.nonzero((windows == tail).all(axis=1))[0]
+        if hits.size == 0:
+            return []
+        start = int(hits[-1]) + n
+        return arr[start : start + max_draft].tolist()
+
+    def _grow_pages_for_decode(self, k: int) -> None:
+        """Ensure every decoding sequence has pages for ctx + k tokens,
+        preempting the youngest (or aborting) under pool pressure."""
         for req in list(self.decoding):
             while (
                 req.state == RequestState.DECODE
@@ -416,6 +459,83 @@ class EngineCore:
                     break
             if req.state == RequestState.DECODE and req.request_id in self.kv.seqs:
                 self.kv.extend(req.request_id, req.ctx_len + k)
+
+    def _run_decode_spec(self, drafts: dict[str, list[int]], k: int) -> None:
+        """Speculative dispatch: feed [last, draft...] as one T=k chunk and
+        accept the agreeing prefix."""
+        t0 = time.perf_counter()
+        self._grow_pages_for_decode(k)
+        if not self.decoding:
+            return
+
+        b = self.ecfg.max_batch_slots
+        tokens = np.zeros((b, k), dtype=np.int32)
+        positions = np.zeros((b, k), dtype=np.int32)
+        ctx_lens = np.zeros((b,), dtype=np.int32)
+        feeds: dict[str, list[int]] = {}
+        for req in self.decoding:
+            i = req.slot
+            draft = drafts.get(req.request_id, [])[: k - 1]
+            feed = [self._last_token[req.request_id]] + draft
+            feed = feed + [feed[-1]] * (k - len(feed))  # pad rows to T=k
+            feeds[req.request_id] = feed
+            tokens[i] = feed
+            positions[i] = np.arange(req.ctx_len - 1, req.ctx_len - 1 + k)
+            ctx_lens[i] = req.ctx_len + k - 1  # keys written for all fed tokens
+            self.metrics["spec_drafted"] += len(draft)
+        tables = self._tables_for(self._slots)
+
+        toks, self._kv_k, self._kv_v = _decode_spec(
+            self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
+            self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
+            page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
+            attn_impl=self.ecfg.attn_impl,
+        )
+        toks_host = np.asarray(jax.device_get(toks))  # [B, k]
+
+        emitted = 0
+        for req in list(self.decoding):
+            i = req.slot
+            feed = feeds[req.request_id]
+            draft = drafts.get(req.request_id, [])[: k - 1]
+            self._emit_token(req, int(toks_host[i, 0]))
+            emitted += 1
+            j = 1
+            while (req.state == RequestState.DECODE and j <= len(draft)
+                   and feed[j] == int(toks_host[i, j - 1])):
+                self._emit_token(req, int(toks_host[i, j]))
+                emitted += 1
+                self.metrics["spec_accepted"] += 1
+                j += 1
+        self.metrics["decode_tokens"] += emitted
+        self.metrics["decode_steps"] += 1
+        self.metrics["decode_time_s"] += time.perf_counter() - t0
+
+    def _run_decode(self) -> None:
+        if not self.decoding:
+            return
+        t0 = time.perf_counter()
+        # Sequences at the context limit finish before K is chosen.
+        for req in list(self.decoding):
+            if req.ctx_len + 1 > self.ecfg.max_seq_len:
+                self._finish(req, FinishReason.MAX_TOKENS)
+        if not self.decoding:
+            return
+        k = self._pick_k()
+        # Prompt-lookup speculation for all-greedy batches: one T=k verify
+        # forward replaces k sequential decode steps when any draft exists.
+        if (k > 1 and self.ecfg.speculative
+                and all(r.sampling.temperature == 0.0 and not r.sampling.guided
+                        for r in self.decoding)):
+            drafts = {r.request_id: self._draft_for(r, k - 1) for r in self.decoding}
+            # Worth it only when most of the batch drafts (nonempty decoding
+            # list makes this imply at least one draft): an undrafted request
+            # gets 1 token from a spec dispatch vs k from multi-step.
+            if 2 * sum(bool(d) for d in drafts.values()) >= len(self.decoding):
+                self._run_decode_spec(drafts, k)
+                return
+        # Grow pages to cover ctx + K for every sequence; preempt on pressure.
+        self._grow_pages_for_decode(k)
         if not self.decoding:
             return
 
