@@ -371,6 +371,28 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_weights(args) -> int:
+    from runbookai_tpu.models.checkpoint import (
+        checkpoint_config,
+        convert_hf_to_checkpoint,
+        is_checkpoint,
+    )
+
+    if args.weights_cmd == "convert":
+        out = convert_hf_to_checkpoint(
+            args.model_path, args.out, model_name=args.name,
+            quantize_int8=args.int8,
+        )
+        print(f"wrote checkpoint: {out} (int8={args.int8})")
+        return 0
+    if not is_checkpoint(args.path):
+        print(f"not a checkpoint: {args.path}")
+        return 1
+    cfg = checkpoint_config(args.path)
+    print(json.dumps(cfg.__dict__, indent=2))
+    return 0
+
+
 def cmd_mcp(args) -> int:
     from runbookai_tpu.server.mcp import MCPServer, run_stdio_server
 
@@ -622,6 +644,19 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("ingest", "replay", "status"):
         op_sub.add_parser(name)
     op.set_defaults(fn=cmd_operability)
+
+    w = sub.add_parser("weights", help="model weight checkpoints")
+    w_sub = w.add_subparsers(dest="weights_cmd", required=True)
+    conv = w_sub.add_parser(
+        "convert", help="HF safetensors -> orbax checkpoint (optionally int8)")
+    conv.add_argument("model_path", help="HF model dir (safetensors + config)")
+    conv.add_argument("out", help="output checkpoint dir")
+    conv.add_argument("--int8", action="store_true",
+                      help="quantize layer weights to int8 during conversion")
+    conv.add_argument("--name", default="hf-model")
+    info = w_sub.add_parser("info", help="describe a checkpoint")
+    info.add_argument("path")
+    w.set_defaults(fn=cmd_weights)
 
     return p
 

@@ -47,6 +47,7 @@ from runbookai_tpu.engine.request import (
 )
 from runbookai_tpu.models.llama import LlamaConfig, forward_impl
 from runbookai_tpu.ops.sampling import sample_tokens
+from runbookai_tpu.utils.trace import annotate, get_tracer
 
 
 @dataclass
@@ -176,11 +177,13 @@ class EngineCore:
         mask_fn: Optional[Callable[[EngineRequest], Optional[np.ndarray]]] = None,
         advance_fn: Optional[Callable[[EngineRequest, int], bool]] = None,
         seed: int = 0,
+        tracer=None,
     ):
         self.cfg = model_cfg
         self.ecfg = engine_cfg or EngineConfig()
         self.params = params
         self.tokenizer = tokenizer
+        self.tracer = tracer if tracer is not None else get_tracer()
         # Guided decoding hooks: mask_fn returns the allowed-token mask for a
         # request (or None), advance_fn feeds a sampled token to the grammar
         # automaton and returns True when the grammar has completed.
@@ -350,13 +353,15 @@ class EngineCore:
             dtype=np.int32,
         )
         tables = self._tables_for([req])
-        last_logits, self._kv_k, self._kv_v = _prefill_step(
-            self.params, self.cfg, jnp.asarray(tokens), self._kv_k, self._kv_v,
-            jnp.asarray(positions), jnp.asarray(tables),
-            jnp.asarray([new_ctx], dtype=jnp.int32),
-            jnp.asarray(chunk_len - 1, dtype=jnp.int32),
-            page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
-        )
+        with self.tracer.span("engine.prefill", tokens=chunk_len,
+                              req=req.request_id), annotate("prefill"):
+            last_logits, self._kv_k, self._kv_v = _prefill_step(
+                self.params, self.cfg, jnp.asarray(tokens), self._kv_k, self._kv_v,
+                jnp.asarray(positions), jnp.asarray(tables),
+                jnp.asarray([new_ctx], dtype=jnp.int32),
+                jnp.asarray(chunk_len - 1, dtype=jnp.int32),
+                page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
+            )
         req.prefill_pos = new_ctx
         self.metrics["prefill_tokens"] += chunk_len
 
@@ -485,13 +490,15 @@ class EngineCore:
             self.metrics["spec_drafted"] += len(draft)
         tables = self._tables_for(self._slots)
 
-        toks, self._kv_k, self._kv_v = _decode_spec(
-            self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
-            self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
-            page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
-            attn_impl=self.ecfg.attn_impl,
-        )
-        toks_host = np.asarray(jax.device_get(toks))  # [B, k]
+        with self.tracer.span("engine.decode_spec", k=k,
+                              batch=len(self.decoding)), annotate("decode_spec"):
+            toks, self._kv_k, self._kv_v = _decode_spec(
+                self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
+                self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
+                page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
+                attn_impl=self.ecfg.attn_impl,
+            )
+            toks_host = np.asarray(jax.device_get(toks))  # [B, k]
 
         emitted = 0
         for req in list(self.decoding):
@@ -562,25 +569,27 @@ class EngineCore:
         tables = self._tables_for(self._slots)
         self._key, sub = jax.random.split(self._key)
 
-        if k == 1:
-            toks, _, self._kv_k, self._kv_v = _decode_step(
-                self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
-                self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
-                jnp.asarray(temps), jnp.asarray(top_ps), sub,
-                jnp.asarray(mask) if need_mask else None,
-                page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
-                attn_impl=self.ecfg.attn_impl,
-            )
-            toks_host = np.asarray(jax.device_get(toks))[:, None]  # [B, 1]
-        else:
-            toks, self._kv_k, self._kv_v = _decode_multi(
-                self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
-                self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
-                jnp.asarray(temps), jnp.asarray(top_ps), sub,
-                page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
-                k_steps=k, attn_impl=self.ecfg.attn_impl,
-            )
-            toks_host = np.asarray(jax.device_get(toks))  # [B, K]
+        with self.tracer.span("engine.decode", k=k,
+                              batch=len(self.decoding)), annotate("decode"):
+            if k == 1:
+                toks, _, self._kv_k, self._kv_v = _decode_step(
+                    self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
+                    self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
+                    jnp.asarray(temps), jnp.asarray(top_ps), sub,
+                    jnp.asarray(mask) if need_mask else None,
+                    page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
+                    attn_impl=self.ecfg.attn_impl,
+                )
+                toks_host = np.asarray(jax.device_get(toks))[:, None]  # [B, 1]
+            else:
+                toks, self._kv_k, self._kv_v = _decode_multi(
+                    self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
+                    self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
+                    jnp.asarray(temps), jnp.asarray(top_ps), sub,
+                    page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
+                    k_steps=k, attn_impl=self.ecfg.attn_impl,
+                )
+                toks_host = np.asarray(jax.device_get(toks))  # [B, K]
 
         emitted = 0
         snapshot = list(self.decoding)

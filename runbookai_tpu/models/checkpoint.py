@@ -1,0 +1,113 @@
+"""Sharded model-weight checkpoints (orbax) — fast reload for serving.
+
+The reference has no model weights at all (SURVEY.md §5.4: "model-weights
+checkpointing does not exist; the TPU build needs weight loading — new
+construction"). Loading 70B from HF safetensors and re-quantizing on every
+boot costs minutes of host time; this module converts once and restores
+directly to sharded device arrays:
+
+    HF safetensors ──load_or_init(quantize_int8=...)──▶ params pytree
+    params pytree  ──save_checkpoint──▶ orbax dir (config.json + pytree/)
+    orbax dir      ──load_checkpoint(shardings=...)──▶ sharded device arrays
+
+Quantized ``{"q": int8, "s": f32}`` leaves are plain arrays to orbax, so
+int8 checkpoints round-trip unchanged. Restore places each leaf directly on
+its TP shard (no full-host materialization) when ``shardings`` is given.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+
+from runbookai_tpu.models.llama import CONFIGS, LlamaConfig
+
+_CONFIG_FILE = "config.json"
+_TREE_DIR = "pytree"
+
+
+def save_checkpoint(path: str | Path, cfg: LlamaConfig, params: Any) -> Path:
+    """Write ``config.json`` + the params pytree under ``path``."""
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    path.mkdir(parents=True, exist_ok=True)
+    (path / _CONFIG_FILE).write_text(json.dumps(dataclasses.asdict(cfg), indent=2))
+    ckptr = ocp.StandardCheckpointer()
+    tree_path = path / _TREE_DIR
+    ckptr.save(tree_path, params, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def checkpoint_config(path: str | Path) -> LlamaConfig:
+    data = json.loads((Path(path) / _CONFIG_FILE).read_text())
+    return LlamaConfig(**data)
+
+
+def is_checkpoint(path: Optional[str | Path]) -> bool:
+    return bool(path) and (Path(path) / _CONFIG_FILE).is_file() \
+        and (Path(path) / _TREE_DIR).exists()
+
+
+def load_checkpoint(
+    path: str | Path,
+    shardings: Optional[Any] = None,
+    dtype=None,
+) -> tuple[LlamaConfig, Any]:
+    """Restore ``(cfg, params)``; leaves land on their shards directly.
+
+    ``shardings`` is the (possibly quant-expanded) ``param_shardings`` tree;
+    missing/None entries restore unsharded. ``dtype`` optionally casts
+    floating-point leaves on restore (int8 payloads are never cast).
+    """
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    cfg = checkpoint_config(path)
+    ckptr = ocp.StandardCheckpointer()
+    meta = ckptr.metadata(path / _TREE_DIR).item_metadata.tree
+
+    def spec_for(leaf_meta, sh):
+        target_dtype = leaf_meta.dtype
+        if (dtype is not None and jnp.issubdtype(target_dtype, jnp.floating)
+                and target_dtype != jnp.float32):  # norms stay f32
+            target_dtype = dtype
+        return jax.ShapeDtypeStruct(leaf_meta.shape, target_dtype, sharding=sh)
+
+    if shardings is None:
+        target = jax.tree.map(lambda m: spec_for(m, None), meta)
+    else:
+        try:
+            target = jax.tree.map(spec_for, meta, shardings,
+                                  is_leaf=lambda x: x is None)
+        except ValueError:
+            # Structure mismatch (e.g. quant-expanded shardings against an
+            # unquantized checkpoint): restore unsharded; the caller reshards.
+            target = jax.tree.map(lambda m: spec_for(m, None), meta)
+    params = ckptr.restore(path / _TREE_DIR, target)
+    return cfg, params
+
+
+def convert_hf_to_checkpoint(
+    model_path: str | Path,
+    out_path: str | Path,
+    model_name: str = "hf-model",
+    quantize_int8: bool = False,
+    dtype=None,
+) -> Path:
+    """One-time conversion: HF safetensors → (optionally int8) orbax dir."""
+    import jax.numpy as jnp
+
+    from runbookai_tpu.models.hf_loader import load_or_init
+
+    cfg, params = load_or_init(
+        model_name if model_name in CONFIGS else "hf-model",
+        model_path, dtype=dtype or jnp.bfloat16, quantize_int8=quantize_int8,
+    )
+    return save_checkpoint(out_path, cfg, params)

@@ -169,6 +169,23 @@ def load_or_init(
     environment (BASELINE.md configs run with real weights when provided).
     """
     if model_path and Path(model_path).exists():
+        from runbookai_tpu.models.checkpoint import is_checkpoint, load_checkpoint
+
+        if is_checkpoint(model_path):
+            # Orbax checkpoint (possibly pre-quantized): restores straight to
+            # the sharded placement, no host-side safetensors pass.
+            cfg, params = load_checkpoint(model_path, shardings=shardings, dtype=dtype)
+            from runbookai_tpu.models.quant import is_quantized, quantize_params
+
+            if quantize_int8 and not any(
+                is_quantized(v) for v in params["layers"].values()
+            ):
+                params = quantize_params(params)
+                if shardings:
+                    params = jax.tree.map(
+                        lambda x, s: jax.device_put(x, s) if s is not None else x,
+                        params, shardings, is_leaf=lambda x: x is None)
+            return cfg, params
         cfg = config_from_hf(model_path, name=model_name)
         return load_params(model_path, cfg, dtype=dtype, shardings=shardings,
                            quantize_int8=quantize_int8)

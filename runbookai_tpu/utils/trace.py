@@ -1,0 +1,135 @@
+"""Tracing: host-side span JSONL + device (XProf) profiling hooks.
+
+SURVEY.md §5.1 — the reference has no tracer; its only "trace" is per-tool
+``durationMs`` plus the scratchpad JSONL. The TPU build adds the real thing:
+
+- :class:`Tracer` — nested host spans appended as JSONL (one object per
+  span: ts, name, ms, depth, meta). Cheap enough to leave on in production;
+  a disabled tracer costs one ``if``.
+- :func:`annotate` — ``jax.profiler.TraceAnnotation`` passthrough so engine
+  dispatches (prefill/decode/spec) show up on the XProf/TensorBoard device
+  timeline with meaningful names.
+- :func:`device_trace` — context manager around
+  ``jax.profiler.start_trace``/``stop_trace`` for capturing a device profile
+  of any region (``RUNBOOK_DEVICE_TRACE=<logdir>`` wraps a whole CLI run).
+
+Enable globally with ``RUNBOOK_TRACE=<file.jsonl>`` (or ``1`` for the
+default ``.runbook/trace/<pid>.jsonl``) or by passing a Tracer explicitly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from pathlib import Path
+from typing import Any, Iterator, Optional
+
+
+class Tracer:
+    """Appends nested span records to a JSONL file."""
+
+    def __init__(self, path: Optional[str | Path], enabled: bool = True):
+        self.enabled = enabled and path is not None
+        self.path = Path(path) if path else None
+        self._depth = 0
+        self._fh = None
+        if self.enabled:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._fh = open(self.path, "a", buffering=1)  # line-buffered
+
+    @contextlib.contextmanager
+    def span(self, name: str, **meta: Any) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        self._depth += 1
+        depth = self._depth
+        try:
+            yield
+        finally:
+            self._depth -= 1
+            rec = {"ts": time.time(), "name": name, "depth": depth,
+                   "ms": round((time.perf_counter() - t0) * 1e3, 3)}
+            if meta:
+                rec["meta"] = meta
+            try:
+                self._fh.write(json.dumps(rec) + "\n")
+            except (OSError, ValueError):
+                self.enabled = False  # disk gone / closed: stop tracing, keep serving
+
+    def event(self, name: str, **meta: Any) -> None:
+        """Zero-duration marker."""
+        if not self.enabled:
+            return
+        rec = {"ts": time.time(), "name": name, "depth": self._depth + 1, "ms": 0.0}
+        if meta:
+            rec["meta"] = meta
+        try:
+            self._fh.write(json.dumps(rec) + "\n")
+        except (OSError, ValueError):
+            self.enabled = False
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+            self.enabled = False
+
+
+_NULL = Tracer(None, enabled=False)
+_global: Optional[Tracer] = None
+
+
+def get_tracer() -> Tracer:
+    """Process-wide tracer, configured from ``RUNBOOK_TRACE`` on first use."""
+    global _global
+    if _global is None:
+        env = os.environ.get("RUNBOOK_TRACE", "")
+        if not env:
+            _global = _NULL
+        else:
+            path = (Path(".runbook") / "trace" / f"{os.getpid()}.jsonl"
+                    if env == "1" else Path(env))
+            try:
+                _global = Tracer(path)
+            except OSError:
+                _global = _NULL
+    return _global
+
+
+def set_tracer(tracer: Optional[Tracer]) -> None:
+    global _global
+    _global = tracer if tracer is not None else _NULL
+
+
+def annotate(name: str):
+    """Named region on the XProf device timeline (no-op off-profile)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str | Path) -> Iterator[None]:
+    """Capture an XProf device profile of the enclosed region."""
+    import jax
+
+    jax.profiler.start_trace(str(logdir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def read_spans(path: str | Path) -> list[dict[str, Any]]:
+    """Load a span JSONL (for tooling/tests)."""
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
