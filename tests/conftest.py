@@ -6,18 +6,17 @@ full TP/DP pjit programs compile and execute without TPU hardware.
 """
 
 import os
+import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from runbookai_tpu.utils.cpu_mesh import force_cpu_platform
+
+force_cpu_platform(8)
 
 import jax
 
-# The environment's TPU plugin overrides JAX_PLATFORMS; force CPU explicitly
-# so tests run on the virtual 8-device host mesh, and use full-precision
-# matmuls so numerics tests compare exactly.
-jax.config.update("jax_platforms", "cpu")
+# Full-precision matmuls so numerics tests compare exactly.
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import asyncio
