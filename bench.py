@@ -1,66 +1,151 @@
 """Serving benchmark — prints ONE JSON line for the driver.
 
 Measures the BASELINE.md contract metrics on the continuous-batching engine:
-decode tokens/sec/chip (headline) and p50 TTFT, using a Llama-3-shaped model
-(~1B params, bf16, full 128k vocab) on the real chip. Weights are random-init
-when no checkpoint is present (no-egress environment) — identical compute to
-real weights. The reference publishes no numbers (`published: {}`), so
-``vs_baseline`` is reported against 1.0 (this repo establishes the baseline).
+decode tokens/sec/chip (headline), p50 TTFT, and MFU. On an accelerator the
+default model is the north-star **Llama-3-8B shape with int8 weights**
+(~9.2GB weights + KV pool fits a 16GB-HBM v5e chip); weights are random-init
+(no-egress environment) — identical compute to real weights. Falls back to
+the ~1B stand-in if the 8B shape exhausts HBM, and to a tiny CPU run if the
+TPU backend is unreachable. The reference publishes no numbers
+(``published: {}``), so ``vs_baseline`` is 1.0 (this repo establishes it).
 
-Env knobs: BENCH_MODEL, BENCH_REQUESTS, BENCH_PROMPT, BENCH_NEW, BENCH_SLOTS.
+Reliability contract (VERDICT r1 weak #9): the TPU plugin in this
+environment can hang for >10 min at backend init — a hang inside a C call
+that SIGALRM cannot interrupt. So the watchdog lives in a parent process
+that never imports jax: it probes the backend in a throwaway subprocess,
+then runs the measured bench in a child subprocess under a hard timeout
+and relays its JSON line. The driver always gets a parseable line, never a
+silent rc=124. An 8B HBM exhaustion retries the ~1B stand-in in a fresh
+child (fresh process = the failed attempt's device buffers are gone).
+
+Env knobs: BENCH_MODEL, BENCH_REQUESTS, BENCH_PROMPT, BENCH_NEW,
+BENCH_SLOTS, BENCH_PAGES, BENCH_PROBE_TIMEOUT, BENCH_WATCHDOG.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+# Peak dense bf16 FLOP/s per chip, keyed by substrings of device_kind
+# (first match wins; public spec-sheet numbers).
+_PEAK_FLOPS = (
+    ("v6e", 918e12), ("v6 lite", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12), ("v5 lite", 197e12), ("v5lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
 
-def main() -> None:
+
+def peak_flops_per_chip(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for key, flops in _PEAK_FLOPS:
+        if key in kind:
+            return flops
+    return None
+
+
+def make_result(value: float, unit: str, details: dict) -> dict:
+    return {
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": value,
+        "unit": unit,
+        "vs_baseline": 1.0,
+        "details": details,
+    }
+
+
+def emit(value: float, unit: str, details: dict) -> None:
+    print(json.dumps(make_result(value, unit, details)), flush=True)
+
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def looks_oom(message: str) -> bool:
+    return any(s in message for s in _OOM_MARKERS)
+
+
+def probe_backend(timeout_s: float) -> dict:
+    """Initialize the jax backend in a throwaway subprocess with a timeout.
+
+    The environment's TPU plugin can hang indefinitely at init; probing
+    out-of-process turns that hang into a diagnosable error string instead
+    of burning the driver's whole timeout (BENCH_r01 was rc=1 with no
+    output; VERDICT r1 weak #9).
+    """
+    code = (
+        "import jax, json; d = jax.devices(); "
+        "print(json.dumps({'platform': d[0].platform, "
+        "'kind': d[0].device_kind, 'n': len(d)}))"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"backend init exceeded {timeout_s}s (hang)"}
+    if out.returncode != 0:
+        return {"ok": False,
+                "error": f"backend init failed rc={out.returncode}: "
+                         f"{out.stderr.strip()[-400:]}"}
+    try:
+        info = json.loads(out.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"ok": False, "error": f"unparseable probe output: {out.stdout[-200:]}"}
+    info["ok"] = True
+    return info
+
+
+def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     import jax
     import jax.numpy as jnp
 
     from runbookai_tpu.engine.engine import EngineConfig, EngineCore
     from runbookai_tpu.engine.request import EngineRequest, SamplingParams
-    from runbookai_tpu.models.llama import CONFIGS, init_params
+    from runbookai_tpu.models.llama import CONFIGS, init_params, init_params_quantized
     from runbookai_tpu.utils.tokens import ByteTokenizer
 
-    platform = jax.devices()[0].platform
-    on_accel = platform in ("tpu", "axon")
-    model_name = os.environ.get(
-        "BENCH_MODEL", "llama3-1b-bench" if on_accel else "llama3-test")
     n_requests = int(os.environ.get("BENCH_REQUESTS", 8))
     prompt_len = int(os.environ.get("BENCH_PROMPT", 128))
     new_tokens = int(os.environ.get("BENCH_NEW", 64))
     slots = int(os.environ.get("BENCH_SLOTS", 8))
+    num_pages = int(os.environ.get("BENCH_PAGES", 1024))
 
     cfg = CONFIGS[model_name]
     dtype = jnp.bfloat16 if on_accel else jnp.float32
-    params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
+    quantized = on_accel and model_name == "llama3-8b-instruct"
+    if quantized:
+        params = init_params_quantized(jax.random.PRNGKey(0), cfg, dtype=dtype)
+    else:
+        params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
     tok = ByteTokenizer()
     ecfg = EngineConfig(
-        page_size=16, num_pages=1024, max_batch_slots=slots,
+        page_size=16, num_pages=num_pages, max_batch_slots=slots,
         prefill_chunk=128, max_seq_len=2048, kv_dtype=dtype, block_pages=16,
     )
     core = EngineCore(cfg, params, tok, ecfg)
 
     rng = np.random.default_rng(0)
 
-    def make_req():
+    def make_req(max_new=new_tokens):
         prompt = rng.integers(0, 256, size=prompt_len).tolist()
         return EngineRequest(
             prompt_ids=prompt,
-            sampling=SamplingParams(temperature=0.0, max_new_tokens=new_tokens,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=max_new,
                                     stop_token_ids=()),
         )
 
     # Warmup: compile prefill + decode programs.
-    warm = make_req()
-    warm.sampling = SamplingParams(temperature=0.0, max_new_tokens=4, stop_token_ids=())
-    core.submit(warm)
+    core.submit(make_req(max_new=4))
     core.run_until_idle()
     core.metrics.update(decode_tokens=0, decode_steps=0, prefill_tokens=0,
                         decode_time_s=0.0, prefill_time_s=0.0)
@@ -78,27 +163,109 @@ def main() -> None:
     ttfts = sorted(r.ttft_ms for r in reqs if r.ttft_ms is not None)
     p50_ttft = ttfts[len(ttfts) // 2] if ttfts else None
 
-    print(json.dumps({
-        "metric": "decode_tokens_per_sec_per_chip",
-        "value": round(decode_tps, 2),
-        "unit": "tok/s",
-        "vs_baseline": 1.0,
-        "details": {
-            "model": model_name,
-            "platform": platform,
-            "devices": len(jax.devices()),
-            "requests": n_requests,
-            "prompt_len": prompt_len,
-            "new_tokens": new_tokens,
-            "batch_slots": slots,
-            "p50_ttft_ms": round(p50_ttft, 1) if p50_ttft is not None else None,
-            "wall_s": round(wall, 2),
-            "total_tokens": total_tokens,
-            "total_throughput_tok_s": round(total_tokens / wall, 2),
-            "decode_steps": m["decode_steps"],
-            "preemptions": m["preemptions"],
-        },
-    }))
+    # MFU: decode FLOPs/token ≈ 2·N over the matmul params (attention reads
+    # against short contexts here add <2% — noted as approximate).
+    peak = peak_flops_per_chip(probe.get("kind", "")) if on_accel else None
+    mfu = (2.0 * cfg.matmul_params * decode_tps / peak) if peak else None
+
+    details = {
+        "model": model_name,
+        "weights": "int8" if quantized else str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
+        "platform": probe.get("platform"),
+        "device_kind": probe.get("kind"),
+        "devices": probe.get("n"),
+        "requests": n_requests,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "batch_slots": slots,
+        "p50_ttft_ms": round(p50_ttft, 1) if p50_ttft is not None else None,
+        "wall_s": round(wall, 2),
+        "total_tokens": total_tokens,
+        "total_throughput_tok_s": round(total_tokens / wall, 2),
+        "decode_steps": m["decode_steps"],
+        "preemptions": m["preemptions"],
+        "matmul_params": cfg.matmul_params,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "peak_flops_per_chip": peak,
+    }
+    if not probe.get("ok", True):
+        details["tpu_error"] = probe.get("error")
+    emit(round(decode_tps, 2), "tok/s", details)
+
+
+def run_inner(model_name: str, on_accel: bool, probe: dict) -> None:
+    """Child-process entry: do the measured run, always print a JSON line."""
+    if not on_accel:
+        from runbookai_tpu.utils.cpu_mesh import force_cpu_platform
+
+        force_cpu_platform(1)
+    try:
+        run_bench(model_name, on_accel, probe)
+    except Exception as e:  # noqa: BLE001 — always emit a parseable line
+        # OOM classified on the full message: XLA puts RESOURCE_EXHAUSTED at
+        # the head and a multi-KB allocation dump after it, so the truncated
+        # tail alone would miss the marker.
+        emit(0.0, "tok/s", {"error": str(e)[-600:], "oom": looks_oom(str(e)),
+                            "model": model_name,
+                            "platform": probe.get("platform")})
+
+
+def _spawn_inner(model_name: str, on_accel: bool, probe: dict,
+                 timeout_s: float) -> dict | None:
+    """Run the bench child under a hard timeout; return its parsed JSON."""
+    argv = [sys.executable, os.path.abspath(__file__), "--inner", model_name,
+            "1" if on_accel else "0", json.dumps(probe)]
+    try:
+        out = subprocess.run(argv, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return parsed
+    return make_result(0.0, "tok/s", {
+        "error": f"bench child rc={out.returncode}, no JSON: "
+                 f"{out.stderr.strip()[-400:]}",
+        "oom": looks_oom(out.stderr),
+    })
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--inner":
+        run_inner(sys.argv[2], sys.argv[3] == "1", json.loads(sys.argv[4]))
+        return
+
+    # Parent: never imports jax, so no hang can reach it.
+    watchdog_s = float(os.environ.get("BENCH_WATCHDOG", 2400))
+    t0 = time.monotonic()
+    probe = probe_backend(float(os.environ.get("BENCH_PROBE_TIMEOUT", 240)))
+    on_accel = probe.get("ok", False) and probe.get("platform") in ("tpu", "axon")
+    if not on_accel:
+        probe.setdefault("platform", "cpu")
+        probe.setdefault("kind", "cpu")
+        probe.setdefault("n", 1)
+
+    model_name = os.environ.get(
+        "BENCH_MODEL", "llama3-8b-instruct" if on_accel else "llama3-test")
+    budget = max(60.0, watchdog_s - (time.monotonic() - t0))
+    result = _spawn_inner(model_name, on_accel, probe, budget)
+    if result is None:
+        emit(0.0, "tok/s", {"error": f"bench child exceeded {budget:.0f}s (hang)",
+                            "model": model_name, "platform": probe.get("platform")})
+        return
+
+    if (result.get("details", {}).get("oom")
+            and model_name == "llama3-8b-instruct"):
+        budget = max(60.0, watchdog_s - (time.monotonic() - t0))
+        retry = _spawn_inner("llama3-1b-bench", on_accel, probe, budget)
+        if retry is not None and not retry.get("details", {}).get("error"):
+            retry.setdefault("details", {})["fallback_from"] = "llama3-8b-instruct OOM"
+            result = retry
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

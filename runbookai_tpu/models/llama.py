@@ -46,6 +46,26 @@ class LlamaConfig:
     def head_dim(self) -> int:
         return self.dim // self.n_heads
 
+    @property
+    def matmul_params(self) -> int:
+        """Analytic count of params that participate in matmuls (layer
+        projections + LM head; excludes the embedding gather) — the ``N``
+        in the decode-FLOPs model ``2·N`` used for MFU reporting."""
+        D, hd = self.dim, self.head_dim
+        per_layer = (
+            D * self.n_heads * hd          # wq
+            + 2 * D * self.n_kv_heads * hd  # wk, wv
+            + self.n_heads * hd * D         # wo
+            + 3 * D * self.ffn_dim          # w_gate, w_up, w_down
+        )
+        return self.n_layers * per_layer + D * self.vocab_size
+
+    @property
+    def total_params(self) -> int:
+        embed = self.vocab_size * self.dim * (1 if self.tie_embeddings else 2)
+        norms = self.n_layers * 2 * self.dim + self.dim
+        return self.matmul_params - self.dim * self.vocab_size + embed + norms
+
 
 CONFIGS: dict[str, LlamaConfig] = {
     "llama3-8b-instruct": LlamaConfig(
@@ -93,6 +113,54 @@ def init_params(key: jax.Array, cfg: LlamaConfig, dtype=jnp.bfloat16) -> Params:
         "w_gate": dense(ks[4], (L, D, F), D),
         "w_up": dense(ks[5], (L, D, F), D),
         "w_down": dense(ks[6], (L, F, D), F),
+        "attn_norm": jnp.ones((L, D), dtype=jnp.float32),
+        "mlp_norm": jnp.ones((L, D), dtype=jnp.float32),
+    }
+    params: Params = {
+        "embed": dense(k_embed, (cfg.vocab_size, D), D),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dtype=jnp.float32),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(k_head, (D, cfg.vocab_size), D)
+    return params
+
+
+def init_params_quantized(key: jax.Array, cfg: LlamaConfig,
+                          dtype=jnp.bfloat16) -> Params:
+    """Random-init params with the seven layer matrices directly in int8.
+
+    For big-model benchmarking on one chip: 8B bf16 is ~16GB and cannot be
+    materialized then quantized on a 16GB-HBM v5e. Sampling ``q`` uniform
+    int8 with a per-channel scale chosen so the dequantized std matches the
+    scaled-normal init (1/sqrt(fan_in)) gives the same matmul cost and
+    magnitude as quantizing real weights, without the bf16 intermediate.
+    Leaves match :mod:`runbookai_tpu.models.quant` (``{"q": int8, "s": f32}``).
+    """
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    L, D, H, KV, F = cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.ffn_dim
+    hd = cfg.head_dim
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                / jnp.sqrt(fan_in)).astype(dtype)
+
+    def qdense(key, shape, fan_in):
+        q = jax.random.randint(key, shape, -127, 128, dtype=jnp.int8)
+        # uniform[-127,127] has std 127/sqrt(3); scale to std 1/sqrt(fan_in)
+        scale = float(3 ** 0.5 / (127.0 * fan_in ** 0.5))
+        s = jnp.full(shape[:-2] + (1, shape[-1]), scale, dtype=jnp.float32)
+        return {"q": q, "s": s}
+
+    ks = jax.random.split(k_layers, 7)
+    layers = {
+        "wq": qdense(ks[0], (L, D, H * hd), D),
+        "wk": qdense(ks[1], (L, D, KV * hd), D),
+        "wv": qdense(ks[2], (L, D, KV * hd), D),
+        "wo": qdense(ks[3], (L, H * hd, D), H * hd),
+        "w_gate": qdense(ks[4], (L, D, F), D),
+        "w_up": qdense(ks[5], (L, D, F), D),
+        "w_down": qdense(ks[6], (L, F, D), F),
         "attn_norm": jnp.ones((L, D), dtype=jnp.float32),
         "mlp_norm": jnp.ones((L, D), dtype=jnp.float32),
     }

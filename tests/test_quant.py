@@ -105,3 +105,40 @@ def test_tp_sharded_quantized_forward_matches():
     out = forward_train(sharded, CFG, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=5e-3, rtol=5e-3)
+
+
+def test_init_params_quantized_structure_and_magnitude():
+    """Direct-int8 random init (bench path for 8B-on-one-chip) matches the
+    quantized-leaf format and the scaled-normal init magnitude."""
+    from runbookai_tpu.models.llama import init_params_quantized
+
+    p = init_params_quantized(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+    for k in LAYER_QUANT_KEYS:
+        assert is_quantized(p["layers"][k]), k
+        assert p["layers"][k]["q"].dtype == jnp.int8
+    # Dequantized std ~ 1/sqrt(fan_in) (same as init_params' scaled normal).
+    w = dequantize_tensor(p["layers"]["w_down"])  # fan_in = ffn_dim
+    got = float(jnp.std(w))
+    want = 1.0 / np.sqrt(CFG.ffn_dim)
+    assert 0.5 * want < got < 1.5 * want, (got, want)
+    # And it serves through the engine unchanged.
+    tok = ByteTokenizer()
+    core = EngineCore(CFG, p, tok, EngineConfig(
+        page_size=4, num_pages=64, max_batch_slots=2, prefill_chunk=8,
+        max_seq_len=128, block_pages=4, kv_dtype=jnp.float32))
+    req = EngineRequest(prompt_ids=tok.encode("int8 init"),
+                        sampling=SamplingParams(temperature=0.0, max_new_tokens=4))
+    core.submit(req)
+    core.run_until_idle()
+    assert req.finish_reason is not None
+
+
+def test_param_count_matches_tree():
+    """Analytic matmul_params/total_params equal the actual pytree sizes."""
+    p = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+    total = sum(x.size for x in jax.tree.leaves(p))
+    assert total == CFG.total_params, (total, CFG.total_params)
+    mm = sum(p["layers"][k].size for k in LAYER_QUANT_KEYS) + p["lm_head"].size
+    assert mm == CFG.matmul_params, (mm, CFG.matmul_params)
+    # North-star shape sanity: Llama-3-8B is 8.03B params.
+    assert abs(CONFIGS["llama3-8b-instruct"].total_params - 8.03e9) < 0.02e9
