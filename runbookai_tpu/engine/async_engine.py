@@ -38,9 +38,14 @@ class AsyncEngine:
         self._stopped = True
         if self._wake:
             self._wake.set()
-        if self._task:
-            await self._task
-            self._task = None
+        task, self._task = self._task, None
+        if task is None:
+            return
+        try:
+            await task
+        except asyncio.CancelledError:
+            if not task.cancelled():
+                raise  # the cancellation targeted stop() itself, not the loop
 
     async def _loop(self) -> None:
         while not self._stopped:

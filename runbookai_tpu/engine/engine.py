@@ -178,6 +178,7 @@ class EngineCore:
         advance_fn: Optional[Callable[[EngineRequest, int], bool]] = None,
         seed: int = 0,
         tracer=None,
+        mesh=None,
     ):
         self.cfg = model_cfg
         self.ecfg = engine_cfg or EngineConfig()
@@ -190,6 +191,18 @@ class EngineCore:
         self.mask_fn = mask_fn
         self.advance_fn = advance_fn
 
+        # Sharded serving: with a mesh, the KV pool shards its kv-head axis
+        # over the TP (``model``) axis alongside the Megatron param shardings
+        # (``params`` must already be device_put by the caller — see
+        # JaxTpuClient.from_config). Page tables / tokens stay host-built and
+        # replicated; XLA inserts the collectives inside the compiled steps.
+        self.mesh = mesh
+        kv_sharding = None
+        if mesh is not None:
+            from runbookai_tpu.parallel.sharding import kv_pool_sharding
+
+            kv_sharding = kv_pool_sharding(model_cfg, mesh)
+
         self.kv = KVCacheManager(
             n_layers=model_cfg.n_layers,
             num_pages=self.ecfg.num_pages,
@@ -198,6 +211,7 @@ class EngineCore:
             head_dim=model_cfg.head_dim,
             max_seq_len=self.ecfg.max_seq_len,
             dtype=self.ecfg.kv_dtype,
+            sharding=kv_sharding,
         )
         self._kv_k = self.kv.pool.kv_k
         self._kv_v = self.kv.pool.kv_v

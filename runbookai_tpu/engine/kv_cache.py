@@ -49,11 +49,21 @@ class PagePool:
         n_kv_heads: int,
         head_dim: int,
         dtype=jnp.bfloat16,
+        sharding=None,
     ) -> "PagePool":
         shape = (n_layers, num_pages * page_size, n_kv_heads, head_dim)
+        if sharding is not None:
+            # Create directly sharded (kv-heads over the model axis): a
+            # host-side zeros + device_put would materialize the full pool on
+            # one device first — an OOM at exactly the scale TP exists for.
+            zeros = jax.jit(lambda: jnp.zeros(shape, dtype=dtype),
+                            out_shardings=sharding)
+            kv_k, kv_v = zeros(), zeros()
+        else:
+            kv_k, kv_v = jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
         return PagePool(
-            kv_k=jnp.zeros(shape, dtype=dtype),
-            kv_v=jnp.zeros(shape, dtype=dtype),
+            kv_k=kv_k,
+            kv_v=kv_v,
             page_size=page_size,
             num_pages=num_pages,
         )
@@ -216,8 +226,10 @@ class KVCacheManager:
         max_seq_len: int,
         dtype=jnp.bfloat16,
         allocator: Optional[PageAllocator] = None,
+        sharding=None,
     ):
-        self.pool = PagePool.create(n_layers, num_pages, page_size, n_kv_heads, head_dim, dtype)
+        self.pool = PagePool.create(n_layers, num_pages, page_size, n_kv_heads,
+                                    head_dim, dtype, sharding=sharding)
         if allocator is None:
             from runbookai_tpu.native import make_page_allocator
 

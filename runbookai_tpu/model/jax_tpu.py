@@ -86,7 +86,7 @@ class JaxTpuClient(BaseLLMClient):
         masker = JsonMaskProvider(tokenizer)
         core = EngineCore(
             cfg, params, tokenizer, ecfg,
-            mask_fn=masker.mask, advance_fn=masker.advance,
+            mask_fn=masker.mask, advance_fn=masker.advance, mesh=mesh,
         )
         return cls(
             core, tokenizer,

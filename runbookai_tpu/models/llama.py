@@ -95,27 +95,40 @@ def get_config(name: str) -> LlamaConfig:
     return CONFIGS[name]
 
 
-def init_params(key: jax.Array, cfg: LlamaConfig, dtype=jnp.bfloat16) -> Params:
-    """Random-init params (scaled normal). Layer weights stacked on axis 0."""
+def _layer_shapes(cfg: LlamaConfig) -> dict[str, tuple[tuple[int, ...], int]]:
+    """The seven stacked layer matrices as ``name -> (shape, fan_in)`` — the
+    single source of truth shared by the bf16 and direct-int8 inits."""
+    L, D, KV, F = cfg.n_layers, cfg.dim, cfg.n_kv_heads, cfg.ffn_dim
+    H, hd = cfg.n_heads, cfg.head_dim
+    return {
+        "wq": ((L, D, H * hd), D),
+        "wk": ((L, D, KV * hd), D),
+        "wv": ((L, D, KV * hd), D),
+        "wo": ((L, H * hd, D), H * hd),
+        "w_gate": ((L, D, F), D),
+        "w_up": ((L, D, F), D),
+        "w_down": ((L, F, D), F),
+    }
+
+
+def _build_params(key: jax.Array, cfg: LlamaConfig, dtype, layer_factory) -> Params:
+    """Shared init skeleton; ``layer_factory(key, shape, fan_in)`` makes the
+    seven stacked layer matrices (dense bf16 or direct-int8 quantized)."""
     k_embed, k_layers, k_head = jax.random.split(key, 3)
+    L, D = cfg.n_layers, cfg.dim
 
     def dense(key, shape, fan_in):
-        return (jax.random.normal(key, shape, dtype=jnp.float32) / jnp.sqrt(fan_in)).astype(dtype)
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                / jnp.sqrt(fan_in)).astype(dtype)
 
-    L, D, H, KV, F = cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.ffn_dim
-    hd = cfg.head_dim
-    ks = jax.random.split(k_layers, 7)
-    layers = {
-        "wq": dense(ks[0], (L, D, H * hd), D),
-        "wk": dense(ks[1], (L, D, KV * hd), D),
-        "wv": dense(ks[2], (L, D, KV * hd), D),
-        "wo": dense(ks[3], (L, H * hd, D), H * hd),
-        "w_gate": dense(ks[4], (L, D, F), D),
-        "w_up": dense(ks[5], (L, D, F), D),
-        "w_down": dense(ks[6], (L, F, D), F),
-        "attn_norm": jnp.ones((L, D), dtype=jnp.float32),
-        "mlp_norm": jnp.ones((L, D), dtype=jnp.float32),
+    shapes = _layer_shapes(cfg)
+    ks = jax.random.split(k_layers, len(shapes))
+    layers: dict[str, Any] = {
+        name: layer_factory(k, shape, fan_in)
+        for k, (name, (shape, fan_in)) in zip(ks, shapes.items())
     }
+    layers["attn_norm"] = jnp.ones((L, D), dtype=jnp.float32)
+    layers["mlp_norm"] = jnp.ones((L, D), dtype=jnp.float32)
     params: Params = {
         "embed": dense(k_embed, (cfg.vocab_size, D), D),
         "layers": layers,
@@ -124,6 +137,16 @@ def init_params(key: jax.Array, cfg: LlamaConfig, dtype=jnp.bfloat16) -> Params:
     if not cfg.tie_embeddings:
         params["lm_head"] = dense(k_head, (D, cfg.vocab_size), D)
     return params
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig, dtype=jnp.bfloat16) -> Params:
+    """Random-init params (scaled normal). Layer weights stacked on axis 0."""
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                / jnp.sqrt(fan_in)).astype(dtype)
+
+    return _build_params(key, cfg, dtype, dense)
 
 
 def init_params_quantized(key: jax.Array, cfg: LlamaConfig,
@@ -137,13 +160,6 @@ def init_params_quantized(key: jax.Array, cfg: LlamaConfig,
     magnitude as quantizing real weights, without the bf16 intermediate.
     Leaves match :mod:`runbookai_tpu.models.quant` (``{"q": int8, "s": f32}``).
     """
-    k_embed, k_layers, k_head = jax.random.split(key, 3)
-    L, D, H, KV, F = cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.ffn_dim
-    hd = cfg.head_dim
-
-    def dense(key, shape, fan_in):
-        return (jax.random.normal(key, shape, dtype=jnp.float32)
-                / jnp.sqrt(fan_in)).astype(dtype)
 
     def qdense(key, shape, fan_in):
         q = jax.random.randint(key, shape, -127, 128, dtype=jnp.int8)
@@ -152,26 +168,7 @@ def init_params_quantized(key: jax.Array, cfg: LlamaConfig,
         s = jnp.full(shape[:-2] + (1, shape[-1]), scale, dtype=jnp.float32)
         return {"q": q, "s": s}
 
-    ks = jax.random.split(k_layers, 7)
-    layers = {
-        "wq": qdense(ks[0], (L, D, H * hd), D),
-        "wk": qdense(ks[1], (L, D, KV * hd), D),
-        "wv": qdense(ks[2], (L, D, KV * hd), D),
-        "wo": qdense(ks[3], (L, H * hd, D), H * hd),
-        "w_gate": qdense(ks[4], (L, D, F), D),
-        "w_up": qdense(ks[5], (L, D, F), D),
-        "w_down": qdense(ks[6], (L, F, D), F),
-        "attn_norm": jnp.ones((L, D), dtype=jnp.float32),
-        "mlp_norm": jnp.ones((L, D), dtype=jnp.float32),
-    }
-    params: Params = {
-        "embed": dense(k_embed, (cfg.vocab_size, D), D),
-        "layers": layers,
-        "final_norm": jnp.ones((D,), dtype=jnp.float32),
-    }
-    if not cfg.tie_embeddings:
-        params["lm_head"] = dense(k_head, (D, cfg.vocab_size), D)
-    return params
+    return _build_params(key, cfg, dtype, qdense)
 
 
 def qmm(x: jnp.ndarray, w: Any) -> jnp.ndarray:

@@ -65,4 +65,17 @@ def kv_pool_sharding(cfg: LlamaConfig, mesh: Mesh) -> NamedSharding:
     tp = mesh.shape.get(MODEL_AXIS, 1)
     if cfg.n_kv_heads % tp == 0:
         return NamedSharding(mesh, P(None, None, MODEL_AXIS, None))
+    # GQA with tp > n_kv_heads (e.g. 70B n_kv=8 on TP16): the pool — and
+    # wk/wv — replicate, costing tp× the KV memory. That silently defeats
+    # the TP memory plan, so say so; the supported layout for 70B-on-16 is
+    # tp=8 × dp=2 (int8 weights ≈ 8.75GB/chip + sharded KV). A head×seq 2D
+    # KV mesh is the documented extension path.
+    import warnings
+
+    warnings.warn(
+        f"KV pool cannot shard: n_kv_heads={cfg.n_kv_heads} not divisible by "
+        f"tp={tp}; replicating the full page pool on every chip. Use tp ≤ "
+        f"{cfg.n_kv_heads} (e.g. tp=8 × dp=2 on a 16-chip slice).",
+        stacklevel=2,
+    )
     return NamedSharding(mesh, P())

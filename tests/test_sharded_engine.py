@@ -1,0 +1,107 @@
+"""TP-sharded serving engine on a CPU mesh (VERDICT r1 weak #2 / next #3).
+
+The paged serving forward is a different code path from ``forward_train`` —
+the 70B-TP serving claim needs EngineCore itself proven on a >1-device mesh:
+sharded params + sharded KV pool through the full continuous-batching cycle
+(chunked prefill, batched decode, preemption-by-recompute, prefix cache),
+with greedy outputs matching the unsharded engine.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from runbookai_tpu.engine.engine import EngineConfig, EngineCore
+from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+from runbookai_tpu.models.llama import CONFIGS, init_params
+from runbookai_tpu.parallel.mesh import MODEL_AXIS, build_mesh
+from runbookai_tpu.parallel.sharding import kv_pool_sharding, param_shardings
+from runbookai_tpu.utils.tokens import ByteTokenizer
+
+CFG = CONFIGS["llama3-test"]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    tok = ByteTokenizer()
+    params = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+    mesh = build_mesh(1, 2)  # data=1, model=2 of the 8 virtual CPU devices
+    sharded = jax.tree.map(jax.device_put, params, param_shardings(CFG, mesh))
+    return tok, params, mesh, sharded
+
+
+def make_core(tok, params, mesh=None, **kw):
+    defaults = dict(
+        page_size=4, num_pages=64, max_batch_slots=4, prefill_chunk=8,
+        max_seq_len=128, block_pages=4, kv_dtype=jnp.float32,
+    )
+    defaults.update(kw)
+    return EngineCore(CFG, params, tok, EngineConfig(**defaults), mesh=mesh)
+
+
+def greedy(core, prompts, max_new=8):
+    reqs = [
+        EngineRequest(prompt_ids=list(p),
+                      sampling=SamplingParams(temperature=0.0, max_new_tokens=max_new))
+        for p in prompts
+    ]
+    for r in reqs:
+        core.submit(r)
+    core.run_until_idle()
+    return reqs
+
+
+def test_kv_pool_is_sharded_on_model_axis(setup):
+    tok, params, mesh, sharded = setup
+    core = make_core(tok, sharded, mesh=mesh)
+    spec = core._kv_k.sharding.spec
+    assert spec[2] == MODEL_AXIS, spec
+    # Per-device shard holds half the kv heads.
+    shard_shape = core._kv_k.addressable_shards[0].data.shape
+    assert shard_shape[2] == CFG.n_kv_heads // 2
+
+
+def test_sharded_engine_matches_unsharded_greedy(setup):
+    tok, params, mesh, sharded = setup
+    prompts = [
+        tok.encode("investigate high latency in checkout"),
+        tok.encode("pods crashlooping in payments namespace"),
+        tok.encode("error rate spike after deploy"),
+    ]
+    ref = greedy(make_core(tok, params), prompts)
+    got = greedy(make_core(tok, sharded, mesh=mesh), prompts)
+    for r, g in zip(ref, got):
+        assert g.out_ids == r.out_ids
+        assert g.finish_reason == r.finish_reason
+
+
+def test_sharded_engine_preemption_cycle(setup):
+    """Tiny page pool forces preemption on the sharded engine; every request
+    still completes and the KV pool stays sharded across the cycle."""
+    tok, params, mesh, sharded = setup
+    prompts = [tok.encode("a" * 21), tok.encode("b" * 21)]
+    # 19 usable pages: each sequence at full length needs 16, so two can only
+    # run together until the pool forces an eviction (same scenario as
+    # test_engine.test_forced_preemption_mid_decode, now on the mesh).
+    solos = [greedy(make_core(tok, params), [p], max_new=40)[0] for p in prompts]
+    core = make_core(tok, sharded, mesh=mesh, num_pages=20, max_batch_slots=2)
+    core.ecfg.decode_steps_per_dispatch = 1
+    core.ecfg.admit_headroom_tokens = 8
+    reqs = greedy(core, prompts, max_new=40)
+    assert core.metrics["preemptions"] >= 1, "scenario must actually preempt"
+    for r, solo in zip(reqs, solos):
+        assert r.all_out_ids == solo.all_out_ids
+    assert core.kv.allocator.free_pages == 20 - 1
+    assert core._kv_k.sharding.spec[2] == MODEL_AXIS
+
+
+def test_sharded_prefix_cache_reuse(setup):
+    """Second request with a shared page-aligned prefix skips cached pages."""
+    tok, params, mesh, sharded = setup
+    core = make_core(tok, sharded, mesh=mesh)
+    shared = tok.encode("system prompt: you are an SRE agent. " * 2)
+    a = greedy(core, [shared + tok.encode("q1")], max_new=4)[0]
+    b = greedy(core, [shared + tok.encode("q2")], max_new=4)[0]
+    assert a.finish_reason is not None and b.finish_reason is not None
+    assert core.metrics["cached_prefix_tokens"] > 0
