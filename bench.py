@@ -131,6 +131,7 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     ecfg = EngineConfig(
         page_size=16, num_pages=num_pages, max_batch_slots=slots,
         prefill_chunk=128, max_seq_len=2048, kv_dtype=dtype, block_pages=16,
+        attn_impl=os.environ.get("BENCH_ATTN", "pallas" if on_accel else "xla"),
     )
     core = EngineCore(cfg, params, tok, ecfg)
 
@@ -174,6 +175,7 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         "platform": probe.get("platform"),
         "device_kind": probe.get("kind"),
         "devices": probe.get("n"),
+        "attn_impl": ecfg.attn_impl,
         "requests": n_requests,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,

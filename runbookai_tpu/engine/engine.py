@@ -142,9 +142,9 @@ def _decode_spec(
     positions leave garbage K/V exactly like multi-step decode does —
     position-addressed writes are overwritten when the real tokens arrive.
 
-    With ``attn_impl="pallas"`` the T>1 verify forward takes forward_impl's
-    XLA fallback (the Pallas kernel is decode/T=1 only) — the same kernel
-    mix chunked prefill already has.
+    With ``attn_impl="pallas"`` the T>1 verify forward runs the Pallas chunk
+    kernel (``paged_chunk_attention``) — positions are contiguous from
+    ``ctx-1``, satisfying the kernel's contiguity contract.
     """
     logits, kv_k, kv_v = forward_impl(
         params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
@@ -153,14 +153,15 @@ def _decode_spec(
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv_k, kv_v  # [B, K]
 
 
-@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages"), donate_argnums=(3, 4))
+@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl"),
+         donate_argnums=(3, 4))
 def _prefill_step(
     params, cfg: LlamaConfig, tokens, kv_k, kv_v, positions, tables, ctx_lens,
-    last_idx, page_size: int, block_pages: int,
+    last_idx, page_size: int, block_pages: int, attn_impl: str = "xla",
 ):
     logits, kv_k, kv_v = forward_impl(
         params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
-        page_size=page_size, block_pages=block_pages,
+        page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
     )
     return logits[0, last_idx], kv_k, kv_v
 
@@ -375,6 +376,7 @@ class EngineCore:
                 jnp.asarray([new_ctx], dtype=jnp.int32),
                 jnp.asarray(chunk_len - 1, dtype=jnp.int32),
                 page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
+                attn_impl=self.ecfg.attn_impl,
             )
         req.prefill_pos = new_ctx
         self.metrics["prefill_tokens"] += chunk_len

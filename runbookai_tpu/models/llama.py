@@ -111,9 +111,11 @@ def _layer_shapes(cfg: LlamaConfig) -> dict[str, tuple[tuple[int, ...], int]]:
     }
 
 
-def _build_params(key: jax.Array, cfg: LlamaConfig, dtype, layer_factory) -> Params:
+def _build_params(key: jax.Array, cfg: LlamaConfig, dtype,
+                  layer_factory=None) -> Params:
     """Shared init skeleton; ``layer_factory(key, shape, fan_in)`` makes the
-    seven stacked layer matrices (dense bf16 or direct-int8 quantized)."""
+    seven stacked layer matrices (default: the same scaled-normal ``dense``
+    used for embed/lm_head; the int8 init passes ``qdense``)."""
     k_embed, k_layers, k_head = jax.random.split(key, 3)
     L, D = cfg.n_layers, cfg.dim
 
@@ -121,6 +123,8 @@ def _build_params(key: jax.Array, cfg: LlamaConfig, dtype, layer_factory) -> Par
         return (jax.random.normal(key, shape, dtype=jnp.float32)
                 / jnp.sqrt(fan_in)).astype(dtype)
 
+    if layer_factory is None:
+        layer_factory = dense
     shapes = _layer_shapes(cfg)
     ks = jax.random.split(k_layers, len(shapes))
     layers: dict[str, Any] = {
@@ -141,12 +145,7 @@ def _build_params(key: jax.Array, cfg: LlamaConfig, dtype, layer_factory) -> Par
 
 def init_params(key: jax.Array, cfg: LlamaConfig, dtype=jnp.bfloat16) -> Params:
     """Random-init params (scaled normal). Layer weights stacked on axis 0."""
-
-    def dense(key, shape, fan_in):
-        return (jax.random.normal(key, shape, dtype=jnp.float32)
-                / jnp.sqrt(fan_in)).astype(dtype)
-
-    return _build_params(key, cfg, dtype, dense)
+    return _build_params(key, cfg, dtype)
 
 
 def init_params_quantized(key: jax.Array, cfg: LlamaConfig,
@@ -235,15 +234,25 @@ def forward_impl(
             k_pages = write_seq(k_pages, k[i], positions[i], page_tables[i])
             v_pages = write_seq(v_pages, v[i], positions[i], page_tables[i])
 
-        if attn_impl == "pallas" and t == 1:
+        if attn_impl == "pallas":
             from runbookai_tpu.ops.paged_attention_pallas import (
+                paged_chunk_attention,
                 paged_decode_attention,
             )
 
-            attn = paged_decode_attention(
-                q[:, 0], k_pages, v_pages, page_tables, ctx_lens,
-                page_size=page_size,
-            )[:, None]
+            # Interpret mode on CPU keeps the kernel path testable on the
+            # virtual mesh; on TPU this compiles under Mosaic.
+            interp = jax.default_backend() == "cpu"
+            if t == 1:
+                attn = paged_decode_attention(
+                    q[:, 0], k_pages, v_pages, page_tables, ctx_lens,
+                    page_size=page_size, interpret=interp,
+                )[:, None]
+            else:
+                attn = paged_chunk_attention(
+                    q, k_pages, v_pages, page_tables, ctx_lens, positions,
+                    page_size=page_size, interpret=interp,
+                )
         else:
             attn = paged_attention(
                 q, k_pages, v_pages, page_tables, ctx_lens, positions,

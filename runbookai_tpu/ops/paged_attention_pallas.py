@@ -12,6 +12,15 @@ Pattern per PAPERS.md "Ragged Paged Attention" + the pallas guide
 axis iterates sequentially, carrying (m, l, acc) scratch; the output block is
 written on the sequence's last page step. Decode-shaped (T = 1).
 
+Two kernels share the flash-accumulate pattern:
+
+- :func:`paged_decode_attention` — decode-shaped (T = 1), grid (batch, pages).
+- :func:`paged_chunk_attention` — T > 1 (chunked prefill and the speculative
+  verify forward), grid (batch, q_blocks, pages) with the page axis innermost
+  so scratch carries across a sequence's pages; query positions are scalar-
+  prefetched for the causal+ragged mask, and the query dimension is blocked
+  to bound VMEM scratch (TQ·n_q accumulator rows per step).
+
 Selected by ``EngineConfig.attn_impl = "pallas"``; interpret mode keeps it
 testable on CPU meshes.
 """
@@ -156,3 +165,177 @@ def paged_decode_attention(
         out_shape=jax.ShapeDtypeStruct((b, n_q, hd), q.dtype),
         interpret=interpret,
     )(page_tables, ctx_lens, q, k_pages, v_pages)
+
+
+def _chunk_kernel(
+    # scalar prefetch:
+    page_tables_ref,  # [B, P] int32 (SMEM)
+    ctx_lens_ref,  # [B] int32 (SMEM)
+    q_start_ref,  # [B] int32 (SMEM) — absolute position of each row's query 0
+    # blocks:
+    q_ref,  # [1, TQ, n_q, hd]
+    k_ref,  # [1, page_size, n_kv, hd]
+    v_ref,  # [1, page_size, n_kv, hd]
+    o_ref,  # [1, TQ, n_q, hd]
+    # scratch:
+    m_ref,  # [TQ*n_q, 128] f32
+    l_ref,  # [TQ*n_q, 128] f32
+    acc_ref,  # [TQ*n_q, hd] f32
+    *,
+    page_size: int,
+    n_kv: int,
+    group: int,
+    tq: int,
+    pages_per_seq: int,
+):
+    b = pl.program_id(0)
+    qb = pl.program_id(1)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ctx = ctx_lens_ref[b]
+    base = p * page_size
+    # Query positions are contiguous per sequence (wrapper contract), so row
+    # positions derive from the scalar start — no vector SMEM reads needed.
+    q0 = q_start_ref[b] + qb * tq
+    qpos_max = q0 + tq - 1
+
+    @pl.when((base < ctx) & (base <= qpos_max))
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)  # [TQ, n_q, hd]
+        hd = q.shape[-1]
+        scale = 1.0 / (hd ** 0.5)
+        # Row r of a per-kv-head block is query token r // group; mask built
+        # entirely from 2D iotas (Mosaic-friendly).
+        shape = (tq * group, page_size)
+        cache_pos = base + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        qpos_rows = q0 + jax.lax.broadcasted_iota(jnp.int32, shape, 0) // group
+        mask = (cache_pos < ctx) & (cache_pos <= qpos_rows)
+
+        m_prev = m_ref[:, :1]  # [TQ*n_q, 1]
+        l_prev = l_ref[:, :1]
+        acc_prev = acc_ref[:]
+
+        s_rows = []
+        v_heads = []
+        for h in range(n_kv):
+            k_h = k_ref[0, :, h, :].astype(jnp.float32)  # [ps, hd]
+            # [TQ, group, hd] -> [TQ*group, hd] rows (t-major within the head)
+            q_h = q[:, h * group : (h + 1) * group].reshape(tq * group, hd)
+            s_h = jax.lax.dot_general(
+                q_h * scale, k_h, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [TQ*group, ps]
+            s_rows.append(jnp.where(mask, s_h, NEG_INF))
+            v_heads.append(v_ref[0, :, h, :].astype(jnp.float32))  # [ps, hd]
+        s = jnp.concatenate(s_rows, axis=0)  # [TQ*n_q, ps] (kv-major blocks)
+
+        m_blk = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        alpha = jnp.exp(m_prev - m_new)
+        # Fully-masked rows keep m == NEG_INF; exp(s - m) would be exp(0)=1
+        # there, so zero masked probabilities explicitly (keeps l exact and
+        # padded rows normalizing to zero).
+        p_blk = jnp.where(jnp.concatenate([mask] * n_kv, axis=0),
+                          jnp.exp(s - m_new), 0.0)
+        l_new = l_prev * alpha + jnp.sum(p_blk, axis=1, keepdims=True)
+
+        pv_rows = []
+        for h in range(n_kv):
+            p_h = p_blk[h * tq * group : (h + 1) * tq * group]
+            pv_rows.append(jax.lax.dot_general(
+                p_h, v_heads[h], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ))  # [TQ*group, hd]
+        pv = jnp.concatenate(pv_rows, axis=0)
+
+        acc_ref[:] = acc_prev * alpha + pv
+        m_ref[:, :1] = m_new
+        l_ref[:, :1] = l_new
+
+    @pl.when(p == pages_per_seq - 1)
+    def _finalize():
+        l_final = jnp.maximum(l_ref[:, :1], 1e-30)
+        out = acc_ref[:] / l_final  # [TQ*n_q, hd] in kv-major head blocks
+        hd = out.shape[-1]
+        # Per-head static slices back to [TQ, group, hd] (no 4D transpose).
+        for h in range(n_kv):
+            blk = out[h * tq * group : (h + 1) * tq * group]
+            o_ref[0, :, h * group : (h + 1) * group, :] = (
+                blk.reshape(tq, group, hd).astype(o_ref.dtype))
+
+
+def paged_chunk_attention(
+    q: jnp.ndarray,  # [B, T, n_q, hd]
+    k_flat: jnp.ndarray,  # [num_pages * page_size, n_kv, hd]
+    v_flat: jnp.ndarray,  # same
+    page_tables: jnp.ndarray,  # [B, P] int32 (physical page ids; 0 = null)
+    ctx_lens: jnp.ndarray,  # [B] int32 — cache length AFTER the chunk
+    q_positions: jnp.ndarray,  # [B, T] int32 absolute positions of the queries
+    page_size: int,
+    interpret: bool = False,
+    q_block: int | None = None,
+) -> jnp.ndarray:
+    """Ragged paged attention for T>1 chunks (prefill / speculative verify).
+
+    Matches :func:`runbookai_tpu.ops.attention.paged_attention` semantics —
+    causal over absolute positions, ragged over per-sequence context lengths —
+    under one contract: each sequence's ``q_positions`` row must be contiguous
+    ascending (``q_positions[i, t] == q_positions[i, 0] + t``). Both engine
+    chunk paths satisfy this (prefill feeds ``range(pos, pos+chunk)``; the
+    speculative verify feeds ``range(ctx-1, ctx-1+k)``); prefill's trash-
+    position pad tail violates it, but those rows' outputs are discarded and
+    their K/V go to the null page.
+    """
+    b, t, n_q, hd = q.shape
+    n_kv = k_flat.shape[1]
+    group = n_q // n_kv
+    pages_per_seq = page_tables.shape[1]
+    k_pages = k_flat.reshape(-1, page_size, n_kv, hd)
+    v_pages = v_flat.reshape(-1, page_size, n_kv, hd)
+    q_start = q_positions[:, 0].astype(jnp.int32)
+
+    # Block the query dim so VMEM scratch stays bounded (~1k accumulator rows).
+    tq = q_block if q_block is not None else min(t, max(1, 1024 // n_q))
+    t_pad = ((t + tq - 1) // tq) * tq
+    n_qb = t_pad // tq
+    if t_pad != t:
+        # Padded rows act like later queries (q0 + t): they attend at most the
+        # whole context and their outputs are sliced off on return.
+        q = jnp.pad(q, ((0, 0), (0, t_pad - t), (0, 0), (0, 0)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, n_qb, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, tq, n_q, hd),
+                         lambda b_, qb_, p_, pt, cl, qs: (b_, qb_, 0, 0)),
+            pl.BlockSpec((1, page_size, n_kv, hd),
+                         lambda b_, qb_, p_, pt, cl, qs: (pt[b_, p_], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, n_kv, hd),
+                         lambda b_, qb_, p_, pt, cl, qs: (pt[b_, p_], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tq, n_q, hd),
+                               lambda b_, qb_, p_, pt, cl, qs: (b_, qb_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((tq * n_q, 128), jnp.float32),  # m
+            pltpu.VMEM((tq * n_q, 128), jnp.float32),  # l
+            pltpu.VMEM((tq * n_q, hd), jnp.float32),  # acc
+        ],
+    )
+    kernel = functools.partial(
+        _chunk_kernel, page_size=page_size, n_kv=n_kv, group=group, tq=tq,
+        pages_per_seq=pages_per_seq,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, t_pad, n_q, hd), q.dtype),
+        interpret=interpret,
+    )(page_tables, ctx_lens, q_start, q, k_pages, v_pages)
+    return out[:, :t]

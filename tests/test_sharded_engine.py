@@ -9,14 +9,13 @@ with greedy outputs matching the unsharded engine.
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from runbookai_tpu.engine.engine import EngineConfig, EngineCore
 from runbookai_tpu.engine.request import EngineRequest, SamplingParams
 from runbookai_tpu.models.llama import CONFIGS, init_params
 from runbookai_tpu.parallel.mesh import MODEL_AXIS, build_mesh
-from runbookai_tpu.parallel.sharding import kv_pool_sharding, param_shardings
+from runbookai_tpu.parallel.sharding import param_shardings
 from runbookai_tpu.utils.tokens import ByteTokenizer
 
 CFG = CONFIGS["llama3-test"]
