@@ -110,9 +110,12 @@ def parse_evidence_classification(response: str) -> tuple[str, str]:
     # Negations first: "no strong evidence" / "not strong" must not inflate
     # confidence via the bare "strong" substring.
     # Contrast markers (but/yet/however) break the negation scope, so
-    # "not weak but strong" still classifies as strong.
+    # "not weak but strong" still classifies as strong; intensifiers
+    # (only/just/merely/simply) do too — "not only strong but overwhelming"
+    # is an affirmation, not a negation.
     if re.search(r"\b(no|not|without|lacks?|lacking)\s+"
-                 r"((?!(?:but|yet|however)\b)\w+\s+){0,3}strong", lower):
+                 r"((?!(?:but|yet|however|only|just|merely|simply)\b)\w+\s+){0,3}strong",
+                 lower):
         return ("weak", response) if "weak" in lower else ("none", response)
     if "strong" in lower:
         return "strong", response

@@ -379,10 +379,14 @@ def cmd_weights(args) -> int:
     )
 
     if args.weights_cmd == "convert":
-        out = convert_hf_to_checkpoint(
-            args.model_path, args.out, model_name=args.name,
-            quantize_int8=args.int8,
-        )
+        try:
+            out = convert_hf_to_checkpoint(
+                args.model_path, args.out, model_name=args.name,
+                quantize_int8=args.int8, allow_random_init=args.random_init,
+            )
+        except FileNotFoundError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 1
         print(f"wrote checkpoint: {out} (int8={args.int8})")
         return 0
     if not is_checkpoint(args.path):
@@ -654,6 +658,8 @@ def build_parser() -> argparse.ArgumentParser:
     conv.add_argument("--int8", action="store_true",
                       help="quantize layer weights to int8 during conversion")
     conv.add_argument("--name", default="hf-model")
+    conv.add_argument("--random-init", action="store_true",
+                      help="allow a missing model_path (random weights; CI only)")
     info = w_sub.add_parser("info", help="describe a checkpoint")
     info.add_argument("path")
     w.set_defaults(fn=cmd_weights)

@@ -80,6 +80,7 @@ def load_checkpoint(
             target_dtype = dtype
         return jax.ShapeDtypeStruct(leaf_meta.shape, target_dtype, sharding=sh)
 
+    fallback = False
     if shardings is None:
         target = jax.tree.map(lambda m: spec_for(m, None), meta)
     else:
@@ -88,9 +89,28 @@ def load_checkpoint(
                                   is_leaf=lambda x: x is None)
         except ValueError:
             # Structure mismatch (e.g. quant-expanded shardings against an
-            # unquantized checkpoint): restore unsharded; the caller reshards.
+            # unquantized checkpoint). Restoring the whole tree unsharded is
+            # an OOM/perf cliff at 70B scale, so warn loudly and reshard
+            # leaf-by-leaf after restore where specs still line up.
+            import warnings
+            warnings.warn(
+                f"load_checkpoint({path}): shardings tree does not match the "
+                "checkpoint structure; restoring unsharded and resharding "
+                "matching leaves with device_put. Re-convert the checkpoint "
+                "to silence this.", stacklevel=2)
             target = jax.tree.map(lambda m: spec_for(m, None), meta)
+            fallback = True
     params = ckptr.restore(path / _TREE_DIR, target)
+    if fallback:
+        flat_sh = {tuple(map(str, p)): s for p, s in
+                   jax.tree_util.tree_flatten_with_path(
+                       shardings, is_leaf=lambda x: x is None)[0] if s is not None}
+        flat_pm = jax.tree_util.tree_flatten_with_path(params)[0]
+        moved = {tuple(map(str, p)): jax.device_put(v, flat_sh[tuple(map(str, p))])
+                 for p, v in flat_pm if tuple(map(str, p)) in flat_sh}
+        params = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(params),
+            [moved.get(tuple(map(str, p)), v) for p, v in flat_pm])
     return cfg, params
 
 
@@ -100,11 +120,23 @@ def convert_hf_to_checkpoint(
     model_name: str = "hf-model",
     quantize_int8: bool = False,
     dtype=None,
+    allow_random_init: bool = False,
 ) -> Path:
-    """One-time conversion: HF safetensors → (optionally int8) orbax dir."""
+    """One-time conversion: HF safetensors → (optionally int8) orbax dir.
+
+    Raises ``FileNotFoundError`` for a missing ``model_path`` — falling
+    through to random init here would write a valid-looking checkpoint of
+    garbage weights with no error. ``allow_random_init=True`` opts into
+    that fallback explicitly (CI / no-egress smoke checkpoints).
+    """
     import jax.numpy as jnp
 
     from runbookai_tpu.models.hf_loader import load_or_init
+
+    if not Path(model_path).exists() and not allow_random_init:
+        raise FileNotFoundError(
+            f"weights convert: model_path does not exist: {model_path} "
+            "(pass --random-init to write a random-weights checkpoint)")
 
     cfg, params = load_or_init(
         model_name if model_name in CONFIGS else "hf-model",

@@ -348,4 +348,11 @@ def validate_config(config: Config) -> list[str]:
     mesh = config.llm.mesh
     if mesh.data < 1 or mesh.model < 1:
         problems.append("llm.mesh axes must be >= 1")
+    slack = config.incident.slack
+    if (slack.enabled and slack.app_token
+            and "mode" not in slack.model_fields_set):
+        problems.append(
+            "incident.slack: app_token is set but mode is defaulted to "
+            "'http' — socket-mode deployments must now set mode: socket "
+            "explicitly (the default changed from 'socket')")
     return problems

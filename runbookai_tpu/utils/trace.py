@@ -22,22 +22,44 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import threading
 import time
 from pathlib import Path
 from typing import Any, Iterator, Optional
 
 
 class Tracer:
-    """Appends nested span records to a JSONL file."""
+    """Appends nested span records to a JSONL file.
+
+    Thread-safe: the process-wide tracer is shared across server request
+    threads and the engine loop, so span depth is tracked per-thread and
+    each record is written whole under a lock.
+    """
 
     def __init__(self, path: Optional[str | Path], enabled: bool = True):
         self.enabled = enabled and path is not None
         self.path = Path(path) if path else None
-        self._depth = 0
+        self._local = threading.local()
+        self._lock = threading.Lock()
         self._fh = None
         if self.enabled:
             self.path.parent.mkdir(parents=True, exist_ok=True)
             self._fh = open(self.path, "a", buffering=1)  # line-buffered
+
+    @property
+    def _depth(self) -> int:
+        return getattr(self._local, "depth", 0)
+
+    @_depth.setter
+    def _depth(self, value: int) -> None:
+        self._local.depth = value
+
+    def _write(self, rec: dict[str, Any]) -> None:
+        try:
+            with self._lock:
+                self._fh.write(json.dumps(rec) + "\n")
+        except (OSError, ValueError, AttributeError):
+            self.enabled = False  # disk gone / closed: stop tracing, keep serving
 
     @contextlib.contextmanager
     def span(self, name: str, **meta: Any) -> Iterator[None]:
@@ -55,10 +77,7 @@ class Tracer:
                    "ms": round((time.perf_counter() - t0) * 1e3, 3)}
             if meta:
                 rec["meta"] = meta
-            try:
-                self._fh.write(json.dumps(rec) + "\n")
-            except (OSError, ValueError):
-                self.enabled = False  # disk gone / closed: stop tracing, keep serving
+            self._write(rec)
 
     def event(self, name: str, **meta: Any) -> None:
         """Zero-duration marker."""
@@ -67,16 +86,14 @@ class Tracer:
         rec = {"ts": time.time(), "name": name, "depth": self._depth + 1, "ms": 0.0}
         if meta:
             rec["meta"] = meta
-        try:
-            self._fh.write(json.dumps(rec) + "\n")
-        except (OSError, ValueError):
-            self.enabled = False
+        self._write(rec)
 
     def close(self) -> None:
-        if self._fh:
-            self._fh.close()
-            self._fh = None
-            self.enabled = False
+        with self._lock:
+            if self._fh:
+                self._fh.close()
+                self._fh = None
+                self.enabled = False
 
 
 _NULL = Tracer(None, enabled=False)

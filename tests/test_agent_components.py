@@ -228,6 +228,20 @@ def test_evidence_classification_parse_json_and_fallback():
     strength, _ = parse_evidence_classification("metrics all normal")
     assert strength == "none"
 
+    # Negation scope: "no strong evidence" is weak/none, but contrast
+    # markers and intensifiers break the scope (ADVICE r1).
+    strength, _ = parse_evidence_classification("there is no strong evidence here")
+    assert strength == "none"
+    strength, _ = parse_evidence_classification(
+        "not weak but strong correlation with the deploy")
+    assert strength == "strong"
+    strength, _ = parse_evidence_classification(
+        "the signal is not only strong but overwhelming")
+    assert strength == "strong"
+    strength, _ = parse_evidence_classification(
+        "this is not just strong, it is conclusive")
+    assert strength == "strong"
+
 
 def test_confidence_formatting_and_aggregation():
     from runbookai_tpu.agent.confidence import (

@@ -85,8 +85,9 @@ def test_checkpoint_mismatched_quant_shardings_falls_back(tmp_path):
     path = save_checkpoint(tmp_path / "ck", CFG, params)
     mesh = build_mesh(2, 2)
     sh = shardings_with_quant(param_shardings(CFG, mesh))
-    cfg, loaded = load_or_init("llama3-test", path, dtype=jnp.float32,
-                               shardings=sh, quantize_int8=True)
+    with pytest.warns(UserWarning, match="resharding"):
+        cfg, loaded = load_or_init("llama3-test", path, dtype=jnp.float32,
+                                   shardings=sh, quantize_int8=True)
     assert is_quantized(loaded["layers"]["wq"])
 
 
@@ -94,8 +95,12 @@ def test_cli_weights_convert_and_info(tmp_path, capsys):
     from runbookai_tpu.cli.main import main
 
     out = tmp_path / "ck"
-    # Nonexistent model path -> random-init fallback, still a valid convert.
+    # Nonexistent model path is an error (a typo'd path must not silently
+    # write a random-weights checkpoint) unless --random-init opts in.
     rc = main(["weights", "convert", str(tmp_path / "missing"), str(out), "--int8"])
+    assert rc == 1 and not is_checkpoint(out)
+    rc = main(["weights", "convert", str(tmp_path / "missing"), str(out),
+               "--int8", "--random-init"])
     assert rc == 0 and is_checkpoint(out)
     rc = main(["weights", "info", str(out)])
     assert rc == 0
@@ -103,6 +108,13 @@ def test_cli_weights_convert_and_info(tmp_path, capsys):
                      if False else "{}") or None
     # info printed the config json
     assert checkpoint_config(out).name == "llama3-test"
+
+
+def test_convert_missing_path_raises(tmp_path):
+    from runbookai_tpu.models.checkpoint import convert_hf_to_checkpoint
+
+    with pytest.raises(FileNotFoundError):
+        convert_hf_to_checkpoint(tmp_path / "nope", tmp_path / "out")
 
 
 # ------------------------------------------------------------------ tracing
@@ -123,6 +135,33 @@ def test_tracer_spans_nested(tmp_path):
     assert by_name["inner"]["depth"] == 2 and by_name["outer"]["depth"] == 1
     assert by_name["outer"]["meta"] == {"phase": "x"}
     assert by_name["marker"]["ms"] == 0.0
+
+
+def test_tracer_thread_safety(tmp_path):
+    """Depth is per-thread and lines never interleave (ADVICE r1: the
+    process-wide tracer is shared by server threads + the engine loop)."""
+    import threading
+
+    path = tmp_path / "mt.jsonl"
+    tr = Tracer(path)
+
+    def work(tag):
+        for _ in range(50):
+            with tr.span(f"outer-{tag}"):
+                with tr.span(f"inner-{tag}"):
+                    pass
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tr.close()
+    spans = read_spans(path)  # raises on interleaved/corrupt JSON lines
+    assert len(spans) == 4 * 50 * 2
+    for s in spans:
+        want = 2 if s["name"].startswith("inner") else 1
+        assert s["depth"] == want, s
 
 
 def test_tracer_disabled_is_noop(tmp_path):

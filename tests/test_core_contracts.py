@@ -101,6 +101,19 @@ def test_validate_config_reports_problems(tmp_path):
     assert any("confluence" in p for p in problems)
 
 
+def test_validate_config_flags_defaulted_slack_mode():
+    """Socket credentials with a defaulted (now-http) transport get a
+    startup warning so existing socket deployments notice (ADVICE r1)."""
+    cfg = Config.model_validate(
+        {"incident": {"slack": {"enabled": True, "app_token": "xapp-1"}}})
+    assert any("mode" in p and "socket" in p for p in validate_config(cfg))
+    # Explicit mode (either value) silences it.
+    cfg = Config.model_validate(
+        {"incident": {"slack": {"enabled": True, "app_token": "xapp-1",
+                                "mode": "socket"}}})
+    assert not any("mode is defaulted" in p for p in validate_config(cfg))
+
+
 def test_byte_tokenizer_roundtrip_and_specials():
     tok = ByteTokenizer()
     text = "<|begin_of_text|>hello ⚡ world<|eot_id|>"
