@@ -108,6 +108,24 @@ class InvestigationOrchestrator:
         if self.event_sink:
             self.event_sink(ev)
 
+    async def _complete(self, prompt: str, schema: Optional[str] = None) -> str:
+        """LLM completion, requesting the named grammar when the client
+        supports schema-constrained guided decoding (jax-tpu does; the seam
+        stays ``complete(prompt) -> str`` for mocks/adapters).
+
+        Only the *call* is probed for the schema kwarg — a coroutine
+        function raises TypeError at call time for an unknown kwarg, before
+        any generation runs — so TypeErrors from inside generation surface
+        instead of silently re-running unguided."""
+        if schema is not None:
+            try:
+                coro = self.llm.complete(prompt, schema=schema)
+            except TypeError:
+                coro = None
+            if coro is not None:
+                return await coro
+        return await self.llm.complete(prompt)
+
     # ------------------------------------------------------------------ main
 
     async def investigate(self, incident_id: str = "",
@@ -232,7 +250,8 @@ class InvestigationOrchestrator:
 
     async def run_triage(self, incident_id: str, description: str) -> lp.TriageResult:
         context = await self.gather_triage_context(incident_id, description)
-        raw = await self.llm.complete(lp.fill_prompt("triage", context=context))
+        raw = await self._complete(lp.fill_prompt("triage", context=context),
+                                   schema="triage")
         triage = lp.parse_triage(raw)
         if not triage.summary:
             triage.summary = description or f"incident {incident_id}"
@@ -244,13 +263,13 @@ class InvestigationOrchestrator:
     # ------------------------------------------------------------ hypotheses
 
     async def generate_hypotheses(self, triage: lp.TriageResult) -> None:
-        raw = await self.llm.complete(lp.fill_prompt(
+        raw = await self._complete(lp.fill_prompt(
             "generate_hypotheses",
             summary=triage.summary,
             symptoms=", ".join(triage.symptoms),
             services=", ".join(triage.affected_services),
             evidence="\n".join(triage.signals),
-        ))
+        ), schema="hypotheses")
         generated = lp.parse_hypotheses(raw)
         for g in generated.hypotheses[:5]:
             if g.statement:
@@ -320,10 +339,10 @@ class InvestigationOrchestrator:
 
         if m.can_transition(Phase.EVALUATE):
             m.transition(Phase.EVALUATE)
-        raw = await self.llm.complete(lp.fill_prompt(
+        raw = await self._complete(lp.fill_prompt(
             "evaluate_evidence", hypothesis=hypothesis.statement,
             evidence=evidence_text,
-        ))
+        ), schema="evaluation")
         evaluation = lp.parse_evaluation(raw)
 
         for query, result, error in results:
@@ -359,12 +378,12 @@ class InvestigationOrchestrator:
         evidence_text = "\n".join(
             f"- [{e.tool}] {e.result_summary[:200]}" for e in m.evidence[-15:]
         )
-        raw = await self.llm.complete(lp.fill_prompt(
+        raw = await self._complete(lp.fill_prompt(
             "generate_conclusion",
             summary=description or m.incident_id,
             tree=m.hypothesis_tree_markdown(),
             evidence=evidence_text,
-        ))
+        ), schema="conclusion")
         conclusion = lp.parse_conclusion(raw)
         confirmed = m.confirmed_hypothesis()
         if not conclusion.root_cause and confirmed is not None:
@@ -415,12 +434,12 @@ class InvestigationOrchestrator:
     async def run_remediation(self, conclusion: lp.Conclusion) -> lp.RemediationPlan:
         runbooks = await self.fetch_relevant_runbooks()
         fixes = await self.fetch_code_fix_candidates()
-        raw = await self.llm.complete(lp.fill_prompt(
+        raw = await self._complete(lp.fill_prompt(
             "generate_remediation",
             root_cause=self.machine.root_cause or "",
             services=", ".join(self.machine.affected_services),
             runbooks=runbooks, fixes=fixes,
-        ))
+        ), schema="remediation")
         plan = lp.parse_remediation(raw)
         for step in plan.steps:
             self.machine.remediation_plan.append(RemediationStep(
@@ -473,8 +492,9 @@ class InvestigationOrchestrator:
             ],
         )
         if use_llm and lines:
-            raw = await self.llm.complete(lp.fill_prompt(
-                "analyze_logs", logs="\n".join(lines[:80])))
+            raw = await self._complete(lp.fill_prompt(
+                "analyze_logs", logs="\n".join(lines[:80])),
+                schema="log_analysis")
             llm_result = lp.parse_log_analysis(raw)
             for cat in llm_result.error_categories:
                 if cat not in merged.error_categories:

@@ -33,8 +33,10 @@ class SamplingParams:
     max_new_tokens: int = 512
     stop_token_ids: tuple[int, ...] = ()
     stop_strings: tuple[str, ...] = ()
-    # When set, token-level grammar masking constrains output to valid JSON
-    # (see runbookai_tpu.model.guided). Value is a grammar name ("json").
+    # When set, token-level grammar masking constrains output: "json" is the
+    # generic well-formed-JSON automaton (runbookai_tpu.model.guided); any
+    # name registered with the mask provider selects a compiled schema
+    # grammar ("triage", "evaluation", ... — model.schema_guided).
     guided: Optional[str] = None
 
 

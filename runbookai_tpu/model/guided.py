@@ -26,17 +26,53 @@ _STR_ESC = 2  # after backslash in string
 _NUMBER = 3  # inside a number
 _LITERAL = 4  # inside true/false/null
 _AFTER = 5  # after a complete value (expecting , } ] or end)
-_OBJ_KEY = 6  # expecting object key string or '}'
+_OBJ_KEY = 6  # right after '{': key string or '}'
 _OBJ_COLON = 7  # expecting ':'
+_OBJ_KEY_REQ = 8  # after ',' in an object: key string only (no trailing comma)
+_ARR_FIRST = 9  # right after '[': value or ']'
 
 _WS = b" \t\n\r"
 _DIGITS = b"0123456789"
-_NUM_CONT = b"0123456789.eE+-"
 _LITERALS = {b"true", b"false", b"null"}
+_ESC_SIMPLE = b'"\\/bfnrt'
+_HEX = b"0123456789abcdefABCDEF"
+# JSON number DFA: states where the number-so-far is a complete valid number.
+_NUM_COMPLETE = ("zero", "int", "frac", "exp")
+
+
+def utf8_lead(b: int):
+    """Classify a UTF-8 lead byte per the standard DFA (no overlongs, no
+    surrogates): returns ``(continuations, lo, hi)`` where lo..hi bounds the
+    *first* continuation byte (later ones are always 0x80..0xBF), or None
+    for an invalid lead. Shared by the generic and schema string automata so
+    the two cannot drift."""
+    if 0xC2 <= b <= 0xDF:
+        return 1, 0x80, 0xBF
+    if b == 0xE0:
+        return 2, 0xA0, 0xBF
+    if b == 0xED:
+        return 2, 0x80, 0x9F
+    if 0xE1 <= b <= 0xEF:
+        return 2, 0x80, 0xBF
+    if b == 0xF0:
+        return 3, 0x90, 0xBF
+    if 0xF1 <= b <= 0xF3:
+        return 3, 0x80, 0xBF
+    if b == 0xF4:
+        return 3, 0x80, 0x8F
+    return None
 
 
 class JsonMachine:
-    """Incremental JSON validator over bytes."""
+    """Incremental **strict** JSON validator over bytes.
+
+    Strict means: only documents ``json.loads`` accepts get through —
+    full number grammar (no leading zeros, no dangling exponent), valid
+    escape sequences (``\\uXXXX`` with 4 hex digits), and well-formed
+    UTF-8 string content. Strictness matters because guided decoding uses
+    this machine to *steer* sampling: any byte sequence the automaton
+    admits, a random model will eventually emit.
+    """
 
     def __init__(self, max_depth: int = 32):
         self.mode = _VALUE
@@ -46,27 +82,35 @@ class JsonMachine:
         self.max_depth = max_depth
         self.complete = False
         self.dead = False
-        self.num_has_digit = False
+        self.num_state = ""  # JSON number DFA state while mode == _NUMBER
+        self.u8_need = 0  # pending UTF-8 continuation bytes in a string
+        self.u8_lo = 0x80  # allowed range for the next continuation byte
+        self.u8_hi = 0xBF
+        self.hex_rem = 0  # remaining \uXXXX hex digits
 
     @property
     def is_complete(self) -> bool:
         """True when the bytes so far form a complete JSON document. A
-        top-level number qualifies once it has a digit (numbers have no
-        terminator byte)."""
+        top-level number qualifies once its DFA state is terminal (numbers
+        have no terminator byte)."""
         return self.complete or (
-            self.mode == _NUMBER and not self.stack and self.num_has_digit
+            self.mode == _NUMBER and not self.stack
+            and self.num_state in _NUM_COMPLETE
         )
 
     def signature(self) -> tuple:
         return (self.mode, tuple(self.stack), self.literal, self.lit_pos,
-                self.complete, self.num_has_digit)
+                self.complete, self.dead, self.num_state,
+                self.u8_need, self.u8_lo, self.u8_hi, self.hex_rem)
 
     def copy(self) -> "JsonMachine":
         m = JsonMachine(self.max_depth)
         m.mode, m.stack = self.mode, list(self.stack)
         m.literal, m.lit_pos = self.literal, self.lit_pos
         m.complete, m.dead = self.complete, self.dead
-        m.num_has_digit = self.num_has_digit
+        m.num_state = self.num_state
+        m.u8_need, m.u8_lo, m.u8_hi = self.u8_need, self.u8_lo, self.u8_hi
+        m.hex_rem = self.hex_rem
         return m
 
     # ------------------------------------------------------------------ core
@@ -87,29 +131,99 @@ class JsonMachine:
         mode = self.mode
 
         if mode == _STRING:
+            if self.u8_need:  # inside a multi-byte UTF-8 character
+                if self.u8_lo <= b <= self.u8_hi:
+                    self.u8_need -= 1
+                    self.u8_lo, self.u8_hi = 0x80, 0xBF
+                    return True
+                return self._die()
             if b == 0x5C:  # backslash
                 self.mode = _STR_ESC
-            elif b == 0x22:  # closing quote
+                return True
+            if b == 0x22:  # closing quote
                 if self.stack and self.stack[-1] == -1:
                     # This string was an object key: pop marker, expect colon.
                     self.stack.pop()
                     self.mode = _OBJ_COLON
                 else:
                     self._close_value()
-            elif b < 0x20:
+                return True
+            if b < 0x20:
                 return self._die()
+            if b < 0x80:
+                return True
+            lead = utf8_lead(b)
+            if lead is None:
+                return self._die()
+            self.u8_need, self.u8_lo, self.u8_hi = lead
             return True
         if mode == _STR_ESC:
-            # Accept any printable escape continuation (full \uXXXX validation
-            # is intentionally lax — invalid escapes are caught by json.loads).
-            self.mode = _STRING
-            return True
-        if mode == _NUMBER:
-            if b in _NUM_CONT:
-                if b in _DIGITS:
-                    self.num_has_digit = True
+            if self.hex_rem:
+                if b in _HEX:
+                    self.hex_rem -= 1
+                    if self.hex_rem == 0:
+                        self.mode = _STRING
+                    return True
+                return self._die()
+            if b in _ESC_SIMPLE:
+                self.mode = _STRING
                 return True
-            # Number ended; reinterpret this byte in AFTER mode.
+            if b == 0x75:  # 'u' → four hex digits
+                self.hex_rem = 4
+                return True
+            return self._die()
+        if mode == _NUMBER:
+            s = self.num_state
+            if s == "neg":
+                if b == 0x30:
+                    self.num_state = "zero"
+                    return True
+                if b in _DIGITS:
+                    self.num_state = "int"
+                    return True
+                return self._die()
+            if s in ("zero", "int"):
+                if b in _DIGITS:
+                    if s == "zero":
+                        return self._die()  # leading zero: 01 is not JSON
+                    return True
+                if b == 0x2E:  # '.'
+                    self.num_state = "frac0"
+                    return True
+                if b in (0x65, 0x45):  # e/E
+                    self.num_state = "exp0"
+                    return True
+            elif s == "frac0":
+                if b in _DIGITS:
+                    self.num_state = "frac"
+                    return True
+                return self._die()
+            elif s == "frac":
+                if b in _DIGITS:
+                    return True
+                if b in (0x65, 0x45):
+                    self.num_state = "exp0"
+                    return True
+            elif s == "exp0":
+                if b in (0x2B, 0x2D):  # sign
+                    self.num_state = "exp1"
+                    return True
+                if b in _DIGITS:
+                    self.num_state = "exp"
+                    return True
+                return self._die()
+            elif s == "exp1":
+                if b in _DIGITS:
+                    self.num_state = "exp"
+                    return True
+                return self._die()
+            elif s == "exp":
+                if b in _DIGITS:
+                    return True
+            # Number ended; only complete DFA states may terminate, and the
+            # byte is reinterpreted in AFTER mode.
+            if self.num_state not in _NUM_COMPLETE:
+                return self._die()
             self._close_value()
             self.complete = not self.stack and self.mode == _AFTER
             return self.advance(b)
@@ -138,16 +252,15 @@ class JsonMachine:
                 if len(self.stack) >= self.max_depth:
                     return self._die()
                 self.stack.append(0x5B)
-                self.mode = _VALUE
+                self.mode = _ARR_FIRST
                 return True
-            if b == 0x5D and self.stack and self.stack[-1] == 0x5B:  # empty array
-                self.stack.pop()
-                self._close_value()
-                self.complete = not self.stack
-                return True
-            if b in _DIGITS or b == 0x2D:  # digit or '-'
+            if b == 0x2D:  # '-'
                 self.mode = _NUMBER
-                self.num_has_digit = b in _DIGITS
+                self.num_state = "neg"
+                return True
+            if b in _DIGITS:
+                self.mode = _NUMBER
+                self.num_state = "zero" if b == 0x30 else "int"
                 return True
             for lit in _LITERALS:
                 if b == lit[0]:
@@ -156,12 +269,22 @@ class JsonMachine:
                     return True
             return self._die()
 
-        if mode == _OBJ_KEY:
+        if mode == _ARR_FIRST:
+            if b == 0x5D:  # ']' — empty array
+                self.stack.pop()
+                self._close_value()
+                self.complete = not self.stack
+                return True
+            self.mode = _VALUE
+            return self.advance(b)
+
+        if mode in (_OBJ_KEY, _OBJ_KEY_REQ):
             if b == 0x22:
                 self.stack.append(-1)  # marker: string being read is a key
                 self.mode = _STRING
                 return True
-            if b == 0x7D:  # '}' — empty object
+            if b == 0x7D and mode == _OBJ_KEY:  # '}' — empty object only;
+                # after a comma a key is required (no trailing commas)
                 self.stack.pop()
                 self._close_value()
                 self.complete = not self.stack
@@ -179,7 +302,7 @@ class JsonMachine:
                 return self._die()  # trailing garbage after a complete value
             top = self.stack[-1]
             if b == 0x2C:  # ','
-                self.mode = _OBJ_KEY if top == 0x7B else _VALUE
+                self.mode = _OBJ_KEY_REQ if top == 0x7B else _VALUE
                 return True
             if b == 0x7D and top == 0x7B:
                 self.stack.pop()
@@ -207,12 +330,31 @@ class JsonMachine:
 
 
 class JsonMaskProvider:
-    """Builds per-step allowed-token masks for an engine + tokenizer pair."""
+    """Builds per-step allowed-token masks for an engine + tokenizer pair.
 
-    def __init__(self, tokenizer):
+    ``schemas`` maps grammar names (``SamplingParams.guided`` values) to
+    compiled schema trees (:mod:`runbookai_tpu.model.schema_guided`); the
+    name ``"json"`` — or any unregistered name — selects the generic JSON
+    automaton. Mask caching is shared: schema-machine signatures embed the
+    schema name, so they never collide with generic-JSON signatures.
+    """
+
+    def __init__(self, tokenizer, schemas: Optional[dict] = None,
+                 limits=None):
         self.tokenizer = tokenizer
+        self.schemas = schemas or {}
+        self.limits = limits
         self._token_bytes: Optional[list[bytes]] = None
         self._cache: dict[tuple, np.ndarray] = {}
+        # Control tokens are never content: their byte expansion is markup
+        # ("<|eot_id|>") that would otherwise be admissible inside a string.
+        self._special = frozenset(
+            getattr(tokenizer, "special_ids", None)
+            or (t for t in (tokenizer.bos_id, tokenizer.eos_id,
+                            tokenizer.eot_id,
+                            getattr(tokenizer, "pad_id", None))
+                if t is not None)
+        )
 
     def _bytes_table(self) -> list[bytes]:
         if self._token_bytes is None:
@@ -221,9 +363,29 @@ class JsonMaskProvider:
             ]
         return self._token_bytes
 
-    def machine_for(self, req) -> JsonMachine:
+    def machine_for(self, req):
         if req.guided_state is None:
-            req.guided_state = JsonMachine()
+            name = req.sampling.guided
+            schema = self.schemas.get(name) if name else None
+            if schema is not None:
+                import dataclasses
+
+                from runbookai_tpu.model.schema_guided import (
+                    SchemaLimits,
+                    SchemaMachine,
+                )
+
+                limits = self.limits or SchemaLimits()
+                # Size the string-headroom cache bucket to the real vocab:
+                # a bucket smaller than the longest token would let a cached
+                # mask admit a token that overflows max_str_len.
+                longest = max(map(len, self._bytes_table()))
+                if limits.max_token_bytes < longest:
+                    limits = dataclasses.replace(limits,
+                                                 max_token_bytes=longest)
+                req.guided_state = SchemaMachine(schema, name, limits=limits)
+            else:
+                req.guided_state = JsonMachine()
         return req.guided_state
 
     def mask(self, req) -> np.ndarray:
@@ -235,7 +397,7 @@ class JsonMaskProvider:
         table = self._bytes_table()
         out = np.zeros(self.tokenizer.vocab_size, dtype=bool)
         for tid, bts in enumerate(table):
-            if not bts:
+            if not bts or tid in self._special:
                 continue
             probe = machine.copy()
             if probe.advance_bytes(bts):

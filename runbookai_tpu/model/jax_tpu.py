@@ -24,6 +24,7 @@ from runbookai_tpu.model.chat_template import (
 )
 from runbookai_tpu.model.client import BaseLLMClient
 from runbookai_tpu.model.guided import JsonMaskProvider
+from runbookai_tpu.model.schema_guided import orchestrator_schemas
 from runbookai_tpu.models.hf_loader import load_or_init
 from runbookai_tpu.utils.tokens import load_tokenizer
 
@@ -93,7 +94,7 @@ class JaxTpuClient(BaseLLMClient):
                        if jax.default_backend() in ("tpu", "axon") and mesh is None
                        else "xla"),
         )
-        masker = JsonMaskProvider(tokenizer)
+        masker = JsonMaskProvider(tokenizer, schemas=orchestrator_schemas())
         core = EngineCore(
             cfg, params, tokenizer, ecfg,
             mask_fn=masker.mask, advance_fn=masker.advance, mesh=mesh,
@@ -105,18 +106,23 @@ class JaxTpuClient(BaseLLMClient):
         )
 
     @classmethod
-    def for_testing(cls, model_name: str = "llama3-test", **engine_kw) -> "JaxTpuClient":
+    def for_testing(cls, model_name: str = "llama3-test",
+                    temperature: float = 0.0, max_new_tokens: int = 32,
+                    max_seq_len: int = 256, schema_limits=None,
+                    **engine_kw) -> "JaxTpuClient":
         """Tiny random-init client on the byte tokenizer (CPU tests)."""
         tokenizer = load_tokenizer(None)
         cfg, params = load_or_init(model_name, None, dtype=jnp.float32)
         ecfg = EngineConfig(
             page_size=4, num_pages=256, max_batch_slots=4, prefill_chunk=32,
-            max_seq_len=256, kv_dtype=jnp.float32, **engine_kw,
+            max_seq_len=max_seq_len, kv_dtype=jnp.float32, **engine_kw,
         )
-        masker = JsonMaskProvider(tokenizer)
+        masker = JsonMaskProvider(tokenizer, schemas=orchestrator_schemas(),
+                                  limits=schema_limits)
         core = EngineCore(cfg, params, tokenizer, ecfg,
                           mask_fn=masker.mask, advance_fn=masker.advance)
-        return cls(core, tokenizer, max_new_tokens=32)
+        return cls(core, tokenizer, temperature=temperature,
+                   max_new_tokens=max_new_tokens)
 
     # ------------------------------------------------------------------- API
 
@@ -145,14 +151,17 @@ class JaxTpuClient(BaseLLMClient):
             },
         )
 
-    async def complete(self, prompt: str, guided: Optional[bool] = None) -> str:
+    async def complete(self, prompt: str, guided: Optional[bool] = None,
+                       schema: Optional[str] = None) -> str:
         """Plain completion; guided JSON masking on by default (config) since
-        every orchestrator prompt expects a JSON document back."""
+        every orchestrator prompt expects a JSON document back. ``schema``
+        names a compiled grammar (``"triage"``, ``"evaluation"``, … — see
+        :func:`~runbookai_tpu.model.schema_guided.orchestrator_schemas`)
+        that constrains the output to exactly that document shape."""
         use_guided = self.guided_json if guided is None else guided
         ids = self.tokenizer.encode(build_completion_prompt(prompt))
-        out = await self.engine.generate(
-            ids, self._sampling(guided="json" if use_guided else None)
-        )
+        grammar = (schema or "json") if use_guided else None
+        out = await self.engine.generate(ids, self._sampling(guided=grammar))
         return out.text
 
     async def shutdown(self) -> None:

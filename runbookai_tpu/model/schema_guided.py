@@ -1,0 +1,671 @@
+"""Schema-constrained guided decoding: pydantic models → byte grammars.
+
+SURVEY.md §7 ("the zod schemas in ``llm-parser.ts:21-210`` become the
+grammars"): the generic JSON automaton in :mod:`runbookai_tpu.model.guided`
+guarantees *well-formed* output, but an 8B model can still emit a
+syntactically-valid, schema-invalid triage object. This module compiles each
+orchestrator schema (:mod:`runbookai_tpu.agent.llm_parser`) into a byte-level
+automaton that admits exactly the documents the pydantic model validates:
+
+- objects emit **all** fields, in declaration order, with forced key bytes;
+- ``Literal[...]`` fields become enum tries (``"high"|"medium"|"low"`` …);
+- strings are strict-UTF-8 with valid JSON escapes and a length cap;
+- numbers follow the full JSON number grammar (no ``01``, no dangling ``1e``);
+- ``dict``/``Any`` fields fall back to the generic JSON value machine.
+
+Fixed key order is a deliberate tightening (jsonformer-style): the model
+never spends probability mass deciding which key comes next, and the parse
+is deterministic. The tolerant parser downstream remains as a fallback for
+unguided providers.
+
+The machines duck-type :class:`~runbookai_tpu.model.guided.JsonMachine`
+(``advance``/``advance_bytes``/``copy``/``signature``/``is_complete``/
+``dead``) so :class:`~runbookai_tpu.model.guided.JsonMaskProvider` caches
+their token masks identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache
+from typing import Any, Literal, Optional, get_args, get_origin
+
+from pydantic import BaseModel
+
+from runbookai_tpu.model.guided import JsonMachine, utf8_lead
+
+_WS = b" \t\n\r"
+_DIGITS = frozenset(b"0123456789")
+_HEX = frozenset(b"0123456789abcdefABCDEF")
+_ESC_SIMPLE = frozenset(b'"\\/bfnrt')
+
+# advance() results
+_CONT = 0
+_DONE = 1  # frame finished, byte consumed
+_REDO = 2  # frame finished BEFORE this byte; re-offer to parent
+_DEAD = 3
+# (PUSH, subnode): delegate this (unconsumed) byte to a child frame
+_PUSH = 4
+
+
+# --------------------------------------------------------------------------- #
+# schema nodes                                                                #
+# --------------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass(frozen=True)
+class SNode:
+    uid: int  # unique within one compiled schema (stable across machines)
+
+
+@dataclasses.dataclass(frozen=True)
+class SObject(SNode):
+    # ((b'"key"', subnode), ...) in emission order
+    fields: tuple[tuple[bytes, SNode], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class SArray(SNode):
+    item: SNode
+    min_items: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class SString(SNode):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class SEnum(SNode):
+    # full byte literals including quotes: (b'"high"', b'"medium"', ...)
+    options: tuple[bytes, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class SNumber(SNode):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class SBool(SNode):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class SAny(SNode):
+    require_object: bool = False  # True for dict-typed fields
+
+
+class _Uid:
+    def __init__(self):
+        self.n = 0
+
+    def __call__(self) -> int:
+        self.n += 1
+        return self.n
+
+
+def compile_model(model: type[BaseModel]) -> SObject:
+    """Pydantic model → schema tree with stable node uids."""
+    return _compile_object(model, _Uid())
+
+
+def _compile_object(model: type[BaseModel], uid: _Uid) -> SObject:
+    fields = []
+    for name, info in model.model_fields.items():
+        fields.append((b'"' + name.encode() + b'"',
+                       _compile_annotation(info.annotation, uid)))
+    return SObject(uid(), tuple(fields))
+
+
+def _compile_annotation(ann: Any, uid: _Uid) -> SNode:
+    origin = get_origin(ann)
+    if origin is Literal:
+        return SEnum(uid(), tuple(b'"' + str(a).encode() + b'"'
+                                  for a in get_args(ann)))
+    if ann is str:
+        return SString(uid())
+    if ann is bool:
+        return SBool(uid())
+    if ann in (int, float):
+        return SNumber(uid())
+    if origin is list:
+        (item,) = get_args(ann) or (Any,)
+        return SArray(uid(), _compile_annotation(item, uid))
+    if origin is dict:
+        return SAny(uid(), require_object=True)
+    if isinstance(ann, type) and issubclass(ann, BaseModel):
+        return _compile_object(ann, uid)
+    return SAny(uid())  # Any / unsupported → generic JSON value
+
+
+# --------------------------------------------------------------------------- #
+# frames                                                                      #
+# --------------------------------------------------------------------------- #
+
+
+class _ObjectFrame:
+    __slots__ = ("node", "phase", "idx", "kpos")
+    # phases: 0 '{', 1 key literal, 2 ':', 3 value, 4 after value, 5 empty '}'
+
+    def __init__(self, node: SObject):
+        self.node = node
+        self.phase = 0
+        self.idx = 0
+        self.kpos = 0
+
+    def advance(self, b: int, lim):
+        ph = self.phase
+        if ph == 0:
+            if b in _WS:
+                return _CONT
+            if b == 0x7B:  # '{'
+                self.phase = 1 if self.node.fields else 5
+                return _CONT
+            return _DEAD
+        if ph == 1:
+            key = self.node.fields[self.idx][0]
+            if self.kpos == 0 and b in _WS:
+                return _CONT
+            if self.kpos < len(key) and b == key[self.kpos]:
+                self.kpos += 1
+                if self.kpos == len(key):
+                    self.phase = 2
+                return _CONT
+            return _DEAD
+        if ph == 2:
+            if b in _WS:
+                return _CONT
+            if b == 0x3A:  # ':'
+                self.phase = 3
+                return _CONT
+            return _DEAD
+        if ph == 3:
+            if b in _WS:
+                return _CONT
+            return (_PUSH, self.node.fields[self.idx][1])
+        if ph == 4:
+            if b in _WS:
+                return _CONT
+            if self.idx < len(self.node.fields) - 1:
+                if b == 0x2C:  # ','
+                    self.idx += 1
+                    self.kpos = 0
+                    self.phase = 1
+                    return _CONT
+                return _DEAD
+            if b == 0x7D:  # '}'
+                return _DONE
+            return _DEAD
+        # ph == 5: empty object
+        if b in _WS:
+            return _CONT
+        return _DONE if b == 0x7D else _DEAD
+
+    def child_done(self):
+        self.phase = 4
+
+    def sig(self):
+        return ("o", self.node.uid, self.phase, self.idx, self.kpos)
+
+    def copy(self):
+        f = _ObjectFrame.__new__(_ObjectFrame)
+        f.node, f.phase, f.idx, f.kpos = self.node, self.phase, self.idx, self.kpos
+        return f
+
+
+class _ArrayFrame:
+    __slots__ = ("node", "phase", "count")
+    # phases: 0 '[', 1 first value or ']', 2 after value, 3 next value
+
+    def __init__(self, node: SArray):
+        self.node = node
+        self.phase = 0
+        self.count = 0
+
+    def advance(self, b: int, lim):
+        ph = self.phase
+        if ph == 0:
+            if b in _WS:
+                return _CONT
+            if b == 0x5B:  # '['
+                self.phase = 1
+                return _CONT
+            return _DEAD
+        if ph == 1:
+            if b in _WS:
+                return _CONT
+            if b == 0x5D and self.node.min_items == 0:  # ']'
+                return _DONE
+            return (_PUSH, self.node.item)
+        if ph == 2:
+            if b in _WS:
+                return _CONT
+            if b == 0x2C and self.count < lim.max_array_items:  # ','
+                self.phase = 3
+                return _CONT
+            if b == 0x5D and self.count >= self.node.min_items:
+                return _DONE
+            return _DEAD
+        # ph == 3
+        if b in _WS:
+            return _CONT
+        return (_PUSH, self.node.item)
+
+    def child_done(self):
+        self.count += 1
+        self.phase = 2
+
+    def sig(self):
+        # Count matters to the mask only near the min bound and at the cap
+        # (the cap flag is appended by SchemaMachine.signature, which owns
+        # the limits); bucketing keeps the mask cache small.
+        return ("a", self.node.uid, self.phase,
+                min(self.count, self.node.min_items + 1))
+
+    def copy(self):
+        f = _ArrayFrame.__new__(_ArrayFrame)
+        f.node, f.phase, f.count = self.node, self.phase, self.count
+        return f
+
+
+class _StringFrame:
+    __slots__ = ("phase", "count", "need", "lo", "hi")
+    # phases: 0 open quote, 1 content, 2 escape, 3-6 \uXXXX hex digits
+
+    def __init__(self):
+        self.phase = 0
+        self.count = 0  # content bytes so far
+        self.need = 0  # pending UTF-8 continuation bytes
+        self.lo = 0x80
+        self.hi = 0xBF
+
+    def advance(self, b: int, lim):
+        ph = self.phase
+        maxlen = lim.max_str_len
+        if ph == 0:
+            if b in _WS:
+                return _CONT
+            if b == 0x22:
+                self.phase = 1
+                return _CONT
+            return _DEAD
+        if ph == 1:
+            if self.need:
+                if self.lo <= b <= self.hi:
+                    self.need -= 1
+                    self.lo, self.hi = 0x80, 0xBF
+                    return _CONT
+                return _DEAD
+            if b == 0x22:  # closing quote
+                return _DONE
+            if self.count >= maxlen:
+                return _DEAD  # only the close is admissible at the cap
+            if b == 0x5C:  # backslash
+                self.phase = 2
+                return _CONT
+            if b < 0x20:
+                return _DEAD
+            if b < 0x80:
+                self.count += 1
+                return _CONT
+            # UTF-8 lead byte: whole character must fit under the cap.
+            lead = utf8_lead(b)
+            if lead is None:
+                return _DEAD
+            need, lo, hi = lead
+            if self.count + need + 1 > maxlen:
+                return _DEAD
+            self.count += need + 1
+            self.need, self.lo, self.hi = need, lo, hi
+            return _CONT
+        if ph == 2:
+            if b in _ESC_SIMPLE:
+                self.phase = 1
+                self.count += 1
+                return _CONT
+            if b == 0x75:  # 'u'
+                self.phase = 3
+                return _CONT
+            return _DEAD
+        # hex digits of \uXXXX
+        if b in _HEX:
+            if ph == 6:
+                self.phase = 1
+                self.count += 1
+            else:
+                self.phase = ph + 1
+            return _CONT
+        return _DEAD
+
+    def child_done(self):  # pragma: no cover - strings have no children
+        raise AssertionError
+
+    def sig(self, remaining: int = 0, bucket: int = 16):
+        # The mask depends on head-room only up to the longest token's byte
+        # length (`bucket`, sized by the provider from the real vocab);
+        # bucketing keeps cache entries O(bucket), not one per character.
+        return ("s", self.phase, self.need, self.lo, self.hi,
+                min(remaining, bucket))
+
+    def copy(self):
+        f = _StringFrame.__new__(_StringFrame)
+        f.phase, f.count = self.phase, self.count
+        f.need, f.lo, f.hi = self.need, self.lo, self.hi
+        return f
+
+
+class _NumberFrame:
+    __slots__ = ("state",)
+    # states: start, neg (after '-'), zero (leading 0), int, frac0, frac,
+    #         exp0 (after e/E), exp1 (after exp sign), exp
+
+    def __init__(self):
+        self.state = "start"
+
+    def advance(self, b: int, lim):
+        s = self.state
+        if s == "start":
+            if b in _WS:
+                return _CONT
+            if b == 0x2D:  # '-'
+                self.state = "neg"
+                return _CONT
+            if b == 0x30:  # '0'
+                self.state = "zero"
+                return _CONT
+            if b in _DIGITS:
+                self.state = "int"
+                return _CONT
+            return _DEAD
+        if s == "neg":
+            if b == 0x30:
+                self.state = "zero"
+                return _CONT
+            if b in _DIGITS:
+                self.state = "int"
+                return _CONT
+            return _DEAD
+        if s in ("zero", "int"):
+            if b in _DIGITS:
+                if s == "zero":
+                    return _DEAD  # no leading zeros (json.loads rejects 01)
+                return _CONT
+            if b == 0x2E:  # '.'
+                self.state = "frac0"
+                return _CONT
+            if b in (0x65, 0x45):  # e/E
+                self.state = "exp0"
+                return _CONT
+            return _REDO  # number complete; byte belongs to the parent
+        if s == "frac0":
+            if b in _DIGITS:
+                self.state = "frac"
+                return _CONT
+            return _DEAD
+        if s == "frac":
+            if b in _DIGITS:
+                return _CONT
+            if b in (0x65, 0x45):
+                self.state = "exp0"
+                return _CONT
+            return _REDO
+        if s == "exp0":
+            if b in (0x2B, 0x2D):  # '+'/'-'
+                self.state = "exp1"
+                return _CONT
+            if b in _DIGITS:
+                self.state = "exp"
+                return _CONT
+            return _DEAD
+        if s == "exp1":
+            if b in _DIGITS:
+                self.state = "exp"
+                return _CONT
+            return _DEAD
+        # s == "exp"
+        if b in _DIGITS:
+            return _CONT
+        return _REDO
+
+    def child_done(self):  # pragma: no cover
+        raise AssertionError
+
+    def sig(self):
+        return ("n", self.state)
+
+    def copy(self):
+        f = _NumberFrame.__new__(_NumberFrame)
+        f.state = self.state
+        return f
+
+
+class _LiteralSetFrame:
+    """Match one of a set of byte literals (enums, true/false)."""
+
+    __slots__ = ("options", "pos", "alive")
+
+    def __init__(self, options: tuple[bytes, ...]):
+        self.options = options
+        self.pos = 0
+        self.alive = (1 << len(options)) - 1  # bitmask of candidates
+
+    def advance(self, b: int, lim):
+        if self.pos == 0 and b in _WS:
+            return _CONT
+        nxt = 0
+        done = False
+        for i, opt in enumerate(self.options):
+            if not (self.alive >> i) & 1:
+                continue
+            if self.pos < len(opt) and opt[self.pos] == b:
+                if self.pos + 1 == len(opt):
+                    done = True
+                else:
+                    nxt |= 1 << i
+        if done:
+            return _DONE
+        if not nxt:
+            return _DEAD
+        self.alive = nxt
+        self.pos += 1
+        return _CONT
+
+    def child_done(self):  # pragma: no cover
+        raise AssertionError
+
+    def sig(self):
+        return ("l", self.options, self.pos, self.alive)
+
+    def copy(self):
+        f = _LiteralSetFrame.__new__(_LiteralSetFrame)
+        f.options, f.pos, f.alive = self.options, self.pos, self.alive
+        return f
+
+
+_BOOL_OPTIONS = (b"true", b"false")
+
+
+class _AnyFrame:
+    """Free JSON value via a nested generic :class:`JsonMachine`."""
+
+    __slots__ = ("m", "started", "require_object")
+
+    def __init__(self, require_object: bool = False):
+        self.m = JsonMachine()
+        self.started = False
+        self.require_object = require_object
+
+    def advance(self, b: int, lim):
+        if not self.started and b not in _WS:
+            if self.require_object and b != 0x7B:
+                return _DEAD
+            self.started = True
+        if self.m.advance(b):
+            return _CONT
+        # The nested machine died: if its value had completed, this byte is
+        # the parent's terminator (',', '}', ']'); re-offer it.
+        return _REDO if self.m.is_complete else _DEAD
+
+    def child_done(self):  # pragma: no cover
+        raise AssertionError
+
+    def sig(self):
+        return ("y", self.require_object, self.started, self.m.signature())
+
+    def copy(self):
+        f = _AnyFrame.__new__(_AnyFrame)
+        f.m = self.m.copy()
+        f.started = self.started
+        f.require_object = self.require_object
+        return f
+
+
+def _make_frame(node: SNode):
+    if isinstance(node, SObject):
+        return _ObjectFrame(node)
+    if isinstance(node, SArray):
+        return _ArrayFrame(node)
+    if isinstance(node, SEnum):
+        return _LiteralSetFrame(node.options)
+    if isinstance(node, SBool):
+        return _LiteralSetFrame(_BOOL_OPTIONS)
+    if isinstance(node, SString):
+        return _StringFrame()
+    if isinstance(node, SNumber):
+        return _NumberFrame()
+    if isinstance(node, SAny):
+        return _AnyFrame(node.require_object)
+    raise TypeError(node)
+
+
+# --------------------------------------------------------------------------- #
+# machine                                                                     #
+# --------------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass(frozen=True)
+class SchemaLimits:
+    """Generation-side bounds (not part of the JSON schema): they keep a
+    random/underconfident model from rambling inside an unbounded string or
+    array. Large enough to never bind on real orchestrator outputs."""
+
+    max_str_len: int = 512  # content bytes per string
+    max_array_items: int = 32
+    # Longest token byte-expansion in the vocab — the mask-cache bucket for
+    # string head-room. The provider overrides this from the real table; a
+    # too-small value would cache a mask admitting a token that overflows
+    # max_str_len mid-string and kills the machine.
+    max_token_bytes: int = 16
+
+
+class SchemaMachine:
+    """Incremental validator for one compiled schema; JsonMachine-duck-typed."""
+
+    def __init__(self, schema: SNode, name: str,
+                 limits: Optional[SchemaLimits] = None):
+        self.schema = schema
+        self.name = name
+        self.limits = limits or SchemaLimits()
+        self.stack: list = [_make_frame(schema)]
+        self.complete = False
+        self.dead = False
+
+    @property
+    def is_complete(self) -> bool:
+        return self.complete
+
+    def signature(self) -> tuple:
+        sigs = []
+        for fr in self.stack:
+            if isinstance(fr, _StringFrame):
+                sigs.append(fr.sig(self.limits.max_str_len - fr.count,
+                                   self.limits.max_token_bytes))
+            elif isinstance(fr, _ArrayFrame):
+                s = fr.sig()
+                sigs.append(s + (fr.count >= self.limits.max_array_items,))
+            else:
+                sigs.append(fr.sig())
+        return ("schema", self.name, self.complete, self.dead, tuple(sigs))
+
+    def copy(self) -> "SchemaMachine":
+        m = SchemaMachine.__new__(SchemaMachine)
+        m.schema, m.name, m.limits = self.schema, self.name, self.limits
+        m.stack = [fr.copy() for fr in self.stack]
+        m.complete, m.dead = self.complete, self.dead
+        return m
+
+    def advance(self, byte: int) -> bool:
+        if self.dead:
+            return False
+        if not self.stack:  # complete document: trailing whitespace only
+            if byte in _WS:
+                return True
+            return self._die()
+        while True:
+            res = self.stack[-1].advance(byte, self.limits)
+            if res == _CONT:
+                return True
+            if res == _DEAD:
+                return self._die()
+            if isinstance(res, tuple) and res[0] == _PUSH:
+                self.stack.append(_make_frame(res[1]))
+                continue  # re-offer the byte to the new child
+            if res == _DONE:
+                self.stack.pop()
+                if self.stack:
+                    self.stack[-1].child_done()
+                    return True
+                self.complete = True
+                return True
+            # _REDO: frame finished before this byte
+            self.stack.pop()
+            if self.stack:
+                self.stack[-1].child_done()
+                continue  # re-offer to parent
+            self.complete = True
+            if byte in _WS:
+                return True
+            return self._die()
+
+    def _die(self) -> bool:
+        self.dead = True
+        return False
+
+    def advance_bytes(self, data: bytes) -> bool:
+        for b in data:
+            if not self.advance(b):
+                return False
+        return True
+
+
+# --------------------------------------------------------------------------- #
+# orchestrator schema registry                                                #
+# --------------------------------------------------------------------------- #
+
+
+@lru_cache(maxsize=None)
+def orchestrator_schemas() -> dict[str, SObject]:
+    """The six structured-investigation grammars, compiled once. Names match
+    the prompt templates in :mod:`runbookai_tpu.agent.llm_parser` and are the
+    values accepted by ``SamplingParams.guided`` / ``complete(schema=...)``."""
+    from runbookai_tpu.agent import llm_parser as lp
+
+    return {
+        "triage": compile_model(lp.TriageResult),
+        "hypotheses": compile_model(lp.HypothesisGeneration),
+        "evaluation": compile_model(lp.EvidenceEvaluation),
+        "conclusion": compile_model(lp.Conclusion),
+        "remediation": compile_model(lp.RemediationPlan),
+        "log_analysis": compile_model(lp.LogAnalysis),
+    }
+
+
+SCHEMA_MODELS = {
+    "triage": "TriageResult",
+    "hypotheses": "HypothesisGeneration",
+    "evaluation": "EvidenceEvaluation",
+    "conclusion": "Conclusion",
+    "remediation": "RemediationPlan",
+    "log_analysis": "LogAnalysis",
+}

@@ -39,7 +39,12 @@ def sample_tokens(
     sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
     cumprobs = jnp.cumsum(sorted_probs, axis=-1)
     # Number of tokens kept per row: first index where cumprob >= top_p, +1.
+    # Clamp to the vocab: with top_p=1.0, float32 rounding can leave every
+    # cumprob fractionally below 1.0, and an unclamped keep would gather the
+    # cutoff out of bounds (NaN -> the filter drops ALL tokens, including
+    # grammar-allowed ones).
     keep = jnp.sum(cumprobs < top_p[:, None], axis=-1) + 1  # [B]
+    keep = jnp.minimum(keep, logits.shape[-1])
     cutoff = jnp.take_along_axis(sorted_logits, (keep - 1)[:, None], axis=-1)  # [B,1]
     filtered = jnp.where(scaled >= cutoff, scaled, NEG_INF)
 

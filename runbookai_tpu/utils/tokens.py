@@ -84,6 +84,12 @@ class ByteTokenizer:
             return bytes([tid])
         return self._id_to_special.get(tid, "").encode("utf-8")
 
+    @property
+    def special_ids(self) -> frozenset[int]:
+        """Control tokens — never admissible as grammar *content* (their
+        id_to_bytes expansion is markup like ``<|eot_id|>``, not text)."""
+        return frozenset(self._id_to_special)
+
 
 class HFTokenizer:
     """Wraps a local ``tokenizer.json`` via the HuggingFace ``tokenizers`` lib."""
@@ -116,6 +122,25 @@ class HFTokenizer:
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=False)
+
+    # Single-token byte decode used by guided decoding to walk candidates.
+    def id_to_bytes(self, tid: int) -> bytes:
+        return self._tok.decode([tid], skip_special_tokens=False).encode("utf-8")
+
+    @property
+    def special_ids(self) -> frozenset[int]:
+        """ALL added/control tokens (Llama-3 ships ~250 reserved specials) —
+        none may be admitted as grammar content: their byte expansion is
+        markup like ``<|start_header_id|>`` that a string automaton would
+        otherwise accept."""
+        try:
+            ids = set(self._tok.get_added_tokens_decoder())
+        except AttributeError:  # older `tokenizers` releases
+            ids = set()
+        for tid in (self.bos_id, self.eos_id, self.eot_id, self.pad_id):
+            if tid is not None:
+                ids.add(tid)
+        return frozenset(ids)
 
     def id_to_bytes(self, tid: int) -> bytes:
         return self._tok.decode([tid], skip_special_tokens=False).encode("utf-8")
