@@ -132,6 +132,9 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         page_size=16, num_pages=num_pages, max_batch_slots=slots,
         prefill_chunk=128, max_seq_len=2048, kv_dtype=dtype, block_pages=16,
         attn_impl=os.environ.get("BENCH_ATTN", "pallas" if on_accel else "xla"),
+        # Batch all concurrent prompts' prefill chunks into one dispatch so
+        # TTFT stays ~flat under load (p50_ttft_ms in details tracks this).
+        prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH", slots)),
     )
     core = EngineCore(cfg, params, tok, ecfg)
 
@@ -180,6 +183,7 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
         "batch_slots": slots,
+        "prefill_batch": ecfg.prefill_batch,
         "p50_ttft_ms": round(p50_ttft, 1) if p50_ttft is not None else None,
         "wall_s": round(wall, 2),
         "total_tokens": total_tokens,

@@ -345,6 +345,7 @@ class JsonMaskProvider:
         self.schemas = schemas or {}
         self.limits = limits
         self._token_bytes: Optional[list[bytes]] = None
+        self._longest_token = 0  # set alongside _token_bytes
         self._cache: dict[tuple, np.ndarray] = {}
         # Control tokens are never content: their byte expansion is markup
         # ("<|eot_id|>") that would otherwise be admissible inside a string.
@@ -361,6 +362,7 @@ class JsonMaskProvider:
             self._token_bytes = [
                 self.tokenizer.id_to_bytes(t) for t in range(self.tokenizer.vocab_size)
             ]
+            self._longest_token = max(map(len, self._token_bytes))
         return self._token_bytes
 
     def machine_for(self, req):
@@ -379,10 +381,10 @@ class JsonMaskProvider:
                 # Size the string-headroom cache bucket to the real vocab:
                 # a bucket smaller than the longest token would let a cached
                 # mask admit a token that overflows max_str_len.
-                longest = max(map(len, self._bytes_table()))
-                if limits.max_token_bytes < longest:
-                    limits = dataclasses.replace(limits,
-                                                 max_token_bytes=longest)
+                self._bytes_table()  # populates _longest_token once
+                if limits.max_token_bytes < self._longest_token:
+                    limits = dataclasses.replace(
+                        limits, max_token_bytes=self._longest_token)
                 req.guided_state = SchemaMachine(schema, name, limits=limits)
             else:
                 req.guided_state = JsonMachine()

@@ -659,13 +659,3 @@ def orchestrator_schemas() -> dict[str, SObject]:
         "remediation": compile_model(lp.RemediationPlan),
         "log_analysis": compile_model(lp.LogAnalysis),
     }
-
-
-SCHEMA_MODELS = {
-    "triage": "TriageResult",
-    "hypotheses": "HypothesisGeneration",
-    "evaluation": "EvidenceEvaluation",
-    "conclusion": "Conclusion",
-    "remediation": "RemediationPlan",
-    "log_analysis": "LogAnalysis",
-}

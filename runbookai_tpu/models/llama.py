@@ -22,7 +22,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from runbookai_tpu.ops.attention import paged_attention, write_kv_pages
+from runbookai_tpu.ops.attention import paged_attention, write_kv_pages_batch
 from runbookai_tpu.ops.rope import apply_rope
 
 Params = dict[str, Any]
@@ -224,15 +224,13 @@ def forward_impl(
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
 
-        # Scatter this chunk's K/V into the page pool (per sequence).
-        def write_seq(kv_flat, new, pos_row, table_row):
-            return write_kv_pages(kv_flat, new, pos_row, table_row, page_size)
-
-        # vmap over batch would duplicate the pool; loop sequences instead —
-        # B is small (max_batch_slots) and unrolls at trace time.
-        for i in range(b):
-            k_pages = write_seq(k_pages, k[i], positions[i], page_tables[i])
-            v_pages = write_seq(v_pages, v[i], positions[i], page_tables[i])
+        # Scatter the whole batch's K/V into the page pool in one scatter
+        # (program size stays flat as max_batch_slots grows; disjoint page
+        # ownership makes flattened destinations collision-free).
+        k_pages = write_kv_pages_batch(k_pages, k, positions, page_tables,
+                                       page_size)
+        v_pages = write_kv_pages_batch(v_pages, v, positions, page_tables,
+                                       page_size)
 
         if attn_impl == "pallas":
             from runbookai_tpu.ops.paged_attention_pallas import (

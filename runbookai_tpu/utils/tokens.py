@@ -142,9 +142,6 @@ class HFTokenizer:
                 ids.add(tid)
         return frozenset(ids)
 
-    def id_to_bytes(self, tid: int) -> bytes:
-        return self._tok.decode([tid], skip_special_tokens=False).encode("utf-8")
-
 
 Tokenizer = ByteTokenizer | HFTokenizer
 
