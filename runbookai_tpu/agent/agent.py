@@ -141,7 +141,11 @@ class Agent:
             # Context budget check → compaction (reference agent.ts:414-441).
             context_text = pad.build_tiered_context()
             if estimate_tokens(context_text, self.tokenizer) > self.context_threshold:
-                plan = self.compactor.plan(pad, query)
+                plan = self.compactor.plan(
+                    pad, query, memory=memory,
+                    hypotheses=([h.statement for h in hypotheses.open_hypotheses()]
+                                if hypotheses else None),
+                )
                 pad.apply_compaction_plan(plan)
                 context_text = pad.build_tiered_context()
                 yield AgentEvent("phase", {"name": "compaction",

@@ -1,9 +1,17 @@
 """Importance-scored context compaction.
 
-Parity target: reference ``src/agent/context-compactor.ts`` (:106 scoring —
-recency, error signals, query relevance, size; presets ``incident`` /
-``research`` / ``balanced`` :598). Emits a ``{result_id: tier}`` plan applied
-by ``Scratchpad.apply_compaction_plan`` when the estimated context exceeds the
+Parity target: reference ``src/agent/context-compactor.ts`` — six score
+components (recency, query relevance, error signals, hypothesis relevance,
+service relevance, cited-in-notes) combined by per-preset weights into a
+0-1 score (:106-365), a plan with full/compact/clear tiers bounded by
+``max_full_results``/``max_compact_results`` and the ``min_score_for_full``/
+``min_score_to_keep`` thresholds (:376-470), estimated tokens saved,
+``explain_score`` debugging (:560-590), and the ``createCompactor`` presets
+(:598: incident weights errors+hypotheses, research weights query+recency,
+balanced is the default config).
+
+The plan maps ``result_id -> tier`` and is applied by
+``Scratchpad.apply_compaction_plan`` when the estimated context exceeds the
 threshold (reference ``agent.ts:414-441``).
 """
 
@@ -11,74 +19,240 @@ from __future__ import annotations
 
 import json
 import re
-from dataclasses import dataclass
+from dataclasses import dataclass, field, replace
 
 from runbookai_tpu.agent.scratchpad import TIER_CLEARED, TIER_COMPACT, TIER_FULL, Scratchpad
 
-_ERROR_RE = re.compile(r"error|fail|timeout|exception|5\d\d|critical", re.IGNORECASE)
+_CRITICAL_RE = re.compile(r"error|failed|exception|critical|alarm", re.IGNORECASE)
+_WARNING_RE = re.compile(r"warning|timeout|unhealthy|degraded", re.IGNORECASE)
 
 
 @dataclass(frozen=True)
-class CompactorPreset:
-    name: str
-    keep_full: int  # top-K results kept full
-    keep_compact: int  # next-K kept compact; the rest cleared
-    recency_weight: float
-    error_weight: float
-    relevance_weight: float
-    size_penalty: float
+class ScoreWeights:
+    recency: float = 0.2
+    query_relevance: float = 0.2
+    error_signals: float = 0.2
+    hypothesis_relevance: float = 0.15
+    service_relevance: float = 0.1
+    cited_in_notes: float = 0.15
 
 
-PRESETS = {
-    # Incidents favor fresh signals; research favors breadth of retained detail.
-    "incident": CompactorPreset("incident", keep_full=4, keep_compact=8,
-                                recency_weight=3.0, error_weight=2.0,
-                                relevance_weight=1.0, size_penalty=1.0),
-    "research": CompactorPreset("research", keep_full=8, keep_compact=12,
-                                recency_weight=1.0, error_weight=1.0,
-                                relevance_weight=2.0, size_penalty=0.5),
-    "balanced": CompactorPreset("balanced", keep_full=6, keep_compact=10,
-                                recency_weight=2.0, error_weight=1.5,
-                                relevance_weight=1.5, size_penalty=0.8),
+@dataclass(frozen=True)
+class CompactorConfig:
+    weights: ScoreWeights = field(default_factory=ScoreWeights)
+    max_full_results: int = 10
+    max_compact_results: int = 15
+    min_score_for_full: float = 0.6
+    min_score_to_keep: float = 0.2
+    tokens_per_full_result: int = 2000
+    tokens_per_compact_result: int = 150
+
+
+PRESETS: dict[str, CompactorConfig] = {
+    # Incident investigation: prioritize errors and hypothesis relevance.
+    "incident": CompactorConfig(
+        weights=ScoreWeights(recency=0.15, query_relevance=0.15,
+                             error_signals=0.3, hypothesis_relevance=0.2,
+                             service_relevance=0.1, cited_in_notes=0.1),
+        max_full_results=15, min_score_for_full=0.5),
+    # Research: prioritize query relevance and recency.
+    "research": CompactorConfig(
+        weights=ScoreWeights(recency=0.25, query_relevance=0.3,
+                             error_signals=0.1, hypothesis_relevance=0.1,
+                             service_relevance=0.1, cited_in_notes=0.15),
+        max_full_results=8, min_score_for_full=0.6),
+    "balanced": CompactorConfig(),
 }
 
 
+@dataclass
+class ScoredResult:
+    result_id: str
+    score: float
+    components: dict[str, float]
+    keep_full: bool
+
+
 class ContextCompactor:
-    def __init__(self, preset: str = "balanced"):
-        self.preset = PRESETS[preset]
+    def __init__(self, preset: str | CompactorConfig = "balanced"):
+        self.config = (PRESETS[preset] if isinstance(preset, str) else preset)
 
-    def score(self, entry, rank_from_newest: int, query: str) -> float:
-        p = self.preset
-        recency = p.recency_weight / (1.0 + rank_from_newest)
-        text = json.dumps(entry.full, default=str) if entry.full is not None else ""
-        errors = p.error_weight * min(3, len(_ERROR_RE.findall(text[:20000]))) / 3.0
-        q_words = {w for w in re.findall(r"\w{4,}", query.lower())}
-        arg_text = (json.dumps(entry.args, default=str) + text[:2000]).lower()
-        overlap = sum(1 for w in q_words if w in arg_text)
-        relevance = p.relevance_weight * min(1.0, overlap / max(1, len(q_words)))
-        size_penalty = p.size_penalty * min(1.0, len(text) / 50_000)
-        return recency + errors + relevance - size_penalty
+    # ------------------------------------------------------------- components
 
-    def plan(self, scratchpad: Scratchpad, query: str) -> dict[str, str]:
-        """Score all tool results and assign tiers by rank."""
+    @staticmethod
+    def _score_recency(rank_from_newest: int, total: int) -> float:
+        if total <= 1:
+            return 1.0
+        return 1.0 - rank_from_newest / (total - 1)
+
+    @staticmethod
+    def _score_query_relevance(entry, query: str) -> float:
+        q_words = {w for w in re.findall(r"\w{4,}", (query or "").lower())}
+        if not q_words:
+            return 0.0
+        text = (json.dumps(entry.args, default=str)
+                + json.dumps(entry.full, default=str)[:4000]).lower()
+        matches = sum(1 for w in q_words if w in text)
+        return min(1.0, matches / len(q_words))
+
+    @staticmethod
+    def _score_error_signals(entry) -> float:
+        compact = entry.compact or {}
+        if compact.get("has_errors"):
+            return 1.0
+        health = compact.get("health_status")
+        if health == "critical":
+            return 1.0
+        if health == "degraded":
+            return 0.7
+        text = json.dumps(entry.full, default=str)[:20000]
+        if _CRITICAL_RE.search(text):
+            return 1.0
+        if _WARNING_RE.search(text):
+            return 0.6
+        return 0.0
+
+    @staticmethod
+    def _score_hypothesis_relevance(entry, hypotheses, symptoms) -> float:
+        """Evidence tied to an active hypothesis (or a symptom it names)
+        outranks incidental results (context-compactor.ts:150-200)."""
+        if not hypotheses and not symptoms:
+            return 0.0
+        text = (json.dumps(entry.args, default=str)
+                + (entry.compact or {}).get("summary", "")
+                + json.dumps(entry.full, default=str)[:4000]).lower()
+        for statement in hypotheses or []:
+            words = [w for w in re.findall(r"\w{4,}", statement.lower())][:8]
+            if words and sum(1 for w in words if w in text) >= max(2, len(words) // 2):
+                return 1.0
+        for symptom in symptoms or []:
+            if symptom and symptom.lower()[:20] in text:
+                return 0.5
+        return 0.0
+
+    @staticmethod
+    def _score_service_relevance(entry, services) -> float:
+        if not services:
+            return 0.0
+        compact_services = [s.lower() for s in (entry.compact or {}).get("services", [])]
+        text = (json.dumps(entry.args, default=str)
+                + json.dumps(entry.full, default=str)[:4000]).lower()
+        for service in services:
+            s = service.lower()
+            if any(s in cs for cs in compact_services):
+                return 1.0
+            if s in text:
+                return 0.8
+        return 0.0
+
+    @staticmethod
+    def _score_cited(entry, cited_ids, findings) -> float:
+        if cited_ids and entry.result_id in cited_ids:
+            return 1.0
+        # Fallback: a finding that names this result's summary content.
+        # Word-boundary match: ids are sequential (r1, r2, ...), so a bare
+        # substring test would let r1 false-match a finding citing r12.
+        summary = (entry.compact or {}).get("summary", "")
+        id_re = re.compile(rf"\b{re.escape(entry.result_id)}\b")
+        for finding in findings or []:
+            if id_re.search(finding):
+                return 1.0
+            words = [w for w in re.findall(r"\w{5,}", finding.lower())][:6]
+            if words and summary and all(w in summary.lower() for w in words[:2]):
+                return 0.5
+        return 0.0
+
+    # ---------------------------------------------------------------- scoring
+
+    def score(self, entry, rank_from_newest: int, query: str, total: int = 1,
+              hypotheses=None, services=None, symptoms=None,
+              cited_ids=None, findings=None) -> ScoredResult:
+        components = {
+            "recency": self._score_recency(rank_from_newest, total),
+            "query_relevance": self._score_query_relevance(entry, query),
+            "error_signals": self._score_error_signals(entry),
+            "hypothesis_relevance": self._score_hypothesis_relevance(
+                entry, hypotheses, symptoms),
+            "service_relevance": self._score_service_relevance(entry, services),
+            "cited_in_notes": self._score_cited(entry, cited_ids, findings),
+        }
+        w = self.config.weights
+        total_score = (components["recency"] * w.recency
+                       + components["query_relevance"] * w.query_relevance
+                       + components["error_signals"] * w.error_signals
+                       + components["hypothesis_relevance"] * w.hypothesis_relevance
+                       + components["service_relevance"] * w.service_relevance
+                       + components["cited_in_notes"] * w.cited_in_notes)
+        return ScoredResult(entry.result_id, total_score, components,
+                            keep_full=total_score >= self.config.min_score_for_full)
+
+    def plan(self, scratchpad: Scratchpad, query: str,
+             memory=None, hypotheses=None, cited_ids=None) -> dict[str, str]:
+        """Score all tool results and assign tiers.
+
+        ``memory`` is an ``InvestigationMemory`` (services/symptoms/findings
+        feed the hypothesis/service/cited components); ``hypotheses`` is a
+        list of active hypothesis statements; ``cited_ids`` result ids known
+        to be cited in notes/answers.
+        """
+        services = list(getattr(memory, "services", []) or [])
+        symptoms = list(getattr(memory, "symptoms", []) or [])
+        findings = list(getattr(memory, "findings", []) or [])
         entries = [scratchpad.results[rid] for rid in scratchpad.list_result_ids()]
         n = len(entries)
         scored = [
-            (self.score(e, rank_from_newest=n - 1 - i, query=query), e)
+            self.score(e, rank_from_newest=n - 1 - i, query=query, total=n,
+                       hypotheses=hypotheses, services=services,
+                       symptoms=symptoms, cited_ids=cited_ids,
+                       findings=findings)
             for i, e in enumerate(entries)
         ]
-        scored.sort(key=lambda t: t[0], reverse=True)
+        scored.sort(key=lambda s: s.score, reverse=True)
+
+        cfg = self.config
         plan: dict[str, str] = {}
-        for rank, (_, entry) in enumerate(scored):
-            if rank < self.preset.keep_full:
-                plan[entry.result_id] = TIER_FULL
-            elif rank < self.preset.keep_full + self.preset.keep_compact:
-                plan[entry.result_id] = TIER_COMPACT
+        full = compact = 0
+        for s in scored:
+            if s.score >= cfg.min_score_for_full and full < cfg.max_full_results:
+                plan[s.result_id] = TIER_FULL
+                full += 1
+            elif s.score >= cfg.min_score_to_keep and compact < cfg.max_compact_results:
+                # Includes full-bucket overflow: still-important results
+                # demote to compact rather than vanish.
+                plan[s.result_id] = TIER_COMPACT
+                compact += 1
             else:
-                plan[entry.result_id] = TIER_CLEARED
+                plan[s.result_id] = TIER_CLEARED
+        # Never clear everything: the newest result stays at least compact.
+        if entries and all(t == TIER_CLEARED for t in plan.values()):
+            plan[entries[-1].result_id] = TIER_COMPACT
         return plan
 
+    def estimated_tokens_saved(self, plan: dict[str, str]) -> int:
+        cfg = self.config
+        saved = 0
+        for tier in plan.values():
+            if tier == TIER_COMPACT:
+                saved += cfg.tokens_per_full_result - cfg.tokens_per_compact_result
+            elif tier == TIER_CLEARED:
+                saved += cfg.tokens_per_full_result
+        return saved
 
-def create_compactor(preset: str = "balanced") -> ContextCompactor:
-    """Reference ``createCompactor`` presets (context-compactor.ts:598)."""
-    return ContextCompactor(preset)
+    def explain_score(self, scored: ScoredResult) -> str:
+        """Debugging view of a score (context-compactor.ts:575)."""
+        w = self.config.weights
+        lines = [f"Total Score: {scored.score:.3f}",
+                 f"Keep Full: {scored.keep_full}", "", "Components:"]
+        for name, value in scored.components.items():
+            lines.append(f"  {name}: {value:.2f} x {getattr(w, name)}")
+        return "\n".join(lines)
+
+
+def create_compactor(preset: str = "balanced",
+                     **overrides) -> ContextCompactor:
+    """Reference ``createCompactor`` presets (context-compactor.ts:598);
+    keyword overrides patch the preset config (e.g. ``max_full_results=4``)."""
+    cfg = PRESETS[preset]
+    if overrides:
+        cfg = replace(cfg, **overrides)
+    return ContextCompactor(cfg)

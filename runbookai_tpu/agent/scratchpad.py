@@ -61,8 +61,12 @@ class ToolResultEntry:
             summary = self.compact.get("summary", "")
             highlights = self.compact.get("highlights") or []
             parts = [f"{header} -> {summary}"]
-            for h in highlights[:5]:
-                parts.append(f"  - {h}")
+            if isinstance(highlights, dict):  # per-tool structured highlights
+                for k, v in list(highlights.items())[:5]:
+                    parts.append(f"  - {k}: {json.dumps(v, default=_json_default)[:160]}")
+            else:
+                for h in highlights[:5]:
+                    parts.append(f"  - {h}")
             parts.append(f"  (compacted; drill down via get_full_result {self.result_id})")
             return "\n".join(parts)
         return f"{header} ->\n{json.dumps(self.full, indent=2, default=_json_default)[:8000]}"

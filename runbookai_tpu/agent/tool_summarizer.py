@@ -1,21 +1,33 @@
 """Per-tool compact summaries keeping the agent's context small.
 
-Parity target: reference ``src/agent/tool-summarizer.ts`` (``CompactToolResult``
-:13-28 — summary, highlights, itemCount, services, healthStatus; per-tool
-summarizer classes :742). Summaries are pure functions of the result payload —
-no LLM call — and the ``result_id`` enables drill-down via ``get_full_result``.
+Parity target: reference ``src/agent/tool-summarizer.ts`` — the
+``CompactToolResult`` contract (:13-28: summary, highlights, itemCount,
+hasErrors, services, healthStatus) and the per-tool summarizer registry
+(:724-731: aws_query, cloudwatch_alarms, cloudwatch_logs, pagerduty get/list,
+datadog, prometheus, search_knowledge + default). Field extraction is
+re-derived for THIS build's tool result shapes (e.g. ``tools/aws.py``
+returns ``{service, category, count, resources}`` per service;
+``search_knowledge`` returns ranked chunk hits), not copied.
+
+Summaries are pure functions of the result payload — no LLM call — and the
+``result_id`` kept by the scratchpad enables drill-down via
+``get_full_result``. These are load-bearing for long investigations: context
+stays small *because* the compact tier preserves the decision-relevant
+fields (alarm states, error counts, notable resource names), not a prefix.
 """
 
 from __future__ import annotations
 
 import json
 import re
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 _ERROR_WORDS = re.compile(
     r"\b(error|exception|fail(?:ed|ure)?|timeout|throttl|oom|denied|refused|5\d\d|crit)\w*",
     re.IGNORECASE,
 )
+
+HEALTHY, DEGRADED, CRITICAL, UNKNOWN = "healthy", "degraded", "critical", "unknown"
 
 
 def _walk_strings(obj: Any, limit: int = 400):
@@ -37,7 +49,8 @@ def _count_items(result: Any) -> int:
         return len(result)
     if isinstance(result, dict):
         for key in ("items", "results", "alarms", "events", "logs", "instances",
-                    "pods", "incidents", "series", "resources", "documents"):
+                    "pods", "incidents", "series", "resources", "documents",
+                    "alerts", "monitors", "deployments", "nodes"):
             v = result.get(key)
             if isinstance(v, list):
                 return len(v)
@@ -62,17 +75,25 @@ def _find_services(result: Any) -> list[str]:
     return sorted(found)[:10]
 
 
-def _health_status(result: Any) -> str:
+def _has_error_signals(result: Any) -> bool:
+    for s in _walk_strings(result):
+        if _ERROR_WORDS.search(s):
+            return True
+    return False
+
+
+def _health_from_signals(result: Any) -> str:
+    """Generic fallback health: count error-looking strings."""
     text_signals = 0
     for s in _walk_strings(result):
         if _ERROR_WORDS.search(s):
             text_signals += 1
         if text_signals >= 3:
-            return "unhealthy"
-    return "degraded" if text_signals else "healthy"
+            return CRITICAL
+    return DEGRADED if text_signals else HEALTHY
 
 
-def _highlights(result: Any, max_items: int = 5) -> list[str]:
+def _text_highlights(result: Any, max_items: int = 5) -> list[str]:
     out = []
     for s in _walk_strings(result):
         if _ERROR_WORDS.search(s) and len(s) > 10:
@@ -82,25 +103,341 @@ def _highlights(result: Any, max_items: int = 5) -> list[str]:
     return out
 
 
-def summarize_tool_result(tool: str, args: dict[str, Any], result: Any) -> dict[str, Any]:
-    """Build the compact representation stored in the scratchpad tier."""
-    items = _count_items(result)
-    services = _find_services(result)
-    health = _health_status(result)
-    highlights = _highlights(result)
-    size = len(json.dumps(result, default=str)) if result is not None else 0
-
-    bits = [f"{tool}: {items} item(s)"]
-    if services:
-        bits.append(f"services: {', '.join(services[:4])}")
-    bits.append(f"signal: {health}")
-    summary = "; ".join(bits)
-
+def _compact(summary: str, highlights: Any, item_count: int, services: list[str],
+             health: str, result: Any, has_errors: Optional[bool] = None) -> dict:
     return {
         "summary": summary,
         "highlights": highlights,
-        "item_count": items,
+        "item_count": item_count,
         "services": services,
         "health_status": health,
-        "size_bytes": size,
+        "has_errors": (_has_error_signals(result)
+                       if has_errors is None else has_errors),
+        "size_bytes": len(json.dumps(result, default=str)) if result is not None else 0,
     }
+
+
+# --------------------------------------------------------------------------- #
+# per-tool summarizers (tool-summarizer.ts:190-721, re-derived for our shapes)
+# --------------------------------------------------------------------------- #
+
+
+def _resources_of(payload: Any) -> list:
+    """A service's resource list in either tool shape: the real executor's
+    ``{count, resources: [...]}`` payload or the simulated flat list."""
+    if isinstance(payload, dict) and isinstance(payload.get("resources"), list):
+        return payload["resources"]
+    if isinstance(payload, list):
+        return payload
+    return []
+
+
+_NOTABLE_KEYS = ("name", "service", "serviceName", "functionName", "instanceId",
+                 "alarmName", "DBInstanceIdentifier", "clusterName", "id")
+
+
+def _notable_name(resource: Any) -> Optional[str]:
+    if isinstance(resource, dict):
+        for key in _NOTABLE_KEYS:
+            v = resource.get(key)
+            if isinstance(v, str) and v:
+                return v
+    return None
+
+
+def _summarize_aws_query(args: dict, result: Any) -> dict:
+    if not isinstance(result, dict):
+        return _summarize_default("aws_query", args, result)
+    if "error" in result:
+        return _compact(f"aws_query error: {str(result['error'])[:150]}", {},
+                        0, [], UNKNOWN, result, has_errors=True)
+    # Normalize: single-service answers ({service: [...], note}) and
+    # multi-service fan-outs ({sid: payload, ...}) both become sid -> payload.
+    per_service = {k: v for k, v in result.items()
+                   if k not in ("note",) and isinstance(v, (list, dict))}
+    total = 0
+    errors = 0
+    notable: list[str] = []
+    highlights: dict[str, Any] = {}
+    for sid, payload in per_service.items():
+        if isinstance(payload, dict) and "error" in payload:
+            errors += 1
+            highlights[sid] = {"error": str(payload["error"])[:120]}
+            continue
+        resources = _resources_of(payload)
+        total += len(resources)
+        names = [n for n in (_notable_name(r) for r in resources[:10]) if n][:3]
+        notable.extend(f"{sid}/{n}" for n in names)
+        highlights[sid] = {"count": len(resources), "notable": names,
+                           "sample": resources[:2]}
+    notable = list(dict.fromkeys(notable))[:3]
+    summary = (f"Queried {len(per_service)} AWS service(s), "
+               f"found {total} resource(s).")
+    if notable:
+        summary += f" Notable: {', '.join(notable)}."
+    if errors:
+        summary += f" {errors} error(s)."
+    return _compact(summary, highlights, total, _find_services(result),
+                    _health_from_signals(result), result,
+                    has_errors=errors > 0 or _has_error_signals(result))
+
+
+def _summarize_cloudwatch_alarms(args: dict, result: Any) -> dict:
+    alarms = result.get("alarms", []) if isinstance(result, dict) else []
+    in_alarm = [a for a in alarms
+                if isinstance(a, dict) and a.get("state") in ("ALARM", "alarm")]
+    names = [a.get("alarmName", "?") for a in in_alarm[:5] if isinstance(a, dict)]
+    health = HEALTHY if not in_alarm else (CRITICAL if len(in_alarm) > 2 else DEGRADED)
+    summary = f"{len(alarms)} alarm(s). {len(in_alarm)} in ALARM state."
+    if names:
+        summary += f" Top: {', '.join(names[:3])}."
+    return _compact(summary,
+                    {"total": len(alarms), "alarming": len(in_alarm),
+                     "alarm_names": names},
+                    len(alarms), _find_services(result), health, result,
+                    has_errors=bool(in_alarm))
+
+
+def _summarize_cloudwatch_logs(args: dict, result: Any) -> dict:
+    group = args.get("log_group", "logs")
+    pattern = args.get("filter_pattern", "")
+    if not isinstance(result, dict) or "error" in result:
+        err = result.get("error") if isinstance(result, dict) else str(result)
+        return _compact(f"Log search in {group} failed: {str(err)[:120]}",
+                        {}, 0, [], UNKNOWN, result, has_errors=True)
+    events = result.get("events", [])
+    error_events = [e for e in events if isinstance(e, dict)
+                    and _ERROR_WORDS.search(str(e.get("message", "")))]
+    samples = [str(e.get("message", ""))[:100] for e in error_events[:2]
+               if isinstance(e, dict)] or \
+              [str(e.get("message", ""))[:100] for e in events[:2]
+               if isinstance(e, dict)]
+    summary = (f"Found {len(events)} log event(s) in {group}"
+               + (f' matching "{pattern}"' if pattern else "")
+               + f". {len(error_events)} error(s).")
+    return _compact(summary,
+                    {"count": len(events), "error_count": len(error_events),
+                     "samples": samples},
+                    len(events), _find_services(result),
+                    DEGRADED if error_events else HEALTHY, result,
+                    has_errors=bool(error_events))
+
+
+def _summarize_pd_incident(args: dict, result: Any) -> dict:
+    if not isinstance(result, dict) or "error" in result:
+        err = result.get("error") if isinstance(result, dict) else str(result)
+        return _compact(f"PagerDuty incident lookup failed: {str(err)[:120]}",
+                        {}, 0, [], UNKNOWN, result, has_errors=True)
+    inc = result.get("incident", result)  # tolerate both wrappers
+    status = inc.get("status", "unknown")
+    urgency = inc.get("urgency", "unknown")
+    title = str(inc.get("title", inc.get("summary", "incident")))[:50]
+    service = inc.get("service")
+    alerts = inc.get("alerts", result.get("alerts", []))
+    health = HEALTHY if status == "resolved" else (
+        CRITICAL if urgency == "high" else DEGRADED)
+    services = _find_services(result)
+    if isinstance(service, str) and service not in services:
+        services.append(service)
+    return _compact(
+        f'Incident "{title}": {status} ({urgency}). {len(alerts)} alert(s).',
+        {"id": inc.get("id"), "status": status, "urgency": urgency,
+         "service": service, "alert_count": len(alerts)},
+        1, services, health, result, has_errors=status != "resolved")
+
+
+def _summarize_pd_list(args: dict, result: Any) -> dict:
+    incidents = result.get("incidents", []) if isinstance(result, dict) else []
+    by = {"triggered": 0, "acknowledged": 0, "resolved": 0}
+    for inc in incidents:
+        if isinstance(inc, dict) and inc.get("status") in by:
+            by[inc["status"]] += 1
+    health = HEALTHY if by["triggered"] == 0 else (
+        CRITICAL if by["triggered"] > 2 else DEGRADED)
+    return _compact(
+        f"{len(incidents)} incident(s): {by['triggered']} triggered, "
+        f"{by['acknowledged']} acknowledged.",
+        {"total": len(incidents), **by},
+        len(incidents), _find_services(result), health, result,
+        has_errors=by["triggered"] > 0)
+
+
+def _monitor_state(m: dict) -> str:
+    """Monitor state across shapes: the real /v1/monitor API uses
+    ``overall_state``, the simulated tool ``status``."""
+    return str(m.get("overall_state") or m.get("status") or m.get("state") or "")
+
+
+def _summarize_datadog(args: dict, result: Any) -> dict:
+    action = args.get("action", "query")
+    # The real client returns the bare /v1/monitor list; simulated wraps it.
+    monitors = (result if isinstance(result, list) and action == "monitors"
+                else result.get("monitors") if isinstance(result, dict) else None)
+    if monitors is not None:
+        monitors = monitors or []
+        firing = [m for m in monitors if isinstance(m, dict)
+                  and _monitor_state(m).lower() in ("alert", "firing",
+                                                    "triggered", "warn")]
+        health = HEALTHY if not firing else (
+            CRITICAL if len(firing) > 2 else DEGRADED)
+        return _compact(
+            f"{len(firing)} triggered Datadog monitor(s) of {len(monitors)}.",
+            {"count": len(firing),
+             "monitors": [{"name": m.get("name"), "state": _monitor_state(m)}
+                          for m in monitors[:3] if isinstance(m, dict)]},
+            len(monitors), _find_services(result), health, result,
+            has_errors=bool(firing))
+    if isinstance(result, dict) and "series" in result:
+        series = result["series"]
+        n = len(series) if isinstance(series, (list, dict)) else 1
+        return _compact(f"Datadog metrics: {n} series.",
+                        {"series": list(series)[:5] if isinstance(series, dict)
+                         else n},
+                        n, _find_services(result),
+                        _health_from_signals(result), result)
+    if isinstance(result, dict) and "events" in result:
+        events = result["events"] or []
+        return _compact(f"Found {len(events)} Datadog event(s).",
+                        {"count": len(events)},
+                        len(events), _find_services(result),
+                        _health_from_signals(result), result)
+    return _summarize_default(f"datadog {action}", args, result)
+
+
+def _alert_name(a: dict) -> Any:
+    labels = a.get("labels", {}) if isinstance(a.get("labels"), dict) else {}
+    return a.get("name") or labels.get("alertname")
+
+
+def _alert_severity(a: dict) -> Any:
+    labels = a.get("labels", {}) if isinstance(a.get("labels"), dict) else {}
+    return labels.get("severity") or a.get("severity")
+
+
+def _summarize_prometheus(args: dict, result: Any) -> dict:
+    action = args.get("action", "query")
+    # The real client returns the API envelope {"status", "data": {...}};
+    # the simulated tool returns the inner dict directly.
+    data = result.get("data", result) if isinstance(result, dict) else {}
+    if isinstance(data, dict) and "alerts" in data:
+        alerts = data["alerts"] or []
+        firing = [a for a in alerts if isinstance(a, dict)
+                  and a.get("state", "firing") == "firing"]
+        health = HEALTHY if not firing else (
+            CRITICAL if len(firing) > 2 else DEGRADED)
+        return _compact(
+            f"{len(firing)} firing Prometheus alert(s).",
+            {"count": len(firing),
+             "alerts": [{"name": _alert_name(a), "severity": _alert_severity(a)}
+                        for a in firing[:3] if isinstance(a, dict)]},
+            len(alerts), _find_services(result), health, result,
+            has_errors=bool(firing))
+    targets = (data.get("activeTargets") or data.get("targets")
+               if isinstance(data, dict) else None)
+    if targets is not None:
+        unhealthy = [t for t in targets if isinstance(t, dict)
+                     and t.get("health") not in ("up", "healthy", None)]
+        health = HEALTHY if not unhealthy else (
+            CRITICAL if len(unhealthy) > len(targets) / 2 else DEGRADED)
+        return _compact(
+            f"Prometheus targets: {len(targets) - len(unhealthy)} healthy, "
+            f"{len(unhealthy)} unhealthy.",
+            {"healthy": len(targets) - len(unhealthy),
+             "unhealthy": len(unhealthy)},
+            len(targets), _find_services(result), health, result,
+            has_errors=bool(unhealthy))
+    return _summarize_default(f"prometheus {action}", args, result)
+
+
+def _summarize_kubernetes(args: dict, result: Any) -> dict:
+    action = args.get("action", "status")
+    if not isinstance(result, dict) or "error" in result:
+        err = result.get("error") if isinstance(result, dict) else str(result)
+        return _compact(f"kubernetes_query failed: {str(err)[:120]}", {},
+                        0, [], UNKNOWN, result, has_errors=True)
+    if "pods" in result:
+        pods = result["pods"] or []
+        bad = [p for p in pods if isinstance(p, dict) and p.get("status")
+               not in ("Running", "Succeeded", "Completed", None)]
+        restarts = sum(int(p.get("restarts", 0)) for p in pods
+                       if isinstance(p, dict))
+        health = HEALTHY if not bad else (
+            CRITICAL if len(bad) > 2 else DEGRADED)
+        return _compact(
+            f"{len(pods)} pod(s); {len(bad)} not Running; "
+            f"{restarts} restart(s) total.",
+            {"pods": len(pods), "not_running": len(bad), "restarts": restarts,
+             "bad": [{"name": p.get("name"), "status": p.get("status")}
+                     for p in bad[:3]]},
+            len(pods), _find_services(result), health, result,
+            has_errors=bool(bad))
+    if "nodes" in result:
+        nodes = result["nodes"] or []
+        not_ready = [n for n in nodes if isinstance(n, dict)
+                     and n.get("status") != "Ready"]
+        health = HEALTHY if not not_ready else CRITICAL
+        return _compact(
+            f"{len(nodes)} node(s); {len(not_ready)} not Ready.",
+            {"nodes": len(nodes), "not_ready": len(not_ready)},
+            len(nodes), _find_services(result), health, result,
+            has_errors=bool(not_ready))
+    key = next((k for k in result if isinstance(result[k], list)), None)
+    items = result.get(key, []) if key else []
+    return _compact(f"kubernetes {action}: {len(items)} {key or 'item'}(s).",
+                    {key or "items": len(items)},
+                    len(items), _find_services(result),
+                    _health_from_signals(result), result)
+
+
+def _summarize_knowledge(args: dict, result: Any) -> dict:
+    hits = result.get("results", []) if isinstance(result, dict) else []
+    by_type: dict[str, int] = {}
+    titles = []
+    for h in hits:
+        if isinstance(h, dict):
+            by_type[h.get("type", "doc")] = by_type.get(h.get("type", "doc"), 0) + 1
+            if h.get("type") == "runbook" and len(titles) < 2:
+                titles.append(h.get("title"))
+    type_bits = ", ".join(f"{n} {t}(s)" for t, n in sorted(by_type.items()))
+    return _compact(
+        f"Found {len(hits)} doc(s)" + (f": {type_bits}." if type_bits else "."),
+        {"runbooks": titles, **by_type},
+        len(hits), _find_services(result), UNKNOWN, result, has_errors=False)
+
+
+def _summarize_default(tool: str, args: dict, result: Any) -> dict:
+    items = _count_items(result)
+    services = _find_services(result)
+    health = _health_from_signals(result)
+    if isinstance(result, dict):
+        keys = ", ".join(list(result)[:5])
+        summary = f"{tool}: {items} item(s). Keys: {keys}"
+    elif isinstance(result, list):
+        summary = f"{tool}: {items} item(s)."
+    else:
+        s = str(result)
+        summary = f"{tool}: {s[:200]}{'...' if len(s) > 200 else ''}"
+    return _compact(summary, {"errors": _text_highlights(result)},
+                    items, services, health, result)
+
+
+_SUMMARIZERS: dict[str, Callable[[dict, Any], dict]] = {
+    "aws_query": _summarize_aws_query,
+    "cloudwatch_alarms": _summarize_cloudwatch_alarms,
+    "cloudwatch_logs": _summarize_cloudwatch_logs,
+    "pagerduty_get_incident": _summarize_pd_incident,
+    "pagerduty_list_incidents": _summarize_pd_list,
+    "datadog": _summarize_datadog,
+    "prometheus": _summarize_prometheus,
+    "kubernetes_query": _summarize_kubernetes,
+    "search_knowledge": _summarize_knowledge,
+}
+
+
+def summarize_tool_result(tool: str, args: dict[str, Any], result: Any) -> dict[str, Any]:
+    """Build the compact representation stored in the scratchpad tier
+    (per-tool registry dispatch, reference tool-summarizer.ts:758-763)."""
+    fn = _SUMMARIZERS.get(tool)
+    if fn is not None:
+        return fn(args or {}, result)
+    return _summarize_default(tool, args or {}, result)

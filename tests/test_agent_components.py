@@ -171,21 +171,230 @@ def test_summarizer_detects_errors_and_services():
     compact = summarize_tool_result("cloudwatch_alarms", {}, result)
     assert compact["item_count"] == 2
     assert "payment-api" in compact["services"]
-    assert compact["health_status"] in ("degraded", "unhealthy")
-    assert compact["summary"].startswith("cloudwatch_alarms")
+    assert compact["health_status"] == "degraded"  # 1 alarming, <=2
+    assert compact["summary"] == "2 alarm(s). 1 in ALARM state. Top: x."
+    assert compact["highlights"] == {"total": 2, "alarming": 1,
+                                     "alarm_names": ["x"]}
+    assert compact["has_errors"] is True
+
+
+def test_summarizer_aws_query_fields():
+    """aws_query compact format field-by-field (tool-summarizer.ts:190)."""
+    result = {
+        "ecs": {"service": "ecs", "category": "compute", "count": 2,
+                "resources": [{"service": "payment-api", "status": "ACTIVE"},
+                              {"service": "checkout-web", "status": "ACTIVE"}]},
+        "lambda": {"service": "lambda", "category": "compute", "count": 1,
+                   "resources": [{"functionName": "webhook-fn"}]},
+        "rds": {"error": "AccessDenied: not authorized"},
+    }
+    compact = summarize_tool_result("aws_query", {"service": "all"}, result)
+    assert compact["item_count"] == 3
+    assert compact["summary"].startswith("Queried 3 AWS service(s), found 3 resource(s).")
+    assert "Notable:" in compact["summary"] and "1 error(s)" in compact["summary"]
+    assert compact["highlights"]["ecs"]["count"] == 2
+    assert compact["highlights"]["ecs"]["notable"] == ["payment-api", "checkout-web"]
+    assert compact["highlights"]["lambda"]["notable"] == ["webhook-fn"]
+    assert "AccessDenied" in compact["highlights"]["rds"]["error"]
+    assert compact["has_errors"] is True
+    assert "payment-api" in compact["services"]
+
+
+def test_summarizer_cloudwatch_logs_fields():
+    result = {"log_group": "/aws/lambda/payments",
+              "events": [{"message": "ERROR timeout connecting to db"},
+                         {"message": "request ok"},
+                         {"message": "Exception in handler"}]}
+    compact = summarize_tool_result(
+        "cloudwatch_logs",
+        {"log_group": "/aws/lambda/payments", "filter_pattern": "ERROR"},
+        result)
+    assert compact["item_count"] == 3
+    assert compact["highlights"]["count"] == 3
+    assert compact["highlights"]["error_count"] == 2
+    assert compact["highlights"]["samples"][0].startswith("ERROR timeout")
+    assert compact["health_status"] == "degraded"
+    assert "/aws/lambda/payments" in compact["summary"]
+    assert '"ERROR"' in compact["summary"]
+
+
+def test_summarizer_datadog_monitors_and_k8s_pods():
+    dd = summarize_tool_result("datadog", {"action": "monitors"}, {
+        "monitors": [{"name": "HighLatencyP99", "state": "firing"},
+                     {"name": "ErrorRate", "state": "OK"}]})
+    assert dd["item_count"] == 2
+    assert dd["highlights"]["count"] == 1  # one firing
+    assert dd["highlights"]["monitors"][0] == {"name": "HighLatencyP99",
+                                               "state": "firing"}
+    assert dd["health_status"] == "degraded"
+
+    k8s = summarize_tool_result("kubernetes_query", {"action": "pods"}, {
+        "pods": [{"name": "api-1", "status": "Running", "restarts": 0},
+                 {"name": "api-2", "status": "CrashLoopBackOff", "restarts": 7}]})
+    assert k8s["item_count"] == 2
+    assert k8s["highlights"]["not_running"] == 1
+    assert k8s["highlights"]["restarts"] == 7
+    assert k8s["highlights"]["bad"] == [{"name": "api-2",
+                                         "status": "CrashLoopBackOff"}]
+    assert k8s["health_status"] == "degraded"
+    assert k8s["has_errors"] is True
+
+
+def test_summarizer_pagerduty_and_prometheus_and_knowledge():
+    pd = summarize_tool_result("pagerduty_list_incidents", {}, {
+        "incidents": [{"status": "triggered"}, {"status": "acknowledged"},
+                      {"status": "resolved"}]})
+    assert pd["highlights"] == {"total": 3, "triggered": 1,
+                               "acknowledged": 1, "resolved": 1}
+    assert pd["health_status"] == "degraded"
+
+    prom = summarize_tool_result("prometheus", {"action": "alerts"}, {
+        "alerts": [{"name": "HighLatencyP99", "state": "firing",
+                    "severity": "page"}]})
+    assert prom["summary"] == "1 firing Prometheus alert(s)."
+    assert prom["highlights"]["alerts"] == [{"name": "HighLatencyP99",
+                                             "severity": "page"}]
+
+    kb = summarize_tool_result("search_knowledge", {"query": "latency"}, {
+        "results": [{"title": "Payment latency runbook", "type": "runbook"},
+                    {"title": "Feb outage", "type": "postmortem"}]})
+    assert kb["item_count"] == 2
+    assert kb["highlights"]["runbooks"] == ["Payment latency runbook"]
+    assert kb["highlights"]["runbook"] == 1 and kb["highlights"]["postmortem"] == 1
+    assert kb["has_errors"] is False
+
+
+def test_summarizer_real_tool_shapes():
+    """The summarizers must read the ACTUAL tool payloads, not idealized
+    ones: simulated datadog uses status='Alert', the real monitor API is a
+    bare list with overall_state, and prometheus wraps in {status, data}."""
+    sim_dd = summarize_tool_result("datadog", {"action": "monitors"}, {
+        "monitors": [{"name": "payment-api p99 latency", "status": "Alert",
+                      "query": "avg(last_5m):..."}]})
+    assert sim_dd["highlights"]["count"] == 1
+    assert sim_dd["health_status"] == "degraded"
+    assert sim_dd["has_errors"] is True
+
+    real_dd = summarize_tool_result("datadog", {"action": "monitors"}, [
+        {"name": "cpu", "overall_state": "OK"},
+        {"name": "err-rate", "overall_state": "Alert"}])
+    assert real_dd["highlights"]["count"] == 1
+    assert real_dd["highlights"]["monitors"][1]["state"] == "Alert"
+
+    real_prom = summarize_tool_result("prometheus", {"action": "alerts"}, {
+        "status": "success",
+        "data": {"alerts": [{"state": "firing",
+                             "labels": {"alertname": "HighLatencyP99",
+                                        "severity": "page"}}]}})
+    assert real_prom["summary"] == "1 firing Prometheus alert(s)."
+    assert real_prom["highlights"]["alerts"] == [
+        {"name": "HighLatencyP99", "severity": "page"}]
+
+    real_targets = summarize_tool_result("prometheus", {"action": "targets"}, {
+        "status": "success",
+        "data": {"activeTargets": [{"health": "up"}, {"health": "down"}]}})
+    assert real_targets["highlights"] == {"healthy": 1, "unhealthy": 1}
+    assert real_targets["health_status"] == "degraded"  # 1 of 2, not majority
 
 
 def test_compactor_plan_tiers(tmp_path):
     pad = Scratchpad(session_id="c", root=tmp_path)
     for i in range(8):
-        payload = {"data": "error timeout" if i == 0 else "fine", "i": i}
+        payload = {"data": "error timeout critical alarm" if i == 0 else "fine",
+                   "i": i}
         pad.append_tool_result(ToolCall.new("t", {"i": i}), result=payload)
-    compactor = ContextCompactor("incident")  # keep_full=4, keep_compact=8
+    compactor = ContextCompactor("incident")
     plan = compactor.plan(pad, query="timeout")
     assert set(plan) == set(pad.list_result_ids())
-    assert list(plan.values()).count("full") == 4
-    # the old-but-error-laden result survives at full tier despite age
-    assert plan["r1"] == "full"
+    # The old-but-error-laden result survives despite age (error_signals
+    # 1.0 x 0.3 + query match 0.15 = 0.45 -> compact); signal-free old
+    # results fall below min_score_to_keep and clear.
+    assert plan["r1"] == "compact"
+    assert plan["r2"] == "cleared"
+    # A result with error + query + service signals crosses the full bar.
+    pad.append_tool_result(
+        ToolCall.new("cloudwatch_logs", {"service": "payment-api"}),
+        result={"events": [{"message": "timeout error critical alarm"}]})
+    plan = compactor.plan(pad, query="payment-api timeout",
+                          memory=type("M", (), {
+                              "services": ["payment-api"], "symptoms": [],
+                              "findings": []})())
+    assert plan["r9"] == "full"
+
+
+def test_compactor_components_and_presets(tmp_path):
+    """Preset differentiation + hypothesis/service/cited components
+    (context-compactor.ts:106-365, presets :598)."""
+    from runbookai_tpu.agent.context_compactor import PRESETS, create_compactor
+
+    # Preset weights differ semantically: incident leans on errors,
+    # research on query relevance.
+    assert PRESETS["incident"].weights.error_signals > PRESETS["research"].weights.error_signals
+    assert PRESETS["research"].weights.query_relevance > PRESETS["incident"].weights.query_relevance
+    assert PRESETS["incident"].max_full_results > PRESETS["research"].max_full_results
+
+    pad = Scratchpad(session_id="c2", root=tmp_path)
+    logs_result = {"events": [{"message": "connection pool exhausted timeout",
+                               "service": "payment-api"}]}
+    pad.append_tool_result(
+        ToolCall.new("cloudwatch_logs", {"service": "payment-api"}),
+        result=logs_result,
+        compact=summarize_tool_result("cloudwatch_logs",
+                                      {"service": "payment-api"}, logs_result))
+    pad.append_tool_result(
+        ToolCall.new("aws_query", {"service": "s3"}),
+        result={"buckets": ["assets"]})
+    entry = pad.results["r1"]
+
+    comp = create_compactor("incident")
+
+    class Mem:
+        services = ["payment-api"]
+        symptoms = ["connection pool exhausted"]
+        findings = ["FINDING: pool exhaustion in payment-api"]
+
+    scored = comp.score(
+        entry, rank_from_newest=1, query="why is payment slow", total=2,
+        hypotheses=["payment-api connection pool exhausted under load"],
+        services=Mem.services, symptoms=Mem.symptoms, findings=Mem.findings)
+    assert scored.components["hypothesis_relevance"] == 1.0
+    assert scored.components["service_relevance"] == 1.0
+    assert scored.components["error_signals"] >= 0.6
+    # the unrelated s3 result scores lower on every non-recency component
+    other = comp.score(pad.results["r2"], rank_from_newest=0,
+                       query="why is payment slow", total=2,
+                       hypotheses=["payment-api connection pool exhausted"],
+                       services=Mem.services, symptoms=Mem.symptoms)
+    assert scored.score > other.score
+    # cited_ids wins outright
+    cited = comp.score(entry, rank_from_newest=1, query="", total=2,
+                       cited_ids={"r1"})
+    assert cited.components["cited_in_notes"] == 1.0
+    # findings citing r12 must NOT credit r1 (word-boundary id match)
+    not_cited = comp.score(entry, rank_from_newest=1, query="", total=2,
+                           findings=["evidence in r12 shows pool exhaustion"])
+    assert not_cited.components["cited_in_notes"] == 0.0
+    cited2 = comp.score(entry, rank_from_newest=1, query="", total=2,
+                        findings=["evidence in r1 shows pool exhaustion"])
+    assert cited2.components["cited_in_notes"] == 1.0
+    # explain_score renders every component
+    text = comp.explain_score(scored)
+    assert "hypothesis_relevance" in text and "Total Score" in text
+
+
+def test_compactor_tokens_saved_and_plan_with_memory(tmp_path):
+    from runbookai_tpu.agent.context_compactor import create_compactor
+
+    pad = Scratchpad(session_id="c3", root=tmp_path)
+    for i in range(30):
+        pad.append_tool_result(ToolCall.new("t", {"i": i}),
+                               result={"data": f"row {i}"})
+    comp = create_compactor("research", max_compact_results=5)
+    plan = comp.plan(pad, query="unrelated words entirely")
+    tiers = list(plan.values())
+    assert tiers.count("compact") <= 5
+    assert "cleared" in tiers  # low-score tail is dropped
+    assert comp.estimated_tokens_saved(plan) > 0
 
 
 # ---------------------------------------------------------------------------
