@@ -36,7 +36,10 @@ class GeneratedHypothesis(BaseModel):
 
 
 class HypothesisGeneration(BaseModel):
-    hypotheses: list[GeneratedHypothesis] = Field(default_factory=list)
+    # min_length=1 reaches the guided-decoding grammar: the prompt demands
+    # 3-5 hypotheses, so an empty array is never a valid generation.
+    hypotheses: list[GeneratedHypothesis] = Field(default_factory=list,
+                                                 min_length=1)
 
 
 class EvidenceEvaluation(BaseModel):

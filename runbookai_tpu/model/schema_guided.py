@@ -113,8 +113,15 @@ def compile_model(model: type[BaseModel]) -> SObject:
 def _compile_object(model: type[BaseModel], uid: _Uid) -> SObject:
     fields = []
     for name, info in model.model_fields.items():
-        fields.append((b'"' + name.encode() + b'"',
-                       _compile_annotation(info.annotation, uid)))
+        node = _compile_annotation(info.annotation, uid)
+        # Honor pydantic list-length constraints (Field(min_length=N)) so
+        # e.g. "generate 3-5 hypotheses" can forbid an empty array at the
+        # grammar level, not just in post-hoc validation.
+        min_len = next((m.min_length for m in info.metadata
+                        if hasattr(m, "min_length")), None)
+        if min_len and isinstance(node, SArray):
+            node = dataclasses.replace(node, min_items=min_len)
+        fields.append((b'"' + name.encode() + b'"', node))
     return SObject(uid(), tuple(fields))
 
 

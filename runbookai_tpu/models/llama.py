@@ -83,8 +83,10 @@ CONFIGS: dict[str, LlamaConfig] = {
     ),
     "llama3-test": LlamaConfig(
         # Tiny config for CPU tests; vocab matches the byte tokenizer (262).
+        # max_seq_len covers real agent/orchestrator prompts (byte tokenizer:
+        # 1 token per byte), so live-eval e2e runs fit without truncation.
         name="llama3-test", vocab_size=262, dim=64, n_layers=2, n_heads=4,
-        n_kv_heads=2, ffn_dim=128, max_seq_len=512, rope_theta=10_000.0,
+        n_kv_heads=2, ffn_dim=128, max_seq_len=8192, rope_theta=10_000.0,
     ),
 }
 
