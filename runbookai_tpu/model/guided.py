@@ -89,6 +89,12 @@ class JsonMachine:
         self.hex_rem = 0  # remaining \uXXXX hex digits
 
     @property
+    def in_string(self) -> bool:
+        """Inside string content (where whitespace is content, not
+        padding) — the mask provider's ws-suppression consults this."""
+        return self.mode in (_STRING, _STR_ESC)
+
+    @property
     def is_complete(self) -> bool:
         """True when the bytes so far form a complete JSON document. A
         top-level number qualifies once its DFA state is terminal (numbers
@@ -329,6 +335,14 @@ class JsonMachine:
         return True
 
 
+def _in_string(machine) -> bool:
+    """True when the automaton is inside string content (where whitespace
+    tokens are real content, not structural padding). Both machine families
+    expose ``in_string`` as part of their duck-typed contract — the logic
+    lives with the frames, not here."""
+    return bool(getattr(machine, "in_string", False))
+
+
 class JsonMaskProvider:
     """Builds per-step allowed-token masks for an engine + tokenizer pair.
 
@@ -347,6 +361,8 @@ class JsonMaskProvider:
         self._token_bytes: Optional[list[bytes]] = None
         self._longest_token = 0  # set alongside _token_bytes
         self._cache: dict[tuple, np.ndarray] = {}
+        self._vector: Optional[object] = None  # lazy VectorJsonMasker
+        self._by_first: Optional[list[np.ndarray]] = None  # token ids per first byte
         # Control tokens are never content: their byte expansion is markup
         # ("<|eot_id|>") that would otherwise be admissible inside a string.
         self._special = frozenset(
@@ -397,13 +413,47 @@ class JsonMaskProvider:
         if cached is not None:
             return cached
         table = self._bytes_table()
-        out = np.zeros(self.tokenizer.vocab_size, dtype=bool)
-        for tid, bts in enumerate(table):
-            if not bts or tid in self._special:
-                continue
-            probe = machine.copy()
-            if probe.advance_bytes(bts):
-                out[tid] = True
+        if type(machine) is JsonMachine:
+            # Generic JSON: vectorized full-vocab sweep (guided_mask.py) —
+            # ~max_token_len numpy passes instead of ~vocab Python replays.
+            if self._vector is None:
+                from runbookai_tpu.model.guided_mask import VectorJsonMasker
+
+                self._vector = VectorJsonMasker(table)
+            out = self._vector.mask(machine)
+            for tid in self._special:
+                out[tid] = False
+        else:
+            # Schema machines keep the scalar prober, pre-filtered by
+            # admissible first byte: forced-key/enum states admit a
+            # handful of first bytes, so 256 one-byte probes eliminate
+            # most of the vocab before any full replay.
+            out = np.zeros(self.tokenizer.vocab_size, dtype=bool)
+            first_ok = np.zeros(256, dtype=bool)
+            for b in range(256):
+                if machine.copy().advance(b):
+                    first_ok[b] = True
+            for tid in self._first_byte_groups(first_ok):
+                bts = table[tid]
+                if tid in self._special:
+                    continue
+                probe = machine.copy()
+                if probe.advance_bytes(bts):
+                    out[tid] = True
+        # Steering tightening: in structural positions, suppress tokens that
+        # are *pure whitespace* (kept only if nothing else is admissible).
+        # JSON allows unlimited inter-token whitespace, so a greedy model
+        # whose argmax is "\t" pads forever and the document never completes
+        # within its token budget; banning ws-only tokens outside strings
+        # keeps every admitted token making progress. String *content*
+        # whitespace is untouched (mixed tokens like ",\n" stay admissible).
+        if not _in_string(machine):
+            ws = self._ws_only_ids()
+            if ws.size and out[ws].any():
+                trimmed = out.copy()
+                trimmed[ws] = False
+                if trimmed.any():
+                    out = trimmed
         # Once the JSON value is complete, the stop token ends generation.
         if machine.is_complete:
             out[self.tokenizer.eot_id] = True
@@ -413,6 +463,30 @@ class JsonMaskProvider:
             out[self.tokenizer.eot_id] = True
         self._cache[sig] = out
         return out
+
+    def _ws_only_ids(self) -> np.ndarray:
+        """Token ids whose byte expansion is entirely JSON whitespace."""
+        ids = getattr(self, "_ws_ids", None)
+        if ids is None:
+            table = self._bytes_table()
+            ids = np.array(
+                [tid for tid, bts in enumerate(table)
+                 if bts and all(b in _WS for b in bts)], dtype=np.int64)
+            self._ws_ids = ids
+        return ids
+
+    def _first_byte_groups(self, first_ok: np.ndarray):
+        """Token ids whose first byte is admissible, per precomputed
+        first-byte buckets (built once per provider)."""
+        if self._by_first is None:
+            table = self._bytes_table()
+            buckets: list[list[int]] = [[] for _ in range(256)]
+            for tid, bts in enumerate(table):
+                if bts:
+                    buckets[bts[0]].append(tid)
+            self._by_first = [np.array(b, dtype=np.int64) for b in buckets]
+        for b in np.nonzero(first_ok)[0]:
+            yield from self._by_first[int(b)].tolist()
 
     def advance(self, req, token: int) -> bool:
         """Feed a sampled token; True when the grammar is complete (stop)."""

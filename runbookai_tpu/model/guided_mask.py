@@ -1,0 +1,387 @@
+"""Vectorized token-mask construction for the generic JSON automaton.
+
+The scalar path in :class:`~runbookai_tpu.model.guided.JsonMaskProvider`
+copies the byte machine once per token and replays the token's bytes —
+O(vocab x token_len) Python work per novel automaton state. Fine at the
+262-token test vocab; Llama-3's 128,256-token vocab makes a first-miss
+mask build cost hundreds of milliseconds. This module advances **all
+tokens at once**, one byte position per sweep, over length-sorted token
+arrays — a full-vocab mask is ~max_token_len small numpy sweeps instead
+of ~vocab Python replays.
+
+The automaton is factored into:
+
+- a **finite packed state** per token: (stack-free substate) x
+  (top-of-stack symbol class) x (depth class: empty / mid / at-max).
+  There are ~37 substates (string content, each UTF-8 continuation
+  window, escape/hex progress, the number DFA, literal progress, the
+  six structural modes), giving a few hundred packed states.
+- a **transition table** ``TABLE[state, byte] -> (action, payload)``
+  built by probing the scalar :class:`JsonMachine` itself — one probe
+  per (state, byte), cached per process — so the table *cannot drift*
+  from the scalar semantics it accelerates. Bytes that neither push nor
+  pop resolve entirely in the table (including the scalar machine's
+  internal re-dispatches: a number terminated by ``,`` lands directly
+  in the right AFTER-mode successor). Pushes and pops are the only
+  runtime fixups: small subset updates against a per-token shadow
+  stack.
+- the **pushdown stack**: the machine's starting stack (shared by all
+  tokens) shadowed by per-token absolute-depth arrays ``own``/``ownv``.
+  A push writes the symbol at its absolute depth; a pop re-reads the
+  symbol below from the token's shadow where written, else the shared
+  stack. Stack height moves by ±1, so any return to depth *d* passes
+  through a push at *d* — shadow entries are never stale.
+
+Each sweep is then: one table gather, a masked state assignment, a dead
+update, and subset push/pop fixups. Dead tokens are compacted away when
+they outnumber the living, so restrictive states (e.g. expecting ``:``)
+collapse the active set after the first byte.
+
+Exactness is the contract: any divergence from the scalar machine would
+steer sampling toward bytes the engine later rejects. The differential
+test (``tests/test_guided_vectorized.py``) compares full masks against
+the scalar prober across states drawn from real JSON prefixes.
+
+No reference counterpart (the reference parses model output post-hoc:
+``src/agent/llm-parser.ts:215``); this is serving-side machinery.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+from runbookai_tpu.model.guided import JsonMachine
+
+# Mode encodings — identical values to guided.py's module constants.
+_VALUE, _STRING, _STR_ESC, _NUMBER, _LITERAL = 0, 1, 2, 3, 4
+_AFTER, _OBJ_KEY, _OBJ_COLON, _OBJ_KEY_REQ, _ARR_FIRST = 5, 6, 7, 8, 9
+
+# stack symbol classes: 0 empty/none, 1 = '{', 2 = '[', 3 = key marker
+_SYM_CLASS = {0x7B: 1, 0x5B: 2, -1: 3}
+_CLASS_SYM = {1: 0x7B, 2: 0x5B, 3: -1}
+
+# depth classes
+_D_EMPTY, _D_MID, _D_MAX = 0, 1, 2
+
+# actions
+_A_NONE, _A_DIE, _A_PUSH, _A_POP = 0, 1, 2, 3
+
+
+def _substate_key(m: JsonMachine) -> tuple:
+    """Canonical stack-free substate of a scalar machine (mode-relevant
+    fields only; stale fields from earlier modes are normalized away)."""
+    if m.mode == _STRING:
+        if m.u8_need:
+            return ("str_u8", m.u8_need, m.u8_lo, m.u8_hi)
+        return ("str",)
+    if m.mode == _STR_ESC:
+        return ("esc", m.hex_rem)
+    if m.mode == _NUMBER:
+        return ("num", m.num_state)
+    if m.mode == _LITERAL:
+        return ("lit", bytes(m.literal), m.lit_pos)
+    return ("mode", m.mode)
+
+
+def _apply_substate(m: JsonMachine, key: tuple) -> None:
+    """Materialize a substate key onto a scalar machine (inverse of
+    :func:`_substate_key`)."""
+    kind = key[0]
+    if kind == "str":
+        m.mode = _STRING
+    elif kind == "str_u8":
+        m.mode = _STRING
+        m.u8_need, m.u8_lo, m.u8_hi = key[1], key[2], key[3]
+    elif kind == "esc":
+        m.mode = _STR_ESC
+        m.hex_rem = key[1]
+    elif kind == "num":
+        m.mode = _NUMBER
+        m.num_state = key[1]
+    elif kind == "lit":
+        m.mode = _LITERAL
+        m.literal, m.lit_pos = key[1], key[2]
+    else:
+        m.mode = key[1]
+
+
+def _enumerate_substates() -> list[tuple]:
+    subs: list[tuple] = [("str",)]
+    for need, lo, hi in ((1, 0x80, 0xBF), (2, 0xA0, 0xBF), (2, 0x80, 0x9F),
+                         (2, 0x80, 0xBF), (3, 0x90, 0xBF), (3, 0x80, 0x8F),
+                         (3, 0x80, 0xBF)):
+        subs.append(("str_u8", need, lo, hi))
+    for hx in (0, 1, 2, 3, 4):
+        subs.append(("esc", hx))
+    for ns in ("neg", "zero", "int", "frac0", "frac", "exp0", "exp1", "exp"):
+        subs.append(("num", ns))
+    for lit in (b"true", b"false", b"null"):
+        for pos in range(1, len(lit)):
+            subs.append(("lit", lit, pos))
+    for mode in (_VALUE, _AFTER, _OBJ_KEY, _OBJ_COLON, _OBJ_KEY_REQ,
+                 _ARR_FIRST):
+        subs.append(("mode", mode))
+    return subs
+
+
+_PROBE_MAX_DEPTH = 8  # depth classes make the actual limit irrelevant
+
+
+def _probe_machine(sub: tuple, top: int, depth: int) -> JsonMachine:
+    m = JsonMachine(max_depth=_PROBE_MAX_DEPTH)
+    if depth == _D_EMPTY:
+        stack: list[int] = []
+    else:
+        n = 2 if depth == _D_MID else _PROBE_MAX_DEPTH
+        # filler below the top never influences a single-byte transition's
+        # substate (pops are repacked from runtime-gathered tops)
+        stack = [0x5B] * (n - 1) + [_CLASS_SYM[top]]
+    m.stack = stack
+    _apply_substate(m, sub)
+    return m
+
+
+@lru_cache(maxsize=1)
+def _build_tables():
+    """(TABLE [n_packed*256] uint32, REPACK [n_sub,4,3] uint16, sub_index,
+    packed index helpers). TABLE entry = action<<24 | sym<<16 | payload
+    where payload is a packed state (NONE) or substate id (PUSH/POP)."""
+    subs = _enumerate_substates()
+    sub_id = {s: i for i, s in enumerate(subs)}
+    n_sub = len(subs)
+
+    # packed id = (sub * 4 + top) * 3 + depth; not all combos are
+    # reachable (top=0 ⇔ depth=empty) but the space is tiny.
+    def pack(si: int, top: int, depth: int) -> int:
+        return (si * 4 + top) * 3 + depth
+
+    n_packed = n_sub * 4 * 3
+    table = np.zeros(n_packed * 256, dtype=np.uint32)
+    repack = np.zeros((n_sub, 4, 3), dtype=np.uint16)
+    for si in range(n_sub):
+        for top in range(4):
+            for depth in range(3):
+                repack[si, top, depth] = pack(si, top, depth)
+
+    for si, sub in enumerate(subs):
+        for top in range(4):
+            for depth in range(3):
+                if (top == 0) != (depth == _D_EMPTY):
+                    continue  # unreachable combo
+                ps = pack(si, top, depth)
+                for b in range(256):
+                    m = _probe_machine(sub, top, depth)
+                    before = len(m.stack)
+                    try:
+                        ok = m.advance(b)
+                    except IndexError:
+                        # combo unreachable in practice (e.g. OBJ_KEY with
+                        # an empty stack): the scalar machine's invariants
+                        # don't hold there — mark dead
+                        ok = False
+                    if not ok:
+                        table[ps * 256 + b] = _A_DIE << 24
+                        continue
+                    after = len(m.stack)
+                    nsub = sub_id.get(_substate_key(m))
+                    if nsub is None:
+                        raise AssertionError(
+                            f"substate closure violated: {_substate_key(m)} "
+                            f"from {sub} byte {b}")
+                    if after == before:
+                        code = (_A_NONE << 24) | (pack(nsub, top, depth) << 8)
+                    elif after == before + 1:
+                        sym = _SYM_CLASS[m.stack[-1]]
+                        code = (_A_PUSH << 24) | (sym << 16) | nsub
+                    else:
+                        code = (_A_POP << 24) | nsub
+                    table[ps * 256 + b] = code
+    return table, repack, sub_id
+
+
+def packed_state_of(machine: JsonMachine, sub_id: dict) -> int:
+    si = sub_id[_substate_key(machine)]
+    depth = len(machine.stack)
+    top = _SYM_CLASS[machine.stack[-1]] if depth else 0
+    dclass = (_D_EMPTY if depth == 0
+              else _D_MAX if depth >= machine.max_depth else _D_MID)
+    return (si * 4 + top) * 3 + dclass
+
+
+class VectorJsonMasker:
+    """Full-vocab admissibility masks for :class:`JsonMachine` states.
+
+    Precomputes the vocab's token bytes as padded arrays sorted by length
+    (descending), so each byte sweep touches only the prefix of tokens
+    that still have bytes — total element work is ~sum(token lengths),
+    not vocab x max_len.
+    """
+
+    def __init__(self, token_bytes: list[bytes]):
+        self.vocab_size = len(token_bytes)
+        lens = np.array([len(t) for t in token_bytes], dtype=np.int32)
+        order = np.argsort(-lens, kind="stable")
+        self.order = order.astype(np.int64)
+        self.lens = lens[order]
+        self.max_len = int(self.lens[0]) if self.vocab_size else 0
+        buf = np.zeros((max(self.max_len, 1), self.vocab_size), dtype=np.uint32)
+        for row, tid in enumerate(order):
+            t = token_bytes[tid]
+            if t:
+                buf[: len(t), row] = np.frombuffer(t, dtype=np.uint8)
+        self.tok = buf  # [max_len, vocab]: each sweep reads a contiguous row
+        self.table, self.repack, self.sub_id = _build_tables()
+
+    # ------------------------------------------------------------------ mask
+
+    def mask(self, machine: JsonMachine) -> np.ndarray:
+        """Boolean [vocab] array: token admissible from ``machine``'s state.
+
+        Empty tokens are inadmissible (the provider also excludes special
+        ids). The machine is not mutated.
+        """
+        n = self.vocab_size
+        out = np.zeros(n, dtype=bool)
+        if n == 0 or machine.dead:
+            return out
+
+        st = _State(self, machine)
+        for p in range(self.max_len):
+            if not st.begin_sweep(p):
+                break
+            st.sweep(p)
+            st.maybe_compact(p)
+
+        rows = st.rowid[st.alive & (st.lens > 0)]
+        out[self.order[rows]] = True
+        return out
+
+
+class _State:
+    """Compactable per-token packed-automaton state + the one-byte sweep."""
+
+    def __init__(self, masker: VectorJsonMasker, machine: JsonMachine):
+        n = masker.vocab_size
+        self.table = masker.table
+        self.repack = masker.repack
+        self.tok = masker.tok
+        self.lens = masker.lens
+        self.rowid = np.arange(n, dtype=np.int64)
+
+        ps0 = packed_state_of(machine, masker.sub_id)
+        self.ps = np.full(n, ps0 << 8, dtype=np.uint32)  # pre-shifted
+        self.alive = np.ones(n, dtype=bool)
+        self._scratch = np.empty(n, dtype=np.uint32)
+        self.compacted = False
+        self._recount(masker.max_len)
+
+        self.start_len = len(machine.stack)
+        self.max_depth = machine.max_depth
+        # start_top[d] = shared-stack symbol class at absolute depth d
+        self.start_top = np.zeros(self.start_len + 1, dtype=np.int8)
+        for d, s in enumerate(machine.stack):
+            self.start_top[d + 1] = _SYM_CLASS[s]
+        self.rel = np.zeros(n, dtype=np.int32)  # signed height delta
+        # shadow stack: 2 bits per absolute depth (1-based), packed into
+        # one uint64 per token — symbol 0 means "not written here", which
+        # defers to the shared starting stack. JsonMachine's depth cap is
+        # 32, which is exactly what 64 bits hold.
+        if self.max_depth > 32:
+            raise ValueError("packed shadow stack supports max_depth <= 32")
+        self.own = np.zeros(n, dtype=np.uint64)
+
+        self.na = 0
+
+    # -------------------------------------------------------------- helpers
+
+    def _recount(self, max_len: int) -> None:
+        # na_at[p] = #tokens with len > p, one vectorized binary search
+        self.na_at = np.searchsorted(
+            -self.lens, -np.arange(1, max_len + 1), side="right")
+
+    def begin_sweep(self, p: int) -> bool:
+        self.na = int(self.na_at[p]) if p < len(self.na_at) else 0
+        return self.na > 0 and bool(self.alive[: self.na].any())
+
+    def _depth_class(self, d: np.ndarray) -> np.ndarray:
+        return np.where(d <= 0, _D_EMPTY,
+                        np.where(d >= self.max_depth, _D_MAX, _D_MID))
+
+    def maybe_compact(self, p: int) -> None:
+        """Drop dead rows once they dominate, so later sweeps run over
+        survivors only. Boolean-mask compaction preserves the
+        length-descending order, keeping the active-prefix rule."""
+        na = self.na
+        n_keep = int(np.count_nonzero(self.alive[:na])) + (len(self.lens) - na)
+        if n_keep * 2 > len(self.lens):
+            return
+        keep = np.ones(len(self.lens), dtype=bool)
+        keep[:na] = self.alive[:na]
+        for name in ("ps", "rel", "alive", "lens", "own", "rowid"):
+            setattr(self, name, getattr(self, name)[keep])
+        self.compacted = True
+        self._recount(self.tok.shape[0])
+
+    # ---------------------------------------------------------------- sweep
+
+    def sweep(self, p: int) -> None:
+        """Advance the active prefix by one byte via the packed DFA."""
+        na = self.na
+        alive = self.alive[:na]
+        ps = self.ps[:na]
+        idx = self._scratch[:na]
+        if self.compacted:
+            # post-compaction rows are a sparse subset of the sorted
+            # order: gather their byte column (na is small by now)
+            np.add(ps, self.tok[p].take(self.rowid[:na]), out=idx)
+        else:
+            np.add(ps, self.tok[p, :na], out=idx)
+        code = self.table.take(idx)
+        act = code >> 24
+
+        alive &= act != _A_DIE
+        # NONE payloads are pre-shifted packed states; PUSH/POP payloads
+        # are substate ids whose rows get overwritten by the fixups below,
+        # and dead tokens' states are don't-cares (payloads stay in-range
+        # for next sweep's gather) — so the assignment is unconditional.
+        np.bitwise_and(code, 0xFFFFFF, out=ps)
+
+        stacky = act >= _A_PUSH
+        if not stacky.any():
+            return
+        stacky &= alive
+        sidx = np.nonzero(stacky)[0]
+        pushes = sidx[act[sidx] == _A_PUSH]
+        pops = sidx[act[sidx] == _A_POP]
+        if pushes.size:
+            c = code[pushes]
+            sym = ((c >> 16) & 0xFF).astype(np.uint64)
+            sub = (c & 0xFFFF).astype(np.int64)
+            rel = self.rel[pushes] + 1
+            self.rel[pushes] = rel
+            d_new = self.start_len + rel
+            shift = ((d_new - 1) * 2).astype(np.uint64)
+            own = self.own[pushes]
+            own &= ~(np.uint64(3) << shift)
+            own |= sym << shift
+            self.own[pushes] = own
+            self.ps[pushes] = self.repack[
+                sub, sym.astype(np.int64), self._depth_class(d_new)
+            ].astype(np.uint32) << 8
+
+        # ---- pops: subset fixup, re-deriving the new top -------------- #
+        if pops.size:
+            sub = (code[pops] & 0xFFFF).astype(np.int64)
+            rel = self.rel[pops] - 1
+            self.rel[pops] = rel
+            d = self.start_len + rel
+            shift = (np.maximum(d - 1, 0) * 2).astype(np.uint64)
+            own = ((self.own[pops] >> shift) & np.uint64(3)).astype(np.int64)
+            shared = self.start_top[np.clip(d, 0, self.start_len)]
+            top = np.where(own != 0, own,
+                           np.where(d <= self.start_len, shared, 0))
+            top = np.where(d > 0, top, 0).astype(np.int64)
+            self.ps[pops] = self.repack[
+                sub, top, self._depth_class(d)].astype(np.uint32) << 8

@@ -143,7 +143,14 @@ def _compile_annotation(ann: Any, uid: _Uid) -> SNode:
         return SAny(uid(), require_object=True)
     if isinstance(ann, type) and issubclass(ann, BaseModel):
         return _compile_object(ann, uid)
-    return SAny(uid())  # Any / unsupported → generic JSON value
+    # Any / Optional[...] / Union / unsupported → generic JSON value, and
+    # numeric range constraints (ge/le) are NOT encoded in the grammar: a
+    # document can be grammar-valid yet pydantic-invalid (e.g. a confidence
+    # of 7.5). That is a deliberate degradation — the byte automaton stays
+    # regular and the tolerant parser downstream (llm_parser) clamps or
+    # defaults out-of-range fields. Covered by
+    # test_schema_guided.test_grammar_admits_pydantic_invalid_numbers.
+    return SAny(uid())
 
 
 # --------------------------------------------------------------------------- #
@@ -601,6 +608,20 @@ class SchemaMachine:
         m.stack = [fr.copy() for fr in self.stack]
         m.complete, m.dead = self.complete, self.dead
         return m
+
+    @property
+    def in_string(self) -> bool:
+        """Inside string content (part of the mask-provider contract —
+        see ``guided._in_string``): a string frame on top, or a nested
+        generic machine that is itself inside a string."""
+        if not self.stack:
+            return False
+        top = self.stack[-1]
+        if isinstance(top, _StringFrame):
+            return True
+        if isinstance(top, _AnyFrame):
+            return top.m.in_string
+        return False
 
     def advance(self, byte: int) -> bool:
         if self.dead:

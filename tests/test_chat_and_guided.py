@@ -77,13 +77,30 @@ def test_mask_provider_steers_to_valid_json():
     assert mask[ord("{")] and mask[ord("[")] and mask[ord('"')] and mask[ord("7")]
     assert not mask[ord("}")] and not mask[ord("x")] and not mask[tok.eot_id]
     # Walk a full object through advance(); mask should then include eot.
-    for b in b'{"a": 1}':
+    # (No structural whitespace: the provider suppresses ws-only tokens in
+    # structural positions so guided decoding always makes progress.)
+    for b in b'{"a b":1}':
         assert provider.mask(req)[b], f"byte {chr(b)} should be allowed"
         provider.advance(req, b)
     final = provider.mask(req)
     assert final[tok.eot_id]
     # Mask caching: same signature served from cache
     assert provider.mask(req) is final
+
+
+def test_mask_provider_suppresses_structural_whitespace():
+    """JSON admits unlimited inter-token whitespace; the provider masks
+    ws-only tokens in structural spots (a greedy model would pad forever)
+    while keeping whitespace as *string content*."""
+    tok = ByteTokenizer()
+    provider = JsonMaskProvider(tok)
+    req = EngineRequest(prompt_ids=[], sampling=SamplingParams(guided="json"))
+    mask = provider.mask(req)  # structural position (document start)
+    assert not mask[ord(" ")] and not mask[ord("\t")]
+    for b in b'{"a':
+        provider.advance(req, b)
+    mask = provider.mask(req)  # inside a string: space is content
+    assert mask[ord(" ")]
 
 
 async def test_guided_complete_emits_valid_json():
@@ -104,3 +121,20 @@ async def test_chat_returns_response():
     await client.shutdown()
     assert isinstance(resp.content, str)
     assert resp.usage["prompt_tokens"] > 20
+
+
+def test_ws_allowed_inside_any_frame_strings():
+    """Strings nested in SAny/dict schema fields are string content: the
+    structural-ws suppression must not fire there (r3 review finding)."""
+    from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+    from runbookai_tpu.model.schema_guided import orchestrator_schemas
+
+    tok = ByteTokenizer()
+    provider = JsonMaskProvider(tok, schemas=orchestrator_schemas())
+    req = EngineRequest(prompt_ids=[],
+                        sampling=SamplingParams(guided="remediation"))
+    machine = provider.machine_for(req)
+    prefix = b'{"steps":[{"description":"d","action":"a","params":{"note":"hello'
+    assert machine.advance_bytes(prefix)
+    mask = provider.mask(req)
+    assert mask[ord(" ")], "space must stay admissible inside nested string"

@@ -1,0 +1,240 @@
+"""Differential + performance tests for the vectorized JSON mask builder.
+
+The vectorized masker (``model/guided_mask.py``) must agree byte-for-byte
+with the scalar prober it replaces — a divergence steers sampling toward
+bytes the engine later rejects. States are drawn by advancing the scalar
+machine through prefixes of real JSON documents; masks are compared over a
+vocabulary stocked with adversarial tokens (structural runs, escapes,
+multi-byte UTF-8, number edges).
+
+Perf contract (VERDICT r2 #6): first-miss mask build < 10ms at the
+Llama-3 vocab size (128,256).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from runbookai_tpu.model.guided import JsonMachine, JsonMaskProvider
+from runbookai_tpu.model.guided_mask import VectorJsonMasker
+
+# --------------------------------------------------------------------- vocab
+
+# Tokens chosen to stress every automaton branch: structural closers that
+# pop through the starting stack then push again, escapes, \uXXXX runs,
+# UTF-8 leads/continuations (incl. invalid), number DFA edges, literals,
+# whitespace salads, and keys-with-colons.
+TRICKY = [
+    b"", b" ", b"\t\n\r ", b"{", b"}", b"[", b"]", b"{}", b"[]", b"[[",
+    b"]]", b"]}", b"}]", b'"', b'""', b'"a"', b'"ab', b'\\', b'\\"', b'\\n',
+    b'\\u', b'\\u00', b'\\u004a', b'\\x', b'"key":', b'":', b'",', b'"}',
+    b'"]', b'"],"', b'"},{"', b'},{"k":', b"0", b"1", b"-", b"-0", b"01",
+    b"1.", b"1.5", b"1e", b"1e+", b"1e+5", b"0.5e-3", b"-1.", b"123",
+    b"3.14159", b"true", b"false", b"null", b"tru", b"nul", b"t", b"f",
+    b"n", b"truefalse", b"true,", b"true}", b"true]", b",", b":", b", ",
+    b": ", b",\"", b'{"a":1}', b'{"a":', b'[1,2,3]', b'[1,', b"\xc3\xa9",
+    b"\xc3", b"\xa9", b"\xe2\x82\xac", b"\xe2\x82", b"\xed\xa0\x80",
+    b"\xf0\x9f\x98\x80", b"\xf4\x90\x80\x80", b"\xc0\xaf", b"caf\xc3\xa9",
+    b'"\xe2\x82\xac"', b" {", b" [", b"  5", b'\t"x"', b"e", b"E", b"+",
+    b"-e", b"9e9", b"00", b"0.0", b".", b".5", b'"\\', b'"\\u0041"',
+    b'x', b'hello world', b'The quick', b'()', b'<|x|>',
+]
+TRICKY += [bytes([b]) for b in range(256)]  # every single byte
+
+
+def scalar_mask(machine: JsonMachine, table: list[bytes]) -> np.ndarray:
+    out = np.zeros(len(table), dtype=bool)
+    for tid, bts in enumerate(table):
+        if not bts:
+            continue
+        probe = machine.copy()
+        if probe.advance_bytes(bts):
+            out[tid] = True
+    return out
+
+
+# States: every proper prefix of these documents (plus the full docs).
+DOCS = [
+    b'{"name": "caf\xc3\xa9", "n": -12.5e+3, "ok": true, "tags": ["a", "b\\u0041"], "sub": {"x": [1, 2, {"y": null}], "z": {}}, "last": false}',
+    b'[[1, 2], [], {"k": "v"}, "s\\n", -0.5, 1e9, true, null]',
+    b'  {  "a"  :  [ 0.5 , { "b" : [ [ ] , { } ] } ] }  ',
+    b'"just a string with \\"escape\\" and \xe2\x82\xac"',
+    b"-123.456e-7",
+    b"true",
+    b'{"deep": {"deep": {"deep": {"deep": [[[["x"]]]]}}}}',
+]
+
+
+def iter_states():
+    yield JsonMachine()
+    for doc in DOCS:
+        m = JsonMachine()
+        yield m.copy()
+        for b in doc:
+            assert m.advance(b), f"fixture doc invalid at byte {b!r}"
+            yield m.copy()
+
+
+def test_vectorized_matches_scalar_everywhere():
+    masker = VectorJsonMasker(TRICKY)
+    checked = 0
+    for machine in iter_states():
+        want = scalar_mask(machine, TRICKY)
+        got = masker.mask(machine)
+        if not np.array_equal(want, got):
+            bad = np.nonzero(want != got)[0]
+            raise AssertionError(
+                f"mask mismatch at state {machine.signature()!r}: "
+                f"tokens {[TRICKY[i] for i in bad[:8]]} "
+                f"(want {want[bad[:8]]}, got {got[bad[:8]]})")
+        checked += 1
+    assert checked > 300  # every prefix of every doc
+
+
+def test_vectorized_deep_stack_and_depth_limit():
+    # At max_depth the machine must refuse further '{'/'[' pushes.
+    m = JsonMachine(max_depth=4)
+    for b in b'[[[[':
+        assert m.advance(b)
+    masker = VectorJsonMasker(TRICKY)
+    want = scalar_mask(m, TRICKY)
+    got = masker.mask(m)
+    assert np.array_equal(want, got)
+    assert not got[TRICKY.index(b"[")]  # depth limit reached
+    assert not got[TRICKY.index(b"{")]
+    assert got[TRICKY.index(b"]")]
+
+
+def test_pop_then_push_shadowing():
+    # A token that closes into the shared stack and then opens its own
+    # containers must see *its* top-of-stack, not the shared one.
+    m = JsonMachine()
+    for b in b'[["x"':
+        assert m.advance(b)
+    # state: AFTER inside [ [ — token b'],{"k":1}]' pops to the outer
+    # array, then builds an object: shadow stack must track the '{'.
+    vocab = TRICKY + [b'],{"k":1}]', b'],{"k":1}}', b'],[', b']],']
+    masker = VectorJsonMasker(vocab)
+    want = scalar_mask(m, vocab)
+    got = masker.mask(m)
+    assert np.array_equal(want, got)
+    assert got[vocab.index(b'],{"k":1}]')]
+    assert not got[vocab.index(b'],{"k":1}}')]  # '}' can't close the array
+
+
+# ------------------------------------------------------------------ provider
+
+
+class _FakeTok:
+    """Minimal tokenizer over an explicit byte table."""
+
+    def __init__(self, table):
+        self._table = table
+        self.vocab_size = len(table)
+        self.bos_id = 0
+        self.eos_id = 1
+        self.eot_id = 2
+        self.pad_id = None
+
+    def id_to_bytes(self, tid):
+        return self._table[tid]
+
+
+class _Req:
+    def __init__(self, guided="json"):
+        self.guided_state = None
+        self.sampling = type("S", (), {"guided": guided})()
+
+
+def test_provider_uses_vectorized_path_and_matches():
+    table = [b"<bos>", b"<eos>", b"<eot>"] + TRICKY
+    tok = _FakeTok(table)
+    prov = JsonMaskProvider(tok)
+    req = _Req()
+    got = prov.mask(req)
+    machine = req.guided_state
+    want = scalar_mask(machine, table)
+    want[[0, 1, 2]] = False  # special ids excluded
+    # The provider suppresses ws-only tokens in structural positions
+    # (steering tightening) — mirror that in the expectation.
+    for tid, bts in enumerate(table):
+        if bts and all(b in b" \t\n\r" for b in bts):
+            want[tid] = False
+    if machine.is_complete:
+        want[tok.eot_id] = want[tok.eos_id] = True
+    assert np.array_equal(want, got)
+    assert prov._vector is not None  # fast path actually engaged
+
+
+def synth_bpe_vocab(size: int, seed: int = 0) -> list[bytes]:
+    """Synthetic vocab with BPE-like length distribution (most tokens
+    2-8 ASCII bytes, a tail of long tokens and multi-byte UTF-8)."""
+    rng = np.random.default_rng(seed)
+    out: list[bytes] = []
+    ascii_pool = (b"abcdefghijklmnopqrstuvwxyz"
+                  b"ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789 _-.,:;!?'\"{}[]()\\/")
+    while len(out) < size:
+        ln = int(rng.geometric(0.25))
+        ln = min(ln, 24)
+        if rng.random() < 0.03:  # utf-8 tail
+            ch = chr(int(rng.integers(0x80, 0x2FFF)))
+            out.append(ch.encode("utf-8"))
+        else:
+            idx = rng.integers(0, len(ascii_pool), size=ln)
+            out.append(bytes(ascii_pool[i] for i in idx))
+    return out[:size]
+
+
+def test_first_miss_mask_under_10ms_at_llama3_vocab():
+    vocab = synth_bpe_vocab(128_256)
+    masker = VectorJsonMasker(vocab)  # one-time build, excluded from budget
+    # Warm numpy/caches with one state, then time *novel* states — each
+    # timed call is a genuine first miss (new signature, no mask cache).
+    masker.mask(JsonMachine())
+    states = []
+    m = JsonMachine()
+    for b in b'{"k": [1, {"x": "ab':
+        m.advance(b)
+        states.append(m.copy())
+    times = []
+    for st in states:
+        # masker.mask does no caching, so every call is genuine first-miss
+        # work; min-of-3 strips scheduler noise when the suite runs under
+        # CPU contention without weakening the contract.
+        times.append(min(
+            _timed(masker, st) for _ in range(3)))
+    worst = max(times)
+    assert worst < 0.010, f"first-miss mask build too slow: {worst*1e3:.2f}ms"
+
+
+def _timed(masker, st):
+    t0 = time.perf_counter()
+    masker.mask(st)
+    return time.perf_counter() - t0
+
+
+def test_vectorized_correct_at_scale_spot_check():
+    # At full vocab scale, spot-check agreement on a sampled subset of
+    # tokens (full scalar sweep at 128k is too slow for CI).
+    vocab = synth_bpe_vocab(128_256, seed=1)
+    masker = VectorJsonMasker(vocab)
+    m = JsonMachine()
+    for b in b'{"key": "va':
+        m.advance(b)
+    got = masker.mask(m)
+    rng = np.random.default_rng(2)
+    sample = rng.choice(len(vocab), size=512, replace=False)
+    for tid in sample:
+        probe = m.copy()
+        want = bool(vocab[tid]) and probe.advance_bytes(vocab[tid])
+        assert got[tid] == want, (tid, vocab[tid])
+
+
+def test_json_roundtrip_sanity():
+    # The fixture docs really are JSON (guards against fixture rot).
+    for doc in DOCS:
+        json.loads(doc.decode("utf-8"))

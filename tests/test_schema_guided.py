@@ -253,3 +253,20 @@ def test_orchestrator_requests_schemas():
     orch = InvestigationOrchestrator(PlainMock(), ToolExecutor({}))
     res = asyncio.run(orch.investigate("INC-2", "api is down"))
     assert res.summary is not None
+
+
+def test_grammar_admits_pydantic_invalid_numbers():
+    """Documented degradation (ADVICE r2): numeric range constraints are NOT
+    in the byte grammar — a confidence of 7.5 passes the automaton, and the
+    tolerant parser downstream is the layer that handles it."""
+    doc = (b'{"action":"confirm","confidence":7.5,"reasoning":"r",'
+           b'"supports":true,"strength":"strong","sub_hypotheses":[]}')
+    m = _machine("evaluation")
+    assert m.advance_bytes(doc) and m.is_complete  # grammar-valid
+
+    from runbookai_tpu.agent import llm_parser as lp
+
+    parsed = lp.parse_evaluation(doc.decode())
+    # The fallback layer must yield a *usable* evaluation object, not raise.
+    assert parsed.action in ("continue", "branch", "prune", "confirm")
+    assert isinstance(parsed.confidence, float)
