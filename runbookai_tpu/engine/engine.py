@@ -82,28 +82,31 @@ class EngineConfig:
     spec_ngram: int = 3
 
 
-@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl"),
+@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl",
+                                   "mesh"),
          donate_argnums=(4, 5))
 def _decode_step(
     params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
     temps, top_ps, key, mask, page_size: int, block_pages: int,
-    attn_impl: str = "xla",
+    attn_impl: str = "xla", mesh=None,
 ):
     logits, kv_k, kv_v = forward_impl(
         params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
         page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
+        mesh=mesh,
     )
     tok = sample_tokens(logits[:, -1], key, temps, top_ps, mask)
     return tok, logits[:, -1], kv_k, kv_v
 
 
 @partial(jax.jit,
-         static_argnames=("cfg", "page_size", "block_pages", "k_steps", "attn_impl"),
+         static_argnames=("cfg", "page_size", "block_pages", "k_steps", "attn_impl",
+                          "mesh"),
          donate_argnums=(4, 5))
 def _decode_multi(
     params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
     temps, top_ps, key, page_size: int, block_pages: int, k_steps: int,
-    attn_impl: str = "xla",
+    attn_impl: str = "xla", mesh=None,
 ):
     """K autoregressive decode steps in ONE dispatch (on-device sampling).
 
@@ -120,6 +123,7 @@ def _decode_multi(
         logits, kv_k, kv_v = forward_impl(
             params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
             page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
+            mesh=mesh,
         )
         key, sub = jax.random.split(key)
         tok = sample_tokens(logits[:, -1], sub, temps, top_ps, None)
@@ -132,11 +136,12 @@ def _decode_multi(
     return toks.T, kv_k, kv_v  # [B, K]
 
 
-@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl"),
+@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl",
+                                   "mesh"),
          donate_argnums=(4, 5))
 def _decode_spec(
     params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
-    page_size: int, block_pages: int, attn_impl: str = "xla",
+    page_size: int, block_pages: int, attn_impl: str = "xla", mesh=None,
 ):
     """Verify a speculated chunk: one T=K forward, greedy argmax per position.
 
@@ -154,21 +159,24 @@ def _decode_spec(
     logits, kv_k, kv_v = forward_impl(
         params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
         page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
+        mesh=mesh,
     )
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv_k, kv_v  # [B, K]
 
 
-@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl"),
+@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl",
+                                   "mesh"),
          donate_argnums=(3, 4))
 def _prefill_step(
     params, cfg: LlamaConfig, tokens, kv_k, kv_v, positions, tables, ctx_lens,
-    last_idx, page_size: int, block_pages: int, attn_impl: str = "xla",
+    last_idx, page_size: int, block_pages: int, attn_impl: str = "xla", mesh=None,
 ):
     """Prefill one chunk for a BATCH of sequences; returns each row's final
     real-token logits ([B, vocab])."""
     logits, kv_k, kv_v = forward_impl(
         params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
         page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
+        mesh=mesh,
     )
     rows = jnp.arange(logits.shape[0])
     return logits[rows, last_idx], kv_k, kv_v
@@ -372,7 +380,15 @@ class EngineCore:
                 self.kv.extend(req.request_id, new_ctx)
             except MemoryError:
                 if rows:
-                    break  # run what fits; this request retries next step
+                    # Run what fits; this request retries next step. Keep
+                    # scanning — a later request's (smaller) extension may
+                    # still fit this dispatch (ADVICE r2: breaking here
+                    # head-of-line blocked the rest of the batch). Liveness:
+                    # the HEAD request always fails with rows empty (FIFO
+                    # scan), taking the preempt/abort path below — and a
+                    # skipped request reaches the head in bounded steps as
+                    # earlier rows finish, so no request starves.
+                    continue
                 if self._preempt_youngest():
                     return  # retry next step
                 self.prefilling.remove(req)
@@ -409,7 +425,7 @@ class EngineCore:
                 jnp.asarray(positions), jnp.asarray(tables),
                 jnp.asarray(ctx_lens), jnp.asarray(last_idx),
                 page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
-                attn_impl=self.ecfg.attn_impl,
+                attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
             )
 
         done_rows: list[tuple[int, EngineRequest]] = []
@@ -561,7 +577,7 @@ class EngineCore:
                 self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
                 self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
                 page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
-                attn_impl=self.ecfg.attn_impl,
+                attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
             )
             toks_host = np.asarray(jax.device_get(toks))  # [B, k]
 
@@ -643,7 +659,7 @@ class EngineCore:
                     jnp.asarray(temps), jnp.asarray(top_ps), sub,
                     jnp.asarray(mask) if need_mask else None,
                     page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
-                    attn_impl=self.ecfg.attn_impl,
+                    attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
                 )
                 toks_host = np.asarray(jax.device_get(toks))[:, None]  # [B, 1]
             else:
@@ -652,7 +668,7 @@ class EngineCore:
                     self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
                     jnp.asarray(temps), jnp.asarray(top_ps), sub,
                     page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
-                    k_steps=k, attn_impl=self.ecfg.attn_impl,
+                    k_steps=k, attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
                 )
                 toks_host = np.asarray(jax.device_get(toks))  # [B, K]
 

@@ -87,12 +87,12 @@ class JaxTpuClient(BaseLLMClient):
             decode_steps_per_dispatch=llm_cfg.decode_steps,
             # The Pallas ragged-paged kernels are the TPU hot path (VERDICT r1
             # weak #3); the XLA gather path stays the portable fallback. On a
-            # TP mesh the pool is sharded and an unpartitioned pallas_call
-            # would make XLA all-gather it every step — keep XLA attention
-            # there until the kernel is wrapped in shard_map over kv heads.
+            # TP mesh the kernels run per head-shard via shard_map
+            # (ops/paged_attention_pallas.py) — forward_impl itself falls
+            # back to XLA attention only when GQA heads don't divide the
+            # model axis (where the pool replicates anyway).
             attn_impl=("pallas"
-                       if jax.default_backend() in ("tpu", "axon") and mesh is None
-                       else "xla"),
+                       if jax.default_backend() in ("tpu", "axon") else "xla"),
         )
         masker = JsonMaskProvider(tokenizer, schemas=orchestrator_schemas())
         core = EngineCore(

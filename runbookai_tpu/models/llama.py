@@ -204,14 +204,17 @@ def forward_impl(
     page_size: int,
     block_pages: int = 32,
     attn_impl: str = "xla",
+    mesh=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward chunk. Returns (logits [B, T, vocab] f32, kv_k', kv_v').
 
     Raw (un-jitted) implementation so callers can inline it inside their own
     compiled step functions — nested jit inside lax.scan hangs some remote
     compile backends. ``attn_impl="pallas"`` selects the Pallas ragged paged
-    decode kernel when T == 1. Donate ``kv_k``/``kv_v`` at the jit call site
-    for in-place page updates.
+    decode kernel when T == 1; with a TP ``mesh`` the kernel runs per
+    model-axis shard via shard_map (falling back to the XLA gather path only
+    when GQA heads don't divide the axis — the pool replicates there too).
+    Donate ``kv_k``/``kv_v`` at the jit call site for in-place page updates.
     """
     b, t = tokens.shape
     hd, n_kv = cfg.head_dim, cfg.n_kv_heads
@@ -234,16 +237,42 @@ def forward_impl(
         v_pages = write_kv_pages_batch(v_pages, v, positions, page_tables,
                                        page_size)
 
-        if attn_impl == "pallas":
+        use_pallas = attn_impl == "pallas"
+        shardable = False
+        if use_pallas and mesh is not None:
+            from runbookai_tpu.ops.paged_attention_pallas import tp_shardable
+            from runbookai_tpu.parallel.mesh import MODEL_AXIS
+
+            # On a TP mesh the kernel must run per head-shard (shard_map);
+            # when GQA kv heads don't divide the axis the pool replicates
+            # (kv_pool_sharding) and the XLA gather path is the honest
+            # fallback rather than an implicit every-step all-gather.
+            shardable = tp_shardable(mesh, n_kv)
+            if mesh.shape.get(MODEL_AXIS, 1) > 1 and not shardable:
+                use_pallas = False
+        if use_pallas:
             from runbookai_tpu.ops.paged_attention_pallas import (
                 paged_chunk_attention,
+                paged_chunk_attention_tp,
                 paged_decode_attention,
+                paged_decode_attention_tp,
             )
 
             # Interpret mode on CPU keeps the kernel path testable on the
             # virtual mesh; on TPU this compiles under Mosaic.
             interp = jax.default_backend() == "cpu"
-            if t == 1:
+            if shardable:
+                if t == 1:
+                    attn = paged_decode_attention_tp(
+                        mesh, q[:, 0], k_pages, v_pages, page_tables,
+                        ctx_lens, page_size=page_size, interpret=interp,
+                    )[:, None]
+                else:
+                    attn = paged_chunk_attention_tp(
+                        mesh, q, k_pages, v_pages, page_tables, ctx_lens,
+                        positions, page_size=page_size, interpret=interp,
+                    )
+            elif t == 1:
                 attn = paged_decode_attention(
                     q[:, 0], k_pages, v_pages, page_tables, ctx_lens,
                     page_size=page_size, interpret=interp,
@@ -275,7 +304,7 @@ def forward_impl(
 
 
 forward = partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages",
-                                            "attn_impl"))(forward_impl)
+                                            "attn_impl", "mesh"))(forward_impl)
 
 
 def dense_causal_attention(cfg: LlamaConfig, b: int, t: int):
