@@ -69,6 +69,7 @@ class Agent:
         cache_ttl_seconds: float = 300.0,
         cache_size: int = 100,
         tokenizer: Optional[Any] = None,
+        context_managers: Optional[list] = None,
     ):
         self.llm = llm
         self.tools = {t.name: t for t in tools}
@@ -82,6 +83,10 @@ class Agent:
         self.cache = LRUToolCache(max_size=cache_size, ttl_seconds=cache_ttl_seconds)
         self.executor = ParallelToolExecutor() if parallel_tools else None
         self.tokenizer = tokenizer
+        # Knowledge/Service/Infra context managers (reference agent.ts:293-340):
+        # primed before the loop, re-observed as services/symptoms surface, and
+        # injected into every system prompt via their system_prompt_block().
+        self.context_managers = list(context_managers or [])
 
     # ------------------------------------------------------------------ run
 
@@ -119,10 +124,30 @@ class Agent:
                 },
             })
 
+        # Context managers: seed the knowledge index from the retrieval we
+        # just did (no second search) / pre-discover infra before the first
+        # LLM call (reference agent.ts:293-340).
+        for cm in self.context_managers:
+            try:
+                if hasattr(cm, "absorb"):
+                    cm.absorb(knowledge, query=query)
+                elif hasattr(cm, "prime"):
+                    await cm.prime(query)
+                if hasattr(cm, "discover"):
+                    await cm.discover()
+            except Exception as e:  # noqa: BLE001 — context is best-effort
+                yield AgentEvent("warning", {
+                    "text": f"context manager {type(cm).__name__} failed: {e}"})
+
+        def system_prompt() -> str:
+            blocks = [b for b in (cm.system_prompt_block()
+                                  for cm in self.context_managers) if b]
+            return build_system_prompt([*(extra_context or []), *blocks])
+
         # Knowledge-only fast path (reference agent.ts:356-390).
         if knowledge_block and is_procedural_query(query):
             resp = await self.llm.chat(
-                build_system_prompt(extra_context),
+                system_prompt(),
                 build_knowledge_only_prompt(query, knowledge_block),
             )
             if "KNOWLEDGE_INSUFFICIENT" not in resp.content:
@@ -162,8 +187,7 @@ class Agent:
                 yield AgentEvent("phase", {"name": "thinking",
                                            "detail": f"iteration {iteration + 1}"})
 
-            resp = await self.llm.chat(build_system_prompt(extra_context),
-                                       prompt, tool_schemas)
+            resp = await self.llm.chat(system_prompt(), prompt, tool_schemas)
             if resp.thinking:
                 pad.append_thinking(resp.thinking)
                 memory.observe(resp.thinking)
@@ -227,6 +251,17 @@ class Agent:
                         " ".join([query, *new_services, *new_symptoms]),
                         services=new_services or None,
                     )
+                    for cm in self.context_managers:
+                        try:
+                            if new_services and hasattr(cm, "observe_services"):
+                                cm.observe_services(new_services)
+                            if hasattr(cm, "absorb"):
+                                # share the one retrieval above — managers
+                                # never re-query on their own here
+                                cm.absorb(extra, query=" ".join(
+                                    new_services + new_symptoms))
+                        except Exception:  # noqa: BLE001 — best-effort
+                            pass
                     if not extra.empty:
                         citations.track(extra)
                         knowledge_block = render_knowledge(extra) or knowledge_block
@@ -237,7 +272,7 @@ class Agent:
         if final_text is None:
             # Iteration budget exhausted: one synthesis call without tools.
             resp = await self.llm.chat(
-                build_system_prompt(extra_context),
+                system_prompt(),
                 build_final_answer_prompt(query, pad.build_tiered_context(),
                                           knowledge_block,
                                           memory.to_prompt_block()),

@@ -21,9 +21,17 @@ class KnowledgeContextManager:
 
     async def prime(self, query: str) -> RetrievedKnowledge:
         knowledge = await self.retriever.retrieve(query)
-        self._absorb(knowledge)
-        self._seen_terms.update(query.lower().split())
+        self.absorb(knowledge, query=query)
         return knowledge
+
+    def absorb(self, knowledge: RetrievedKnowledge,
+               query: str = "") -> None:
+        """Fold an already-retrieved result into the index — callers that
+        retrieved themselves (Agent.run does, for the prompt block) use
+        this instead of :meth:`prime`, so the search isn't run twice."""
+        self._absorb(knowledge)
+        if query:
+            self._seen_terms.update(query.lower().split())
 
     def _absorb(self, knowledge: RetrievedKnowledge) -> None:
         for item in knowledge.all():

@@ -62,6 +62,16 @@ class OrchestratorResult:
     events: list[AgentEvent] = field(default_factory=list)
 
 
+_LEVELS = ("low", "medium", "high")
+
+
+def _min_level(a: str, b: str) -> str:
+    """Conservative blend of two confidence levels (unknown → low)."""
+    ia = _LEVELS.index(a) if a in _LEVELS else 0
+    ib = _LEVELS.index(b) if b in _LEVELS else 0
+    return _LEVELS[min(ia, ib)]
+
+
 class ToolExecutor:
     """Thin seam: name + params -> result (the orchestrator's tool interface)."""
 
@@ -113,18 +123,28 @@ class InvestigationOrchestrator:
         supports schema-constrained guided decoding (jax-tpu does; the seam
         stays ``complete(prompt) -> str`` for mocks/adapters).
 
-        Only the *call* is probed for the schema kwarg — a coroutine
-        function raises TypeError at call time for an unknown kwarg, before
-        any generation runs — so TypeErrors from inside generation surface
-        instead of silently re-running unguided."""
-        if schema is not None:
-            try:
-                coro = self.llm.complete(prompt, schema=schema)
-            except TypeError:
-                coro = None
-            if coro is not None:
-                return await coro
+        Schema support is probed from ``inspect.signature`` once per client
+        (ADVICE r2: catching TypeError from the call masked genuine
+        TypeErrors raised inside synchronous adapters' argument handling)."""
+        if schema is not None and self._supports_schema():
+            return await self.llm.complete(prompt, schema=schema)
         return await self.llm.complete(prompt)
+
+    def _supports_schema(self) -> bool:
+        cached = getattr(self, "_schema_ok", None)
+        if cached is None:
+            import inspect
+
+            try:
+                sig = inspect.signature(self.llm.complete)
+                params = sig.parameters
+                cached = "schema" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values())
+            except (TypeError, ValueError):  # builtins/partials w/o signature
+                cached = False
+            self._schema_ok = cached
+        return cached
 
     # ------------------------------------------------------------------ main
 
@@ -353,10 +373,17 @@ class InvestigationOrchestrator:
                     supports=evaluation.supports, strength=evaluation.strength,
                 ))
 
+        # Multi-factor confidence (reference confidence.ts:22-46, wired into
+        # evaluation per investigation-orchestrator.ts:1005): blend the LLM's
+        # self-reported level with a score computed from the evidence record —
+        # the conservative of the two wins, so a confident-sounding evaluation
+        # over thin evidence cannot inflate the tree.
+        computed = self._computed_confidence(hypothesis.id, hypothesis.depth)
+        blended = min(float(evaluation.confidence), computed)
         created = m.apply_evaluation(
             hypothesis.id,
             EvaluationAction(evaluation.action),
-            confidence=evaluation.confidence,
+            confidence=blended,
             sub_hypotheses=[s.model_dump() for s in evaluation.sub_hypotheses],
             reason=evaluation.reasoning,
         )
@@ -364,12 +391,34 @@ class InvestigationOrchestrator:
             self._emit("hypothesis_created", id=child.id, statement=child.statement,
                        parent=hypothesis.id)
         self._emit("hypothesis_updated", id=hypothesis.id,
-                   action=evaluation.action, confidence=evaluation.confidence)
+                   action=evaluation.action, confidence=blended,
+                   llm_confidence=evaluation.confidence,
+                   computed_confidence=computed)
 
         if evaluation.action == "confirm":
             m.transition(Phase.CONCLUDE)
             return True
         return False
+
+    def _computed_confidence(self, hypothesis_id: str, depth: int) -> float:
+        """Evidence-derived confidence for one hypothesis, scaled to [0, 1]
+        (the machine's numeric confidence space; confidence.ts scores 0-100)."""
+        from runbookai_tpu.agent.confidence import (
+            ConfidenceFactors,
+            confidence_score,
+        )
+
+        records = [e for e in self.machine.evidence
+                   if e.hypothesis_id == hypothesis_id]
+        support = [e for e in records if e.supports]
+        contra = [e for e in records if not e.supports]
+        score = confidence_score(ConfidenceFactors(
+            evidence_chain_depth=depth + 1,
+            corroborating_signals=len(support),
+            contradicting_signals=len(contra),
+            direct_evidence=any(e.strength == "strong" for e in support),
+        ))
+        return max(0.0, min(1.0, score / 100.0))
 
     # ------------------------------------------------------------ conclusion
 
@@ -389,6 +438,14 @@ class InvestigationOrchestrator:
         if not conclusion.root_cause and confirmed is not None:
             conclusion.root_cause = confirmed.statement
             conclusion.confidence = "medium"
+        if confirmed is not None:
+            # Conclusion confidence is also capped by the evidence-derived
+            # score of the confirmed hypothesis (confidence.ts wiring).
+            from runbookai_tpu.agent.confidence import level_from_value
+
+            computed = self._computed_confidence(confirmed.id, confirmed.depth)
+            conclusion.confidence = _min_level(
+                conclusion.confidence, level_from_value(computed * 100.0))
         m.root_cause = conclusion.root_cause
         m.conclusion_confidence = conclusion.confidence
         for svc in conclusion.affected_services:

@@ -232,6 +232,10 @@ class AgentConfig(BaseModel):
     parallel_tool_calls: bool = True
     tool_cache_ttl_seconds: int = 300
     tool_cache_size: int = 100
+    # Optional pre-discovery of AWS inventory/health into the system prompt
+    # (reference infra-context.ts:597 factory — off by default: it spends
+    # tool calls before the first iteration).
+    infra_context: bool = False
 
 
 class ClaudeIntegrationConfig(BaseModel):

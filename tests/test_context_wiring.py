@@ -1,0 +1,148 @@
+"""Integration: the once-orphaned intelligence now shapes live behavior.
+
+VERDICT r2 weak #4 / next-round #4: KnowledgeContextManager /
+ServiceContextManager / InfraContextManager blocks must appear in the
+system prompts `Agent.run` actually sends; the orchestrator's confidence
+must be capped by the evidence-derived score; `build_agent` must hand the
+engine tokenizer to the Agent.
+"""
+
+import json
+
+import pytest
+
+from runbookai_tpu.agent.agent import Agent
+from runbookai_tpu.agent.knowledge_context import KnowledgeContextManager
+from runbookai_tpu.agent.orchestrator import InvestigationOrchestrator, ToolExecutor
+from runbookai_tpu.agent.service_context import ServiceContextManager
+from runbookai_tpu.agent.types import (
+    KnowledgeResult,
+    LLMResponse,
+    RetrievedKnowledge,
+    ToolCall,
+)
+from runbookai_tpu.knowledge.store.graph import ServiceGraph
+from runbookai_tpu.model.client import MockLLMClient
+
+
+class FakeRetriever:
+    """Knowledge seam returning a fixed runbook set."""
+
+    def __init__(self):
+        self.queries = []
+
+    async def retrieve(self, query, services=None):
+        self.queries.append(query)
+        return RetrievedKnowledge(runbooks=[KnowledgeResult(
+            doc_id="rb-1", title="DB pool exhaustion runbook",
+            content="check pool metrics then scale", knowledge_type="runbook",
+            score=1.0, services=["payment-api"],
+        )])
+
+
+async def collect(agent, query, **kw):
+    return [e async for e in agent.run(query, **kw)]
+
+
+async def test_knowledge_context_block_appears_in_system_prompt(tmp_path):
+    retr = FakeRetriever()
+    kcm = KnowledgeContextManager(retr)
+    llm = MockLLMClient([LLMResponse(content="done")])
+    agent = Agent(llm, [], knowledge=retr, scratchpad_root=tmp_path,
+                  context_managers=[kcm])
+    await collect(agent, "how do I fix db pool exhaustion in payment-api?")
+    assert llm.calls, "no LLM call recorded"
+    sys_prompt = llm.calls[0]["system"]
+    assert "DB pool exhaustion runbook" in sys_prompt  # index block injected
+
+
+async def test_service_context_block_appears_after_observation(tmp_path):
+    graph = ServiceGraph()
+    graph.add_service("payment-api", team="payments", tier=1)
+    graph.add_service("payments-db", team="payments", tier=0)
+    graph.add_dependency("payment-api", "payments-db")
+    scm = ServiceContextManager(graph)
+    scm.observe_services(["payment-api"])
+
+    llm = MockLLMClient([LLMResponse(content="done")])
+    agent = Agent(llm, [], scratchpad_root=tmp_path, context_managers=[scm])
+    await collect(agent, "investigate payment-api latency")
+    sys_prompt = llm.calls[0]["system"]
+    assert "payment-api" in sys_prompt and "payments-db" in sys_prompt
+
+
+async def test_context_manager_failure_is_nonfatal(tmp_path):
+    class Exploding:
+        async def prime(self, q):
+            raise RuntimeError("index offline")
+
+        def system_prompt_block(self):
+            return ""
+
+    llm = MockLLMClient([LLMResponse(content="ok")])
+    agent = Agent(llm, [], scratchpad_root=tmp_path,
+                  context_managers=[Exploding()])
+    events = await collect(agent, "anything")
+    assert any(e.kind == "warning" and "index offline" in e.data["text"]
+               for e in events)
+    assert any(e.kind == "answer" for e in events)  # loop still completed
+
+
+# ----------------------------------------------------------- confidence cap
+
+
+class CompleteMock:
+    def __init__(self, responses):
+        self.queue = list(responses)
+
+    async def complete(self, prompt, schema=None):
+        return self.queue.pop(0) if self.queue else "{}"
+
+
+async def test_overconfident_conclusion_is_capped_by_evidence(tmp_path):
+    """LLM says confidence=high off ONE weak evidence record — the computed
+    score (15 depth + 20 corroboration = 35 < medium threshold) caps it."""
+    triage = json.dumps({"severity": "high", "summary": "s",
+                         "affected_services": [], "symptoms": ["latency"],
+                         "signals": []})
+    hyps = json.dumps({"hypotheses": [
+        {"statement": "connectivity issues to db", "priority": 0.9}]})
+    ev = json.dumps({"action": "confirm", "confidence": 0.95, "supports": True,
+                     "strength": "weak", "reasoning": "maybe"})
+    concl = json.dumps({"root_cause": "db down", "confidence": "high",
+                        "affected_services": [], "summary": "s"})
+    rem = json.dumps({"steps": [], "rollback": "", "notes": ""})
+
+    class OneShotTool:
+        name = "aws_query"
+
+        async def execute(self, **params):
+            return {"status": "degraded"}
+
+    executor = ToolExecutor({"aws_query": OneShotTool()})
+    orch = InvestigationOrchestrator(
+        CompleteMock([triage, hyps, ev, concl, rem]), executor)
+    result = await orch.investigate("PD-1", "db latency")
+    assert result.root_cause == "db down"
+    assert result.confidence == "low"  # capped, despite the LLM's "high"
+    confirmed = orch.machine.confirmed_hypothesis()
+    assert confirmed is not None
+    assert confirmed.confidence <= 0.6  # numeric blend also capped
+
+
+def test_build_agent_passes_engine_tokenizer():
+    from runbookai_tpu.cli.runtime import Runtime, build_agent
+    from runbookai_tpu.utils.config import Config
+
+    class FakeEngineClient:
+        tokenizer = object()
+
+        async def chat(self, *a, **k):  # pragma: no cover - never called
+            raise AssertionError
+
+    rt = Runtime(config=Config(), llm=FakeEngineClient(), tools=[],
+                 knowledge=None, safety=None)
+    agent = build_agent(rt)
+    assert agent.tokenizer is FakeEngineClient.tokenizer
+    # No knowledge / graph / infra flag → no managers, but the hook exists.
+    assert agent.context_managers == []
