@@ -229,6 +229,8 @@ def _summarize_pd_incident(args: dict, result: Any) -> dict:
         return _compact(f"PagerDuty incident lookup failed: {str(err)[:120]}",
                         {}, 0, [], UNKNOWN, result, has_errors=True)
     inc = result.get("incident", result)  # tolerate both wrappers
+    if not isinstance(inc, dict):  # malformed wrapper: summarize the outer
+        inc = result
     status = inc.get("status", "unknown")
     urgency = inc.get("urgency", "unknown")
     title = str(inc.get("title", inc.get("summary", "incident")))[:50]
@@ -359,7 +361,7 @@ def _summarize_kubernetes(args: dict, result: Any) -> dict:
         pods = result["pods"] or []
         bad = [p for p in pods if isinstance(p, dict) and p.get("status")
                not in ("Running", "Succeeded", "Completed", None)]
-        restarts = sum(int(p.get("restarts", 0)) for p in pods
+        restarts = sum(_as_int(p.get("restarts")) for p in pods
                        if isinstance(p, dict))
         health = HEALTHY if not bad else (
             CRITICAL if len(bad) > 2 else DEGRADED)
@@ -434,10 +436,23 @@ _SUMMARIZERS: dict[str, Callable[[dict, Any], dict]] = {
 }
 
 
+def _as_int(value: Any) -> int:
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return 0
+
+
 def summarize_tool_result(tool: str, args: dict[str, Any], result: Any) -> dict[str, Any]:
     """Build the compact representation stored in the scratchpad tier
     (per-tool registry dispatch, reference tool-summarizer.ts:758-763)."""
     fn = _SUMMARIZERS.get(tool)
     if fn is not None:
-        return fn(args or {}, result)
+        try:
+            return fn(args or {}, result)
+        except Exception:  # noqa: BLE001 — ADVICE r2: a malformed payload
+            # (e.g. 'incident' as a string, restarts as None) must degrade
+            # to the generic summary, never crash the agent loop — the
+            # summarizer runs unguarded in agent.py's result handling.
+            return _summarize_default(tool, args or {}, result)
     return _summarize_default(tool, args or {}, result)

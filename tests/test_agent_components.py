@@ -472,3 +472,27 @@ def test_confidence_formatting_and_aggregation():
     assert aggregate_confidence([80, 40], [3, 1]) == 70
     assert has_temporal_correlation(1000.0, 1240.0)
     assert not has_temporal_correlation(1000.0, 1400.0)
+
+
+def test_summarizer_survives_malformed_payloads():
+    """ADVICE r2: one odd tool payload must degrade to the default summary,
+    never crash the agent loop (summarize_tool_result runs unguarded)."""
+    from runbookai_tpu.agent.tool_summarizer import summarize_tool_result
+
+    # incident as a string, not a dict
+    out = summarize_tool_result("pagerduty_get_incident", {},
+                                {"incident": "PD-123 is broken"})
+    assert out["summary"]
+    # pod restarts as None / non-numeric
+    out = summarize_tool_result("kubernetes_query", {"action": "pods"},
+                                {"pods": [{"name": "a", "status": "Running",
+                                           "restarts": None},
+                                          {"name": "b", "status": "Running",
+                                           "restarts": "NaN"}]})
+    assert out["summary"]
+    # completely alien result shapes for every registered summarizer
+    from runbookai_tpu.agent.tool_summarizer import _SUMMARIZERS
+
+    for tool in _SUMMARIZERS:
+        for payload in (None, 17, "text", ["list"], {"weird": object()}):
+            assert summarize_tool_result(tool, {}, payload)["summary"] is not None
