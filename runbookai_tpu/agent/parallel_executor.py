@@ -40,17 +40,24 @@ def analyze_tool_dependencies(
 
 class ParallelToolExecutor:
     def __init__(self, max_concurrency: int = 5,
-                 timeout_seconds: Optional[float] = 120.0):
+                 timeout_seconds: Optional[float] = 120.0,
+                 mutation_timeout_seconds: Optional[float] = None):
         self.max_concurrency = max_concurrency
         self.timeout = timeout_seconds
+        # Mutating tools run the human approval flow INSIDE execute() —
+        # the read-tool watchdog must not cancel an operator mid-decision
+        # (None = no timeout; the approval race has its own).
+        self.mutation_timeout = mutation_timeout_seconds
 
     async def _execute_one(
-        self, call: ToolCall, execute: Callable[[ToolCall], Awaitable[Any]]
+        self, call: ToolCall, execute: Callable[[ToolCall], Awaitable[Any]],
+        is_mutation: bool = False,
     ) -> ToolResult:
         start = time.perf_counter()
+        timeout = self.mutation_timeout if is_mutation else self.timeout
         try:
-            if self.timeout:
-                result = await asyncio.wait_for(execute(call), timeout=self.timeout)
+            if timeout:
+                result = await asyncio.wait_for(execute(call), timeout=timeout)
             else:
                 result = await execute(call)
             return ToolResult(call=call, result=result,
@@ -70,12 +77,15 @@ class ParallelToolExecutor:
     ) -> list[ToolResult]:
         """Execute calls honoring dependency stages; results in input order."""
         sem = asyncio.Semaphore(self.max_concurrency)
+        tool_map = tools or {}
 
         async def bounded(call: ToolCall) -> ToolResult:
             async with sem:
-                return await self._execute_one(call, execute)
+                tool = tool_map.get(call.name)
+                mut = tool is not None and tool.risk != RiskLevel.READ
+                return await self._execute_one(call, execute, is_mutation=mut)
 
-        stages = analyze_tool_dependencies(calls, tools or {})
+        stages = analyze_tool_dependencies(calls, tool_map)
         by_id: dict[str, ToolResult] = {}
         for stage in stages:
             results = await asyncio.gather(*(bounded(c) for c in stage))

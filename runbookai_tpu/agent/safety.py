@@ -166,8 +166,94 @@ class SafetyManager:
             self._last_critical_ts = time.monotonic()
 
 
+def make_raced_approval(
+    store,
+    input_fn: Optional[Callable[[str], str]] = None,
+    notify: Optional[Callable[[str, "ApprovalRequest"], Awaitable[None]]] = None,
+    timeout_s: float = 300.0,
+    poll_interval_s: float = 0.5,
+) -> ApprovalCallback:
+    """CLI prompt RACING Slack-button responses, with a timeout.
+
+    Reference ``approval.ts:347-547`` (``requestApprovalWithOptions``): a
+    pending-approval file is created in the webhook server's
+    :class:`~runbookai_tpu.server.webhook.ApprovalFileStore`; the operator
+    can answer either on the CLI (stdin, run in a worker thread) or by
+    clicking an approve/reject button in Slack (the webhook writes the
+    response file this callback polls). First decision wins; no decision
+    within ``timeout_s`` denies (fail-safe).
+
+    ``notify`` posts the Slack message carrying the buttons (best-effort —
+    an unconfigured Slack just leaves the CLI as the only racer).
+    ``input_fn=None`` disables the CLI racer (headless gateway mode).
+    """
+    import asyncio
+    import uuid as _uuid
+
+    async def raced(req: ApprovalRequest) -> ApprovalDecision:
+        approval_id = f"ap-{_uuid.uuid4().hex[:10]}"
+        store.create_pending(approval_id, {
+            "operation": req.operation, "risk": req.risk.value,
+            "description": req.description, "params": req.params,
+        })
+        if notify is not None:
+            try:
+                await notify(approval_id, req)
+            except Exception:  # noqa: BLE001 — Slack is an optional racer
+                pass
+
+        cli_task = None
+        if input_fn is not None:
+            prompt = make_cli_approval(input_fn)
+            cli_task = asyncio.ensure_future(prompt(req))
+        deadline = time.monotonic() + timeout_s
+        try:
+            while time.monotonic() < deadline:
+                resp = store.poll_response(approval_id)
+                if resp is not None:
+                    return ApprovalDecision(
+                        approved=bool(resp.get("approved")),
+                        approver=f"slack:{resp.get('user', '')}",
+                        reason="slack button")
+                if cli_task is not None and cli_task.done():
+                    return cli_task.result()
+                await asyncio.sleep(poll_interval_s)
+            return ApprovalDecision(
+                approved=False, approver="timeout",
+                reason=f"no decision within {timeout_s:.0f}s")
+        finally:
+            if cli_task is not None and not cli_task.done():
+                cli_task.cancel()
+            # The request is decided (either way): retire the pending file
+            # so /health stops listing it and a late Slack click can't
+            # "approve" an already-resolved request.
+            store.discard_pending(approval_id)
+
+    return raced
+
+
 def make_cli_approval(input_fn: Callable[[str], str] = input) -> ApprovalCallback:
-    """CLI approval: critical requires typing 'yes' (reference parity)."""
+    """CLI approval: critical requires typing 'yes' (reference parity).
+
+    The blocking read runs in a dedicated DAEMON thread so (a) the event
+    loop stays live — :func:`make_raced_approval` polls Slack buttons while
+    the operator's prompt sits unanswered — and (b) an abandoned prompt
+    (the race was decided elsewhere) cannot hang interpreter exit the way
+    a ``to_thread`` executor worker blocked in ``input()`` would."""
+    import asyncio
+    import threading
+
+    def _read(text: str, loop, fut) -> None:
+        try:
+            answer = input_fn(text)
+        except (EOFError, KeyboardInterrupt):
+            answer = ""
+
+        def deliver() -> None:
+            if not fut.cancelled():
+                fut.set_result(answer)
+
+        loop.call_soon_threadsafe(deliver)
 
     async def prompt(req: ApprovalRequest) -> ApprovalDecision:
         header = (
@@ -176,12 +262,16 @@ def make_cli_approval(input_fn: Callable[[str], str] = input) -> ApprovalCallbac
         )
         if req.rollback_hint:
             header += f"  rollback: {req.rollback_hint}\n"
-        if req.risk == RiskLevel.CRITICAL:
-            answer = input_fn(header + "Type 'yes' to approve: ").strip()
-            ok = answer == "yes"
-        else:
-            answer = input_fn(header + "Approve? [y/N]: ").strip().lower()
-            ok = answer in ("y", "yes")
+        critical = req.risk == RiskLevel.CRITICAL
+        text = header + ("Type 'yes' to approve: " if critical
+                         else "Approve? [y/N]: ")
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        threading.Thread(target=_read, args=(text, loop, fut),
+                         daemon=True).start()
+        answer = await fut
+        ok = (answer.strip() == "yes" if critical
+              else answer.strip().lower() in ("y", "yes"))
         return ApprovalDecision(approved=ok, approver="cli",
                                 reason="operator input")
 

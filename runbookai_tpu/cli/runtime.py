@@ -12,7 +12,11 @@ from typing import Any, Optional
 
 from runbookai_tpu.agent.agent import Agent
 from runbookai_tpu.agent.orchestrator import InvestigationOrchestrator, ToolExecutor
-from runbookai_tpu.agent.safety import SafetyManager, make_cli_approval
+from runbookai_tpu.agent.safety import (
+    SafetyManager,
+    make_cli_approval,  # noqa: F401 — re-exported for callers/tests
+    make_raced_approval,
+)
 from runbookai_tpu.agent.state_machine import InvestigationStateMachine
 from runbookai_tpu.model.client import create_llm_client
 from runbookai_tpu.tools.registry import get_runtime_tools
@@ -36,17 +40,68 @@ def build_runtime(config: Config, interactive: bool = True,
         from runbookai_tpu.knowledge.retriever import create_retriever
 
         knowledge = create_retriever(config)
+    # Approvals RACE the CLI prompt against Slack buttons: the webhook
+    # server (runbook webhook) writes response files into the shared
+    # approvals store, so an operator can answer from either surface
+    # (reference approval.ts:347-547 requestApprovalWithOptions).
+    # Non-interactive runs (--yes / gateway) drop the CLI racer but keep
+    # the Slack leg when configured; with neither surface the SafetyManager
+    # falls back to deny-all (fail-safe).
+    from runbookai_tpu.server.webhook import ApprovalFileStore
+
+    notify = _slack_approval_notify(config)
+    approval = None
+    if interactive or notify is not None:
+        approval = make_raced_approval(
+            ApprovalFileStore(f"{config.runbook_dir}/approvals"),
+            input_fn=input if interactive else None,
+            notify=notify,
+            timeout_s=config.safety.approval_timeout_seconds,
+        )
     safety = SafetyManager(
         require_approval=tuple(config.safety.require_approval),
         auto_approve_low_risk=config.safety.auto_approve_low_risk,
         max_mutations_per_session=config.safety.max_mutations_per_session,
         cooldown_seconds=config.safety.cooldown_seconds,
         audit_dir=f"{config.runbook_dir}/audit",
-        approval_callback=make_cli_approval() if interactive else None,
+        approval_callback=approval,
     )
     tools = get_runtime_tools(config, knowledge=knowledge, safety=safety, llm=llm)
     return Runtime(config=config, llm=llm, tools=tools, knowledge=knowledge,
                    safety=safety)
+
+
+def _slack_approval_notify(config: Config):
+    """Approve/Reject Block Kit message for the raced approval (reference
+    approval.ts posts buttons whose action values carry the approval id;
+    the webhook server writes the clicked decision back to the store).
+    Returns None when Slack isn't configured — the CLI races alone."""
+    inc = config.incident
+    if not (inc.slack.enabled and inc.slack.bot_token
+            and inc.slack.default_channel):
+        return None
+    from runbookai_tpu.tools.incident import SlackClient
+
+    slack = SlackClient(inc.slack.bot_token)
+    channel = inc.slack.default_channel
+
+    async def notify(approval_id: str, req) -> None:
+        blocks = [
+            {"type": "section", "text": {"type": "mrkdwn", "text": (
+                f"*APPROVAL REQUIRED* [{req.risk.value.upper()}] "
+                f"`{req.operation}`\n{req.description}")}},
+            {"type": "actions", "elements": [
+                {"type": "button", "action_id": "approve",
+                 "style": "primary", "value": approval_id,
+                 "text": {"type": "plain_text", "text": "Approve"}},
+                {"type": "button", "action_id": "reject", "style": "danger",
+                 "value": approval_id,
+                 "text": {"type": "plain_text", "text": "Reject"}},
+            ]},
+        ]
+        await slack.post_message(channel, req.description, blocks=blocks)
+
+    return notify
 
 
 def _db_exists(config: Config) -> bool:

@@ -45,15 +45,34 @@ class ApprovalFileStore:
         pending = self.root / "pending" / f"{approval_id}.json"
         if not pending.is_file():
             return False
-        (self.root / "responses" / f"{approval_id}.json").write_text(json.dumps({
+        # Atomic write (tmp + rename): the approval race polls this path
+        # every ~0.5s, and a half-written file must never be readable.
+        final = self.root / "responses" / f"{approval_id}.json"
+        tmp = final.with_suffix(".tmp")
+        tmp.write_text(json.dumps({
             "approved": approved, "user": user, "ts": time.time()}))
+        tmp.replace(final)
         pending.unlink()
         return True
+
+    def discard_pending(self, approval_id: str) -> None:
+        """Retire a decided request: the CLI/timeout leg of the approval
+        race resolved it, so the pending file (and any unread response)
+        must go — a late Slack click then correctly reports 'expired'."""
+        for path in (self.root / "pending" / f"{approval_id}.json",
+                     self.root / "responses" / f"{approval_id}.json"):
+            try:
+                path.unlink(missing_ok=True)
+            except OSError:
+                pass
 
     def poll_response(self, approval_id: str) -> Optional[dict[str, Any]]:
         path = self.root / "responses" / f"{approval_id}.json"
         if path.is_file():
-            data = json.loads(path.read_text())
+            try:
+                data = json.loads(path.read_text())
+            except json.JSONDecodeError:
+                return None  # mid-write on a non-atomic FS: retry next tick
             path.unlink()
             return data
         return None

@@ -100,7 +100,7 @@ def get_runtime_tools(config, registry: Optional[ToolRegistry] = None,
         else:
             from runbookai_tpu.tools import kubernetes as k8s_tools
 
-            k8s_tools.register(reg, config)
+            k8s_tools.register(reg, config, safety=safety)
     obs = config.observability
     if obs.datadog.enabled or obs.prometheus.enabled:
         if (obs.datadog.enabled and obs.datadog.simulated) or (
