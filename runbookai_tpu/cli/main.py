@@ -55,6 +55,35 @@ def cmd_ask(args) -> int:
     return 0
 
 
+def cmd_deploy(args) -> int:
+    """Deploy via the deploy-service skill through the agent loop (reference
+    cli.tsx:1556) — pre-deployment checks first; mutations route through the
+    safety/approval gate like any other remediation."""
+    from runbookai_tpu.cli.runtime import build_agent, build_runtime
+
+    config = _load(args)
+    runtime = build_runtime(config, interactive=not args.yes)
+    agent = build_agent(runtime)
+    version = f" version {args.version}" if args.version else ""
+    if args.dry_run:
+        query = (f"Show me what would happen if I deploy {args.service} to "
+                 f"{args.environment}{version}. Do not execute, just explain "
+                 "the steps.")
+    else:
+        query = (f"Deploy {args.service} to {args.environment}{version} using "
+                 "the deploy-service skill. Perform all pre-deployment checks "
+                 "first.")
+    print(f"Deploying {args.service} to {args.environment}..."
+          + (" (dry run)" if args.dry_run else ""))
+
+    async def run() -> None:
+        async for ev in agent.run(query):
+            _print_event(ev)
+
+    asyncio.run(run())
+    return 0
+
+
 def cmd_chat(args) -> int:
     from runbookai_tpu.agent.memory import ConversationMemory
     from runbookai_tpu.cli.runtime import build_agent, build_runtime
@@ -119,7 +148,9 @@ def cmd_investigate(args) -> int:
         from runbookai_tpu.learning.loop import run_learning_loop
 
         artifacts = asyncio.run(run_learning_loop(
-            runtime.llm, result, out_dir=f"{config.runbook_dir}/learning"))
+            runtime.llm, result, out_dir=f"{config.runbook_dir}/learning",
+            base_dir=config.runbook_dir,
+            apply_updates=getattr(args, "apply_learnings", False)))
         print(f"learning artifacts: {artifacts}")
     return 0
 
@@ -534,11 +565,25 @@ def build_parser() -> argparse.ArgumentParser:
     chat = sub.add_parser("chat", help="interactive conversation")
     chat.set_defaults(fn=cmd_chat)
 
+    dep = sub.add_parser("deploy", help="deploy a service via the deploy-service skill")
+    dep.add_argument("service")
+    dep.add_argument("-e", "--environment", default="production")
+    dep.add_argument("--version", default=None)
+    dep.add_argument("--dry-run", action="store_true")
+    dep.add_argument("--yes", action="store_true",
+                     help="non-interactive: no CLI prompts; mutations are "
+                          "approved via Slack buttons when configured, "
+                          "denied otherwise")
+    dep.set_defaults(fn=cmd_deploy)
+
     inv = sub.add_parser("investigate", help="structured incident investigation")
     inv.add_argument("incident_id")
     inv.add_argument("--description", default="")
     inv.add_argument("--execute", action="store_true",
                      help="execute the remediation plan (approval-gated)")
+    inv.add_argument("--apply-learnings", action="store_true",
+                     help="apply runbook updates to the local library "
+                          "instead of writing proposals")
     inv.add_argument("--learn", action="store_true",
                      help="run the learning loop afterwards")
     inv.add_argument("--yes", action="store_true")

@@ -3,7 +3,9 @@
 Parity target: reference ``src/learning/loop.ts`` (``runLearningLoop`` :636) —
 generates a postmortem draft, ``knowledge-suggestions.json``, and runbook
 update proposals into ``.runbook/learning/<id>/`` from the investigation's
-events and conclusion.
+events and conclusion. Proposals are matched against the local runbook
+library and optionally applied (``apply_updates``) — see
+:mod:`runbookai_tpu.learning.runbook_updates`.
 """
 
 from __future__ import annotations
@@ -30,9 +32,15 @@ What went poorly, Action items (with owners as TODO).
 SUGGESTIONS_PROMPT = """\
 From this investigation, propose knowledge-base updates. Respond with ONLY a
 JSON object:
-{{"suggestions": [{{"type": "runbook|known-issue|architecture",
+{{"suggestions": [{{"type": "update_runbook|new_runbook|new_known_issue",
    "title": "...", "reason": "...", "services": ["..."],
-   "outline": "..."}}]}}
+   "confidence": "high|medium|low",
+   "content_markdown": "the section/document body in markdown"}}]}}
+
+Prefer "update_runbook" when an existing runbook likely applies.
+
+Existing local runbooks:
+{runbook_context}
 
 Root cause: {root_cause}
 Services: {services}
@@ -50,14 +58,30 @@ def _timeline(result) -> str:
     return "\n".join(lines) or "(no recorded events)"
 
 
-async def run_learning_loop(llm, result, out_dir: str | Path = ".runbook/learning") -> Path:
-    """Generate artifacts for one investigation result; returns the dir."""
+async def run_learning_loop(llm, result,
+                            out_dir: str | Path = ".runbook/learning",
+                            base_dir: str | Path = ".runbook",
+                            apply_updates: bool = False) -> Path:
+    """Generate artifacts for one investigation result; returns the dir.
+
+    ``apply_updates=True`` appends matched learnings to local runbooks and
+    writes new runbooks into the library; the default writes proposal files
+    under the artifact dir for operator review (loop.ts:514-617).
+    """
+    from runbookai_tpu.learning.runbook_updates import (
+        apply_suggestion,
+        scan_local_runbooks,
+    )
     from runbookai_tpu.model.chat_template import extract_json
 
     inv_id = result.summary.get("incident_id", f"inv-{int(time.time())}")
     d = Path(out_dir) / inv_id
     d.mkdir(parents=True, exist_ok=True)
     timeline = _timeline(result)
+    runbooks = scan_local_runbooks(base_dir)
+    runbook_context = "\n".join(
+        f"- {rb.title} (services: {', '.join(rb.services) or 'unknown'})"
+        for rb in runbooks[:12]) or "No local runbooks found."
 
     postmortem = await llm.complete(POSTMORTEM_PROMPT.format(
         root_cause=result.root_cause, confidence=result.confidence,
@@ -69,15 +93,25 @@ async def run_learning_loop(llm, result, out_dir: str | Path = ".runbook/learnin
     raw = await llm.complete(SUGGESTIONS_PROMPT.format(
         root_cause=result.root_cause,
         services=", ".join(result.affected_services), timeline=timeline,
+        runbook_context=runbook_context,
     ))
     payload = extract_json(raw)
     suggestions: list[dict[str, Any]] = []
     if isinstance(payload, dict) and isinstance(payload.get("suggestions"), list):
         suggestions = [s for s in payload["suggestions"] if isinstance(s, dict)]
+    applied: list[str] = []
+    proposed: list[str] = []
+    for s in suggestions:
+        outcome = apply_suggestion(s, runbooks, d, Path(base_dir),
+                                   apply_updates, inv_id)
+        applied += outcome.applied
+        proposed += outcome.proposed
     (d / "knowledge-suggestions.json").write_text(json.dumps({
         "investigation_id": inv_id,
         "generated_at": time.time(),
         "suggestions": suggestions,
+        "applied": applied,
+        "proposed": proposed,
     }, indent=2))
 
     (d / "record.json").write_text(json.dumps({

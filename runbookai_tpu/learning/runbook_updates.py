@@ -1,0 +1,209 @@
+"""Runbook-update proposals + application — the learning loop's second half.
+
+Parity target: reference ``src/learning/loop.ts`` (:480-636): typed
+knowledge suggestions (``update_runbook`` / ``new_runbook`` /
+``new_known_issue``) are matched against the local runbook library
+(``<base>/runbooks/*.md`` with frontmatter), and either **applied** (an
+"Incident Learnings" section appended to the best-matching runbook, or a
+new frontmattered runbook written into the library) or written as
+**proposal files** under ``.runbook/learning/<id>/{proposals,
+runbook-updates}/`` for operator review. Application is opt-in
+(``apply_updates``) — proposals are the safe default.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+
+@dataclass
+class LocalRunbook:
+    path: Path
+    title: str
+    services: list[str]
+    content: str
+
+
+@dataclass
+class ApplyOutcome:
+    applied: list[str] = field(default_factory=list)
+    proposed: list[str] = field(default_factory=list)
+
+
+_FRONTMATTER = re.compile(r"\A---\s*\n(.*?)\n---\s*\n", re.DOTALL)
+
+
+def _parse_frontmatter(text: str) -> dict[str, Any]:
+    m = _FRONTMATTER.match(text)
+    if not m:
+        return {}
+    out: dict[str, Any] = {}
+    for line in m.group(1).splitlines():
+        if ":" not in line:
+            continue
+        key, _, val = line.partition(":")
+        val = val.strip()
+        if val.startswith("[") and val.endswith("]"):
+            out[key.strip()] = [v.strip().strip("'\"")
+                                for v in val[1:-1].split(",") if v.strip()]
+        else:
+            out[key.strip()] = val.strip("'\"")
+    return out
+
+
+def scan_local_runbooks(base_dir: str | Path) -> list[LocalRunbook]:
+    """Markdown runbooks under ``<base>/runbooks`` (frontmatter title/services
+    with filename/heading fallbacks — reference loop.ts:161-187)."""
+    root = Path(base_dir) / "runbooks"
+    out: list[LocalRunbook] = []
+    if not root.is_dir():
+        return out
+    for path in sorted(root.rglob("*.md")):
+        try:
+            content = path.read_text()
+        except OSError:
+            continue
+        fm = _parse_frontmatter(content)
+        title = str(fm.get("title", ""))
+        if not title:
+            heading = next((l for l in content.splitlines()
+                            if l.startswith("# ")), "")
+            title = heading[2:].strip() or path.stem.replace("-", " ")
+        services = fm.get("services", [])
+        if isinstance(services, str):
+            services = [services]
+        out.append(LocalRunbook(path=path, title=title,
+                                services=[str(s) for s in services],
+                                content=content))
+    return out
+
+
+def _tokens(text: str) -> set[str]:
+    return {t for t in re.split(r"[^a-z0-9]+", text.lower()) if len(t) > 2}
+
+
+def score_runbook_match(suggestion: dict[str, Any], rb: LocalRunbook) -> int:
+    """Service + title-token overlap score (reference loop.ts:443-470)."""
+    score = 0
+    title = rb.title.lower()
+    for svc in suggestion.get("services") or []:
+        s = str(svc).lower()
+        if s and s in (x.lower() for x in rb.services):
+            score += 5
+        if s and s in title:
+            score += 2
+    overlap = _tokens(str(suggestion.get("title", ""))) & _tokens(rb.title)
+    score += len(overlap)
+    return score
+
+
+def find_best_runbook(suggestion: dict[str, Any],
+                      runbooks: list[LocalRunbook]) -> Optional[LocalRunbook]:
+    best, best_score = None, 0
+    for rb in runbooks:
+        s = score_runbook_match(suggestion, rb)
+        if s > best_score:
+            best, best_score = rb, s
+    return best
+
+
+def _slug(text: str) -> str:
+    return re.sub(r"[^a-z0-9]+", "-", text.lower()).strip("-") or "update"
+
+
+def render_learning_section(suggestion: dict[str, Any],
+                            incident_label: str) -> str:
+    return "\n".join([
+        f"## Incident Learnings ({incident_label})",
+        "",
+        f"### {suggestion.get('title', 'Untitled learning')}",
+        "",
+        f"Rationale: {suggestion.get('reason', suggestion.get('reasoning', ''))}",
+        "",
+        str(suggestion.get("content_markdown",
+                           suggestion.get("outline", ""))).strip(),
+        "",
+    ])
+
+
+def _frontmatter(doc_type: str, suggestion: dict[str, Any]) -> str:
+    services = ", ".join(str(s) for s in suggestion.get("services") or [])
+    return "\n".join([
+        "---",
+        f"type: {doc_type}",
+        f"title: {suggestion.get('title', 'Untitled')}",
+        f"services: [{services}]",
+        "tags: [generated, incident-learning]",
+        "---",
+        "",
+    ])
+
+
+def apply_suggestion(
+    suggestion: dict[str, Any],
+    runbooks: list[LocalRunbook],
+    artifact_dir: Path,
+    base_dir: Path,
+    apply_updates: bool,
+    incident_label: str,
+) -> ApplyOutcome:
+    """One suggestion → applied file or proposal file (loop.ts:514-617)."""
+    out = ApplyOutcome()
+    proposals = artifact_dir / "proposals"
+    rb_updates = artifact_dir / "runbook-updates"
+    proposals.mkdir(parents=True, exist_ok=True)
+    rb_updates.mkdir(parents=True, exist_ok=True)
+    stype = str(suggestion.get("type", "new_known_issue"))
+    section = render_learning_section(suggestion, incident_label)
+
+    if stype == "update_runbook":
+        target = find_best_runbook(suggestion, runbooks)
+        if target is not None and apply_updates:
+            if section not in target.content:
+                target.content = target.content.rstrip() + "\n\n" + section + "\n"
+                target.path.write_text(target.content)
+            out.applied.append(str(target.path))
+            return out
+        name = _slug(f"{suggestion.get('title', '')}-{incident_label}")
+        proposal = rb_updates / f"{name}.md"
+        proposal.write_text("\n".join([
+            "# Runbook Update Proposal",
+            "",
+            f"- Incident: {incident_label}",
+            f"- Suggested target: "
+            f"{target.title if target else 'no-local-runbook-match'}",
+            f"- Suggested target path: "
+            f"{target.path if target else 'n/a'}",
+            f"- Confidence: {suggestion.get('confidence', 'unknown')}",
+            "",
+            section,
+        ]))
+        out.proposed.append(str(proposal))
+        return out
+
+    if stype == "new_runbook":
+        filename = f"{_slug(str(suggestion.get('title', 'new-runbook')))}.md"
+        content = _frontmatter("runbook", suggestion) + "\n" + \
+            str(suggestion.get("content_markdown",
+                               suggestion.get("outline", ""))).strip() + "\n"
+        if apply_updates:
+            dest = base_dir / "runbooks" / filename
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            dest.write_text(content)
+            out.applied.append(str(dest))
+        else:
+            dest = proposals / filename
+            dest.write_text(content)
+            out.proposed.append(str(dest))
+        return out
+
+    # new_known_issue: always a proposal (known issues need operator triage)
+    dest = proposals / f"{_slug(str(suggestion.get('title', 'known-issue')))}-known-issue.md"
+    dest.write_text(_frontmatter("known_issue", suggestion) + "\n" +
+                    str(suggestion.get("content_markdown",
+                                       suggestion.get("outline", ""))).strip() + "\n")
+    out.proposed.append(str(dest))
+    return out

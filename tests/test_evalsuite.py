@@ -2,6 +2,7 @@
 dataset converters, report writing."""
 
 import json
+from pathlib import Path
 
 import pytest
 
@@ -206,3 +207,127 @@ def test_setup_datasets_gracefully_fails_offline(tmp_path, monkeypatch):
     monkeypatch.setattr(ra.subprocess, "run", fake_run)
     statuses = ra.setup_datasets(tmp_path, ["rcaeval"])
     assert statuses["rcaeval"].startswith("failed")
+
+
+# ---------------------------------------------------------------- learning
+
+
+class _LearningLLM:
+    """Canned postmortem + typed suggestions."""
+
+    def __init__(self, suggestions):
+        import json as _json
+
+        self._suggestions = _json.dumps({"suggestions": suggestions})
+        self._first = True
+
+    async def complete(self, prompt, schema=None):
+        if self._first:
+            self._first = False
+            return "# Postmortem\nDraft."
+        return self._suggestions
+
+
+def _result():
+    from types import SimpleNamespace
+
+    return SimpleNamespace(
+        summary={"incident_id": "PD-77"}, root_cause="db pool exhausted",
+        confidence="high", affected_services=["payment-api"],
+        conclusion_summary="pool too small", remediation=None, events=[],
+    )
+
+
+async def test_learning_loop_writes_runbook_update_proposal(tmp_path):
+    """update_runbook suggestion + matching local runbook → a proposal file
+    under learning/<id>/runbook-updates (reference loop.ts:514-567)."""
+    from runbookai_tpu.learning.loop import run_learning_loop
+
+    rb_dir = tmp_path / "runbooks"
+    rb_dir.mkdir()
+    (rb_dir / "payment-api.md").write_text(
+        "---\ntitle: Payment API runbook\nservices: [payment-api]\n---\n\n# Payment API runbook\nsteps\n")
+    llm = _LearningLLM([{
+        "type": "update_runbook", "title": "Check db pool size after deploys",
+        "reason": "root cause was pool shrink", "services": ["payment-api"],
+        "confidence": "high", "content_markdown": "1. check pool metrics",
+    }])
+    d = await run_learning_loop(llm, _result(), out_dir=tmp_path / "learning",
+                                base_dir=tmp_path)
+    import json as _json
+
+    meta = _json.loads((d / "knowledge-suggestions.json").read_text())
+    assert len(meta["proposed"]) == 1 and not meta["applied"]
+    proposal = (d / "runbook-updates").glob("*.md")
+    text = next(proposal).read_text()
+    assert "Payment API runbook" in text  # matched the right target
+    assert "check pool metrics" in text
+
+
+async def test_learning_loop_applies_update_when_opted_in(tmp_path):
+    from runbookai_tpu.learning.loop import run_learning_loop
+
+    rb_dir = tmp_path / "runbooks"
+    rb_dir.mkdir()
+    rb = rb_dir / "payment-api.md"
+    rb.write_text("---\ntitle: Payment API runbook\nservices: [payment-api]\n---\n\nbody\n")
+    llm = _LearningLLM([{
+        "type": "update_runbook", "title": "Check db pool size",
+        "reason": "r", "services": ["payment-api"], "confidence": "high",
+        "content_markdown": "1. check pool metrics",
+    }])
+    d = await run_learning_loop(llm, _result(), out_dir=tmp_path / "learning",
+                                base_dir=tmp_path, apply_updates=True)
+    assert "Incident Learnings (PD-77)" in rb.read_text()
+    import json as _json
+
+    meta = _json.loads((d / "knowledge-suggestions.json").read_text())
+    assert meta["applied"] == [str(rb)]
+    # idempotent: running again must not duplicate the section
+    await run_learning_loop(llm.__class__([{
+        "type": "update_runbook", "title": "Check db pool size",
+        "reason": "r", "services": ["payment-api"], "confidence": "high",
+        "content_markdown": "1. check pool metrics",
+    }]), _result(), out_dir=tmp_path / "learning", base_dir=tmp_path,
+        apply_updates=True)
+    assert rb.read_text().count("Incident Learnings (PD-77)") == 1
+
+
+async def test_learning_loop_new_runbook_and_known_issue(tmp_path):
+    from runbookai_tpu.learning.loop import run_learning_loop
+
+    llm = _LearningLLM([
+        {"type": "new_runbook", "title": "Scale the pool",
+         "services": ["db"], "content_markdown": "## Steps\n1. scale"},
+        {"type": "new_known_issue", "title": "Pool shrinks on deploy",
+         "services": ["db"], "content_markdown": "Known issue body"},
+    ])
+    d = await run_learning_loop(llm, _result(), out_dir=tmp_path / "learning",
+                                base_dir=tmp_path, apply_updates=True)
+    # new runbook applied into the library; known issue always a proposal
+    assert (tmp_path / "runbooks" / "scale-the-pool.md").is_file()
+    proposals = list((d / "proposals").glob("*known-issue.md"))
+    assert len(proposals) == 1
+    assert "type: known_issue" in proposals[0].read_text()
+
+
+def test_converters_chew_checked_in_mini_datasets(tmp_path):
+    """Each benchmark converter processes a real (mini) dataset file in its
+    native format — closing VERDICT r2 missing #6 without egress. The
+    converted fixtures must load through the eval runner's fixture schema."""
+    from runbookai_tpu.evalsuite.converters import convert
+    from runbookai_tpu.evalsuite.runner import load_fixtures_file
+
+    root = Path(__file__).parent.parent / "examples" / "evals" / "datasets"
+    for bench, src, want_cases, want_service in (
+        ("rcaeval", "rcaeval-mini.csv", 3, "ts-order-service"),
+        ("rootly", "rootly-mini.jsonl", 2, "checkout-api"),
+        ("tracerca", "tracerca-mini.tsv", 2, "payment-svc"),
+    ):
+        dst = tmp_path / f"{bench}.json"
+        n = convert(bench, root / src, dst)
+        assert n == want_cases
+        cases = load_fixtures_file(dst)
+        assert len(cases) == want_cases
+        assert any(want_service in c.expected_services for c in cases)
+        assert all(c.expected_root_cause for c in cases)
