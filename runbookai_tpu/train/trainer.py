@@ -29,14 +29,21 @@ class TrainState:
     step: int = 0
 
 
-def loss_fn(params, cfg: LlamaConfig, tokens: jnp.ndarray, pad_id: int) -> jnp.ndarray:
-    """Mean next-token cross-entropy, ignoring pad targets."""
-    logits = forward_train(params, cfg, tokens[:, :-1])
-    targets = tokens[:, 1:]
+def masked_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray,
+                         pad_id: int) -> jnp.ndarray:
+    """Mean next-token cross-entropy over non-pad targets — THE loss
+    definition, shared by the dense and pipeline forwards so the two
+    cannot drift."""
     mask = (targets != pad_id).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def loss_fn(params, cfg: LlamaConfig, tokens: jnp.ndarray, pad_id: int) -> jnp.ndarray:
+    """Mean next-token cross-entropy, ignoring pad targets."""
+    logits = forward_train(params, cfg, tokens[:, :-1])
+    return masked_cross_entropy(logits, tokens[:, 1:], pad_id)
 
 
 class Trainer:
@@ -58,7 +65,32 @@ class Trainer:
         self.pad_id = pad_id
         self.tx = optax.adamw(learning_rate, weight_decay=weight_decay)
 
-        p_shard = param_shardings(cfg, mesh)
+        # Pipeline mode: with a pipe axis > 1, layers shard stage-wise and
+        # the GPipe forward/backward runs the schedule (VERDICT r2 #9 —
+        # "don't call it pipeline parallelism until a train step runs on a
+        # pipe mesh"). DP/TP mode otherwise (Megatron shardings).
+        from runbookai_tpu.parallel.mesh import PIPE_AXIS
+
+        self.pipeline = mesh.shape.get(PIPE_AXIS, 1) > 1
+        if self.pipeline:
+            from runbookai_tpu.parallel.pipeline import (
+                loss_fn_pp,
+                pp_param_shardings,
+            )
+
+            if cfg.n_layers % mesh.shape[PIPE_AXIS]:
+                raise ValueError(
+                    f"{cfg.n_layers} layers not divisible by "
+                    f"{mesh.shape[PIPE_AXIS]} pipeline stages")
+            p_shard = pp_param_shardings(cfg, mesh)
+            self.n_microbatches = max(2, mesh.shape[PIPE_AXIS])
+
+            def fwd(params, cfg_, tokens, pad):
+                return loss_fn_pp(params, cfg_, tokens, pad, mesh,
+                                  n_microbatches=self.n_microbatches)
+        else:
+            p_shard = param_shardings(cfg, mesh)
+            fwd = loss_fn
         params = init_params(jax.random.PRNGKey(seed), cfg, dtype=dtype)
         params = jax.tree.map(
             lambda x, s: jax.device_put(x, s), params, p_shard,
@@ -66,13 +98,13 @@ class Trainer:
         )
         opt_state = self.tx.init(params)
         self.state = TrainState(params=params, opt_state=opt_state)
-        self.batch_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+        batch_spec = P() if self.pipeline else P(DATA_AXIS, None)
+        self.batch_sharding = NamedSharding(mesh, batch_spec)
 
-        fwd = loss_fn
         if remat:
             # Rematerialize the forward to trade FLOPs for HBM (activation
             # memory is the training bottleneck on 16GB v5e chips).
-            fwd = jax.checkpoint(loss_fn, static_argnums=(1,))
+            fwd = jax.checkpoint(fwd, static_argnums=(1,))
 
         def step_fn(params, opt_state, tokens):
             loss, grads = jax.value_and_grad(fwd)(params, cfg, tokens, pad_id)

@@ -43,3 +43,48 @@ def test_pipeline_composes_with_dp():
     ref = forward_train(params, CFG, tokens)
     out = forward_train_pp(params, CFG, tokens, mesh, n_microbatches=2)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-4, rtol=3e-4)
+
+
+def test_pipeline_backward_grads_match_dense():
+    """jax.grad through the GPipe schedule must equal grads of the dense
+    forward — scan ticks, ppermute hops, stage masks and the psum'd head
+    all have exact transposes (VERDICT r2 next-round #9)."""
+    from runbookai_tpu.parallel.pipeline import loss_fn_pp
+    from runbookai_tpu.train.trainer import loss_fn
+
+    cfg = CONFIGS["llama3-test"]
+    mesh = build_mesh(pipe=2)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(3, 200, size=(4, 17)), jnp.int32)
+
+    dense_loss, dense_grads = jax.value_and_grad(loss_fn)(
+        params, cfg, tokens, 0)
+    pp_loss, pp_grads = jax.value_and_grad(
+        lambda p: loss_fn_pp(p, cfg, tokens, 0, mesh, n_microbatches=2))(params)
+
+    np.testing.assert_allclose(float(pp_loss), float(dense_loss),
+                               atol=1e-4, rtol=1e-4)
+    flat_d, _ = jax.tree.flatten(dense_grads)
+    flat_p, _ = jax.tree.flatten(pp_grads)
+    for d, p in zip(flat_d, flat_p):
+        np.testing.assert_allclose(np.asarray(p), np.asarray(d),
+                                   atol=5e-3, rtol=5e-3)
+
+
+def test_pipeline_trainer_loss_decreases():
+    """A real train step on a pipe mesh: layers sharded stage-wise, loss
+    decreasing over repeated steps on one batch."""
+    from runbookai_tpu.parallel.mesh import PIPE_AXIS
+    from runbookai_tpu.train.trainer import Trainer
+
+    cfg = CONFIGS["llama3-test"]
+    mesh = build_mesh(pipe=2)
+    trainer = Trainer(cfg, mesh, learning_rate=5e-3, dtype=jnp.float32)
+    assert trainer.pipeline
+    # layers really are stage-sharded
+    spec = trainer.state.params["layers"]["wq"].sharding.spec
+    assert spec[0] == PIPE_AXIS
+    tokens = np.random.default_rng(1).integers(3, 200, size=(4, 17))
+    losses = [trainer.train_step(tokens) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
