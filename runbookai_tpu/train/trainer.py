@@ -69,9 +69,10 @@ class Trainer:
         # the GPipe forward/backward runs the schedule (VERDICT r2 #9 —
         # "don't call it pipeline parallelism until a train step runs on a
         # pipe mesh"). DP/TP mode otherwise (Megatron shardings).
-        from runbookai_tpu.parallel.mesh import PIPE_AXIS
+        from runbookai_tpu.parallel.mesh import PIPE_AXIS, SEQ_AXIS
 
         self.pipeline = mesh.shape.get(PIPE_AXIS, 1) > 1
+        self.sequence_parallel = mesh.shape.get(SEQ_AXIS, 1) > 1
         if self.pipeline:
             from runbookai_tpu.parallel.pipeline import (
                 loss_fn_pp,
@@ -88,6 +89,19 @@ class Trainer:
             def fwd(params, cfg_, tokens, pad):
                 return loss_fn_pp(params, cfg_, tokens, pad, mesh,
                                   n_microbatches=self.n_microbatches)
+        elif self.sequence_parallel:
+            # SP mode: ring attention shards the SEQUENCE over the seq
+            # axis (long-context training — the scale-out lever SURVEY
+            # §5.7 names); params replicate, grads are exact (ppermute's
+            # transpose is the reverse rotation; verified against dense
+            # in tests). tokens [B, T-1] must have T-1 % seq == 0.
+            from runbookai_tpu.parallel.sequence_parallel import forward_train_sp
+
+            p_shard = param_shardings(cfg, mesh)
+
+            def fwd(params, cfg_, tokens, pad):
+                logits = forward_train_sp(params, cfg_, tokens[:, :-1], mesh)
+                return masked_cross_entropy(logits, tokens[:, 1:], pad)
         else:
             p_shard = param_shardings(cfg, mesh)
             fwd = loss_fn
@@ -98,7 +112,8 @@ class Trainer:
         )
         opt_state = self.tx.init(params)
         self.state = TrainState(params=params, opt_state=opt_state)
-        batch_spec = P() if self.pipeline else P(DATA_AXIS, None)
+        batch_spec = (P() if self.pipeline or self.sequence_parallel
+                      else P(DATA_AXIS, None))
         self.batch_sharding = NamedSharding(mesh, batch_spec)
 
         if remat:
