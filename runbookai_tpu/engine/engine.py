@@ -80,6 +80,12 @@ class EngineConfig:
     # acceptance rates are high; a miss still yields one token per dispatch.
     speculative: bool = True
     spec_ngram: int = 3
+    # Grammar fast-forward for guided requests: emit mask-forced token runs
+    # without per-token decode dispatches by folding them into a prefill
+    # chunk. A win where dispatch latency dominates (the tunneled TPU pays
+    # ~70ms per host sync regardless of T); a LOSS on CPU, where compute
+    # scales with the padded chunk length — None = auto (on for tpu/axon).
+    grammar_fast_forward: Optional[bool] = None
 
 
 @partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl",
@@ -308,6 +314,20 @@ class EngineCore:
             self.prefilling.append(req)
             in_flight += 1
 
+    @staticmethod
+    def _fold_into_prompt(req: EngineRequest, prefill_pos: int) -> None:
+        """Fold generated tokens into the prompt. They move to
+        folded_out_ids (not out_ids) so ctx_len never double-counts them
+        and the output/budget accounting still sees every generated token.
+        ``prefill_pos`` says how much of the new prompt already has K/V in
+        the pool (0 for preemption-recompute; the written length for the
+        grammar fast-forward, which keeps its pages)."""
+        req.prompt_ids = req.prompt_ids + req.out_ids
+        req.folded_out_ids = req.folded_out_ids + req.out_ids
+        req.out_ids = []
+        req.block_hashes = None
+        req.prefill_pos = prefill_pos
+
     def _preempt_youngest(self) -> bool:
         """Evict the most recently admitted decoding request (recompute)."""
         if not self.decoding:
@@ -320,14 +340,7 @@ class EngineCore:
         # Publish the victim's full pages before freeing: re-admission will
         # match its own prefix and recompute only the tail.
         self.kv.release(victim.request_id, token_ids=self._kv_valid_tokens(victim))
-        # Fold generated tokens into the prompt for recompute. They move to
-        # folded_out_ids (not out_ids) so ctx_len never double-counts them and
-        # the output/budget accounting still sees every generated token.
-        victim.prompt_ids = victim.prompt_ids + victim.out_ids
-        victim.folded_out_ids = victim.folded_out_ids + victim.out_ids
-        victim.out_ids = []
-        victim.block_hashes = None
-        victim.prefill_pos = 0
+        self._fold_into_prompt(victim, prefill_pos=0)
         victim.state = RequestState.WAITING
         self.waiting.insert(0, victim)
         self.metrics["preemptions"] += 1
@@ -599,6 +612,88 @@ class EngineCore:
         self.metrics["decode_steps"] += 1
         self.metrics["decode_time_s"] += time.perf_counter() - t0
 
+    def _grammar_fast_forward(self, req: EngineRequest) -> None:
+        """Emit a grammar-FORCED token run without per-token model dispatches.
+
+        Schema-guided documents are dominated by deterministic stretches
+        (object keys, quotes, separators — with a byte tokenizer well over
+        half the bytes): wherever the mask admits exactly ONE token there is
+        nothing to sample, so decoding them one 70ms host round-trip at a
+        time is pure overhead. Probe the grammar on a COPY, and when a run
+        of ≥4 forced tokens exists, emit the whole run at once and fold it
+        (with the pending last token) into the prompt — the prefill path
+        then writes their K/V in chunked batches and samples the next free
+        token with the post-run mask. The same fold preemption uses, minus
+        the page release.
+        """
+        enabled = self.ecfg.grammar_fast_forward
+        if enabled is None:
+            enabled = jax.default_backend() in ("tpu", "axon")
+        if not enabled:
+            return
+        if not (self.mask_fn and self.advance_fn and req.sampling.guided):
+            return
+        if req.sampling.stop_strings:
+            # Forced runs would bypass the stop-string tail scan; rare for
+            # guided requests, so just leave them on the per-token path.
+            return
+        budget = req.sampling.max_new_tokens - req.num_generated
+        if budget <= 0:
+            return
+        orig = req.guided_state
+        if orig is None:
+            self.mask_fn(req)  # provider initializes the machine lazily
+            orig = req.guided_state
+            if orig is None:
+                return
+        probe = orig.copy()
+        req.guided_state = probe
+        forced: list[int] = []
+        cap = min(budget, 4 * self.ecfg.prefill_chunk,
+                  self.ecfg.max_seq_len - req.ctx_len - 1)
+        stop_ids = set(req.sampling.stop_token_ids) | {
+            self.tokenizer.eos_id, self.tokenizer.eot_id}
+        try:
+            while len(forced) < cap:
+                m = self.mask_fn(req)
+                if m is None:
+                    break
+                ids = np.nonzero(m)[0]
+                if ids.size != 1 or int(ids[0]) in stop_ids:
+                    break  # stop tokens take the normal emit/finish path
+                tok = int(ids[0])
+                forced.append(tok)
+                if self.advance_fn(req, tok):
+                    break  # grammar completed inside the run
+        except Exception:
+            req.guided_state = orig  # surface provider bugs, state restored
+            raise
+        if len(forced) < 4:
+            req.guided_state = orig  # not worth a fold: restore
+            return
+        # Commit: the advanced probe IS the new grammar state. Forced tokens
+        # are counted separately (not in decode_tokens: their K/V cost lands
+        # in the prefill fold, so booking them as decode throughput would
+        # inflate the BASELINE decode-tok/s metric).
+        req.out_ids.extend(forced)
+        self._last_token[req.request_id] = forced[-1]
+        self.metrics["grammar_forced_tokens"] = (
+            self.metrics.get("grammar_forced_tokens", 0) + len(forced))
+        # Fold emitted-but-unprocessed tokens (the pending last token + the
+        # forced run) into the prompt BEFORE any finish: _kv_valid_tokens /
+        # prefix publication must only ever claim tokens whose K/V exists.
+        written = req.ctx_len - len(forced) - 1  # tokens with K/V in the pool
+        self._fold_into_prompt(req, prefill_pos=written)
+        self.decoding.remove(req)
+        if req.slot is not None:
+            self._slots[req.slot] = None
+            req.slot = None
+        if req.num_generated >= req.sampling.max_new_tokens:
+            self._finish(req, FinishReason.MAX_TOKENS)
+            return
+        req.state = RequestState.PREFILL
+        self.prefilling.append(req)
+
     def _run_decode(self) -> None:
         if not self.decoding:
             return
@@ -607,6 +702,10 @@ class EngineCore:
         for req in list(self.decoding):
             if req.ctx_len + 1 > self.ecfg.max_seq_len:
                 self._finish(req, FinishReason.MAX_TOKENS)
+        # Grammar fast-forward may move guided requests back to prefill
+        # (their next tokens are forced — no sampling needed).
+        for req in list(self.decoding):
+            self._grammar_fast_forward(req)
         if not self.decoding:
             return
         k = self._pick_k()

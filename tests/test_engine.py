@@ -241,3 +241,59 @@ def test_batched_prefill_fewer_dispatches(setup):
     run(4)
     assert calls[4] < calls[1]
     assert calls[4] <= (calls[1] + 3) // 4 + 1  # ~N/4 dispatches, +1 slack
+
+
+async def test_grammar_fast_forward_skips_forced_decode_steps():
+    """Schema-guided generation: grammar-forced stretches (keys, quotes,
+    separators) are emitted without per-token decode dispatches — the run
+    folds into a prefill chunk. Output must still be schema-valid JSON and
+    the engine must record a large forced-token fraction."""
+    import json
+
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.model.schema_guided import SchemaLimits
+
+    client = JaxTpuClient.for_testing(max_new_tokens=1500, max_seq_len=2048,
+                                      schema_limits=SchemaLimits(max_str_len=32),
+                                      grammar_fast_forward=True)
+    out = await client.complete("triage", schema="triage")
+    await client.shutdown()
+    doc = json.loads(out)
+    assert set(doc) >= {"severity", "summary"}
+    m = client.engine.core.metrics
+    forced = m.get("grammar_forced_tokens", 0)
+    assert forced > 20, f"fast-forward never engaged: {forced}"
+    # Forced tokens outnumbering decode steps means dispatches were saved.
+    assert forced > m["decode_steps"] * 0.2
+
+
+async def test_fast_forward_budget_exhaustion_does_not_poison_prefix_cache():
+    """A forced run that exhausts max_new_tokens finishes WITHOUT computing
+    the forced tokens' K/V — the prefix cache must only be fed pages whose
+    K/V actually exists, or identical replays would decode over garbage
+    (r3 review finding)."""
+    import json
+
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.model.schema_guided import SchemaLimits
+
+    client = JaxTpuClient.for_testing(max_new_tokens=24, max_seq_len=2048,
+                                      schema_limits=SchemaLimits(max_str_len=16),
+                                      grammar_fast_forward=True)
+    core = client.engine.core
+    # Run 1: tiny budget ends inside a forced run (triage's forced prefix
+    # '{"severity":"' alone is 13 byte tokens).
+    out1 = await client.complete("triage", schema="triage")
+    assert core.metrics.get("grammar_forced_tokens", 0) > 0
+    # Every cached page must correspond to written K/V: replay the same
+    # prompt with a big budget and the output must be valid JSON (a poisoned
+    # prefix would steer the grammar identically but decode from garbage
+    # K/V, which the schema machine would quickly reject as the sampled
+    # CONTENT chars diverge — parse failure is the observable).
+    client.max_new_tokens = 1500
+    out2 = await client.complete("triage", schema="triage")
+    await client.shutdown()
+    json.loads(out2)
+    # Cached pages are freed-but-reusable (a subset of free): bookkeeping
+    # must stay within the pool either way.
+    assert core.kv.allocator.cached_pages <= core.kv.allocator.free_pages
