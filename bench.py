@@ -100,8 +100,16 @@ def tunnel_evidence() -> dict:
     # The stdio-pumped relay (when the driver runs it) listens on these
     # loopback ports rather than the terminal default — an open socket on
     # ANY of them means the tunnel exists and init deserves patience.
-    candidates = [port] + [8082, 8083, 8087, 8092, 8093, 8097,
-                           8102, 8103, 8107, 8112, 8113, 8117]
+    # AXON_RELAY_PORTS overrides the sweep (empty = primary port only),
+    # which also keeps the tests hermetic on hosts with a live tunnel.
+    relay_env = os.environ.get("AXON_RELAY_PORTS")
+    if relay_env is not None:
+        relay = [int(p) for p in relay_env.split(",")
+                 if p.strip().isdigit() and 0 < int(p) < 65536]
+    else:
+        relay = [8082, 8083, 8087, 8092, 8093, 8097,
+                 8102, 8103, 8107, 8112, 8113, 8117]
+    candidates = [port] + relay
     ev = {
         "jax_platforms": os.environ.get("JAX_PLATFORMS"),
         "axon_pool_ips": os.environ.get("PALLAS_AXON_POOL_IPS"),

@@ -12,7 +12,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from runbookai_tpu.utils.cpu_mesh import force_cpu_platform
 
-force_cpu_platform(8)
+# RUNBOOK_ON_DEVICE=1 skips the CPU forcing so tests/test_pallas_on_device.py
+# can see the session's real accelerator:
+#   RUNBOOK_ON_DEVICE=1 pytest tests/test_pallas_on_device.py
+if os.environ.get("RUNBOOK_ON_DEVICE", "0") in ("", "0"):
+    force_cpu_platform(8)
 
 import jax
 

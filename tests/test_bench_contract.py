@@ -53,6 +53,7 @@ def test_tunnel_evidence_shape(monkeypatch):
 
     monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE", "127.0.0.1")
     monkeypatch.setenv("AXON_TERMINAL_PORT", "1")  # nothing listens on :1
+    monkeypatch.setenv("AXON_RELAY_PORTS", "")  # hermetic: no relay sweep
     ev = tunnel_evidence()
     assert ev["terminal_addr"] == "127.0.0.1:1"
     assert ev["terminal_reachable"] is False
@@ -66,6 +67,7 @@ def test_diagnose_skips_patient_probe_without_tunnel(monkeypatch):
 
     monkeypatch.setenv("JAX_PLATFORMS", "axon")
     monkeypatch.setenv("AXON_TERMINAL_PORT", "1")
+    monkeypatch.setenv("AXON_RELAY_PORTS", "")  # hermetic: no relay sweep
     monkeypatch.setenv("BENCH_PROBE_SHORT", "0.01")
     monkeypatch.setenv("BENCH_PROBE_COOLDOWN", "0")
     monkeypatch.setenv("BENCH_PROBE_ISO", "0.01")
