@@ -141,6 +141,21 @@ def tunnel_evidence() -> dict:
     return ev
 
 
+def strip_axon_paths(env: dict) -> dict:
+    """Drop the axon sitecustomize dir from PYTHONPATH (in place).
+
+    That sitecustomize dials the TPU tunnel at *interpreter startup* —
+    before JAX_PLATFORMS can take effect — and blocks indefinitely when the
+    tunnel is down. Any child that must not touch the tunnel (CPU fallback,
+    JAX_PLATFORMS=tpu isolation probe) needs it gone or it hangs exactly
+    when it is needed most (observed live in r3: a dead tunnel hung even
+    ``JAX_PLATFORMS=cpu python -c 'import jax'``)."""
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p.split(os.sep))
+    return env
+
+
 def probe_backend(timeout_s: float, platforms: str | None = None) -> dict:
     """Initialize the jax backend in a throwaway subprocess with a timeout.
 
@@ -160,6 +175,7 @@ def probe_backend(timeout_s: float, platforms: str | None = None) -> dict:
     env.setdefault("JAX_DEBUG_LOG_MODULES", "jax._src.xla_bridge")
     if platforms is not None:
         env["JAX_PLATFORMS"] = platforms
+        strip_axon_paths(env)
     try:
         out = subprocess.run(
             [sys.executable, "-u", "-c", code],
@@ -269,8 +285,26 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     else:
         params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
     tok = ByteTokenizer()
+    # HBM-aware page budget: cap the KV pool so weights + pool + working set
+    # fit the chip (the slots=16 experiment OOM'd by preallocating an 8GB
+    # pool next to 8.5GB of weights). Uses the device's reported bytes_limit
+    # when available, else the v5e 16GB spec sheet.
+    page_size = 16
+    if on_accel:
+        from runbookai_tpu.models.quant import weight_bytes
+
+        page_bytes = (page_size * cfg.n_layers * 2 * cfg.n_kv_heads
+                      * cfg.head_dim * jnp.dtype(dtype).itemsize)
+        try:
+            hbm = jax.devices()[0].memory_stats()["bytes_limit"]
+        except Exception:  # noqa: BLE001 — plugin may not expose stats
+            hbm = 16 * 1024**3
+        budget = hbm - weight_bytes(params) - int(2.0 * 1024**3)
+        fit = max(256, int(budget // page_bytes))
+        if fit < num_pages:
+            num_pages = fit
     ecfg = EngineConfig(
-        page_size=16, num_pages=num_pages, max_batch_slots=slots,
+        page_size=page_size, num_pages=num_pages, max_batch_slots=slots,
         prefill_chunk=128, max_seq_len=2048, kv_dtype=dtype, block_pages=16,
         attn_impl=os.environ.get("BENCH_ATTN", "pallas" if on_accel else "xla"),
         # Batch all concurrent prompts' prefill chunks into one dispatch so
@@ -289,8 +323,13 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
                                     stop_token_ids=()),
         )
 
-    # Warmup: compile prefill + decode programs.
-    core.submit(make_req(max_new=4))
+    # Warmup: compile every program shape the measured run will hit — the
+    # batched prefill at full occupancy and the multi-step decode — so the
+    # measured TTFT is queue+prefill time, not Mosaic/XLA compile time
+    # (first on-chip run showed 15.6s p50 TTFT, all of it the 8-row prefill
+    # compile landing inside the measured window).
+    for _ in range(min(slots, n_requests)):
+        core.submit(make_req(max_new=new_tokens if slots > 1 else 4))
     core.run_until_idle()
     core.metrics.update(decode_tokens=0, decode_steps=0, prefill_tokens=0,
                         decode_time_s=0.0, prefill_time_s=0.0)
@@ -324,6 +363,7 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
         "batch_slots": slots,
+        "num_pages": num_pages,
         "prefill_batch": ecfg.prefill_batch,
         "p50_ttft_ms": round(p50_ttft, 1) if p50_ttft is not None else None,
         "wall_s": round(wall, 2),
@@ -335,9 +375,44 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         "mfu": round(mfu, 4) if mfu is not None else None,
         "peak_flops_per_chip": peak,
     }
+    if on_accel and os.environ.get("BENCH_BGE", "1") != "0":
+        # Optional secondary metric: never let it discard the measured
+        # headline (an OOM here would otherwise look like an 8B failure).
+        try:
+            details["bge_encode"] = bench_bge_encode()
+        except Exception as e:  # noqa: BLE001
+            details["bge_encode"] = {"error": str(e)[-300:]}
     if not probe.get("ok", True):
         details["tpu_error"] = probe.get("error")
     emit(round(decode_tps, 2), "tok/s", details)
+
+
+def bench_bge_encode() -> dict:
+    """Secondary metric: bge-base embedding throughput (BASELINE.md config 3
+    — knowledge-index encode). Random-init weights, identical compute."""
+    import jax
+    import jax.numpy as jnp
+
+    from runbookai_tpu.models.bge import CONFIGS as BGE_CONFIGS
+    from runbookai_tpu.models.bge import encode, init_params
+
+    cfg = BGE_CONFIGS["bge-base-en-v1.5"]
+    b, t = (int(os.environ.get("BENCH_BGE_BATCH", 128)),
+            int(os.environ.get("BENCH_BGE_SEQ", 512)))
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.bfloat16)
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(b, t)), jnp.int32)
+    attn_mask = jnp.ones((b, t), jnp.int32)
+    fn = jax.jit(lambda p, i, m: encode(p, cfg, i, m))
+    jax.block_until_ready(fn(params, ids, attn_mask))  # compile
+    t0 = time.perf_counter()
+    iters = 5
+    for _ in range(iters):
+        out = fn(params, ids, attn_mask)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    return {"texts_per_s": round(b / dt, 1), "batch": b, "seq_len": t,
+            "model": cfg.name, "weights": "bfloat16"}
 
 
 def run_inner(model_name: str, on_accel: bool, probe: dict) -> None:
@@ -365,6 +440,10 @@ def _spawn_inner(model_name: str, on_accel: bool, probe: dict,
     env = dict(os.environ)
     if probe.get("via") == "JAX_PLATFORMS=tpu":
         env["JAX_PLATFORMS"] = "tpu"  # the isolation probe found the chip here
+        strip_axon_paths(env)  # match the env the probe validated
+    if not on_accel:
+        env["JAX_PLATFORMS"] = "cpu"
+        strip_axon_paths(env)
     try:
         out = subprocess.run(argv, capture_output=True, text=True,
                              timeout=timeout_s, env=env)

@@ -1,0 +1,73 @@
+"""Instrumented engine run on the chip: per-phase wall times at a given
+batch size, to find where large-slot configs lose their time.
+
+  BENCH_SLOTS=16 python examples/engine_phase_timing.py
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from runbookai_tpu.engine.engine import EngineConfig, EngineCore
+from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+from runbookai_tpu.models.llama import CONFIGS, init_params_quantized
+from runbookai_tpu.utils.tokens import ByteTokenizer
+
+
+def main():
+    slots = int(os.environ.get("BENCH_SLOTS", 16))
+    pages = int(os.environ.get("BENCH_PAGES", 1536))
+    prompt_len = int(os.environ.get("BENCH_PROMPT", 128))
+    new_tokens = int(os.environ.get("BENCH_NEW", 64))
+
+    t0 = time.perf_counter()
+    print("backend:", jax.default_backend(), jax.devices()[0].device_kind,
+          flush=True)
+    cfg = CONFIGS["llama3-8b-instruct"]
+    params = init_params_quantized(jax.random.PRNGKey(0), cfg,
+                                   dtype=jnp.bfloat16)
+    jax.block_until_ready(params["layers"]["wq"]["q"])
+    print(f"init_params: {time.perf_counter()-t0:.1f}s", flush=True)
+
+    t0 = time.perf_counter()
+    ecfg = EngineConfig(
+        page_size=16, num_pages=pages, max_batch_slots=slots,
+        prefill_chunk=128, max_seq_len=2048, kv_dtype=jnp.bfloat16,
+        block_pages=16, attn_impl="pallas", prefill_batch=slots,
+    )
+    core = EngineCore(cfg, params, ByteTokenizer(), ecfg)
+    print(f"engine init: {time.perf_counter()-t0:.1f}s", flush=True)
+
+    rng = np.random.default_rng(0)
+
+    def make_req(max_new):
+        return EngineRequest(
+            prompt_ids=rng.integers(0, 256, size=prompt_len).tolist(),
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=max_new,
+                                    stop_token_ids=()),
+        )
+
+    for r in [make_req(new_tokens) for _ in range(slots)]:
+        core.submit(r)
+    steps = 0
+    while core.has_work():
+        t0 = time.perf_counter()
+        pre_pref = len(core.prefilling)
+        pre_dec = len(core.decoding)
+        core.step()
+        steps += 1
+        print(f"step {steps:3d}: {time.perf_counter()-t0:7.2f}s "
+              f"(prefilling={pre_pref}, decoding={pre_dec})", flush=True)
+        if steps > 200:
+            break
+    m = core.metrics
+    print("metrics:", {k: round(v, 3) if isinstance(v, float) else v
+                       for k, v in m.items()}, flush=True)
+    print("decode tok/s:", round(m["decode_tokens"] / max(m["decode_time_s"], 1e-9), 2))
+
+
+if __name__ == "__main__":
+    main()

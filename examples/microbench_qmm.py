@@ -1,0 +1,75 @@
+"""Microbench: is the int8->bf16 convert fused into the decode matmul?
+
+Times qmm (weight-only int8) vs a bf16 matmul at decode shapes and reports
+effective HBM bandwidth. If the convert fuses into the dot's operand read,
+int8 should move ~half the bytes of bf16 and run ~2x faster; if XLA
+materializes a bf16 copy of the weight, int8 is *slower* (read int8 + write
+bf16 + read bf16).
+
+Run on the chip:  python examples/microbench_qmm.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from runbookai_tpu.models.llama import qmm
+from runbookai_tpu.models.quant import quantize_tensor
+
+
+def timeit(fn, *args, iters=50):
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else fn(*args).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    print("backend:", jax.default_backend(), jax.devices()[0].device_kind)
+    d_in, d_out = 4096, 14336
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (d_in, d_out), jnp.bfloat16)
+    wq = quantize_tensor(w)
+    wq = {"q": wq["q"], "s": wq["s"]}
+
+    bf16_mm = jax.jit(lambda x, w: x @ w)
+    q_mm = jax.jit(qmm)
+
+    for b in (8, 16, 32):
+        x = jax.random.normal(key, (b, d_in), jnp.bfloat16)
+        t_bf = timeit(bf16_mm, x, w)
+        t_q = timeit(q_mm, x, wq)
+        bytes_bf = d_in * d_out * 2
+        bytes_q = d_in * d_out * 1
+        print(f"b={b:3d}  bf16 {t_bf*1e3:7.3f} ms ({bytes_bf/t_bf/1e9:6.1f} GB/s)"
+              f"   int8 {t_q*1e3:7.3f} ms ({bytes_q/t_q/1e9:6.1f} GB/s eff)"
+              f"   speedup {t_bf/t_q:4.2f}x")
+
+    # Scan-stacked variant: weights indexed per layer inside lax.scan, the
+    # exact access pattern of the decode forward.
+    L = 8
+    wq_l = {"q": jnp.broadcast_to(wq["q"], (L,) + wq["q"].shape),
+            "s": jnp.broadcast_to(wq["s"], (L,) + wq["s"].shape)}
+
+    @jax.jit
+    def scan_qmm(x, wq_l):
+        def step(h, lw):
+            # Feed the matmul back into the carry so the dot stays live
+            # (a *0 trick would let XLA dead-code-eliminate the compute).
+            out = qmm(h, {"q": lw["q"], "s": lw["s"]})
+            return h + 1e-6 * out[:, :h.shape[1]], None
+        h, _ = jax.lax.scan(step, x, wq_l)
+        return h
+
+    x = jax.random.normal(key, (8, d_in), jnp.bfloat16)
+    t = timeit(scan_qmm, x, wq_l, iters=20)
+    print(f"scan({L} layers) int8  {t*1e3:7.3f} ms "
+          f"({L*bytes_q/t/1e9:6.1f} GB/s eff)")
+
+
+if __name__ == "__main__":
+    main()

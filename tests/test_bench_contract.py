@@ -77,3 +77,15 @@ def test_diagnose_skips_patient_probe_without_tunnel(monkeypatch):
     assert modes[0] == "short-no-tunnel"
     assert "isolate-jax-platforms-tpu" in modes
     assert all(a["timeout_s"] <= 120 for a in ev["probe_attempts"])
+
+def test_strip_axon_paths():
+    # CPU fallback children must not load the axon sitecustomize: it dials
+    # the tunnel at interpreter startup and hangs when the tunnel is down.
+    from bench import strip_axon_paths
+
+    env = {"PYTHONPATH": "/root/.axon_site:/root/repo:/other"}
+    strip_axon_paths(env)
+    assert env["PYTHONPATH"] == "/root/repo:/other"
+    env = {}
+    strip_axon_paths(env)
+    assert env["PYTHONPATH"] == ""
