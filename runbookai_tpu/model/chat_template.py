@@ -44,17 +44,27 @@ def render_message(role: str, content: str) -> str:
     return f"{H_START}{role}{H_END}\n\n{content}{EOT}"
 
 
-def build_chat_prompt(
-    system_prompt: str,
-    user_prompt: str,
-    tools: Optional[list[dict[str, Any]]] = None,
-    history: Optional[list[tuple[str, str]]] = None,
-) -> str:
-    """Render the full Llama-3 prompt ending at the assistant header."""
-    system = system_prompt or "You are a helpful assistant."
-    if tools:
-        schemas = json.dumps(tools, indent=2)
-        system += TOOL_INSTRUCTIONS.format(tool_schemas=schemas)
+_FAMILY_FORMATS = {"llama": "llama3", "qwen2": "chatml", "mistral": "mistral"}
+
+
+def format_for_model(model_name: str, family: str | None = None) -> str:
+    """Prompt format by model family: ``llama3`` (default), ``chatml``
+    (Qwen2), ``mistral`` ([INST] wrapping).
+
+    ``family`` — the loaded config's authoritative family (from HF
+    ``model_type``) — wins; the name sniff is the fallback for bare names
+    (e.g. a fine-tune served under an arbitrary name)."""
+    if family in _FAMILY_FORMATS:
+        return _FAMILY_FORMATS[family]
+    n = model_name.lower()
+    if "qwen" in n:
+        return "chatml"
+    if "mistral" in n or "mixtral" in n:
+        return "mistral"
+    return "llama3"
+
+
+def _render_llama3(system: str, history, user_prompt: str) -> str:
     parts = [BEGIN, render_message("system", system)]
     for role, content in history or []:
         parts.append(render_message(role, content))
@@ -63,9 +73,57 @@ def build_chat_prompt(
     return "".join(parts)
 
 
-def build_completion_prompt(prompt: str) -> str:
+def _render_chatml(system: str, history, user_prompt: str) -> str:
+    def msg(role, content):
+        return f"<|im_start|>{role}\n{content}<|im_end|>\n"
+
+    parts = [msg("system", system)]
+    for role, content in history or []:
+        parts.append(msg(role, content))
+    parts.append(msg("user", user_prompt))
+    parts.append("<|im_start|>assistant\n")
+    return "".join(parts)
+
+
+def _render_mistral(system: str, history, user_prompt: str) -> str:
+    # Mistral-instruct: system folded into the first user turn; assistant
+    # turns closed with </s>.
+    turns = list(history or []) + [("user", user_prompt)]
+    out = ["<s>"]
+    first_user = True
+    for role, content in turns:
+        if role == "user":
+            if first_user and system:
+                content = f"{system}\n\n{content}"
+                first_user = False
+            out.append(f"[INST] {content} [/INST]")
+        else:
+            out.append(f" {content}</s>")
+    return "".join(out)
+
+
+_RENDERERS = {"llama3": _render_llama3, "chatml": _render_chatml,
+              "mistral": _render_mistral}
+
+
+def build_chat_prompt(
+    system_prompt: str,
+    user_prompt: str,
+    tools: Optional[list[dict[str, Any]]] = None,
+    history: Optional[list[tuple[str, str]]] = None,
+    fmt: str = "llama3",
+) -> str:
+    """Render the full chat prompt ending at the assistant turn opener."""
+    system = system_prompt or "You are a helpful assistant."
+    if tools:
+        schemas = json.dumps(tools, indent=2)
+        system += TOOL_INSTRUCTIONS.format(tool_schemas=schemas)
+    return _RENDERERS[fmt](system, history, user_prompt)
+
+
+def build_completion_prompt(prompt: str, fmt: str = "llama3") -> str:
     """The orchestrator's ``complete(prompt)`` path: single user turn."""
-    return build_chat_prompt("", prompt)
+    return build_chat_prompt("", prompt, fmt=fmt)
 
 
 # --------------------------------------------------------------------------- #

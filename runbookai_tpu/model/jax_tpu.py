@@ -20,6 +20,7 @@ from runbookai_tpu.engine.request import SamplingParams
 from runbookai_tpu.model.chat_template import (
     build_chat_prompt,
     build_completion_prompt,
+    format_for_model,
     parse_assistant_output,
 )
 from runbookai_tpu.model.client import BaseLLMClient
@@ -38,6 +39,7 @@ class JaxTpuClient(BaseLLMClient):
         top_p: float = 1.0,
         max_new_tokens: int = 1024,
         guided_json: bool = True,
+        chat_format: str = "llama3",
     ):
         self.core = core
         self.engine = AsyncEngine(core)
@@ -46,6 +48,7 @@ class JaxTpuClient(BaseLLMClient):
         self.top_p = top_p
         self.max_new_tokens = max_new_tokens
         self.guided_json = guided_json
+        self.chat_format = chat_format
 
     # ------------------------------------------------------------- factories
 
@@ -103,6 +106,7 @@ class JaxTpuClient(BaseLLMClient):
             core, tokenizer,
             temperature=llm_cfg.temperature, top_p=llm_cfg.top_p,
             max_new_tokens=llm_cfg.max_new_tokens, guided_json=llm_cfg.guided_json,
+            chat_format=format_for_model(model_cfg_name, cfg.family),
         )
 
     @classmethod
@@ -122,7 +126,8 @@ class JaxTpuClient(BaseLLMClient):
         core = EngineCore(cfg, params, tokenizer, ecfg,
                           mask_fn=masker.mask, advance_fn=masker.advance)
         return cls(core, tokenizer, temperature=temperature,
-                   max_new_tokens=max_new_tokens)
+                   max_new_tokens=max_new_tokens,
+                   chat_format=format_for_model(model_name, cfg.family))
 
     # ------------------------------------------------------------------- API
 
@@ -136,7 +141,8 @@ class JaxTpuClient(BaseLLMClient):
         )
 
     async def chat(self, system_prompt, user_prompt, tools=None) -> LLMResponse:
-        prompt = build_chat_prompt(system_prompt, user_prompt, tools)
+        prompt = build_chat_prompt(system_prompt, user_prompt, tools,
+                                   fmt=self.chat_format)
         ids = self.tokenizer.encode(prompt)
         out = await self.engine.generate(ids, self._sampling())
         content, tool_calls, thinking = parse_assistant_output(out.text)
@@ -159,7 +165,8 @@ class JaxTpuClient(BaseLLMClient):
         :func:`~runbookai_tpu.model.schema_guided.orchestrator_schemas`)
         that constrains the output to exactly that document shape."""
         use_guided = self.guided_json if guided is None else guided
-        ids = self.tokenizer.encode(build_completion_prompt(prompt))
+        ids = self.tokenizer.encode(
+            build_completion_prompt(prompt, fmt=self.chat_format))
         grammar = (schema or "json") if use_guided else None
         out = await self.engine.generate(ids, self._sampling(guided=grammar))
         return out.text

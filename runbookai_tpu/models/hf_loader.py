@@ -34,9 +34,28 @@ _LAYER_MAP = {
     "mlp_norm": ("model.layers.{i}.post_attention_layernorm.weight", False),
 }
 
+# Qwen2-only bias leaves (1-D per layer, no transpose).
+_BIAS_MAP = {
+    "bq": "model.layers.{i}.self_attn.q_proj.bias",
+    "bk": "model.layers.{i}.self_attn.k_proj.bias",
+    "bv": "model.layers.{i}.self_attn.v_proj.bias",
+}
+
+
+# HF model_type values this loader serves. All three share the Llama block
+# (pre-norm GQA attention + SwiGLU); qwen2 adds q/k/v projection biases.
+# Mistral sliding-window checkpoints load fine and are served with full
+# attention (exact for contexts up to the window).
+SUPPORTED_MODEL_TYPES = ("llama", "qwen2", "mistral")
+
 
 def config_from_hf(model_dir: str | Path, name: str = "hf-model") -> LlamaConfig:
     raw = json.loads((Path(model_dir) / "config.json").read_text())
+    model_type = raw.get("model_type", "llama")
+    if model_type not in SUPPORTED_MODEL_TYPES:
+        raise ValueError(
+            f"model_type {model_type!r} not supported; known: "
+            f"{SUPPORTED_MODEL_TYPES}")
     return LlamaConfig(
         name=name,
         vocab_size=raw["vocab_size"],
@@ -47,8 +66,14 @@ def config_from_hf(model_dir: str | Path, name: str = "hf-model") -> LlamaConfig
         ffn_dim=raw["intermediate_size"],
         rope_theta=raw.get("rope_theta", 500_000.0),
         norm_eps=raw.get("rms_norm_eps", 1e-5),
-        max_seq_len=raw.get("max_position_embeddings", 8192),
+        # Sliding-window checkpoints (Mistral v0.1) are served with full
+        # attention — exact only up to the window, so the window clamps the
+        # serveable context rather than silently changing semantics past it.
+        max_seq_len=min(raw.get("max_position_embeddings", 8192),
+                        raw.get("sliding_window") or 1 << 30),
         tie_embeddings=raw.get("tie_word_embeddings", False),
+        qkv_bias=model_type == "qwen2",
+        family=model_type,
     )
 
 
@@ -146,6 +171,11 @@ def load_params(
             continue
         leaf_dtype = jnp.float32 if leaf.endswith("norm") else dtype
         layers[leaf] = _put(stacked, leaf_dtype, shard_of("layers", leaf))
+    if cfg.qkv_bias:
+        for leaf, tmpl in _BIAS_MAP.items():
+            stacked = np.stack([idx.get(tmpl.format(i=i))
+                                for i in range(cfg.n_layers)])
+            layers[leaf] = _put(stacked, dtype, shard_of("layers", leaf))
     params["layers"] = layers
     params["final_norm"] = _put(idx.get("model.norm.weight"), jnp.float32, shard_of("final_norm"))
     if not cfg.tie_embeddings:

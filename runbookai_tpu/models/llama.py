@@ -41,6 +41,14 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     max_seq_len: int = 8192
     tie_embeddings: bool = False
+    # Qwen2-family attention: biases on the q/k/v projections only (HF
+    # ``Qwen2Attention``); Llama/Mistral run bias-free. The scan-stacked
+    # layer dict simply carries three extra [L, heads*hd] leaves.
+    qkv_bias: bool = False
+    # Model family ("llama" | "qwen2" | "mistral") — drives the chat
+    # template. Set from HF config.json's authoritative ``model_type`` by
+    # the loader; name sniffing is only the fallback for bare names.
+    family: str = "llama"
 
     @property
     def head_dim(self) -> int:
@@ -87,6 +95,27 @@ CONFIGS: dict[str, LlamaConfig] = {
         # 1 token per byte), so live-eval e2e runs fit without truncation.
         name="llama3-test", vocab_size=262, dim=64, n_layers=2, n_heads=4,
         n_kv_heads=2, ffn_dim=128, max_seq_len=8192, rope_theta=10_000.0,
+    ),
+    # Qwen2 family: identical block structure with q/k/v projection biases
+    # and ChatML prompts (HF ``Qwen2ForCausalLM``; config.json model_type
+    # "qwen2"). Serving/training/TP paths are shared with Llama.
+    "qwen2-7b-instruct": LlamaConfig(
+        name="qwen2-7b-instruct", vocab_size=152_064, dim=3584, n_layers=28,
+        n_heads=28, n_kv_heads=4, ffn_dim=18_944, rope_theta=1_000_000.0,
+        max_seq_len=32_768, qkv_bias=True, family="qwen2",
+    ),
+    "qwen2-test": LlamaConfig(
+        name="qwen2-test", vocab_size=262, dim=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, ffn_dim=128, max_seq_len=8192, rope_theta=10_000.0,
+        qkv_bias=True, family="qwen2",
+    ),
+    # Mistral v0.3: Llama block structure exactly (GQA, no bias), different
+    # dims/vocab/theta. Sliding-window variants (v0.1) are served with full
+    # attention — exact for contexts ≤ the window (4096).
+    "mistral-7b-instruct": LlamaConfig(
+        name="mistral-7b-instruct", vocab_size=32_768, dim=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, ffn_dim=14_336, rope_theta=1_000_000.0,
+        max_seq_len=32_768, family="mistral",
     ),
 }
 
@@ -135,6 +164,11 @@ def _build_params(key: jax.Array, cfg: LlamaConfig, dtype,
     }
     layers["attn_norm"] = jnp.ones((L, D), dtype=jnp.float32)
     layers["mlp_norm"] = jnp.ones((L, D), dtype=jnp.float32)
+    if cfg.qkv_bias:
+        hd = cfg.head_dim
+        layers["bq"] = jnp.zeros((L, cfg.n_heads * hd), dtype=dtype)
+        layers["bk"] = jnp.zeros((L, cfg.n_kv_heads * hd), dtype=dtype)
+        layers["bv"] = jnp.zeros((L, cfg.n_kv_heads * hd), dtype=dtype)
     params: Params = {
         "embed": dense(k_embed, (cfg.vocab_size, D), D),
         "layers": layers,
@@ -223,9 +257,12 @@ def forward_impl(
     def layer_step(hidden, layer_in):
         lp, k_pages, v_pages = layer_in
         x = rms_norm(hidden, lp["attn_norm"], cfg.norm_eps)
-        q = qmm(x, lp["wq"]).reshape(b, t, cfg.n_heads, hd)
-        k = qmm(x, lp["wk"]).reshape(b, t, n_kv, hd)
-        v = qmm(x, lp["wv"]).reshape(b, t, n_kv, hd)
+        q, k, v = qmm(x, lp["wq"]), qmm(x, lp["wk"]), qmm(x, lp["wv"])
+        if cfg.qkv_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(b, t, cfg.n_heads, hd)
+        k = k.reshape(b, t, n_kv, hd)
+        v = v.reshape(b, t, n_kv, hd)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
 
@@ -330,9 +367,12 @@ def transformer_layer(hidden, lp, cfg: LlamaConfig, positions, attn_fn):
     b, t = hidden.shape[:2]
     hd, n_kv, n_q = cfg.head_dim, cfg.n_kv_heads, cfg.n_heads
     x = rms_norm(hidden, lp["attn_norm"], cfg.norm_eps)
-    q = apply_rope(qmm(x, lp["wq"]).reshape(b, t, n_q, hd), positions, cfg.rope_theta)
-    k = apply_rope(qmm(x, lp["wk"]).reshape(b, t, n_kv, hd), positions, cfg.rope_theta)
-    v = qmm(x, lp["wv"]).reshape(b, t, n_kv, hd)
+    q, k, v = qmm(x, lp["wq"]), qmm(x, lp["wk"]), qmm(x, lp["wv"])
+    if cfg.qkv_bias:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = apply_rope(q.reshape(b, t, n_q, hd), positions, cfg.rope_theta)
+    k = apply_rope(k.reshape(b, t, n_kv, hd), positions, cfg.rope_theta)
+    v = v.reshape(b, t, n_kv, hd)
     ctx = attn_fn(q, k, v).reshape(b, t, n_q * hd)
     hidden = hidden + qmm(ctx, lp["wo"])
     y = rms_norm(hidden, lp["mlp_norm"], cfg.norm_eps)

@@ -154,13 +154,13 @@ def pp_param_shardings(cfg: LlamaConfig, mesh: Mesh,
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
+    layer_keys = ["wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                  "attn_norm", "mlp_norm"]
+    if cfg.qkv_bias:
+        layer_keys += ["bq", "bk", "bv"]
     shardings = {
         "embed": ns(),
-        "layers": {
-            k: ns(axis_name) for k in
-            ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-             "attn_norm", "mlp_norm")
-        },
+        "layers": {k: ns(axis_name) for k in layer_keys},
         "final_norm": ns(),
     }
     if not cfg.tie_embeddings:

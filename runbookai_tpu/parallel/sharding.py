@@ -56,6 +56,12 @@ def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> dict[str, Any]:
         },
         "final_norm": ns(),
     }
+    if cfg.qkv_bias:
+        # Biases follow their projection's output axis (column-parallel).
+        shardings["layers"]["bq"] = (
+            ns(None, MODEL_AXIS) if heads_ok else ns())
+        shardings["layers"]["bk"] = ns(None, MODEL_AXIS) if kv_ok else ns()
+        shardings["layers"]["bv"] = ns(None, MODEL_AXIS) if kv_ok else ns()
     if not cfg.tie_embeddings:
         shardings["lm_head"] = ns(None, MODEL_AXIS) if vocab_ok else ns()
     return shardings

@@ -103,8 +103,11 @@ class HFTokenizer:
         self._tok = _Tok.from_file(str(p))
         self.vocab_size = self._tok.get_vocab_size()
         self.bos_id = self._find_id(["<|begin_of_text|>", "<s>", "[CLS]"])
-        self.eos_id = self._find_id(["<|end_of_text|>", "</s>", "[SEP]"])
-        self.eot_id = self._find_id(["<|eot_id|>"]) or self.eos_id
+        self.eos_id = self._find_id(
+            ["<|end_of_text|>", "<|endoftext|>", "</s>", "[SEP]"])
+        # End-of-turn: Llama-3 <|eot_id|>, ChatML (Qwen2) <|im_end|>.
+        self.eot_id = (self._find_id(["<|eot_id|>", "<|im_end|>"])
+                       or self.eos_id)
         self.pad_id = self._find_id(["<|pad|>", "<pad>", "[PAD]"]) or 0
 
     def _find_id(self, candidates: list[str]) -> Optional[int]:

@@ -138,3 +138,36 @@ def test_ws_allowed_inside_any_frame_strings():
     assert machine.advance_bytes(prefix)
     mask = provider.mask(req)
     assert mask[ord(" ")], "space must stay admissible inside nested string"
+
+
+def test_chat_format_selection_and_rendering():
+    from runbookai_tpu.model.chat_template import format_for_model
+
+    assert format_for_model("qwen2-7b-instruct") == "chatml"
+    assert format_for_model("mistral-7b-instruct") == "mistral"
+    assert format_for_model("llama3-8b-instruct") == "llama3"
+
+    chatml = build_chat_prompt("sys", "hi", history=[("user", "a"),
+                                                     ("assistant", "b")],
+                               fmt="chatml")
+    assert chatml.startswith("<|im_start|>system\nsys<|im_end|>\n")
+    assert chatml.endswith("<|im_start|>assistant\n")
+    assert "<|im_start|>user\na<|im_end|>" in chatml
+
+    mistral = build_chat_prompt("sys", "hi", history=[("user", "a"),
+                                                      ("assistant", "b")],
+                                fmt="mistral")
+    # System folds into the FIRST user turn; assistant turns close with </s>.
+    assert mistral.startswith("<s>[INST] sys\n\na [/INST] b</s>")
+    assert mistral.endswith("[INST] hi [/INST]")
+
+
+async def test_qwen2_engine_generates():
+    # The qkv-bias model family runs end-to-end through the engine (scan
+    # carries the extra bias leaves) and uses ChatML prompts.
+    client = JaxTpuClient.for_testing("qwen2-test")
+    assert client.chat_format == "chatml"  # derived from cfg.family
+    resp = await client.chat("You are terse.", "hello")
+    assert isinstance(resp.content, str)
+    assert resp.usage["completion_tokens"] > 0
+    await client.shutdown()

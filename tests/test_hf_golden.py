@@ -35,17 +35,23 @@ def _load(name):
 
 
 @pytest.mark.parametrize("name,tied", [("hf-tiny-untied", False),
-                                       ("hf-tiny-tied", True)])
+                                       ("hf-tiny-tied", True),
+                                       ("hf-tiny-qwen2", False)])
 def test_train_forward_matches_hf_logits(name, tied):
     cfg, params, ids, want = _load(name)
     assert cfg.tie_embeddings is tied
     assert cfg.n_kv_heads == 2 and cfg.n_heads == 4  # real GQA layout
+    if "qwen2" in name:
+        # Qwen2 = same block + q/k/v biases; the loader must pick them up
+        # (a dropped bias would still pass a llama-only suite).
+        assert cfg.qkv_bias and "bq" in params["layers"]
     got = np.asarray(forward_train(params, cfg, jnp.asarray(ids)))
     # float32 end-to-end on both sides; tolerance covers op-order drift only.
     np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
 
 
-@pytest.mark.parametrize("name", ["hf-tiny-untied", "hf-tiny-tied"])
+@pytest.mark.parametrize("name", ["hf-tiny-untied", "hf-tiny-tied",
+                                  "hf-tiny-qwen2"])
 def test_serving_forward_matches_hf_logits(name):
     """The paged serving forward (chunked prefill through the KV pool) must
     agree with the HF logits too — this is the path the engine actually
@@ -99,3 +105,33 @@ def test_loader_would_catch_a_transposed_projection():
 #   ids = [[1,7,42,200,3,99,5,17],[2,250,11,0,88,123,45,6]]
 #   np.savez_compressed(".../expected_logits.npz", input_ids=ids,
 #                       logits=model(torch.tensor(ids)).logits.numpy())
+
+
+def test_config_from_hf_family_and_sliding_window(tmp_path):
+    import json as _json
+
+    # Mistral v0.1-style config: the sliding window clamps the serveable
+    # context (full attention is exact only up to the window).
+    (tmp_path / "config.json").write_text(_json.dumps({
+        "model_type": "mistral", "vocab_size": 32000, "hidden_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "intermediate_size": 128,
+        "max_position_embeddings": 32768, "sliding_window": 4096,
+    }))
+    cfg = config_from_hf(tmp_path, name="downloaded-finetune")
+    assert cfg.family == "mistral" and not cfg.qkv_bias
+    assert cfg.max_seq_len == 4096
+
+    # The chat format follows the checkpoint's model_type even when the
+    # serving name says nothing about the family.
+    from runbookai_tpu.model.chat_template import format_for_model
+    assert format_for_model("downloaded-finetune", cfg.family) == "mistral"
+
+    (tmp_path / "config.json").write_text(_json.dumps({
+        "model_type": "gpt_bigcode", "vocab_size": 100, "hidden_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "intermediate_size": 128,
+    }))
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="not supported"):
+        config_from_hf(tmp_path)

@@ -77,3 +77,30 @@ def test_graft_entry_compiles():
     fn, args = graft.entry()
     out = jax.jit(fn)(*args)
     assert out.shape[0] == 1 and np.isfinite(np.asarray(out)).all()
+
+
+def test_qwen2_bias_shardings_and_tp_forward():
+    """qkv-bias params get column-parallel bias shardings, and the TP
+    forward with biases matches single-device numerics."""
+    from runbookai_tpu.models.llama import CONFIGS, forward_train, init_params
+
+    qcfg = CONFIGS["qwen2-test"]
+    mesh = build_mesh(2, 2)
+    sh = param_shardings(qcfg, mesh)
+    assert "model" in str(sh["layers"]["bq"].spec)
+    assert "model" in str(sh["layers"]["bk"].spec)
+
+    params = init_params(jax.random.PRNGKey(1), qcfg, dtype=jnp.float32)
+    # Nonzero biases so a silently-dropped bias would change logits.
+    params["layers"]["bq"] = params["layers"]["bq"] + 0.03
+    params["layers"]["bk"] = params["layers"]["bk"] - 0.02
+    params["layers"]["bv"] = params["layers"]["bv"] + 0.01
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, qcfg.vocab_size, (2, 8)),
+        jnp.int32)
+    ref = forward_train(params, qcfg, tokens)
+
+    sharded = jax.tree.map(jax.device_put, params, sh)
+    got = forward_train(sharded, qcfg, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)

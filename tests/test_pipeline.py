@@ -88,3 +88,22 @@ def test_pipeline_trainer_loss_decreases():
     tokens = np.random.default_rng(1).integers(3, 200, size=(4, 17))
     losses = [trainer.train_step(tokens) for _ in range(5)]
     assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_qwen2_bias_leaves():
+    """qkv-bias configs must flow through pp_param_shardings + the GPipe
+    forward (the bias leaves ride the same stage placement)."""
+    from runbookai_tpu.parallel.pipeline import pp_param_shardings
+
+    qcfg = CONFIGS["qwen2-test"]
+    mesh = build_mesh(pipe=2)
+    params = init_params(jax.random.PRNGKey(0), qcfg, dtype=jnp.float32)
+    params["layers"]["bq"] = params["layers"]["bq"] + 0.05
+    sh = pp_param_shardings(qcfg, mesh)
+    placed = jax.tree.map(jax.device_put, params, sh)  # raises on mismatch
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 1,
+                                qcfg.vocab_size)
+    ref = forward_train(params, qcfg, tokens)
+    out = forward_train_pp(placed, qcfg, tokens, mesh, n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-4, rtol=3e-4)
