@@ -44,7 +44,8 @@ def render_message(role: str, content: str) -> str:
     return f"{H_START}{role}{H_END}\n\n{content}{EOT}"
 
 
-_FAMILY_FORMATS = {"llama": "llama3", "qwen2": "chatml", "mistral": "mistral"}
+_FAMILY_FORMATS = {"llama": "llama3", "qwen2": "chatml", "mistral": "mistral",
+                   "mixtral": "mistral"}
 
 
 def format_for_model(model_name: str, family: str | None = None) -> str:

@@ -42,11 +42,12 @@ _BIAS_MAP = {
 }
 
 
-# HF model_type values this loader serves. All three share the Llama block
-# (pre-norm GQA attention + SwiGLU); qwen2 adds q/k/v projection biases.
-# Mistral sliding-window checkpoints load fine and are served with full
-# attention (exact for contexts up to the window).
-SUPPORTED_MODEL_TYPES = ("llama", "qwen2", "mistral")
+# HF model_type values this loader serves. All share the Llama block
+# (pre-norm GQA attention + SwiGLU); qwen2 adds q/k/v projection biases,
+# mixtral swaps the dense FFN for an 8-expert top-2 MoE. Mistral
+# sliding-window checkpoints load fine and are served with full attention
+# (exact for contexts up to the window).
+SUPPORTED_MODEL_TYPES = ("llama", "qwen2", "mistral", "mixtral")
 
 
 def config_from_hf(model_dir: str | Path, name: str = "hf-model") -> LlamaConfig:
@@ -74,6 +75,8 @@ def config_from_hf(model_dir: str | Path, name: str = "hf-model") -> LlamaConfig
         tie_embeddings=raw.get("tie_word_embeddings", False),
         qkv_bias=model_type == "qwen2",
         family=model_type,
+        n_experts=raw.get("num_local_experts", 0) if model_type == "mixtral" else 0,
+        top_k_experts=raw.get("num_experts_per_tok", 2),
     )
 
 
@@ -153,12 +156,9 @@ def load_params(
         idx.get("model.embed_tokens.weight"), dtype, shard_of("embed")
     )
     layers: dict[str, Any] = {}
-    for leaf, (tmpl, transpose) in _LAYER_MAP.items():
-        mats = []
-        for i in range(cfg.n_layers):
-            w = idx.get(tmpl.format(i=i))
-            mats.append(w.T if transpose else w)
-        stacked = np.stack(mats)
+
+    def store(leaf: str, stacked: np.ndarray) -> None:
+        """Place one stacked leaf (quantizing the big matrices on request)."""
         if quantize_int8 and leaf in LAYER_QUANT_KEYS:
             q, s = quantize_array_np(stacked)
             leaf_sh = shard_of("layers", leaf)
@@ -168,9 +168,36 @@ def load_params(
                 "q": _put(q, jnp.int8, leaf_sh.get("q")),
                 "s": _put(s, jnp.float32, leaf_sh.get("s")),
             }
-            continue
+            return
         leaf_dtype = jnp.float32 if leaf.endswith("norm") else dtype
         layers[leaf] = _put(stacked, leaf_dtype, shard_of("layers", leaf))
+
+    layer_map = dict(_LAYER_MAP)
+    if cfg.n_experts:
+        for k in ("w_gate", "w_up", "w_down"):
+            layer_map.pop(k)
+    for leaf, (tmpl, transpose) in layer_map.items():
+        mats = []
+        for i in range(cfg.n_layers):
+            w = idx.get(tmpl.format(i=i))
+            mats.append(w.T if transpose else w)
+        store(leaf, np.stack(mats))
+    if cfg.n_experts:
+        # Mixtral MoE FFN: experts stacked on a leading E axis per layer
+        # (HF w1=gate, w3=up, w2=down, all [out, in] → transposed), plus
+        # the router (never quantized — tiny and precision-critical).
+        for leaf, part in (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2")):
+            tmpl = ("model.layers.{i}.block_sparse_moe.experts.{e}."
+                    + part + ".weight")
+            store(leaf, np.stack([
+                np.stack([idx.get(tmpl.format(i=i, e=e)).T
+                          for e in range(cfg.n_experts)])
+                for i in range(cfg.n_layers)]))
+        layers["router"] = _put(
+            np.stack([idx.get(
+                f"model.layers.{i}.block_sparse_moe.gate.weight").T
+                for i in range(cfg.n_layers)]),
+            dtype, shard_of("layers", "router"))
     if cfg.qkv_bias:
         for leaf, tmpl in _BIAS_MAP.items():
             stacked = np.stack([idx.get(tmpl.format(i=i))

@@ -45,10 +45,21 @@ class LlamaConfig:
     # ``Qwen2Attention``); Llama/Mistral run bias-free. The scan-stacked
     # layer dict simply carries three extra [L, heads*hd] leaves.
     qkv_bias: bool = False
-    # Model family ("llama" | "qwen2" | "mistral") — drives the chat
-    # template. Set from HF config.json's authoritative ``model_type`` by
-    # the loader; name sniffing is only the fallback for bare names.
+    # Model family ("llama" | "qwen2" | "mistral" | "mixtral") — drives the
+    # chat template. Set from HF config.json's authoritative ``model_type``
+    # by the loader; name sniffing is only the fallback for bare names.
     family: str = "llama"
+    # Mixture-of-Experts (Mixtral): 0 = dense FFN. When > 0 the FFN leaves
+    # gain a leading expert axis ([L, E, D, F]) plus a router [L, D, E],
+    # and the block runs :func:`runbookai_tpu.ops.moe.moe_ffn`. Expert
+    # parallelism shards the E axis over the mesh's model axis.
+    n_experts: int = 0
+    top_k_experts: int = 2
+    # Per-expert queue headroom. 0 (default) = dropless: capacity N, exact
+    # Mixtral/transformers numerics at E× the buffer cost. Perf-tuned
+    # serving can trade exactness for smaller dispatch buffers by setting
+    # e.g. 1.25–2.0 (token-expert assignments past the capacity drop).
+    capacity_factor: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -56,23 +67,33 @@ class LlamaConfig:
 
     @property
     def matmul_params(self) -> int:
-        """Analytic count of params that participate in matmuls (layer
-        projections + LM head; excludes the embedding gather) — the ``N``
-        in the decode-FLOPs model ``2·N`` used for MFU reporting."""
+        """Analytic count of params that participate in matmuls *per token*
+        (layer projections + LM head; excludes the embedding gather) — the
+        ``N`` in the decode-FLOPs model ``2·N`` used for MFU reporting. For
+        MoE this counts the ``top_k`` ACTIVE experts (the FLOPs actually
+        spent per token), not the full expert bank."""
         D, hd = self.dim, self.head_dim
+        ffn_mult = self.top_k_experts if self.n_experts else 1
         per_layer = (
             D * self.n_heads * hd          # wq
             + 2 * D * self.n_kv_heads * hd  # wk, wv
             + self.n_heads * hd * D         # wo
-            + 3 * D * self.ffn_dim          # w_gate, w_up, w_down
+            + ffn_mult * 3 * D * self.ffn_dim  # active FFN experts
+            + (D * self.n_experts if self.n_experts else 0)  # router
         )
         return self.n_layers * per_layer + D * self.vocab_size
 
     @property
     def total_params(self) -> int:
-        embed = self.vocab_size * self.dim * (1 if self.tie_embeddings else 2)
-        norms = self.n_layers * 2 * self.dim + self.dim
-        return self.matmul_params - self.dim * self.vocab_size + embed + norms
+        """All weights, including every expert (the memory-side count)."""
+        D = self.dim
+        embed = self.vocab_size * D * (1 if self.tie_embeddings else 2)
+        norms = self.n_layers * 2 * D + D
+        ffn_mult = self.n_experts if self.n_experts else 1
+        ffn_delta = (ffn_mult - (self.top_k_experts if self.n_experts else 1)
+                     ) * 3 * D * self.ffn_dim * self.n_layers
+        return (self.matmul_params - D * self.vocab_size + embed + norms
+                + ffn_delta)
 
 
 CONFIGS: dict[str, LlamaConfig] = {
@@ -117,6 +138,20 @@ CONFIGS: dict[str, LlamaConfig] = {
         n_heads=32, n_kv_heads=8, ffn_dim=14_336, rope_theta=1_000_000.0,
         max_seq_len=32_768, family="mistral",
     ),
+    # Mixtral 8x7B: Mistral attention + 8-expert top-2 MoE FFN. Serving on
+    # v5e needs int8 + TP/EP (47B total params); the test config exercises
+    # the identical code path on CPU.
+    "mixtral-8x7b-instruct": LlamaConfig(
+        name="mixtral-8x7b-instruct", vocab_size=32_000, dim=4096,
+        n_layers=32, n_heads=32, n_kv_heads=8, ffn_dim=14_336,
+        rope_theta=1_000_000.0, max_seq_len=32_768, family="mixtral",
+        n_experts=8, top_k_experts=2,
+    ),
+    "mixtral-test": LlamaConfig(
+        name="mixtral-test", vocab_size=262, dim=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, ffn_dim=128, max_seq_len=8192, rope_theta=10_000.0,
+        family="mixtral", n_experts=4, top_k_experts=2,
+    ),
 }
 
 
@@ -127,19 +162,33 @@ def get_config(name: str) -> LlamaConfig:
 
 
 def _layer_shapes(cfg: LlamaConfig) -> dict[str, tuple[tuple[int, ...], int]]:
-    """The seven stacked layer matrices as ``name -> (shape, fan_in)`` — the
-    single source of truth shared by the bf16 and direct-int8 inits."""
+    """The stacked layer matrices as ``name -> (shape, fan_in)`` — the
+    single source of truth shared by the bf16 and direct-int8 inits. MoE
+    configs put a leading expert axis on the FFN leaves (+ a router, which
+    stays un-quantized — it's tiny and routing is precision-critical)."""
     L, D, KV, F = cfg.n_layers, cfg.dim, cfg.n_kv_heads, cfg.ffn_dim
     H, hd = cfg.n_heads, cfg.head_dim
-    return {
+    shapes = {
         "wq": ((L, D, H * hd), D),
         "wk": ((L, D, KV * hd), D),
         "wv": ((L, D, KV * hd), D),
         "wo": ((L, H * hd, D), H * hd),
-        "w_gate": ((L, D, F), D),
-        "w_up": ((L, D, F), D),
-        "w_down": ((L, F, D), F),
     }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        shapes.update({
+            "w_gate": ((L, E, D, F), D),
+            "w_up": ((L, E, D, F), D),
+            "w_down": ((L, E, F, D), F),
+            "router": ((L, D, E), D),
+        })
+    else:
+        shapes.update({
+            "w_gate": ((L, D, F), D),
+            "w_up": ((L, D, F), D),
+            "w_down": ((L, F, D), F),
+        })
+    return shapes
 
 
 def _build_params(key: jax.Array, cfg: LlamaConfig, dtype,
@@ -159,7 +208,9 @@ def _build_params(key: jax.Array, cfg: LlamaConfig, dtype,
     shapes = _layer_shapes(cfg)
     ks = jax.random.split(k_layers, len(shapes))
     layers: dict[str, Any] = {
-        name: layer_factory(k, shape, fan_in)
+        # The router stays in the dense dtype even under int8 init —
+        # routing logits are precision-critical and the tensor is tiny.
+        name: (dense if name == "router" else layer_factory)(k, shape, fan_in)
         for k, (name, (shape, fan_in)) in zip(ks, shapes.items())
     }
     layers["attn_norm"] = jnp.ones((L, D), dtype=jnp.float32)
@@ -218,6 +269,19 @@ def qmm(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     if isinstance(w, dict):
         return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
     return x @ w
+
+
+def ffn_block(y: jnp.ndarray, lp: dict, cfg: LlamaConfig) -> jnp.ndarray:
+    """SwiGLU FFN (dense) or Mixtral MoE, by config — shared by the paged
+    serving forward, the dense training forward, and the pipeline stages.
+    Residual is added by the caller."""
+    if cfg.n_experts:
+        from runbookai_tpu.ops.moe import moe_ffn
+
+        return moe_ffn(y, lp["router"], lp["w_gate"], lp["w_up"],
+                       lp["w_down"], cfg.top_k_experts, cfg.capacity_factor)
+    return qmm(jax.nn.silu(qmm(y, lp["w_gate"])) * qmm(y, lp["w_up"]),
+               lp["w_down"])
 
 
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
@@ -327,8 +391,7 @@ def forward_impl(
         hidden = hidden + qmm(attn.reshape(b, t, cfg.n_heads * hd), lp["wo"])
 
         y = rms_norm(hidden, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(qmm(y, lp["w_gate"]))
-        hidden = hidden + qmm(gate * qmm(y, lp["w_up"]), lp["w_down"])
+        hidden = hidden + ffn_block(y, lp, cfg)
         return hidden, (k_pages, v_pages)
 
     h, (kv_k_new, kv_v_new) = jax.lax.scan(
@@ -376,7 +439,7 @@ def transformer_layer(hidden, lp, cfg: LlamaConfig, positions, attn_fn):
     ctx = attn_fn(q, k, v).reshape(b, t, n_q * hd)
     hidden = hidden + qmm(ctx, lp["wo"])
     y = rms_norm(hidden, lp["mlp_norm"], cfg.norm_eps)
-    return hidden + qmm(jax.nn.silu(qmm(y, lp["w_gate"])) * qmm(y, lp["w_up"]), lp["w_down"])
+    return hidden + ffn_block(y, lp, cfg)
 
 
 def lm_head_logits(params: Params, cfg: LlamaConfig, hidden) -> jnp.ndarray:

@@ -84,21 +84,32 @@ def shardings_with_quant(shardings: Params, params: Optional[Params] = None,
                          keys=LAYER_QUANT_KEYS) -> Params:
     """Expand a ``param_shardings`` tree to match quantized param structure.
 
-    ``q`` keeps the original weight's spec. ``s [L, 1, out]`` follows the
-    output axis: column-parallel weights shard their scales the same way;
-    row-parallel weights (contraction sharded) replicate them — the scale
-    multiplies the *partial sums' combined* output, and XLA applies it after
-    its inserted psum. With ``params`` given, only leaves actually quantized
-    there are expanded; otherwise every key in ``keys`` is.
+    ``q`` keeps the original weight's spec. ``s`` (``[.., 1, out]``, same
+    rank as the weight) keeps the weight's spec except on the contraction
+    axis (-2), where it has extent 1 and must replicate: column-parallel
+    weights shard their scales the same way; row-parallel weights
+    (contraction sharded) replicate them — the scale multiplies the
+    *partial sums' combined* output, and XLA applies it after its inserted
+    psum. Works for the dense rank-3 [L, in, out] and the MoE rank-4
+    [L, E, in, out] leaves alike. With ``params`` given, only leaves
+    actually quantized there are expanded; otherwise every key in ``keys``
+    is (skipping keys absent from the sharding tree).
     """
     if params is not None:
         keys = [k for k, v in params["layers"].items() if is_quantized(v)]
     out = dict(shardings)
     layers = dict(shardings["layers"])
     for k in keys:
+        if k not in shardings["layers"]:
+            continue
         base: NamedSharding = shardings["layers"][k]
-        spec = tuple(base.spec) + (None,) * (3 - len(tuple(base.spec)))
-        s_spec = P(None, None, spec[2]) if spec[2] is not None else P()
+        spec = list(base.spec)
+        # param_shardings writes full-rank specs (3 dense / 4 MoE); clear
+        # the contraction axis (-2), where the scale has extent 1. An empty
+        # (replicated) spec stays replicated.
+        if len(spec) >= 3:
+            spec[-2] = None
+        s_spec = P(*spec) if any(a is not None for a in spec) else P()
         layers[k] = {"q": base, "s": NamedSharding(base.mesh, s_spec)}
     out["layers"] = layers
     return out

@@ -1,0 +1,92 @@
+"""Mixture-of-Experts FFN with static-shape dispatch (Mixtral-style).
+
+TPU-first design: everything is fixed-shape so the whole layer jits once.
+Routing is Mixtral's exactly (softmax over ALL expert logits in float32,
+top-k selection, selected weights renormalized) so golden parity against
+``transformers.MixtralForCausalLM`` holds. Dispatch is GShard-style
+capacity-slotted, but built with a single scatter instead of the classic
+``[N, E, C]`` one-hot tensor:
+
+- every (token, k) pair gets a slot index inside its expert's queue via a
+  cumulative count; pairs past the capacity drop (contribute zero),
+- tokens scatter into a ``[E * C (+1 overflow), D]`` buffer (slot indices
+  are unique per expert by construction, so the scatter is collision-free),
+- experts run as one batched einsum over the leading E axis,
+- outputs gather back by the same indices and combine with the gate weights.
+
+Expert parallelism = shard the leading E axis of the expert weights and the
+dispatched ``[E, C, D]`` activations over the mesh's ``model`` axis; XLA
+inserts the all-to-alls from the shardings (scaling-book recipe). Capacity
+``C = clamp(ceil(capacity_factor * N * top_k / E), 1, N)``, with
+``capacity_factor <= 0`` (the config default) meaning dropless ``C = N`` —
+exact transformers numerics; perf-tuned serving lowers the factor.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(n_tokens: int, n_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Static per-expert queue length for a dispatch of ``n_tokens``.
+
+    ``capacity_factor <= 0`` means dropless: capacity ``n_tokens`` (the
+    worst case — every token routes to the same expert), which reproduces
+    transformers' ragged gather exactly."""
+    if capacity_factor <= 0:
+        return n_tokens
+    c = math.ceil(capacity_factor * n_tokens * top_k / n_experts)
+    return max(1, min(int(c), n_tokens))
+
+
+def moe_ffn(
+    y: jnp.ndarray,          # [B, T, D] (post-norm hidden)
+    router: jnp.ndarray,     # [D, E]
+    w_gate: Any,             # [E, D, F] (or int8 dict)
+    w_up: Any,               # [E, D, F]
+    w_down: Any,             # [E, F, D]
+    top_k: int,
+    capacity_factor: float,
+) -> jnp.ndarray:
+    """SwiGLU MoE block output (residual NOT added). Mixtral numerics."""
+    b, t, d = y.shape
+    e = router.shape[-1]
+    n = b * t
+    cap = expert_capacity(n, e, top_k, capacity_factor)
+    x = y.reshape(n, d)
+
+    from runbookai_tpu.models.llama import qmm  # deferred: models->ops cycle
+
+    logits = (x.astype(jnp.float32) @ router.astype(jnp.float32))  # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)              # [N, K]
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    # Slot of each (token, k) pair inside its expert's queue: running count
+    # of prior assignments to the same expert, in (token, k) order.
+    onehot = jax.nn.one_hot(gate_idx.reshape(-1), e, dtype=jnp.int32)  # [N*K, E]
+    slot = jnp.sum((jnp.cumsum(onehot, axis=0) - onehot) * onehot, axis=-1)
+    keep = slot < cap
+    dest = jnp.where(keep, gate_idx.reshape(-1) * cap + slot, e * cap)
+
+    # Collision-free scatter dispatch (row e*cap+c holds that queue entry;
+    # the final row is the shared overflow bin, read back as zeros).
+    x_rep = jnp.repeat(x, top_k, axis=0)                           # [N*K, D]
+    buf = jnp.zeros((e * cap + 1, d), x.dtype).at[dest].set(x_rep)
+    xe = buf[: e * cap].reshape(e, cap, d)                         # [E, C, D]
+
+    # qmm batches [E, C, a] @ [E, a, b] (jnp.matmul leading-axis batching;
+    # the int8 dict's [E, 1, b] scale broadcasts) — one int8 semantics.
+    act = jax.nn.silu(qmm(xe, w_gate)) * qmm(xe, w_up)
+    out_e = qmm(act, w_down)                                       # [E, C, D]
+
+    flat = jnp.concatenate(
+        [out_e.reshape(e * cap, d), jnp.zeros((1, d), out_e.dtype)])
+    back = flat[dest].reshape(n, top_k, d)                         # [N, K, D]
+    combined = jnp.sum(back * gate_vals[..., None].astype(back.dtype), axis=1)
+    return combined.reshape(b, t, d)

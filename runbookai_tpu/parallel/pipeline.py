@@ -158,6 +158,8 @@ def pp_param_shardings(cfg: LlamaConfig, mesh: Mesh,
                   "attn_norm", "mlp_norm"]
     if cfg.qkv_bias:
         layer_keys += ["bq", "bk", "bv"]
+    if cfg.n_experts:
+        layer_keys.append("router")
     shardings = {
         "embed": ns(),
         "layers": {k: ns(axis_name) for k in layer_keys},

@@ -56,6 +56,15 @@ def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> dict[str, Any]:
         },
         "final_norm": ns(),
     }
+    if cfg.n_experts:
+        # Expert parallelism: the FFN leaves are [L, E, D, F]/[L, E, F, D];
+        # shard the expert axis over the model axis (XLA inserts the
+        # dispatch/combine collectives from the einsum operand shardings).
+        # The router is tiny and replicates.
+        ep_ok = cfg.n_experts % tp == 0
+        ep = ns(None, MODEL_AXIS, None, None) if ep_ok else ns()
+        shardings["layers"].update(
+            {"w_gate": ep, "w_up": ep, "w_down": ep, "router": ns()})
     if cfg.qkv_bias:
         # Biases follow their projection's output axis (column-parallel).
         shardings["layers"]["bq"] = (

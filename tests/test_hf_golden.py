@@ -36,7 +36,8 @@ def _load(name):
 
 @pytest.mark.parametrize("name,tied", [("hf-tiny-untied", False),
                                        ("hf-tiny-tied", True),
-                                       ("hf-tiny-qwen2", False)])
+                                       ("hf-tiny-qwen2", False),
+                                       ("hf-tiny-mixtral", False)])
 def test_train_forward_matches_hf_logits(name, tied):
     cfg, params, ids, want = _load(name)
     assert cfg.tie_embeddings is tied
@@ -45,13 +46,17 @@ def test_train_forward_matches_hf_logits(name, tied):
         # Qwen2 = same block + q/k/v biases; the loader must pick them up
         # (a dropped bias would still pass a llama-only suite).
         assert cfg.qkv_bias and "bq" in params["layers"]
+    if "mixtral" in name:
+        # 4-expert top-2 MoE; capacity 2.0*N*K/E >= N here, so dispatch is
+        # provably dropless and parity vs transformers is exact.
+        assert cfg.n_experts == 4 and "router" in params["layers"]
     got = np.asarray(forward_train(params, cfg, jnp.asarray(ids)))
     # float32 end-to-end on both sides; tolerance covers op-order drift only.
     np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
 
 
 @pytest.mark.parametrize("name", ["hf-tiny-untied", "hf-tiny-tied",
-                                  "hf-tiny-qwen2"])
+                                  "hf-tiny-qwen2", "hf-tiny-mixtral"])
 def test_serving_forward_matches_hf_logits(name):
     """The paged serving forward (chunked prefill through the KV pool) must
     agree with the HF logits too — this is the path the engine actually
