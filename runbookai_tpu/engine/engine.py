@@ -93,7 +93,7 @@ class EngineConfig:
          donate_argnums=(4, 5))
 def _decode_step(
     params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
-    temps, top_ps, key, mask, page_size: int, block_pages: int,
+    temps, top_ps, top_ks, key, mask, page_size: int, block_pages: int,
     attn_impl: str = "xla", mesh=None,
 ):
     logits, kv_k, kv_v = forward_impl(
@@ -101,7 +101,7 @@ def _decode_step(
         page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
         mesh=mesh,
     )
-    tok = sample_tokens(logits[:, -1], key, temps, top_ps, mask)
+    tok = sample_tokens(logits[:, -1], key, temps, top_ps, mask, top_ks)
     return tok, logits[:, -1], kv_k, kv_v
 
 
@@ -111,7 +111,7 @@ def _decode_step(
          donate_argnums=(4, 5))
 def _decode_multi(
     params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
-    temps, top_ps, key, page_size: int, block_pages: int, k_steps: int,
+    temps, top_ps, top_ks, key, page_size: int, block_pages: int, k_steps: int,
     attn_impl: str = "xla", mesh=None,
 ):
     """K autoregressive decode steps in ONE dispatch (on-device sampling).
@@ -132,7 +132,7 @@ def _decode_multi(
             mesh=mesh,
         )
         key, sub = jax.random.split(key)
-        tok = sample_tokens(logits[:, -1], sub, temps, top_ps, None)
+        tok = sample_tokens(logits[:, -1], sub, temps, top_ps, None, top_ks)
         carry = (tok[:, None], positions + 1, kv_k, kv_v, ctx_lens + 1, key)
         return carry, tok
 
@@ -454,11 +454,13 @@ class EngineCore:
             # win for short prompts finishing together).
             temps = np.zeros((b,), dtype=np.float32)
             top_ps = np.ones((b,), dtype=np.float32)
+            top_ks = np.zeros((b,), dtype=np.int32)
             need_mask = False
             mask = np.ones((b, self.cfg.vocab_size), dtype=bool)
             for i, req in done_rows:
                 temps[i] = req.sampling.temperature
                 top_ps[i] = req.sampling.top_p
+                top_ks[i] = req.sampling.top_k
                 if self.mask_fn and req.sampling.guided:
                     m = self.mask_fn(req)
                     if m is not None:
@@ -468,6 +470,7 @@ class EngineCore:
             toks = sample_tokens(
                 last_logits, sub, jnp.asarray(temps), jnp.asarray(top_ps),
                 jnp.asarray(mask) if need_mask else None,
+                jnp.asarray(top_ks),
             )
             toks_host = np.asarray(jax.device_get(toks))
             for i, req in done_rows:
@@ -732,6 +735,7 @@ class EngineCore:
         ctx_lens = np.zeros((b,), dtype=np.int32)
         temps = np.zeros((b,), dtype=np.float32)
         top_ps = np.ones((b,), dtype=np.float32)
+        top_ks = np.zeros((b,), dtype=np.int32)
         need_mask = False
         mask = np.ones((b, self.cfg.vocab_size), dtype=bool)
         for req in self.decoding:
@@ -741,6 +745,7 @@ class EngineCore:
             ctx_lens[i] = req.ctx_len
             temps[i] = req.sampling.temperature
             top_ps[i] = req.sampling.top_p
+            top_ks[i] = req.sampling.top_k
             if self.mask_fn and req.sampling.guided:
                 m = self.mask_fn(req)
                 if m is not None:
@@ -755,7 +760,7 @@ class EngineCore:
                 toks, _, self._kv_k, self._kv_v = _decode_step(
                     self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
                     self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
-                    jnp.asarray(temps), jnp.asarray(top_ps), sub,
+                    jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks), sub,
                     jnp.asarray(mask) if need_mask else None,
                     page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
                     attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
@@ -765,7 +770,7 @@ class EngineCore:
                 toks, self._kv_k, self._kv_v = _decode_multi(
                     self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
                     self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
-                    jnp.asarray(temps), jnp.asarray(top_ps), sub,
+                    jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks), sub,
                     page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
                     k_steps=k, attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
                 )

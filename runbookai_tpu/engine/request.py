@@ -30,6 +30,7 @@ class FinishReason(str, Enum):
 class SamplingParams:
     temperature: float = 0.0
     top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled; composes with top_p
     max_new_tokens: int = 512
     stop_token_ids: tuple[int, ...] = ()
     stop_strings: tuple[str, ...] = ()

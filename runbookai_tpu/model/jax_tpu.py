@@ -37,6 +37,7 @@ class JaxTpuClient(BaseLLMClient):
         tokenizer,
         temperature: float = 0.0,
         top_p: float = 1.0,
+        top_k: int = 0,
         max_new_tokens: int = 1024,
         guided_json: bool = True,
         chat_format: str = "llama3",
@@ -46,6 +47,7 @@ class JaxTpuClient(BaseLLMClient):
         self.tokenizer = tokenizer
         self.temperature = temperature
         self.top_p = top_p
+        self.top_k = top_k
         self.max_new_tokens = max_new_tokens
         self.guided_json = guided_json
         self.chat_format = chat_format
@@ -105,6 +107,7 @@ class JaxTpuClient(BaseLLMClient):
         return cls(
             core, tokenizer,
             temperature=llm_cfg.temperature, top_p=llm_cfg.top_p,
+            top_k=llm_cfg.top_k,
             max_new_tokens=llm_cfg.max_new_tokens, guided_json=llm_cfg.guided_json,
             chat_format=format_for_model(model_cfg_name, cfg.family),
         )
@@ -135,6 +138,7 @@ class JaxTpuClient(BaseLLMClient):
         return SamplingParams(
             temperature=self.temperature,
             top_p=self.top_p,
+            top_k=self.top_k,
             max_new_tokens=max_new or self.max_new_tokens,
             stop_token_ids=(self.tokenizer.eot_id, self.tokenizer.eos_id),
             guided=guided,

@@ -57,6 +57,24 @@ def config_from_hf(model_dir: str | Path, name: str = "hf-model") -> LlamaConfig
         raise ValueError(
             f"model_type {model_type!r} not supported; known: "
             f"{SUPPORTED_MODEL_TYPES}")
+    # Llama-3.1-style long-context rope scaling (rope_type "llama3").
+    # Other scaling schemes (linear/dynamic/yarn) would silently produce
+    # wrong logits past the original context if dropped — refuse loudly,
+    # matching the unsupported-model_type behavior.
+    rs = raw.get("rope_scaling") or {}
+    rope_scaling = None
+    rs_type = rs.get("rope_type", rs.get("type"))
+    if rs_type == "llama3":
+        rope_scaling = (
+            float(rs["factor"]),
+            float(rs.get("low_freq_factor", 1.0)),
+            float(rs.get("high_freq_factor", 4.0)),
+            int(rs.get("original_max_position_embeddings", 8192)),
+        )
+    elif rs_type not in (None, "default"):
+        raise ValueError(
+            f"rope_scaling type {rs_type!r} not supported (only 'llama3'); "
+            f"loading without it would silently change long-context numerics")
     return LlamaConfig(
         name=name,
         vocab_size=raw["vocab_size"],
@@ -66,6 +84,7 @@ def config_from_hf(model_dir: str | Path, name: str = "hf-model") -> LlamaConfig
         n_kv_heads=raw.get("num_key_value_heads", raw["num_attention_heads"]),
         ffn_dim=raw["intermediate_size"],
         rope_theta=raw.get("rope_theta", 500_000.0),
+        rope_scaling=rope_scaling,
         norm_eps=raw.get("rms_norm_eps", 1e-5),
         # Sliding-window checkpoints (Mistral v0.1) are served with full
         # attention — exact only up to the window, so the window clamps the

@@ -38,6 +38,10 @@ class LlamaConfig:
     n_kv_heads: int
     ffn_dim: int
     rope_theta: float = 500_000.0
+    # Llama-3.1 long-context rope scaling (NTK-by-parts): tuple
+    # (factor, low_freq_factor, high_freq_factor, original_max_pos) or
+    # None. Set from HF config.json's rope_scaling (rope_type "llama3").
+    rope_scaling: Optional[tuple] = None
     norm_eps: float = 1e-5
     max_seq_len: int = 8192
     tie_embeddings: bool = False
@@ -109,6 +113,25 @@ CONFIGS: dict[str, LlamaConfig] = {
         # Small-dim stand-in for quick single-chip bench sanity runs.
         name="llama3-1b-bench", vocab_size=128_256, dim=2048, n_layers=16,
         n_heads=32, n_kv_heads=8, ffn_dim=8192,
+    ),
+    # Llama-3.1/3.2: same blocks with NTK-by-parts rope scaling for 128k
+    # contexts; 3.2 ties embeddings. (8B dims match llama3-8b.)
+    "llama3.1-8b-instruct": LlamaConfig(
+        name="llama3.1-8b-instruct", vocab_size=128_256, dim=4096,
+        n_layers=32, n_heads=32, n_kv_heads=8, ffn_dim=14_336,
+        max_seq_len=131_072, rope_scaling=(8.0, 1.0, 4.0, 8192),
+    ),
+    "llama3.2-1b-instruct": LlamaConfig(
+        name="llama3.2-1b-instruct", vocab_size=128_256, dim=2048,
+        n_layers=16, n_heads=32, n_kv_heads=8, ffn_dim=8192,
+        max_seq_len=131_072, rope_scaling=(32.0, 1.0, 4.0, 8192),
+        tie_embeddings=True,
+    ),
+    "llama3.2-3b-instruct": LlamaConfig(
+        name="llama3.2-3b-instruct", vocab_size=128_256, dim=3072,
+        n_layers=28, n_heads=24, n_kv_heads=8, ffn_dim=8192,
+        max_seq_len=131_072, rope_scaling=(32.0, 1.0, 4.0, 8192),
+        tie_embeddings=True,
     ),
     "llama3-test": LlamaConfig(
         # Tiny config for CPU tests; vocab matches the byte tokenizer (262).
@@ -327,8 +350,8 @@ def forward_impl(
         q = q.reshape(b, t, cfg.n_heads, hd)
         k = k.reshape(b, t, n_kv, hd)
         v = v.reshape(b, t, n_kv, hd)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
         # Scatter the whole batch's K/V into the page pool in one scatter
         # (program size stays flat as max_batch_slots grows; disjoint page
@@ -433,8 +456,10 @@ def transformer_layer(hidden, lp, cfg: LlamaConfig, positions, attn_fn):
     q, k, v = qmm(x, lp["wq"]), qmm(x, lp["wk"]), qmm(x, lp["wv"])
     if cfg.qkv_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-    q = apply_rope(q.reshape(b, t, n_q, hd), positions, cfg.rope_theta)
-    k = apply_rope(k.reshape(b, t, n_kv, hd), positions, cfg.rope_theta)
+    q = apply_rope(q.reshape(b, t, n_q, hd), positions, cfg.rope_theta,
+                   cfg.rope_scaling)
+    k = apply_rope(k.reshape(b, t, n_kv, hd), positions, cfg.rope_theta,
+                   cfg.rope_scaling)
     v = v.reshape(b, t, n_kv, hd)
     ctx = attn_fn(q, k, v).reshape(b, t, n_q * hd)
     hidden = hidden + qmm(ctx, lp["wo"])

@@ -7,16 +7,36 @@ engine needs — decode steps apply RoPE at per-sequence positions.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
 
 
-def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
-    """Inverse frequencies, shape [head_dim // 2], float32."""
+def rope_frequencies(head_dim: int, theta: float,
+                     scaling: Optional[tuple] = None) -> jnp.ndarray:
+    """Inverse frequencies, shape [head_dim // 2], float32.
+
+    ``scaling`` is the Llama-3.1 long-context NTK-by-parts tuple
+    ``(factor, low_freq_factor, high_freq_factor, original_max_pos)`` (HF
+    ``rope_scaling`` with ``rope_type="llama3"``): wavelengths shorter than
+    ``orig/high`` keep their frequency, longer than ``orig/low`` divide by
+    ``factor``, and the band between interpolates smoothly — extending 8k
+    training context to 128k serving context."""
     exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
-    return 1.0 / (theta**exponents)
+    inv_freq = 1.0 / (theta**exponents)
+    if scaling is None:
+        return inv_freq
+    factor, low, high, orig_max = (float(scaling[0]), float(scaling[1]),
+                                   float(scaling[2]), float(scaling[3]))
+    wavelen = 2.0 * jnp.pi / inv_freq
+    smooth = (orig_max / wavelen - low) / (high - low)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    # smooth==1 (short wavelen) -> unscaled; smooth==0 (long) -> /factor.
+    return (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
 
 
-def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float,
+               scaling: Optional[tuple] = None) -> jnp.ndarray:
     """Rotate ``x`` of shape [B, T, H, D] at integer ``positions`` [B, T].
 
     Uses the interleaved-pair convention folded as (first half, second half)
@@ -24,7 +44,7 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndar
     numerical stability, returning the input dtype.
     """
     b, t, h, d = x.shape
-    inv_freq = rope_frequencies(d, theta)  # [D/2]
+    inv_freq = rope_frequencies(d, theta, scaling)  # [D/2]
     angles = positions.astype(jnp.float32)[:, :, None] * inv_freq[None, None, :]  # [B,T,D/2]
     cos = jnp.cos(angles)[:, :, None, :]  # [B,T,1,D/2]
     sin = jnp.sin(angles)[:, :, None, :]

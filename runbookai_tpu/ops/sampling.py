@@ -22,8 +22,10 @@ def sample_tokens(
     temperature: jnp.ndarray,  # [B] float32; 0 -> greedy
     top_p: jnp.ndarray,  # [B] float32 in (0, 1]
     mask: jnp.ndarray | None = None,  # [B, vocab] bool, True = allowed
+    top_k: jnp.ndarray | None = None,  # [B] int32; 0 -> disabled
 ) -> jnp.ndarray:
-    """Sample one token per row. Vectorized top-p via sorted-CDF threshold."""
+    """Sample one token per row. Vectorized top-p via sorted-CDF threshold;
+    top-k composes with top-p (a token must survive both filters)."""
     if mask is not None:
         logits = jnp.where(mask, logits, NEG_INF)
 
@@ -47,6 +49,14 @@ def sample_tokens(
     keep = jnp.minimum(keep, logits.shape[-1])
     cutoff = jnp.take_along_axis(sorted_logits, (keep - 1)[:, None], axis=-1)  # [B,1]
     filtered = jnp.where(scaled >= cutoff, scaled, NEG_INF)
+
+    if top_k is not None:
+        # Keep the k highest-scaled tokens (rank cutoff on the same sorted
+        # array); rows with top_k <= 0 keep the whole vocab.
+        k_eff = jnp.where(top_k > 0, top_k, logits.shape[-1])
+        k_idx = jnp.clip(k_eff - 1, 0, logits.shape[-1] - 1)
+        cutoff_k = jnp.take_along_axis(sorted_logits, k_idx[:, None], axis=-1)
+        filtered = jnp.where(scaled >= cutoff_k, filtered, NEG_INF)
 
     sampled = jax.random.categorical(key, filtered, axis=-1)
     return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)

@@ -68,6 +68,7 @@ class LLMConfig(BaseModel):
     max_new_tokens: int = 1024
     temperature: float = 0.0
     top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled; composes with top_p
     # Paged KV cache (engine):
     page_size: int = 16  # tokens per KV page
     num_pages: int = 2048  # page pool size (static for XLA)

@@ -37,7 +37,8 @@ def _load(name):
 @pytest.mark.parametrize("name,tied", [("hf-tiny-untied", False),
                                        ("hf-tiny-tied", True),
                                        ("hf-tiny-qwen2", False),
-                                       ("hf-tiny-mixtral", False)])
+                                       ("hf-tiny-mixtral", False),
+                                       ("hf-tiny-rope31", True)])
 def test_train_forward_matches_hf_logits(name, tied):
     cfg, params, ids, want = _load(name)
     assert cfg.tie_embeddings is tied
@@ -47,16 +48,21 @@ def test_train_forward_matches_hf_logits(name, tied):
         # (a dropped bias would still pass a llama-only suite).
         assert cfg.qkv_bias and "bq" in params["layers"]
     if "mixtral" in name:
-        # 4-expert top-2 MoE; capacity 2.0*N*K/E >= N here, so dispatch is
-        # provably dropless and parity vs transformers is exact.
+        # 4-expert top-2 MoE; dropless capacity (config default), so
+        # parity vs transformers is exact.
         assert cfg.n_experts == 4 and "router" in params["layers"]
+    if "rope31" in name:
+        # Llama-3.1 NTK-by-parts scaling; sequence runs past
+        # original_max_pos so the interpolated band affects logits.
+        assert cfg.rope_scaling == (8.0, 1.0, 4.0, 64)
     got = np.asarray(forward_train(params, cfg, jnp.asarray(ids)))
     # float32 end-to-end on both sides; tolerance covers op-order drift only.
     np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
 
 
 @pytest.mark.parametrize("name", ["hf-tiny-untied", "hf-tiny-tied",
-                                  "hf-tiny-qwen2", "hf-tiny-mixtral"])
+                                  "hf-tiny-qwen2", "hf-tiny-mixtral",
+                                  "hf-tiny-rope31"])
 def test_serving_forward_matches_hf_logits(name):
     """The paged serving forward (chunked prefill through the KV pool) must
     agree with the HF logits too — this is the path the engine actually
@@ -139,4 +145,15 @@ def test_config_from_hf_family_and_sliding_window(tmp_path):
     }))
     import pytest as _pytest
     with _pytest.raises(ValueError, match="not supported"):
+        config_from_hf(tmp_path)
+
+    # Unsupported rope_scaling schemes must refuse loudly — dropping them
+    # would silently change long-context numerics.
+    (tmp_path / "config.json").write_text(_json.dumps({
+        "model_type": "llama", "vocab_size": 100, "hidden_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "intermediate_size": 128,
+        "rope_scaling": {"type": "linear", "factor": 4.0},
+    }))
+    with _pytest.raises(ValueError, match="rope_scaling"):
         config_from_hf(tmp_path)

@@ -142,6 +142,16 @@ def test_sampling_modes():
     mask = jnp.asarray([[True, False, True, True], [True, True, True, True]])
     masked = sample_tokens(logits, key, jnp.zeros(2), jnp.ones(2), mask=mask)
     assert masked.tolist() == [2, 0]
+    # top_k=1 -> argmax even at high temperature; 0 disables the filter
+    for seed in range(4):
+        k1 = sample_tokens(logits, jax.random.PRNGKey(seed), jnp.full(2, 5.0),
+                           jnp.ones(2), top_k=jnp.asarray([1, 0]))
+        assert int(k1[0]) == 1
+    # top_k=2 at high temp: only the two best ever sampled
+    seen = {int(sample_tokens(logits, jax.random.PRNGKey(s), jnp.full(2, 9.0),
+                              jnp.ones(2), top_k=jnp.full(2, 2))[0])
+            for s in range(16)}
+    assert seen <= {1, 2} and len(seen) == 2
 
 
 def test_allocator_invariants():
@@ -154,3 +164,16 @@ def test_allocator_invariants():
         a.alloc(1)
     a.free(pages)
     assert a.free_pages == 7
+
+
+def test_rope_scaling_changes_long_positions_only_low_freqs():
+    # NTK-by-parts: high-frequency components unchanged, low-frequency
+    # components divided by the factor.
+    from runbookai_tpu.ops.rope import rope_frequencies
+
+    base = np.asarray(rope_frequencies(64, 10_000.0))
+    scaled = np.asarray(rope_frequencies(64, 10_000.0,
+                                         (8.0, 1.0, 4.0, 64)))
+    assert np.allclose(scaled[0], base[0])          # highest freq untouched
+    assert np.allclose(scaled[-1], base[-1] / 8.0)  # lowest divided
+    assert np.all(scaled <= base + 1e-9)

@@ -148,3 +148,4 @@ def test_moe_train_grads_flow():
     g = jax.grad(loss)(params)
     assert float(jnp.abs(g["layers"]["router"]).max()) > 0
     assert float(jnp.abs(g["layers"]["w_gate"]).max()) > 0
+
