@@ -90,6 +90,8 @@ def cmd_chat(args) -> int:
 
     config = _load(args)
     runtime = build_runtime(config)
+    if getattr(args, "raw", False):
+        return _chat_raw(runtime)
     agent = build_agent(runtime)
     memory = ConversationMemory(summarize_after_messages=16)
     print("runbook chat — empty line or 'exit' to quit")
@@ -117,6 +119,47 @@ def cmd_chat(args) -> int:
         if not line or line in ("exit", "quit"):
             break
         asyncio.run(turn(line))
+    return 0
+
+
+def _chat_raw(runtime) -> int:
+    """Direct model chat (no agent loop): tokens print as they stream —
+    the human-facing path for eyeballing model behavior and latency."""
+    history: list[tuple[str, str]] = []
+    llm = runtime.llm
+    print("runbook chat --raw — streaming model chat; empty line to quit")
+
+    async def turn(text: str) -> None:
+        pieces = []
+        # Prior turns ride in the prompt (the agentless path has no
+        # ConversationMemory; without this every turn would be stateless).
+        if history:
+            transcript = "\n".join(f"{role}: {msg}" for role, msg in history)
+            prompt = (f"# Conversation so far\n{transcript}\n\n"
+                      f"# Current message\n{text}")
+        else:
+            prompt = text
+        # Event-dict stream protocol (LLMClient.chat_stream): true token
+        # streaming on the engine client, chunked fallback on mocks.
+        async for ev in llm.chat_stream("You are a concise SRE assistant.",
+                                        prompt):
+            if ev.get("type") == "text":
+                pieces.append(ev["delta"])
+                print(ev["delta"], end="", flush=True)
+        print()
+        history.append(("user", text))
+        history.append(("assistant", "".join(pieces)))
+
+    while True:
+        try:
+            line = input("\nyou> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            break
+        if not line or line in ("exit", "quit"):
+            break
+        asyncio.run(turn(line))
+    if hasattr(llm, "shutdown"):
+        asyncio.run(llm.shutdown())
     return 0
 
 
@@ -563,6 +606,8 @@ def build_parser() -> argparse.ArgumentParser:
     ask.set_defaults(fn=cmd_ask)
 
     chat = sub.add_parser("chat", help="interactive conversation")
+    chat.add_argument("--raw", action="store_true",
+                      help="direct streaming model chat (no agent loop)")
     chat.set_defaults(fn=cmd_chat)
 
     dep = sub.add_parser("deploy", help="deploy a service via the deploy-service skill")

@@ -29,6 +29,12 @@ class AsyncEngine:
         self._stopped = False
 
     async def start(self) -> None:
+        # A done task means the loop that owned it was torn down (e.g. a
+        # caller drives each turn with its own asyncio.run) — restart on
+        # the current loop, along with the loop-bound wake event, or every
+        # later request would enqueue forever with nothing stepping.
+        if self._task is not None and self._task.done():
+            self._task = None
         if self._task is None:
             self._wake = asyncio.Event()
             self._stopped = False
@@ -67,8 +73,7 @@ class AsyncEngine:
         sampling: Optional[SamplingParams] = None,
     ) -> EngineOutput:
         """Submit one request and await its completion."""
-        if self._task is None:
-            await self.start()
+        await self.start()  # idempotent; restarts after a torn-down loop
         req = EngineRequest(prompt_ids=prompt_ids, sampling=sampling or SamplingParams())
         req.done_event = asyncio.Event()
         loop = asyncio.get_running_loop()
@@ -87,3 +92,47 @@ class AsyncEngine:
         self._wake.set()
         await done
         return self.core.output_for(req)
+
+    async def generate_stream(
+        self,
+        prompt_ids: list[int],
+        sampling: Optional[SamplingParams] = None,
+    ):
+        """Async iterator of token ids as the engine samples them.
+
+        Token callbacks fire on the engine's worker thread and bridge to
+        the caller's loop through an asyncio queue; ``None`` is the
+        completion sentinel. Stop tokens ARE yielded (callers that render
+        text should skip ids in their stop set, as ``output_for`` does) —
+        see ``JaxTpuClient.chat_stream`` for the text-level wrapper.
+        """
+        await self.start()  # idempotent; restarts after a torn-down loop
+        req = EngineRequest(prompt_ids=prompt_ids,
+                            sampling=sampling or SamplingParams())
+        loop = asyncio.get_running_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+
+        def on_token(tok: int) -> None:
+            loop.call_soon_threadsafe(queue.put_nowait, tok)
+
+        class _Event:
+            def set(self_inner) -> None:  # noqa: N805
+                loop.call_soon_threadsafe(queue.put_nowait, None)
+
+        req.on_token = on_token
+        req.done_event = _Event()  # type: ignore[assignment]
+        with self._lock:
+            self.core.submit(req)
+        self._wake.set()
+        try:
+            while True:
+                tok = await queue.get()
+                if tok is None:
+                    break
+                yield tok
+        finally:
+            # Early exit (consumer break / exception): free the slot + KV
+            # pages instead of decoding to max_new_tokens for nobody.
+            if req.finish_reason is None:
+                with self._lock:
+                    self.core.abort(req.request_id)

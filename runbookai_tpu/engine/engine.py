@@ -374,6 +374,26 @@ class EngineCore:
         if req.done_event is not None:
             req.done_event.set()
 
+    def abort(self, request_id: str) -> bool:
+        """Abort a live request (streaming consumer went away): frees its
+        batch slot and KV pages immediately so concurrent requests are not
+        starved by a generation nobody is draining. Returns False when the
+        request is unknown or already finished."""
+        for pool in (self.waiting, self.prefilling, self.decoding):
+            for req in pool:
+                if req.request_id == request_id:
+                    if req in self.waiting:
+                        self.waiting.remove(req)
+                        req.state = RequestState.FINISHED
+                        req.finish_reason = FinishReason.ABORTED
+                        self.finished.append(req)
+                        if req.done_event is not None:
+                            req.done_event.set()
+                    else:
+                        self._finish(req, FinishReason.ABORTED)
+                    return True
+        return False
+
     # --------------------------------------------------------------- prefill
 
     def _run_prefill(self) -> None:
@@ -494,6 +514,8 @@ class EngineCore:
     def _emit_token(self, req: EngineRequest, token: int) -> None:
         """Record a sampled token and apply finish rules."""
         req.out_ids.append(token)
+        if req.on_token is not None:
+            req.on_token(token)
         self._last_token[req.request_id] = token
         grammar_done = False
         if self.advance_fn and req.sampling.guided:
@@ -679,6 +701,9 @@ class EngineCore:
         # in the prefill fold, so booking them as decode throughput would
         # inflate the BASELINE decode-tok/s metric).
         req.out_ids.extend(forced)
+        if req.on_token is not None:
+            for tok in forced:
+                req.on_token(tok)
         self._last_token[req.request_id] = forced[-1]
         self.metrics["grammar_forced_tokens"] = (
             self.metrics.get("grammar_forced_tokens", 0) + len(forced))

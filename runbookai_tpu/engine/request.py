@@ -64,6 +64,11 @@ class EngineRequest:
     guided_state: Any = None  # grammar automaton state
     # Completion signal for the async API (set by AsyncEngine).
     done_event: Optional[asyncio.Event] = None
+    # Streaming hook: called with each sampled token id from the engine's
+    # worker thread (bridge to an event loop with call_soon_threadsafe).
+    # Preemption-by-recompute does NOT re-call this for folded tokens, so
+    # a stream sees every token exactly once.
+    on_token: Optional[Any] = None
 
     @property
     def ctx_len(self) -> int:

@@ -161,6 +161,57 @@ class JaxTpuClient(BaseLLMClient):
             },
         )
 
+    async def chat_stream(self, system_prompt, user_prompt, tools=None):
+        """TRUE token streaming override of the BaseLLMClient fallback
+        (which chunks a completed response). Yields the same event-dict
+        protocol: ``{"type": "text", "delta"}`` per decoded piece, then
+        parsed ``tool_call`` events, then ``{"type": "done", "response"}``.
+
+        Divergence from the fallback, by design: text deltas are the RAW
+        model output as sampled (tool-call/thinking markup included — it
+        cannot be parsed out until the document completes), while
+        ``done.response.content`` is the parsed content, exactly as
+        :meth:`chat` returns it. Consumers that must render only parsed
+        content should buffer until ``done``.
+
+        Incremental UTF-8 decoding (``codecs`` incremental decoder over the
+        tokenizer's per-id byte sequences) so multi-byte characters split
+        across tokens never yield mojibake; stop tokens are skipped,
+        mirroring ``EngineCore.output_for``.
+        """
+        import codecs
+
+        prompt = build_chat_prompt(system_prompt, user_prompt, tools,
+                                   fmt=self.chat_format)
+        ids = self.tokenizer.encode(prompt)
+        stop_ids = {self.tokenizer.eot_id, self.tokenizer.eos_id}
+        decoder = codecs.getincrementaldecoder("utf-8")("replace")
+        n_tokens = 0
+        parts: list[str] = []
+
+        def flush(piece: str):
+            if piece:
+                parts.append(piece)
+                return {"type": "text", "delta": piece}
+            return None
+
+        async for tok in self.engine.generate_stream(ids, self._sampling()):
+            n_tokens += 1
+            if tok in stop_ids:
+                continue
+            ev = flush(decoder.decode(self.tokenizer.id_to_bytes(tok)))
+            if ev:
+                yield ev
+        ev = flush(decoder.decode(b"", final=True))
+        if ev:
+            yield ev
+        content, tool_calls, thinking = parse_assistant_output("".join(parts))
+        for call in tool_calls:
+            yield {"type": "tool_call", "call": call}
+        yield {"type": "done", "response": LLMResponse(
+            content=content, tool_calls=tool_calls, thinking=thinking,
+            usage={"prompt_tokens": len(ids), "completion_tokens": n_tokens})}
+
     async def complete(self, prompt: str, guided: Optional[bool] = None,
                        schema: Optional[str] = None) -> str:
         """Plain completion; guided JSON masking on by default (config) since

@@ -14,6 +14,7 @@ real tokenizer is both available and required. Two implementations:
 
 from __future__ import annotations
 
+from functools import lru_cache
 from pathlib import Path
 from typing import Optional, Sequence
 
@@ -91,6 +92,27 @@ class ByteTokenizer:
         return frozenset(self._id_to_special)
 
 
+@lru_cache(maxsize=1)
+def _gpt2_byte_decoder() -> dict[str, int]:
+    """Inverse of GPT-2's bytes_to_unicode: vocab char -> raw byte.
+
+    Byte-level BPE tokenizers (GPT-2, Llama-3, Qwen2) store each raw byte
+    as a printable unicode char in vocab strings; mapping back recovers the
+    exact byte sequence of a single token, even when it is half of a
+    multi-byte UTF-8 character."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("¡"), ord("¬") + 1))
+          + list(range(ord("®"), ord("ÿ") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
 class HFTokenizer:
     """Wraps a local ``tokenizer.json`` via the HuggingFace ``tokenizers`` lib."""
 
@@ -126,8 +148,19 @@ class HFTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=False)
 
-    # Single-token byte decode used by guided decoding to walk candidates.
+    # Single-token byte decode used by guided decoding to walk candidates
+    # and by streaming's incremental UTF-8 decoder. Byte-level BPE vocab
+    # strings (Llama-3/GPT-2 style) map char-by-char through the inverted
+    # bytes_to_unicode table, so a multi-byte character SPLIT ACROSS TOKENS
+    # round-trips exactly; decode([tid]) would yield U+FFFD per half-token.
     def id_to_bytes(self, tid: int) -> bytes:
+        token = self._tok.id_to_token(tid)
+        if token is None:
+            return b""
+        dec = _gpt2_byte_decoder()
+        if all(ch in dec for ch in token):
+            return bytes(dec[ch] for ch in token)
+        # Non-byte-level vocab (sentencepiece "▁" style) or special token.
         return self._tok.decode([tid], skip_special_tokens=False).encode("utf-8")
 
     @property

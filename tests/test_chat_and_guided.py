@@ -171,3 +171,87 @@ async def test_qwen2_engine_generates():
     assert isinstance(resp.content, str)
     assert resp.usage["completion_tokens"] > 0
     await client.shutdown()
+
+
+async def test_chat_stream_matches_non_stream():
+    # Streaming deltas joined must equal the non-streaming chat content
+    # (greedy sampling; independent clients with the same seed/weights).
+    client = JaxTpuClient.for_testing(max_new_tokens=16)
+    full = await client.chat("You are terse.", "status of payment-api?")
+    await client.shutdown()
+
+    client2 = JaxTpuClient.for_testing(max_new_tokens=16)
+    events = [ev async for ev in client2.chat_stream(
+        "You are terse.", "status of payment-api?")]
+    await client2.shutdown()
+    deltas = [ev["delta"] for ev in events if ev["type"] == "text"]
+    assert len(deltas) >= 1
+    done = [ev for ev in events if ev["type"] == "done"]
+    assert len(done) == 1 and done[0]["response"].content == full.content
+    assert done[0]["response"].usage["completion_tokens"] > 0
+
+
+def test_stream_survives_per_turn_event_loops():
+    # CLI-style driving: each turn runs under its own asyncio.run, which
+    # tears down the loop that owned the engine task. The engine must
+    # restart on the next loop instead of hanging (r3 review finding).
+    import asyncio as _asyncio
+
+    client = JaxTpuClient.for_testing(max_new_tokens=6)
+
+    async def one_turn():
+        return [ev async for ev in client.chat_stream("sys", "hi")]
+
+    first = _asyncio.run(one_turn())
+    second = _asyncio.run(one_turn())  # hung forever before the fix
+    assert any(ev["type"] == "done" for ev in first)
+    assert any(ev["type"] == "done" for ev in second)
+    _asyncio.run(client.shutdown())
+
+
+async def test_stream_early_exit_aborts_request():
+    # A consumer that stops iterating must free the slot + KV pages.
+    client = JaxTpuClient.for_testing(max_new_tokens=64)
+    agen = client.engine.generate_stream(
+        client.tokenizer.encode("some prompt"),
+        client._sampling())
+    async for _tok in agen:
+        break
+    await agen.aclose()
+    core = client.core
+    for _ in range(200):
+        if not core.has_work:
+            break
+        await __import__("asyncio").sleep(0.02)
+    assert not core.has_work
+    assert core.finished and core.finished[-1].finish_reason is not None
+    await client.shutdown()
+
+
+def test_hf_tokenizer_streaming_bytes_roundtrip(tmp_path):
+    # Byte-level BPE: a multi-byte char split across tokens must round-trip
+    # through per-token id_to_bytes + incremental UTF-8 decode.
+    import codecs
+
+    from tokenizers import Tokenizer as _Tok
+    from tokenizers import models, pre_tokenizers
+
+    from runbookai_tpu.utils.tokens import HFTokenizer
+
+    # Byte-level alphabet vocab (every byte one token): any emoji/CJK char
+    # necessarily splits across several tokens.
+    alphabet = pre_tokenizers.ByteLevel.alphabet()
+    vocab = {ch: i for i, ch in enumerate(sorted(alphabet))}
+    tok = _Tok(models.BPE(vocab=vocab, merges=[]))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    path = tmp_path / "tokenizer.json"
+    tok.save(str(path))
+
+    hf = HFTokenizer(path)
+    text = "héllo 🚀 世界"
+    ids = hf.encode(text)
+    assert len(ids) > len(text)  # multi-byte chars split across ids
+    dec = codecs.getincrementaldecoder("utf-8")("replace")
+    out = "".join(dec.decode(hf.id_to_bytes(i)) for i in ids)
+    out += dec.decode(b"", final=True)
+    assert out == text  # decode([tid]) per token would give U+FFFD soup
