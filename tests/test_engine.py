@@ -297,3 +297,51 @@ async def test_fast_forward_budget_exhaustion_does_not_poison_prefix_cache():
     # Cached pages are freed-but-reusable (a subset of free): bookkeeping
     # must stay within the pool either way.
     assert core.kv.allocator.cached_pages <= core.kv.allocator.free_pages
+
+
+def test_mixed_workload_stress(setup):
+    """Chaos-style invariant check: 12 requests with mixed sampling modes
+    (greedy / temperature / top-k / guided JSON), shared prompt prefixes,
+    and a page pool tight enough to force preemption. Everything must
+    finish, guided outputs must parse, and the pool must drain clean."""
+    import json as _json
+
+    from runbookai_tpu.model.guided import JsonMaskProvider
+
+    tok, params = setup
+    masker = JsonMaskProvider(tok)
+    core = EngineCore(CFG, params, tok, EngineConfig(
+        page_size=4, num_pages=48, max_batch_slots=4, prefill_chunk=8,
+        max_seq_len=96, block_pages=4, kv_dtype=jnp.float32,
+        grammar_fast_forward=False,
+    ), mask_fn=masker.mask, advance_fn=masker.advance)
+
+    shared = tok.encode("incident: payment-api latency is elevated. ")
+    rng = np.random.default_rng(7)
+    reqs = []
+    for i in range(12):
+        prompt = list(shared) + rng.integers(32, 120, size=4 + i).tolist()
+        if i % 4 == 0:
+            s = SamplingParams(temperature=0.0, max_new_tokens=10)
+        elif i % 4 == 1:
+            s = SamplingParams(temperature=0.8, top_p=0.9, max_new_tokens=10)
+        elif i % 4 == 2:
+            s = SamplingParams(temperature=0.7, top_k=8, max_new_tokens=10)
+        else:
+            s = SamplingParams(temperature=0.0, max_new_tokens=24,
+                               guided="json")
+        reqs.append(EngineRequest(prompt_ids=prompt, sampling=s))
+    for r in reqs:
+        core.submit(r)
+    core.run_until_idle(max_steps=3000)
+
+    assert len(core.finished) == 12
+    for i, r in enumerate(reqs):
+        assert r.finish_reason is not None, f"req {i} unfinished"
+        assert r.num_generated > 0
+        if r.sampling.guided:
+            text = core.output_for(r).text
+            _json.loads(text)  # guided output must parse strictly
+    # Pool drains clean: all sequences released every page.
+    assert not core.kv.seqs
+    assert core.kv.allocator.free_pages == 48 - 1  # page 0 reserved null

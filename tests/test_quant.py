@@ -142,3 +142,31 @@ def test_param_count_matches_tree():
     assert mm == CFG.matmul_params, (mm, CFG.matmul_params)
     # North-star shape sanity: Llama-3-8B is 8.03B params.
     assert abs(CONFIGS["llama3-8b-instruct"].total_params - 8.03e9) < 0.02e9
+
+
+def test_70b_int8_tp8_memory_plan_fits_v5e():
+    """The documented 70B serving plan (int8 weights, tp=8, dp=2 on a
+    v5e-16) must arithmetically fit the 16GB/chip HBM budget with KV-pool
+    headroom — this is the math the sharded loader implements."""
+    from runbookai_tpu.models.llama import CONFIGS
+
+    cfg = CONFIGS["llama3-70b-instruct"]
+    tp = 8
+    hbm = 16 * 1024**3
+    layer_matmul = cfg.matmul_params - cfg.dim * cfg.vocab_size
+    int8_shard = layer_matmul / tp                      # 1 byte/param, sharded
+    # Per-output-channel f32 scales: 4 bytes per output column (~dim-sized
+    # rows); bounded by params/dim * 4.
+    scales = layer_matmul / cfg.dim * 4 / tp
+    embed = cfg.vocab_size * cfg.dim * 2 / tp           # bf16, vocab-sharded
+    head = cfg.vocab_size * cfg.dim * 2 / tp
+    norms = (cfg.n_layers * 2 + 1) * cfg.dim * 4        # f32, replicated
+    weights_per_chip = int8_shard + scales + embed + head + norms
+    assert weights_per_chip < 10.5 * 1024**3            # ~10GB/chip
+
+    # Leaves >= 4GB for the KV pool: 70B GQA (8 kv heads sharded over tp=8
+    # -> 1 head/chip), 128 head dim, 80 layers, bf16.
+    kv_per_token = 80 * 2 * (cfg.n_kv_heads // tp) * 128 * 2
+    budget = hbm - weights_per_chip - 1.5 * 1024**3     # runtime headroom
+    tokens = budget / kv_per_token
+    assert tokens > 80_000  # >80k pooled tokens/chip, e.g. 10 x 8k contexts
