@@ -437,6 +437,30 @@ def cmd_eval(args) -> int:
     return 0 if report.pass_rate >= args.min_pass_rate else 1
 
 
+def cmd_serve(args) -> int:
+    """OpenAI-compatible HTTP endpoint over the serving engine."""
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.server.openai_api import OpenAIServer
+
+    config = _load(args)
+    if config.llm.provider != "jax-tpu":
+        print("serve requires llm.provider: jax-tpu (a real engine to serve)",
+              file=sys.stderr)
+        return 1
+    client = JaxTpuClient.from_config(config.llm)
+    server = OpenAIServer(client, model_name=config.llm.model,
+                          host=args.host, port=args.port)
+    print(f"serving {config.llm.model} at http://{args.host}:{server.port}/v1 "
+          f"(POST /v1/chat/completions, GET /v1/models, /healthz)")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+    return 0
+
+
 def cmd_bench(args) -> int:
     import runpy
 
@@ -697,6 +721,12 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--setup-datasets", action="store_true",
                     help="git-clone missing dataset repos first")
     ev.set_defaults(fn=cmd_eval)
+
+    serve = sub.add_parser(
+        "serve", help="OpenAI-compatible HTTP endpoint over the engine")
+    serve.add_argument("--host", default="127.0.0.1")
+    serve.add_argument("--port", type=int, default=8000)
+    serve.set_defaults(fn=cmd_serve)
 
     bench = sub.add_parser("bench", help="serving benchmark (one JSON line)")
     bench.set_defaults(fn=cmd_bench)

@@ -71,8 +71,14 @@ class AsyncEngine:
         self,
         prompt_ids: list[int],
         sampling: Optional[SamplingParams] = None,
+        timeout_s: Optional[float] = None,
     ) -> EngineOutput:
-        """Submit one request and await its completion."""
+        """Submit one request and await its completion.
+
+        With ``timeout_s``, a stalled generation is ABORTED in the engine
+        (slot + KV pages freed) before ``TimeoutError`` propagates — a
+        caller-side timeout alone would leave the request decoding to
+        max_new_tokens for nobody."""
         await self.start()  # idempotent; restarts after a torn-down loop
         req = EngineRequest(prompt_ids=prompt_ids, sampling=sampling or SamplingParams())
         req.done_event = asyncio.Event()
@@ -90,7 +96,16 @@ class AsyncEngine:
         with self._lock:
             self.core.submit(req)
         self._wake.set()
-        await done
+        if timeout_s is None:
+            await done
+        else:
+            try:
+                await asyncio.wait_for(done, timeout_s)
+            except asyncio.TimeoutError:
+                with self._lock:
+                    self.core.abort(req.request_id)
+                raise TimeoutError(
+                    f"generation exceeded {timeout_s}s (request aborted)")
         return self.core.output_for(req)
 
     async def generate_stream(

@@ -1,0 +1,312 @@
+"""OpenAI-compatible HTTP serving surface over the continuous-batching engine.
+
+``runbook serve`` exposes the in-tree TPU serving engine the way the
+ecosystem expects a model server to look (vLLM/TGI-style), so existing
+OpenAI-client tooling can point at a TPU slice with no code changes:
+
+- ``POST /v1/chat/completions`` — non-streaming and ``stream: true`` (SSE
+  ``data:`` chunks, ``[DONE]`` terminator).
+- ``GET /v1/models`` — the single served model.
+- ``GET /healthz`` — liveness + engine metrics snapshot.
+
+Architecture: a ``ThreadingHTTPServer`` (stdlib; no web framework in the
+image) with a dedicated asyncio loop thread that owns the
+:class:`~runbookai_tpu.engine.async_engine.AsyncEngine` — request handlers
+bridge with ``run_coroutine_threadsafe``, so concurrent HTTP requests batch
+together inside the engine exactly like concurrent agent investigations do.
+No reference counterpart (RunbookAI calls hosted APIs; SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+import uuid
+from concurrent.futures import TimeoutError as _FutTimeout  # builtin alias 3.11+, distinct on 3.10
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+
+def messages_to_prompt_parts(messages: list[dict[str, Any]]):
+    """OpenAI messages -> (system, history, user) for build_chat_prompt."""
+    system = ""
+    turns: list[tuple[str, str]] = []
+    for m in messages:
+        role = m.get("role", "user")
+        content = m.get("content") or ""
+        if isinstance(content, list):  # content-part arrays
+            content = "".join(p.get("text", "") for p in content
+                              if isinstance(p, dict))
+        if role == "system":
+            system = content if not system else f"{system}\n{content}"
+        elif role in ("user", "assistant"):
+            turns.append((role, content))
+    if turns and turns[-1][0] == "user":
+        user = turns.pop()[1]
+    else:
+        user = ""
+    return system, turns, user
+
+
+class _EngineBridge:
+    """Owns the asyncio loop thread the AsyncEngine lives on."""
+
+    def __init__(self, client):
+        self.client = client  # JaxTpuClient
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self.loop.run_forever, name="serve-loop", daemon=True)
+        self._thread.start()
+
+    def run(self, coro, timeout: Optional[float] = None):
+        return asyncio.run_coroutine_threadsafe(
+            coro, self.loop).result(timeout)
+
+    def stream(self, agen, timeout: Optional[float] = None):
+        """Drain an async generator from a plain thread, yielding items."""
+        sentinel = object()
+
+        async def _next():
+            try:
+                return await agen.__anext__()
+            except StopAsyncIteration:
+                return sentinel
+
+        while True:
+            item = self.run(_next(), timeout)
+            if item is sentinel:
+                return
+            yield item
+
+    def shutdown(self) -> None:
+        try:
+            self.run(self.client.shutdown(), timeout=10)
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=5)
+
+
+def _completion_payload(model: str, content: str, usage: dict,
+                        finish: str = "stop") -> dict:
+    return {
+        "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": content},
+            "finish_reason": finish,
+        }],
+        "usage": {
+            "prompt_tokens": usage.get("prompt_tokens", 0),
+            "completion_tokens": usage.get("completion_tokens", 0),
+            "total_tokens": (usage.get("prompt_tokens", 0)
+                             + usage.get("completion_tokens", 0)),
+        },
+    }
+
+
+def _chunk_payload(model: str, delta: dict, finish: Optional[str],
+                   chunk_id: str) -> dict:
+    return {
+        "id": chunk_id,
+        "object": "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+    }
+
+
+def make_handler(bridge: _EngineBridge, model_name: str,
+                 request_timeout: float):
+    from runbookai_tpu.engine.request import SamplingParams
+
+    client = bridge.client
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args) -> None:  # quiet; metrics via /healthz
+            pass
+
+        def _json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, code: int, message: str) -> None:
+            self._json(code, {"error": {"message": message,
+                                        "type": "invalid_request_error"}})
+
+        def do_GET(self) -> None:  # noqa: N802 — http.server API
+            if self.path == "/v1/models":
+                self._json(200, {"object": "list", "data": [{
+                    "id": model_name, "object": "model",
+                    "owned_by": "runbookai-tpu"}]})
+            elif self.path == "/healthz":
+                m = dict(client.core.metrics)
+                self._json(200, {"status": "ok", "model": model_name,
+                                 "metrics": m})
+            else:
+                self._error(404, f"no route {self.path}")
+
+        def do_POST(self) -> None:  # noqa: N802
+            if self.path != "/v1/chat/completions":
+                self._error(404, f"no route {self.path}")
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                messages = body.get("messages") or []
+                if not messages:
+                    raise ValueError("messages is required")
+                system, history, user = messages_to_prompt_parts(messages)
+                # Client-supplied values: coercion failures are 400s too.
+                sampling = SamplingParams(
+                    temperature=float(body.get("temperature",
+                                               client.temperature)),
+                    top_p=float(body.get("top_p", client.top_p)),
+                    top_k=int(body.get("top_k", client.top_k)),
+                    max_new_tokens=int(body.get("max_tokens")
+                                       or client.max_new_tokens),
+                    stop_token_ids=(client.tokenizer.eot_id,
+                                    client.tokenizer.eos_id),
+                )
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._error(400, str(e))
+                return
+
+            from runbookai_tpu.model.chat_template import build_chat_prompt
+
+            prompt = build_chat_prompt(system, user, history=history,
+                                       fmt=client.chat_format)
+            ids = client.tokenizer.encode(prompt)
+
+            try:
+                if body.get("stream"):
+                    self._stream_response(ids, sampling)
+                else:
+                    # The engine-side timeout ABORTS a stalled request
+                    # (frees slot + KV pages) before raising; the bridge
+                    # timeout is just a belt over a wedged loop thread.
+                    out = bridge.run(
+                        client.engine.generate(ids, sampling,
+                                               timeout_s=request_timeout),
+                        timeout=request_timeout + 30)
+                    finish = ("length" if out.finish_reason.value
+                              == "max_tokens" else "stop")
+                    self._json(200, _completion_payload(
+                        model_name, out.text,
+                        {"prompt_tokens": len(ids),
+                         "completion_tokens": out.decode_tokens},
+                        finish))
+            except (TimeoutError, _FutTimeout):
+                self._error(504, "generation timed out")
+            except BrokenPipeError:
+                pass  # client went away; engine abort handled in stream path
+
+        def _stream_response(self, ids, sampling) -> None:
+            import codecs
+
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def send_chunk(payload: dict) -> None:
+                data = f"data: {json.dumps(payload)}\n\n".encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data
+                                 + b"\r\n")
+                self.wfile.flush()
+
+            chunk_id = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+            send_chunk(_chunk_payload(model_name, {"role": "assistant"},
+                                      None, chunk_id))
+            stop_ids = {client.tokenizer.eot_id, client.tokenizer.eos_id}
+            decoder = codecs.getincrementaldecoder("utf-8")("replace")
+            agen = client.engine.generate_stream(ids, sampling)
+            n_tokens = 0
+            saw_stop = False
+            try:
+                for tok in bridge.stream(agen, timeout=request_timeout):
+                    n_tokens += 1
+                    if tok in stop_ids:
+                        saw_stop = True
+                        continue
+                    piece = decoder.decode(client.tokenizer.id_to_bytes(tok))
+                    if piece:
+                        send_chunk(_chunk_payload(
+                            model_name, {"content": piece}, None, chunk_id))
+                tail = decoder.decode(b"", final=True)
+                if tail:
+                    send_chunk(_chunk_payload(
+                        model_name, {"content": tail}, None, chunk_id))
+                # max_tokens truncation reports "length", like non-stream.
+                finish = ("length" if not saw_stop
+                          and n_tokens >= sampling.max_new_tokens else "stop")
+                send_chunk(_chunk_payload(model_name, {}, finish, chunk_id))
+                done = b"data: [DONE]\n\n"
+                self.wfile.write(f"{len(done):x}\r\n".encode() + done
+                                 + b"\r\n0\r\n\r\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                # Client disconnected mid-stream: close the generator so
+                # AsyncEngine aborts the request and frees its slot/pages.
+                bridge.run(agen.aclose(), timeout=10)
+            except (TimeoutError, _FutTimeout):
+                # Headers are already out — a 504 JSON error here would
+                # corrupt the chunked SSE body. Abort the engine request,
+                # then end the stream with an error event + terminator so
+                # clients see a well-formed (if truncated) stream.
+                try:
+                    bridge.run(agen.aclose(), timeout=10)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+                try:
+                    err = (b'data: {"error": {"message": '
+                           b'"generation timed out"}}\n\ndata: [DONE]\n\n')
+                    self.wfile.write(f"{len(err):x}\r\n".encode() + err
+                                     + b"\r\n0\r\n\r\n")
+                    self.wfile.flush()
+                except OSError:
+                    pass
+
+    return Handler
+
+
+class OpenAIServer:
+    """Lifecycle wrapper: build, serve_forever (or background), shutdown."""
+
+    def __init__(self, client, model_name: str, host: str = "127.0.0.1",
+                 port: int = 8000, request_timeout: float = 600.0):
+        self.bridge = _EngineBridge(client)
+        self.httpd = ThreadingHTTPServer(
+            (host, port), make_handler(self.bridge, model_name,
+                                       request_timeout))
+        self.model_name = model_name
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def start_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, name="openai-http",
+                             daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.bridge.shutdown()

@@ -1,0 +1,151 @@
+"""OpenAI-compatible serving endpoint over the real (tiny) engine.
+
+Drives actual HTTP against a live ThreadingHTTPServer + engine-loop thread:
+completions, SSE streaming, concurrent requests batching in the engine,
+message-array conversion, and error paths.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from runbookai_tpu.model.jax_tpu import JaxTpuClient
+from runbookai_tpu.server.openai_api import (
+    OpenAIServer,
+    messages_to_prompt_parts,
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    client = JaxTpuClient.for_testing(max_new_tokens=8)
+    srv = OpenAIServer(client, model_name="llama3-test", port=0)
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+def _post(srv, path, payload, stream=False):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    return urllib.request.urlopen(req, timeout=120)
+
+
+def test_models_and_health(server):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/v1/models", timeout=30) as r:
+        models = json.loads(r.read())
+    assert models["data"][0]["id"] == "llama3-test"
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz", timeout=30) as r:
+        health = json.loads(r.read())
+    assert health["status"] == "ok" and "metrics" in health
+
+
+def test_chat_completion(server):
+    with _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "system", "content": "terse"},
+                     {"role": "user", "content": "hello"}],
+        "max_tokens": 6,
+    }) as r:
+        body = json.loads(r.read())
+    assert body["object"] == "chat.completion"
+    assert body["choices"][0]["message"]["role"] == "assistant"
+    assert body["usage"]["completion_tokens"] > 0
+    assert body["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+def test_chat_completion_streaming(server):
+    with _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 6, "stream": True,
+    }) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = r.read().decode()
+    events = [line[len("data: "):] for line in raw.splitlines()
+              if line.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    # max_tokens truncation must surface as "length" (stop-token end: "stop")
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+    content = "".join(c["choices"][0]["delta"].get("content", "")
+                      for c in chunks)
+    assert isinstance(content, str)
+
+
+def test_concurrent_requests_batch(server):
+    results = []
+
+    def one(i):
+        with _post(server, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": f"q{i}"}],
+            "max_tokens": 5,
+        }) as r:
+            results.append(json.loads(r.read()))
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert len(results) == 4
+    assert all(r["usage"]["completion_tokens"] > 0 for r in results)
+
+
+def test_bad_request(server):
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/v1/chat/completions", {"messages": []})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/v1/other", {"messages": [{"role": "user",
+                                                  "content": "x"}]})
+    assert e.value.code == 404
+
+
+def test_messages_conversion():
+    system, history, user = messages_to_prompt_parts([
+        {"role": "system", "content": "be terse"},
+        {"role": "user", "content": "a"},
+        {"role": "assistant", "content": "b"},
+        {"role": "user", "content": [{"type": "text", "text": "c1"},
+                                     {"type": "text", "text": "c2"}]},
+    ])
+    assert system == "be terse"
+    assert history == [("user", "a"), ("assistant", "b")]
+    assert user == "c1c2"
+
+
+def test_bad_sampling_params_are_400(server):
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "x"}],
+            "temperature": "hot",
+        })
+    assert e.value.code == 400
+
+
+async def test_generate_timeout_aborts_request():
+    # Engine-level timeout must abort (free slot/pages), not just raise.
+    client = JaxTpuClient.for_testing(max_new_tokens=256)
+    with pytest.raises(TimeoutError):
+        await client.engine.generate(
+            client.tokenizer.encode("a long prompt to decode"),
+            client._sampling(), timeout_s=0.05)
+    core = client.core
+    import asyncio as _a
+    for _ in range(300):
+        if not core.has_work:
+            break
+        await _a.sleep(0.02)
+    assert not core.has_work
+    assert core.finished and core.finished[-1].finish_reason is not None
+    await client.shutdown()
