@@ -72,6 +72,7 @@ class AsyncEngine:
         prompt_ids: list[int],
         sampling: Optional[SamplingParams] = None,
         timeout_s: Optional[float] = None,
+        priority: int = 0,
     ) -> EngineOutput:
         """Submit one request and await its completion.
 
@@ -80,7 +81,9 @@ class AsyncEngine:
         caller-side timeout alone would leave the request decoding to
         max_new_tokens for nobody."""
         await self.start()  # idempotent; restarts after a torn-down loop
-        req = EngineRequest(prompt_ids=prompt_ids, sampling=sampling or SamplingParams())
+        req = EngineRequest(prompt_ids=prompt_ids,
+                            sampling=sampling or SamplingParams(),
+                            priority=priority)
         req.done_event = asyncio.Event()
         loop = asyncio.get_running_loop()
         # done_event.set() happens on a worker thread; bridge it safely.
@@ -112,6 +115,7 @@ class AsyncEngine:
         self,
         prompt_ids: list[int],
         sampling: Optional[SamplingParams] = None,
+        priority: int = 0,
     ):
         """Async iterator of token ids as the engine samples them.
 
@@ -123,7 +127,8 @@ class AsyncEngine:
         """
         await self.start()  # idempotent; restarts after a torn-down loop
         req = EngineRequest(prompt_ids=prompt_ids,
-                            sampling=sampling or SamplingParams())
+                            sampling=sampling or SamplingParams(),
+                            priority=priority)
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
 

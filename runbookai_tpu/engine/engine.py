@@ -282,6 +282,11 @@ class EngineCore:
     def _admit(self) -> None:
         free_slots = sum(s is None for s in self._slots)
         in_flight = len(self.prefilling)
+        # Priority classes first, FCFS within a class. Stable sort on each
+        # admission pass keeps re-queued (preempted) requests ahead of
+        # same-priority newcomers via their original arrival_time.
+        if len(self.waiting) > 1:
+            self.waiting.sort(key=lambda r: (-r.priority, r.arrival_time))
         while self.waiting and (free_slots - in_flight) > 0:
             req = self.waiting[0]
             # Headroom never exceeds what the request could actually generate;
@@ -293,13 +298,29 @@ class EngineCore:
             # block admission reserving headroom it can never use.
             headroom = min(self.ecfg.admit_headroom_tokens,
                            max(req.sampling.max_new_tokens - req.num_generated, 0))
-            if not (self.prefilling or self.decoding) and in_flight == 0:
+            idle = not (self.prefilling or self.decoding)
+            if idle:
                 headroom = 0
             if req.block_hashes is None:
                 req.block_hashes = hash_blocks(req.prompt_ids, self.ecfg.page_size)
             ok, matched = self.kv.probe_admit(req.prompt_ids, headroom,
                                               hashes=req.block_hashes)
             if not ok:
+                if idle:
+                    # Idle engine, zero headroom, retired prefix pages count
+                    # as free — if it still doesn't fit, no future release
+                    # can ever make it fit. Fail it rather than spinning
+                    # has_work forever (liveness: surfaced by the priority
+                    # preemption test, but reachable by any oversized
+                    # prompt or a recompute cycle whose folded prompt
+                    # outgrew the pool).
+                    self.waiting.pop(0)
+                    req.state = RequestState.FAILED
+                    req.finish_reason = FinishReason.ABORTED
+                    self.finished.append(req)
+                    if req.done_event is not None:
+                        req.done_event.set()
+                    continue
                 break
             self.waiting.pop(0)
             # Reuse resident pages for the shared prompt prefix (same system
@@ -329,10 +350,12 @@ class EngineCore:
         req.prefill_pos = prefill_pos
 
     def _preempt_youngest(self) -> bool:
-        """Evict the most recently admitted decoding request (recompute)."""
+        """Evict the lowest-priority, most recently arrived decoding
+        request (recompute on re-admission)."""
         if not self.decoding:
             return False
-        victim = max(self.decoding, key=lambda r: r.arrival_time)
+        victim = max(self.decoding,
+                     key=lambda r: (-r.priority, r.arrival_time))
         self.decoding.remove(victim)
         if victim.slot is not None:
             self._slots[victim.slot] = None

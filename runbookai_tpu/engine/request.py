@@ -46,6 +46,10 @@ class EngineRequest:
     prompt_ids: list[int]
     sampling: SamplingParams = field(default_factory=SamplingParams)
     request_id: str = field(default_factory=lambda: f"req-{uuid.uuid4().hex[:10]}")
+    # Scheduling class: higher admits first and is preempted last (FCFS
+    # within a class). Interactive agent turns outrank background eval
+    # batches this way without separate engines.
+    priority: int = 0
     # Monotonic clock — compared against perf_counter() timestamps in the engine.
     arrival_time: float = field(default_factory=time.perf_counter)
 

@@ -30,6 +30,34 @@ from runbookai_tpu.models.hf_loader import load_or_init
 from runbookai_tpu.utils.tokens import load_tokenizer
 
 
+async def stream_text(engine, tokenizer, prompt_ids, sampling,
+                      state: Optional[dict] = None, priority: int = 0):
+    """Token stream -> text-piece stream, shared by every streaming surface
+    (client ``chat_stream``, OpenAI SSE endpoint): incremental UTF-8 decode
+    over per-token bytes (multi-byte chars split across tokens never yield
+    mojibake) and stop-token skipping, mirroring ``EngineCore.output_for``.
+    ``state`` (optional dict) receives ``n_tokens`` / ``saw_stop`` for
+    finish-reason reporting."""
+    import codecs
+
+    stop_ids = {tokenizer.eot_id, tokenizer.eos_id}
+    decoder = codecs.getincrementaldecoder("utf-8")("replace")
+    async for tok in engine.generate_stream(prompt_ids, sampling,
+                                            priority=priority):
+        if state is not None:
+            state["n_tokens"] = state.get("n_tokens", 0) + 1
+        if tok in stop_ids:
+            if state is not None:
+                state["saw_stop"] = True
+            continue
+        piece = decoder.decode(tokenizer.id_to_bytes(tok))
+        if piece:
+            yield piece
+    tail = decoder.decode(b"", final=True)
+    if tail:
+        yield tail
+
+
 class JaxTpuClient(BaseLLMClient):
     def __init__(
         self,
@@ -174,43 +202,25 @@ class JaxTpuClient(BaseLLMClient):
         :meth:`chat` returns it. Consumers that must render only parsed
         content should buffer until ``done``.
 
-        Incremental UTF-8 decoding (``codecs`` incremental decoder over the
-        tokenizer's per-id byte sequences) so multi-byte characters split
-        across tokens never yield mojibake; stop tokens are skipped,
-        mirroring ``EngineCore.output_for``.
+        Text decoding/stop handling is the shared :func:`stream_text`
+        (also behind the OpenAI SSE endpoint).
         """
-        import codecs
-
         prompt = build_chat_prompt(system_prompt, user_prompt, tools,
                                    fmt=self.chat_format)
         ids = self.tokenizer.encode(prompt)
-        stop_ids = {self.tokenizer.eot_id, self.tokenizer.eos_id}
-        decoder = codecs.getincrementaldecoder("utf-8")("replace")
-        n_tokens = 0
+        state: dict = {}
         parts: list[str] = []
-
-        def flush(piece: str):
-            if piece:
-                parts.append(piece)
-                return {"type": "text", "delta": piece}
-            return None
-
-        async for tok in self.engine.generate_stream(ids, self._sampling()):
-            n_tokens += 1
-            if tok in stop_ids:
-                continue
-            ev = flush(decoder.decode(self.tokenizer.id_to_bytes(tok)))
-            if ev:
-                yield ev
-        ev = flush(decoder.decode(b"", final=True))
-        if ev:
-            yield ev
+        async for piece in stream_text(self.engine, self.tokenizer, ids,
+                                       self._sampling(), state=state):
+            parts.append(piece)
+            yield {"type": "text", "delta": piece}
         content, tool_calls, thinking = parse_assistant_output("".join(parts))
         for call in tool_calls:
             yield {"type": "tool_call", "call": call}
         yield {"type": "done", "response": LLMResponse(
             content=content, tool_calls=tool_calls, thinking=thinking,
-            usage={"prompt_tokens": len(ids), "completion_tokens": n_tokens})}
+            usage={"prompt_tokens": len(ids),
+                   "completion_tokens": state.get("n_tokens", 0)})}
 
     async def complete(self, prompt: str, guided: Optional[bool] = None,
                        schema: Optional[str] = None) -> str:

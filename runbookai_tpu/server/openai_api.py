@@ -65,7 +65,13 @@ class _EngineBridge:
             coro, self.loop).result(timeout)
 
     def stream(self, agen, timeout: Optional[float] = None):
-        """Drain an async generator from a plain thread, yielding items."""
+        """Drain an async generator from a plain thread, yielding items.
+
+        On a per-item timeout the pending ``__anext__`` task is CANCELLED
+        on the loop first — that unwinds the generator's suspended await so
+        its ``finally`` (the engine-abort path) actually runs — and only
+        then is ``aclose`` awaited; closing a still-running generator would
+        raise RuntimeError and leak the engine request."""
         sentinel = object()
 
         async def _next():
@@ -75,7 +81,24 @@ class _EngineBridge:
                 return sentinel
 
         while True:
-            item = self.run(_next(), timeout)
+            fut = asyncio.run_coroutine_threadsafe(_next(), self.loop)
+            try:
+                item = fut.result(timeout)
+            except _FutTimeout:
+                fut.cancel()
+
+                async def _close():
+                    try:
+                        await agen.aclose()
+                    except RuntimeError:
+                        pass  # cancellation still unwinding
+
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        _close(), self.loop).result(10)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+                raise TimeoutError("stream item timed out")
             if item is sentinel:
                 return
             yield item
@@ -200,6 +223,12 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                         client.engine.generate(ids, sampling,
                                                timeout_s=request_timeout),
                         timeout=request_timeout + 30)
+                    if out.finish_reason.value == "aborted":
+                        # Admission fail-fast (prompt can never fit) or
+                        # mid-decode abort: an error, not a completion.
+                        self._error(503, "request aborted by the engine "
+                                         "(insufficient KV capacity)")
+                        return
                     finish = ("length" if out.finish_reason.value
                               == "max_tokens" else "stop")
                     self._json(200, _completion_payload(
@@ -213,7 +242,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 pass  # client went away; engine abort handled in stream path
 
         def _stream_response(self, ids, sampling) -> None:
-            import codecs
+            from runbookai_tpu.model.jax_tpu import stream_text
 
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
@@ -227,55 +256,45 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                                  + b"\r\n")
                 self.wfile.flush()
 
-            chunk_id = f"chatcmpl-{uuid.uuid4().hex[:12]}"
-            send_chunk(_chunk_payload(model_name, {"role": "assistant"},
-                                      None, chunk_id))
-            stop_ids = {client.tokenizer.eot_id, client.tokenizer.eos_id}
-            decoder = codecs.getincrementaldecoder("utf-8")("replace")
-            agen = client.engine.generate_stream(ids, sampling)
-            n_tokens = 0
-            saw_stop = False
-            try:
-                for tok in bridge.stream(agen, timeout=request_timeout):
-                    n_tokens += 1
-                    if tok in stop_ids:
-                        saw_stop = True
-                        continue
-                    piece = decoder.decode(client.tokenizer.id_to_bytes(tok))
-                    if piece:
-                        send_chunk(_chunk_payload(
-                            model_name, {"content": piece}, None, chunk_id))
-                tail = decoder.decode(b"", final=True)
-                if tail:
-                    send_chunk(_chunk_payload(
-                        model_name, {"content": tail}, None, chunk_id))
-                # max_tokens truncation reports "length", like non-stream.
-                finish = ("length" if not saw_stop
-                          and n_tokens >= sampling.max_new_tokens else "stop")
-                send_chunk(_chunk_payload(model_name, {}, finish, chunk_id))
-                done = b"data: [DONE]\n\n"
+            def send_terminator(extra: bytes = b"") -> None:
+                done = extra + b"data: [DONE]\n\n"
                 self.wfile.write(f"{len(done):x}\r\n".encode() + done
                                  + b"\r\n0\r\n\r\n")
                 self.wfile.flush()
+
+            chunk_id = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+            send_chunk(_chunk_payload(model_name, {"role": "assistant"},
+                                      None, chunk_id))
+            state: dict = {}
+            # Shared with JaxTpuClient.chat_stream: one copy of the
+            # incremental-UTF-8 / stop-token handling for all surfaces.
+            agen = stream_text(client.engine, client.tokenizer, ids,
+                               sampling, state=state)
+            try:
+                for piece in bridge.stream(agen, timeout=request_timeout):
+                    send_chunk(_chunk_payload(
+                        model_name, {"content": piece}, None, chunk_id))
+                # max_tokens truncation reports "length", like non-stream.
+                finish = ("length"
+                          if not state.get("saw_stop")
+                          and state.get("n_tokens", 0)
+                          >= sampling.max_new_tokens else "stop")
+                send_chunk(_chunk_payload(model_name, {}, finish, chunk_id))
+                send_terminator()
             except (BrokenPipeError, ConnectionResetError):
                 # Client disconnected mid-stream: close the generator so
                 # AsyncEngine aborts the request and frees its slot/pages.
-                bridge.run(agen.aclose(), timeout=10)
-            except (TimeoutError, _FutTimeout):
-                # Headers are already out — a 504 JSON error here would
-                # corrupt the chunked SSE body. Abort the engine request,
-                # then end the stream with an error event + terminator so
-                # clients see a well-formed (if truncated) stream.
                 try:
                     bridge.run(agen.aclose(), timeout=10)
-                except Exception:  # noqa: BLE001 — teardown best-effort
+                except Exception:  # noqa: BLE001 — socket is gone anyway
                     pass
+            except (TimeoutError, _FutTimeout):
+                # bridge.stream already cancelled + closed the generator
+                # (engine abort ran). Headers are out, so end the chunked
+                # SSE body well-formed with an error event, never a 504.
                 try:
-                    err = (b'data: {"error": {"message": '
-                           b'"generation timed out"}}\n\ndata: [DONE]\n\n')
-                    self.wfile.write(f"{len(err):x}\r\n".encode() + err
-                                     + b"\r\n0\r\n\r\n")
-                    self.wfile.flush()
+                    send_terminator(b'data: {"error": {"message": '
+                                    b'"generation timed out"}}\n\n')
                 except OSError:
                     pass
 

@@ -13,7 +13,12 @@ import pytest
 
 from runbookai_tpu.engine.async_engine import AsyncEngine
 from runbookai_tpu.engine.engine import EngineConfig, EngineCore
-from runbookai_tpu.engine.request import EngineRequest, FinishReason, SamplingParams
+from runbookai_tpu.engine.request import (
+    EngineRequest,
+    FinishReason,
+    RequestState,
+    SamplingParams,
+)
 from runbookai_tpu.models.llama import CONFIGS, forward, init_params
 from runbookai_tpu.utils.tokens import ByteTokenizer
 
@@ -345,3 +350,61 @@ def test_mixed_workload_stress(setup):
     # Pool drains clean: all sequences released every page.
     assert not core.kv.seqs
     assert core.kv.allocator.free_pages == 48 - 1  # page 0 reserved null
+
+
+def test_priority_scheduling(setup):
+    """Higher-priority requests admit first and survive preemption longer."""
+    tok, params = setup
+    core = make_core(tok, params, max_batch_slots=1, num_pages=64)
+    lo = EngineRequest(prompt_ids=tok.encode("background eval batch item"),
+                       sampling=SamplingParams(max_new_tokens=4), priority=0)
+    hi = EngineRequest(prompt_ids=tok.encode("interactive agent turn"),
+                       sampling=SamplingParams(max_new_tokens=4), priority=5)
+    core.submit(lo)   # arrives FIRST
+    core.submit(hi)
+    core.run_until_idle()
+    # One slot: the high-priority request must have been served first.
+    assert hi.finish_reason is not None and lo.finish_reason is not None
+    hi_idx = core.finished.index(hi)
+    lo_idx = core.finished.index(lo)
+    assert hi_idx < lo_idx
+
+    # Preemption picks the LOWEST priority victim even when it is older:
+    # pool fits both prompts but not both completions.
+    core2 = make_core(tok, params, max_batch_slots=4, num_pages=24,
+                      admit_headroom_tokens=0)
+    lo2 = EngineRequest(prompt_ids=tok.encode("low priority prompt"),
+                        sampling=SamplingParams(max_new_tokens=24), priority=0)
+    hi2 = EngineRequest(prompt_ids=tok.encode("high priority prompt!"),
+                        sampling=SamplingParams(max_new_tokens=24), priority=5)
+    core2.submit(lo2)
+    core2.submit(hi2)
+    preempted_states = []
+    for _ in range(400):
+        before = core2.metrics["preemptions"]
+        core2.step()
+        if core2.metrics["preemptions"] > before:
+            preempted_states.append((lo2.state, hi2.state))
+        if not core2.has_work:
+            break
+    for lo_state, hi_state in preempted_states:
+        # Whenever someone was evicted, it was never the high-priority
+        # request while the low-priority one kept decoding.
+        assert not (hi_state == RequestState.WAITING
+                    and lo_state == RequestState.DECODE)
+    assert lo2.finish_reason is not None and hi2.finish_reason is not None
+
+
+def test_impossible_fit_fails_instead_of_spinning(setup):
+    """A request that can never fit the page pool must FAIL promptly —
+    an idle engine with a too-big prompt used to spin has_work forever."""
+    tok, params = setup
+    core = make_core(tok, params, num_pages=8, max_seq_len=2048)
+    big = EngineRequest(prompt_ids=list(range(200)) * 2,  # 400 tokens, 8 pages*4
+                        sampling=SamplingParams(max_new_tokens=4))
+    core.submit(big)
+    done = core.run_until_idle(max_steps=50)
+    assert not core.has_work
+    assert big.state == RequestState.FAILED
+    assert big.finish_reason == FinishReason.ABORTED
+    assert big in done or big in core.finished
