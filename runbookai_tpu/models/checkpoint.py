@@ -46,6 +46,10 @@ def save_checkpoint(path: str | Path, cfg: LlamaConfig, params: Any) -> Path:
 
 def checkpoint_config(path: str | Path) -> LlamaConfig:
     data = json.loads((Path(path) / _CONFIG_FILE).read_text())
+    # JSON round-trips tuples as lists; the config must stay hashable (it
+    # is a static jit argument) and ==-comparable with the original.
+    if data.get("rope_scaling") is not None:
+        data["rope_scaling"] = tuple(data["rope_scaling"])
     return LlamaConfig(**data)
 
 

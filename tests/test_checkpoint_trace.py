@@ -187,3 +187,25 @@ def test_engine_emits_trace_spans(tmp_path):
     names = {s["name"] for s in read_spans(tmp_path / "engine.jsonl")}
     assert "engine.prefill" in names
     assert names & {"engine.decode", "engine.decode_spec"}
+
+
+def test_checkpoint_roundtrip_moe_and_rope_scaling(tmp_path):
+    """MoE (rank-4 expert leaves + router) and rope-scaled configs must
+    round-trip: JSON turns the rope_scaling tuple into a list, which would
+    break config hashability (static jit arg) if not restored."""
+    import dataclasses as _dc
+
+    from runbookai_tpu.models.llama import CONFIGS, init_params
+
+    moe_cfg = _dc.replace(CONFIGS["mixtral-test"],
+                          rope_scaling=(8.0, 1.0, 4.0, 8192))
+    params = init_params(jax.random.PRNGKey(3), moe_cfg, dtype=jnp.float32)
+    path = save_checkpoint(tmp_path / "moe", moe_cfg, params)
+    restored_cfg = checkpoint_config(path)
+    assert restored_cfg == moe_cfg
+    hash(restored_cfg)  # static-arg requirement
+    cfg2, restored = load_checkpoint(path)
+    assert cfg2.n_experts == 4 and cfg2.rope_scaling == (8.0, 1.0, 4.0, 8192)
+    assert restored["layers"]["router"].shape == (2, 64, 4)
+    assert restored["layers"]["w_gate"].shape == (2, 4, 64, 128)
+    _assert_trees_equal(params, restored)
