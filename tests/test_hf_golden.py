@@ -157,3 +157,17 @@ def test_config_from_hf_family_and_sliding_window(tmp_path):
     }))
     with _pytest.raises(ValueError, match="rope_scaling"):
         config_from_hf(tmp_path)
+
+
+def test_bge_encoder_matches_hf_bert():
+    """The knowledge encoder (from-scratch JAX BERT) must reproduce
+    transformers BertModel CLS embeddings from a real save_pretrained
+    artifact — retrieval quality rides on these numerics."""
+    from runbookai_tpu.models.bge import encode, load_params as bge_load
+
+    d = FIXTURES / "hf-tiny-bert"
+    cfg, params = bge_load(d, dtype=jnp.float32)
+    blob = np.load(d / "expected_embeddings.npz")
+    got = np.asarray(encode(params, cfg, jnp.asarray(blob["input_ids"]),
+                            jnp.asarray(blob["attention_mask"])))
+    np.testing.assert_allclose(got, blob["cls_norm"], atol=2e-4, rtol=2e-3)
