@@ -17,7 +17,12 @@ import threading
 from typing import Optional
 
 from runbookai_tpu.engine.engine import EngineCore
-from runbookai_tpu.engine.request import EngineOutput, EngineRequest, SamplingParams
+from runbookai_tpu.engine.request import (
+    EngineOutput,
+    EngineRequest,
+    FinishReason,
+    SamplingParams,
+)
 
 
 class AsyncEngine:
@@ -34,6 +39,13 @@ class AsyncEngine:
         # the current loop, along with the loop-bound wake event, or every
         # later request would enqueue forever with nothing stepping.
         if self._task is not None and self._task.done():
+            # Retrieve the crashed task's exception so asyncio doesn't log
+            # "Task exception was never retrieved" at GC (the crash itself
+            # was already reported by _fail_live_requests).
+            try:
+                self._task.exception()
+            except asyncio.CancelledError:
+                pass
             self._task = None
         if self._task is None:
             self._wake = asyncio.Event()
@@ -61,7 +73,41 @@ class AsyncEngine:
                 self._wake.clear()
                 await self._wake.wait()
                 continue
-            await asyncio.to_thread(self._locked_step)
+            try:
+                await asyncio.to_thread(self._locked_step)
+            except Exception:  # noqa: BLE001 — step blew up (e.g. device error)
+                # Fail every live request NOW: letting the loop task die
+                # would leave their done_events unset and every pending
+                # generate()/generate_stream() awaiting forever. Callers'
+                # post-submit liveness check restarts a fresh loop.
+                self._fail_live_requests()
+                raise
+
+    def _fail_live_requests(self) -> None:
+        import logging
+
+        logging.getLogger(__name__).exception(
+            "engine step failed; aborting live requests")
+        with self._lock:
+            for req in list(self.core.waiting) + list(self.core.prefilling) \
+                    + list(self.core.decoding):
+                try:
+                    self.core.abort(req.request_id)
+                except Exception:  # noqa: BLE001 — core state corrupted
+                    # abort()'s own cleanup failed: force the request out
+                    # of the pools anyway so a restarted loop doesn't
+                    # re-step a zombie, and unblock its awaiter.
+                    for pool in (self.core.waiting, self.core.prefilling,
+                                 self.core.decoding):
+                        if req in pool:
+                            pool.remove(req)
+                    if req.slot is not None and req.slot < len(self.core._slots):
+                        self.core._slots[req.slot] = None
+                        req.slot = None
+                    req.finish_reason = req.finish_reason or FinishReason.ABORTED
+                    self.core.finished.append(req)
+                    if req.done_event is not None:
+                        req.done_event.set()
 
     def _locked_step(self) -> None:
         with self._lock:
@@ -99,6 +145,13 @@ class AsyncEngine:
         with self._lock:
             self.core.submit(req)
         self._wake.set()
+        # The loop may have crashed between our start() and this submit;
+        # event-loop scheduling makes exactly one of these true: either the
+        # crash's abort sweep saw our request, or the task is done now and
+        # a fresh loop must pick the request up.
+        if self._task is None or self._task.done():
+            await self.start()
+            self._wake.set()
         if timeout_s is None:
             await done
         else:
@@ -144,6 +197,9 @@ class AsyncEngine:
         with self._lock:
             self.core.submit(req)
         self._wake.set()
+        if self._task is None or self._task.done():
+            await self.start()  # loop crashed mid-submit; see generate()
+            self._wake.set()
         try:
             while True:
                 tok = await queue.get()

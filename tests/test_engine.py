@@ -437,3 +437,32 @@ def test_long_prompt_chunked_prefill(setup):
     core.run_until_idle()
     assert req.out_ids == ref.out_ids
     assert core.metrics["prefill_tokens"] >= 1200
+
+
+async def test_step_exception_fails_live_requests(setup):
+    """A step() blow-up (e.g. transient device error) must resolve every
+    pending generate instead of leaving awaiters hanging on a dead loop
+    task; the next request restarts the loop."""
+    tok, params = setup
+    core = make_core(tok, params)
+    eng = AsyncEngine(core)
+    boom = {"n": 0}
+    real_step = core.step
+
+    def flaky_step():
+        if boom["n"] == 0:
+            boom["n"] += 1
+            raise RuntimeError("injected device error")
+        return real_step()
+
+    core.step = flaky_step
+    out = await eng.generate(tok.encode("hello"),
+                             SamplingParams(max_new_tokens=4))
+    # First request died with the injected error (aborted, not hung)...
+    assert out.finish_reason == FinishReason.ABORTED
+    # ...and the engine recovered for the next one.
+    out2 = await eng.generate(tok.encode("world"),
+                              SamplingParams(max_new_tokens=4))
+    assert out2.finish_reason is not None
+    assert out2.decode_tokens >= 1
+    await eng.stop()
