@@ -64,6 +64,8 @@ class AsyncEngine:
         except asyncio.CancelledError:
             if not task.cancelled():
                 raise  # the cancellation targeted stop() itself, not the loop
+        except Exception:  # noqa: BLE001
+            pass  # step crash — already reported by _fail_live_requests
 
     async def _loop(self) -> None:
         while not self._stopped:
@@ -78,8 +80,8 @@ class AsyncEngine:
             except Exception:  # noqa: BLE001 — step blew up (e.g. device error)
                 # Fail every live request NOW: letting the loop task die
                 # would leave their done_events unset and every pending
-                # generate()/generate_stream() awaiting forever. Callers'
-                # post-submit liveness check restarts a fresh loop.
+                # generate()/generate_stream() awaiting forever. The next
+                # caller's start() clears the done task and restarts.
                 self._fail_live_requests()
                 raise
 
@@ -94,20 +96,10 @@ class AsyncEngine:
                 try:
                     self.core.abort(req.request_id)
                 except Exception:  # noqa: BLE001 — core state corrupted
-                    # abort()'s own cleanup failed: force the request out
-                    # of the pools anyway so a restarted loop doesn't
-                    # re-step a zombie, and unblock its awaiter.
-                    for pool in (self.core.waiting, self.core.prefilling,
-                                 self.core.decoding):
-                        if req in pool:
-                            pool.remove(req)
-                    if req.slot is not None and req.slot < len(self.core._slots):
-                        self.core._slots[req.slot] = None
-                        req.slot = None
-                    req.finish_reason = req.finish_reason or FinishReason.ABORTED
-                    self.core.finished.append(req)
-                    if req.done_event is not None:
-                        req.done_event.set()
+                    # abort()'s own cleanup failed: force-finish so a
+                    # restarted loop doesn't re-step a zombie and the
+                    # awaiter unblocks.
+                    self.core.force_finish(req)
 
     def _locked_step(self) -> None:
         with self._lock:
@@ -145,13 +137,10 @@ class AsyncEngine:
         with self._lock:
             self.core.submit(req)
         self._wake.set()
-        # The loop may have crashed between our start() and this submit;
-        # event-loop scheduling makes exactly one of these true: either the
-        # crash's abort sweep saw our request, or the task is done now and
-        # a fresh loop must pick the request up.
-        if self._task is None or self._task.done():
-            await self.start()
-            self._wake.set()
+        # No liveness re-check needed: there is no await between start()
+        # and this point, so a loop crash can only be delivered once we
+        # suspend below — and its abort sweep then sees this request in
+        # the pools and resolves our future.
         if timeout_s is None:
             await done
         else:
@@ -197,9 +186,6 @@ class AsyncEngine:
         with self._lock:
             self.core.submit(req)
         self._wake.set()
-        if self._task is None or self._task.done():
-            await self.start()  # loop crashed mid-submit; see generate()
-            self._wake.set()
         try:
             while True:
                 tok = await queue.get()

@@ -397,6 +397,31 @@ class EngineCore:
         if req.done_event is not None:
             req.done_event.set()
 
+    def force_finish(self, req: EngineRequest) -> None:
+        """Best-effort finish for crash recovery: every cleanup step runs
+        independently (pool removal, slot, KV pages, last-token map), so a
+        corrupted core still ends with the request out of the live pools
+        and its awaiter unblocked. Normal paths use :meth:`_finish`."""
+        for pool in (self.waiting, self.prefilling, self.decoding):
+            if req in pool:
+                pool.remove(req)
+        if req.slot is not None and req.slot < len(self._slots):
+            self._slots[req.slot] = None
+            req.slot = None
+        try:
+            if req.request_id in self.kv.seqs:
+                self.kv.release(req.request_id,
+                                token_ids=self._kv_valid_tokens(req))
+        except Exception:  # noqa: BLE001 — release itself may be poisoned
+            pass
+        self._last_token.pop(req.request_id, None)
+        req.state = RequestState.FINISHED
+        req.finish_reason = req.finish_reason or FinishReason.ABORTED
+        if req not in self.finished:
+            self.finished.append(req)
+        if req.done_event is not None:
+            req.done_event.set()
+
     def abort(self, request_id: str) -> bool:
         """Abort a live request (streaming consumer went away): frees its
         batch slot and KV pages immediately so concurrent requests are not
