@@ -111,6 +111,7 @@ class AsyncEngine:
         sampling: Optional[SamplingParams] = None,
         timeout_s: Optional[float] = None,
         priority: int = 0,
+        adapter: Optional[str] = None,
     ) -> EngineOutput:
         """Submit one request and await its completion.
 
@@ -121,7 +122,7 @@ class AsyncEngine:
         await self.start()  # idempotent; restarts after a torn-down loop
         req = EngineRequest(prompt_ids=prompt_ids,
                             sampling=sampling or SamplingParams(),
-                            priority=priority)
+                            priority=priority, adapter=adapter)
         req.done_event = asyncio.Event()
         loop = asyncio.get_running_loop()
         # done_event.set() happens on a worker thread; bridge it safely.
@@ -158,6 +159,7 @@ class AsyncEngine:
         prompt_ids: list[int],
         sampling: Optional[SamplingParams] = None,
         priority: int = 0,
+        adapter: Optional[str] = None,
     ):
         """Async iterator of token ids as the engine samples them.
 
@@ -170,7 +172,7 @@ class AsyncEngine:
         await self.start()  # idempotent; restarts after a torn-down loop
         req = EngineRequest(prompt_ids=prompt_ids,
                             sampling=sampling or SamplingParams(),
-                            priority=priority)
+                            priority=priority, adapter=adapter)
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
 

@@ -93,13 +93,13 @@ class EngineConfig:
          donate_argnums=(4, 5))
 def _decode_step(
     params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
-    temps, top_ps, top_ks, key, mask, page_size: int, block_pages: int,
-    attn_impl: str = "xla", mesh=None,
+    temps, top_ps, top_ks, key, mask, adapter_ids, page_size: int,
+    block_pages: int, attn_impl: str = "xla", mesh=None,
 ):
     logits, kv_k, kv_v = forward_impl(
         params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
         page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
-        mesh=mesh,
+        mesh=mesh, adapter_ids=adapter_ids,
     )
     tok = sample_tokens(logits[:, -1], key, temps, top_ps, mask, top_ks)
     return tok, logits[:, -1], kv_k, kv_v
@@ -111,8 +111,8 @@ def _decode_step(
          donate_argnums=(4, 5))
 def _decode_multi(
     params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
-    temps, top_ps, top_ks, key, page_size: int, block_pages: int, k_steps: int,
-    attn_impl: str = "xla", mesh=None,
+    temps, top_ps, top_ks, key, adapter_ids, page_size: int, block_pages: int,
+    k_steps: int, attn_impl: str = "xla", mesh=None,
 ):
     """K autoregressive decode steps in ONE dispatch (on-device sampling).
 
@@ -129,7 +129,7 @@ def _decode_multi(
         logits, kv_k, kv_v = forward_impl(
             params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
             page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
-            mesh=mesh,
+            mesh=mesh, adapter_ids=adapter_ids,
         )
         key, sub = jax.random.split(key)
         tok = sample_tokens(logits[:, -1], sub, temps, top_ps, None, top_ks)
@@ -147,7 +147,8 @@ def _decode_multi(
          donate_argnums=(4, 5))
 def _decode_spec(
     params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
-    page_size: int, block_pages: int, attn_impl: str = "xla", mesh=None,
+    adapter_ids, page_size: int, block_pages: int, attn_impl: str = "xla",
+    mesh=None,
 ):
     """Verify a speculated chunk: one T=K forward, greedy argmax per position.
 
@@ -165,7 +166,7 @@ def _decode_spec(
     logits, kv_k, kv_v = forward_impl(
         params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
         page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
-        mesh=mesh,
+        mesh=mesh, adapter_ids=adapter_ids,
     )
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv_k, kv_v  # [B, K]
 
@@ -175,14 +176,15 @@ def _decode_spec(
          donate_argnums=(3, 4))
 def _prefill_step(
     params, cfg: LlamaConfig, tokens, kv_k, kv_v, positions, tables, ctx_lens,
-    last_idx, page_size: int, block_pages: int, attn_impl: str = "xla", mesh=None,
+    last_idx, adapter_ids, page_size: int, block_pages: int,
+    attn_impl: str = "xla", mesh=None,
 ):
     """Prefill one chunk for a BATCH of sequences; returns each row's final
     real-token logits ([B, vocab])."""
     logits, kv_k, kv_v = forward_impl(
         params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
         page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
-        mesh=mesh,
+        mesh=mesh, adapter_ids=adapter_ids,
     )
     rows = jnp.arange(logits.shape[0])
     return logits[rows, last_idx], kv_k, kv_v
@@ -202,10 +204,18 @@ class EngineCore:
         seed: int = 0,
         tracer=None,
         mesh=None,
+        lora_registry=None,
     ):
         self.cfg = model_cfg
         self.ecfg = engine_cfg or EngineConfig()
         self.params = params
+        # Multi-LoRA: the stacked adapter pytree rides inside params so the
+        # compiled steps see one tree; per-dispatch adapter_ids rows select
+        # each sequence's adapter (models/lora.py).
+        self.lora = lora_registry
+        if lora_registry is not None:
+            self.params = dict(params)
+            self.params["lora"] = lora_registry.stacked()
         self.tokenizer = tokenizer
         self.tracer = tracer if tracer is not None else get_tracer()
         # Guided decoding hooks: mask_fn returns the allowed-token mask for a
@@ -253,9 +263,21 @@ class EngineCore:
 
     # ------------------------------------------------------------------ API
 
+    def refresh_lora(self) -> None:
+        """Pick up adapters registered after engine construction."""
+        if self.lora is not None:
+            self.params = dict(self.params)
+            self.params["lora"] = self.lora.stacked()
+
     def submit(self, req: EngineRequest) -> None:
         if not req.prompt_ids:
             req.prompt_ids = [self.tokenizer.bos_id]
+        if req.adapter is not None:
+            if self.lora is None:
+                raise ValueError(
+                    f"request names adapter {req.adapter!r} but the engine "
+                    f"has no LoRA registry")
+            req.adapter_idx = self.lora.index_of(req.adapter)
         if req.guided_state is None and req.sampling.guided and self.mask_fn:
             pass  # guided_state initialized lazily by the mask provider
         req.state = RequestState.WAITING
@@ -267,6 +289,13 @@ class EngineCore:
 
     def _trash_pos(self) -> int:
         return self.kv.max_pages_per_seq * self.ecfg.page_size
+
+    def _adapter_ids_for_slots(self) -> np.ndarray:
+        """Per-slot LoRA adapter rows (0 = base) for a decode dispatch."""
+        ids = np.zeros((self.ecfg.max_batch_slots,), dtype=np.int32)
+        for req in self.decoding:
+            ids[req.slot] = req.adapter_idx
+        return ids
 
     def _tables_for(self, reqs: list[Optional[EngineRequest]]) -> np.ndarray:
         """[N, max_pages + 1] page tables with the trailing trash column."""
@@ -302,9 +331,15 @@ class EngineCore:
             if idle:
                 headroom = 0
             if req.block_hashes is None:
-                req.block_hashes = hash_blocks(req.prompt_ids, self.ecfg.page_size)
+                # Seeded by the LoRA adapter row: adapter KV differs for
+                # the same tokens, so each adapter gets its own prefix-
+                # cache namespace (base = seed 0).
+                req.block_hashes = hash_blocks(req.prompt_ids,
+                                               self.ecfg.page_size,
+                                               seed=req.adapter_idx)
             ok, matched = self.kv.probe_admit(req.prompt_ids, headroom,
-                                              hashes=req.block_hashes)
+                                              hashes=req.block_hashes,
+                                              hash_seed=req.adapter_idx)
             if not ok:
                 if idle:
                     # Idle engine, zero headroom, retired prefix pages count
@@ -328,7 +363,8 @@ class EngineCore:
             # novel token.
             cached = self.kv.add_sequence(req.request_id, req.prompt_ids,
                                           hashes=req.block_hashes,
-                                          matched=matched)
+                                          matched=matched,
+                                          hash_seed=req.adapter_idx)
             req.state = RequestState.PREFILL
             req.prefill_pos = cached
             self.metrics["cached_prefix_tokens"] += cached
@@ -490,6 +526,7 @@ class EngineCore:
         positions = np.full((b, t), self._trash_pos(), dtype=np.int32)
         ctx_lens = np.ones((b,), dtype=np.int32)
         last_idx = np.zeros((b,), dtype=np.int32)
+        adapter_ids = np.zeros((b,), dtype=np.int32)
         tables = self._tables_for([r for r, _, _ in rows] +
                                   [None] * (b - len(rows)))
         for i, (req, chunk_len, new_ctx) in enumerate(rows):
@@ -497,6 +534,7 @@ class EngineCore:
             positions[i, :chunk_len] = np.arange(req.prefill_pos, new_ctx)
             ctx_lens[i] = new_ctx
             last_idx[i] = chunk_len - 1
+            adapter_ids[i] = req.adapter_idx
 
         with self.tracer.span("engine.prefill", batch=len(rows),
                               tokens=int(sum(c for _, c, _ in rows))), \
@@ -505,6 +543,7 @@ class EngineCore:
                 self.params, self.cfg, jnp.asarray(tokens), self._kv_k, self._kv_v,
                 jnp.asarray(positions), jnp.asarray(tables),
                 jnp.asarray(ctx_lens), jnp.asarray(last_idx),
+                jnp.asarray(adapter_ids),
                 page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
                 attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
             )
@@ -656,12 +695,14 @@ class EngineCore:
             ctx_lens[i] = req.ctx_len + k - 1  # keys written for all fed tokens
             self.metrics["spec_drafted"] += len(draft)
         tables = self._tables_for(self._slots)
+        adapter_ids = self._adapter_ids_for_slots()
 
         with self.tracer.span("engine.decode_spec", k=k,
                               batch=len(self.decoding)), annotate("decode_spec"):
             toks, self._kv_k, self._kv_v = _decode_spec(
                 self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
                 self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
+                jnp.asarray(adapter_ids),
                 page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
                 attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
             )
@@ -825,6 +866,7 @@ class EngineCore:
                     mask[i] = m
                     need_mask = True
         tables = self._tables_for(self._slots)
+        adapter_ids = self._adapter_ids_for_slots()
         self._key, sub = jax.random.split(self._key)
 
         with self.tracer.span("engine.decode", k=k,
@@ -835,6 +877,7 @@ class EngineCore:
                     self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
                     jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks), sub,
                     jnp.asarray(mask) if need_mask else None,
+                    jnp.asarray(adapter_ids),
                     page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
                     attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
                 )
@@ -844,6 +887,7 @@ class EngineCore:
                     self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
                     self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
                     jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks), sub,
+                    jnp.asarray(adapter_ids),
                     page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
                     k_steps=k, attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
                 )

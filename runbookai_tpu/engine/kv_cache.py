@@ -70,16 +70,21 @@ class PagePool:
 
 
 def hash_blocks(token_ids: Sequence[int], page_size: int,
-                max_blocks: Optional[int] = None) -> list[int]:
+                max_blocks: Optional[int] = None, seed: int = 0) -> list[int]:
     """FNV-1a hash chain over full pages of ``token_ids``.
 
     Block i's hash folds in block i-1's, so equal hashes imply equal full
     prefixes (up to hash collisions), never equal pages at different depths.
     Dispatches to the C++ implementation when the native library is built.
+
+    ``seed`` partitions the cache namespace: KV pages computed under a LoRA
+    adapter hold DIFFERENT values for the same tokens (adapters on wk/wv),
+    so each adapter_idx seeds its own chain and can never match another
+    adapter's (or the base model's) pages.
     """
     from runbookai_tpu import native
 
-    if native.available():
+    if seed == 0 and native.available():
         out = native.hash_blocks_native(token_ids, page_size, max_blocks)
         if out is not None:
             return out
@@ -87,7 +92,7 @@ def hash_blocks(token_ids: Sequence[int], page_size: int,
     if max_blocks is not None:
         n_full = min(n_full, max_blocks)
     out: list[int] = []
-    h = 0xCBF29CE484222325
+    h = 0xCBF29CE484222325 ^ ((seed * 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF)
     for b in range(n_full):
         for t in token_ids[b * page_size : (b + 1) * page_size]:
             h ^= (t + 1) & 0xFFFFFFFFFFFFFFFF
@@ -206,6 +211,7 @@ class SequenceAllocation:
     pages: list[int] = field(default_factory=list)
     ctx_len: int = 0  # tokens currently cached
     registered_blocks: int = 0  # full pages whose hashes are published
+    hash_seed: int = 0  # prefix-cache namespace (LoRA adapter_idx)
 
     def pages_needed(self, new_len: int, page_size: int) -> int:
         have = len(self.pages)
@@ -246,21 +252,25 @@ class KVCacheManager:
     # ----------------------------------------------------------- prefix reuse
 
     def _prompt_hashes(self, prompt_ids: Sequence[int],
-                       hashes: Optional[list[int]]) -> list[int]:
+                       hashes: Optional[list[int]],
+                       hash_seed: int = 0) -> list[int]:
         """Hash chain for matching: capped below ``len(prompt_ids)`` so at
         least one prompt token is always prefilled (the engine needs its
         logits to sample from). ``hashes`` may be a memoized full chain."""
         max_blocks = (len(prompt_ids) - 1) // self.page_size
         if hashes is not None:
             return hashes[:max_blocks]
-        return hash_blocks(prompt_ids, self.page_size, max_blocks)
+        return hash_blocks(prompt_ids, self.page_size, max_blocks,
+                           seed=hash_seed)
 
     def _match_pages(self, prompt_ids: Sequence[int],
-                     hashes: Optional[list[int]]) -> list[int]:
+                     hashes: Optional[list[int]],
+                     hash_seed: int = 0) -> list[int]:
         """Resident pages holding the prompt's leading full blocks, verified
         token-by-token (a bare hash hit is never trusted)."""
         matched: list[int] = []
-        for b, h in enumerate(self._prompt_hashes(prompt_ids, hashes)):
+        for b, h in enumerate(self._prompt_hashes(prompt_ids, hashes,
+                                                  hash_seed)):
             page = self.allocator.lookup(h)
             if page is None:
                 break
@@ -271,12 +281,15 @@ class KVCacheManager:
         return matched
 
     def match_prefix(self, prompt_ids: Sequence[int],
-                     hashes: Optional[list[int]] = None) -> int:
+                     hashes: Optional[list[int]] = None,
+                     hash_seed: int = 0) -> int:
         """Longest reusable page-aligned prefix length (read-only probe)."""
-        return len(self._match_pages(prompt_ids, hashes)) * self.page_size
+        return len(self._match_pages(prompt_ids, hashes,
+                                     hash_seed)) * self.page_size
 
     def probe_admit(self, prompt_ids: Sequence[int], headroom_tokens: int = 0,
                     hashes: Optional[list[int]] = None,
+                    hash_seed: int = 0,
                     ) -> tuple[bool, list[int]]:
         """Admission check honoring prefix reuse: ``(fits, matched_pages)``.
 
@@ -287,7 +300,7 @@ class KVCacheManager:
         pages are returned so ``add_sequence(matched=...)`` needn't re-walk
         the chain (valid only until the next alloc/release).
         """
-        matched = self._match_pages(prompt_ids, hashes)
+        matched = self._match_pages(prompt_ids, hashes, hash_seed)
         cached = len(matched) * self.page_size
         reserved = sum(1 for p in matched if self.allocator.is_retired(p))
         need = self.add_pages_needed(len(prompt_ids), cached, headroom_tokens)
@@ -295,15 +308,19 @@ class KVCacheManager:
 
     def add_sequence(self, seq_id: str, prompt_ids: Optional[Sequence[int]] = None,
                      hashes: Optional[list[int]] = None,
-                     matched: Optional[list[int]] = None) -> int:
+                     matched: Optional[list[int]] = None,
+                     hash_seed: int = 0) -> int:
         """Register a sequence, reusing cached prefix pages. Returns the
         number of prompt tokens whose KV is already resident. ``matched``
         short-circuits the chain walk with pages a just-run ``probe_admit``
-        already verified."""
-        alloc = SequenceAllocation()
+        already verified. ``hash_seed`` (the LoRA adapter row) is REMEMBERED
+        on the allocation, so later publishes release into the same cache
+        namespace the pages were matched from."""
+        alloc = SequenceAllocation(hash_seed=hash_seed)
         cached = 0
         if prompt_ids:
-            pages = matched if matched is not None else self._match_pages(prompt_ids, hashes)
+            pages = (matched if matched is not None
+                     else self._match_pages(prompt_ids, hashes, hash_seed))
             for page in pages:
                 self.allocator.acquire(page)
                 alloc.pages.append(page)
@@ -325,7 +342,8 @@ class KVCacheManager:
             return
         max_blocks = min(len(token_ids) // self.page_size, len(alloc.pages))
         if hashes is None or len(hashes) < max_blocks:
-            hashes = hash_blocks(token_ids, self.page_size, max_blocks)
+            hashes = hash_blocks(token_ids, self.page_size, max_blocks,
+                                 seed=alloc.hash_seed)
         for b in range(alloc.registered_blocks, max_blocks):
             page = alloc.pages[b]
             self.allocator.register(page, hashes[b])

@@ -50,6 +50,10 @@ class EngineRequest:
     # within a class). Interactive agent turns outrank background eval
     # batches this way without separate engines.
     priority: int = 0
+    # LoRA adapter name (None = base model). Resolved to a stacked-adapter
+    # row index at submit; requests with different adapters batch together.
+    adapter: Optional[str] = None
+    adapter_idx: int = 0  # engine-resolved; 0 is the reserved zero adapter
     # Monotonic clock — compared against perf_counter() timestamps in the engine.
     arrival_time: float = field(default_factory=time.perf_counter)
 

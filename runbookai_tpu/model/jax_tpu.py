@@ -31,7 +31,8 @@ from runbookai_tpu.utils.tokens import load_tokenizer
 
 
 async def stream_text(engine, tokenizer, prompt_ids, sampling,
-                      state: Optional[dict] = None, priority: int = 0):
+                      state: Optional[dict] = None, priority: int = 0,
+                      adapter: Optional[str] = None):
     """Token stream -> text-piece stream, shared by every streaming surface
     (client ``chat_stream``, OpenAI SSE endpoint): incremental UTF-8 decode
     over per-token bytes (multi-byte chars split across tokens never yield
@@ -43,7 +44,8 @@ async def stream_text(engine, tokenizer, prompt_ids, sampling,
     stop_ids = {tokenizer.eot_id, tokenizer.eos_id}
     decoder = codecs.getincrementaldecoder("utf-8")("replace")
     async for tok in engine.generate_stream(prompt_ids, sampling,
-                                            priority=priority):
+                                            priority=priority,
+                                            adapter=adapter):
         if state is not None:
             state["n_tokens"] = state.get("n_tokens", 0) + 1
         if tok in stop_ids:
@@ -127,10 +129,20 @@ class JaxTpuClient(BaseLLMClient):
             attn_impl=("pallas"
                        if jax.default_backend() in ("tpu", "axon") else "xla"),
         )
+        lora_registry = None
+        if getattr(llm_cfg, "lora_adapters", None):
+            from runbookai_tpu.models.lora import LoraRegistry
+
+            lora_registry = LoraRegistry(
+                cfg, rank=llm_cfg.lora_rank,
+                targets=tuple(llm_cfg.lora_targets), dtype=dtype)
+            for name, path in llm_cfg.lora_adapters.items():
+                lora_registry.load_peft_dir(name, path)
         masker = JsonMaskProvider(tokenizer, schemas=orchestrator_schemas())
         core = EngineCore(
             cfg, params, tokenizer, ecfg,
             mask_fn=masker.mask, advance_fn=masker.advance, mesh=mesh,
+            lora_registry=lora_registry,
         )
         return cls(
             core, tokenizer,
@@ -144,7 +156,7 @@ class JaxTpuClient(BaseLLMClient):
     def for_testing(cls, model_name: str = "llama3-test",
                     temperature: float = 0.0, max_new_tokens: int = 32,
                     max_seq_len: int = 256, schema_limits=None,
-                    **engine_kw) -> "JaxTpuClient":
+                    lora_registry=None, **engine_kw) -> "JaxTpuClient":
         """Tiny random-init client on the byte tokenizer (CPU tests)."""
         tokenizer = load_tokenizer(None)
         cfg, params = load_or_init(model_name, None, dtype=jnp.float32)
@@ -155,7 +167,8 @@ class JaxTpuClient(BaseLLMClient):
         masker = JsonMaskProvider(tokenizer, schemas=orchestrator_schemas(),
                                   limits=schema_limits)
         core = EngineCore(cfg, params, tokenizer, ecfg,
-                          mask_fn=masker.mask, advance_fn=masker.advance)
+                          mask_fn=masker.mask, advance_fn=masker.advance,
+                          lora_registry=lora_registry)
         return cls(core, tokenizer, temperature=temperature,
                    max_new_tokens=max_new_tokens,
                    chat_format=format_for_model(model_name, cfg.family))

@@ -326,6 +326,7 @@ def forward_impl(
     block_pages: int = 32,
     attn_impl: str = "xla",
     mesh=None,
+    adapter_ids: Optional[jnp.ndarray] = None,  # [B] int32 LoRA rows
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward chunk. Returns (logits [B, T, vocab] f32, kv_k', kv_v').
 
@@ -340,11 +341,21 @@ def forward_impl(
     b, t = tokens.shape
     hd, n_kv = cfg.head_dim, cfg.n_kv_heads
     h = params["embed"][tokens]  # [B, T, D]
+    lora = params.get("lora")  # {leaf: {"A": [L,N,in,r], "B": [L,N,r,out]}}
+    if lora is not None and adapter_ids is None:
+        adapter_ids = jnp.zeros((b,), jnp.int32)  # zero adapter = base
+
+    if lora is not None:
+        from runbookai_tpu.models.lora import apply_lora  # deferred: cycle
 
     def layer_step(hidden, layer_in):
-        lp, k_pages, v_pages = layer_in
+        lp, lp_lora, k_pages, v_pages = layer_in
         x = rms_norm(hidden, lp["attn_norm"], cfg.norm_eps)
         q, k, v = qmm(x, lp["wq"]), qmm(x, lp["wk"]), qmm(x, lp["wv"])
+        if lp_lora is not None:
+            q = q + apply_lora(x, lp_lora, "wq", adapter_ids)
+            k = k + apply_lora(x, lp_lora, "wk", adapter_ids)
+            v = v + apply_lora(x, lp_lora, "wv", adapter_ids)
         if cfg.qkv_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(b, t, cfg.n_heads, hd)
@@ -411,14 +422,18 @@ def forward_impl(
                 q, k_pages, v_pages, page_tables, ctx_lens, positions,
                 page_size=page_size, block_pages=block_pages,
             )
-        hidden = hidden + qmm(attn.reshape(b, t, cfg.n_heads * hd), lp["wo"])
+        ctx = attn.reshape(b, t, cfg.n_heads * hd)
+        o = qmm(ctx, lp["wo"])
+        if lp_lora is not None:
+            o = o + apply_lora(ctx, lp_lora, "wo", adapter_ids)
+        hidden = hidden + o
 
         y = rms_norm(hidden, lp["mlp_norm"], cfg.norm_eps)
         hidden = hidden + ffn_block(y, lp, cfg)
         return hidden, (k_pages, v_pages)
 
     h, (kv_k_new, kv_v_new) = jax.lax.scan(
-        layer_step, h, (params["layers"], kv_k, kv_v)
+        layer_step, h, (params["layers"], lora, kv_k, kv_v)
     )
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]

@@ -1,0 +1,207 @@
+"""Multi-LoRA serving: per-request low-rank adapters batched in one engine.
+
+TPU-first shape discipline: ALL registered adapters live in one stacked
+pytree under ``params["lora"]`` —
+
+    {"wq": {"A": [L, N, D, r], "B": [L, N, r, out]}, "wv": {...}, ...}
+
+— so the scan-stacked forward carries them like any other layer leaf, and a
+single compiled program serves every adapter mix: each decode/prefill
+dispatch passes ``adapter_ids [batch]`` and the layer body gathers that
+row's A/B before two small einsums (rank r ≈ 8–64, negligible FLOPs next
+to the base matmul). Adapter index 0 is RESERVED as the zero adapter (A=0,
+B=0): requests without an adapter select it and get exactly the base
+model, so the no-LoRA fast path needs no branch.
+
+``alpha/r`` scaling is baked into B at registration time. Adapters load
+from HF PEFT directories (``adapter_config.json`` +
+``adapter_model.safetensors``). No reference counterpart (hosted APIs);
+parity target is the multi-LoRA feature of vLLM-class serving frameworks.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from runbookai_tpu.models.llama import LlamaConfig
+
+# Projections LoRA can target, with their output widths.
+_TARGET_OUT = {
+    "wq": lambda cfg: cfg.n_heads * cfg.head_dim,
+    "wk": lambda cfg: cfg.n_kv_heads * cfg.head_dim,
+    "wv": lambda cfg: cfg.n_kv_heads * cfg.head_dim,
+    "wo": lambda cfg: cfg.dim,
+}
+# HF PEFT module names -> our leaves.
+_PEFT_NAMES = {"q_proj": "wq", "k_proj": "wk", "v_proj": "wv", "o_proj": "wo"}
+
+
+def _target_in_dim(cfg: LlamaConfig, leaf: str) -> int:
+    return cfg.n_heads * cfg.head_dim if leaf == "wo" else cfg.dim
+
+
+class LoraRegistry:
+    """Name -> adapter index; owns the stacked adapter pytree.
+
+    Registration re-stacks the (host-side) arrays — it happens once per
+    adapter at startup, while the hot path only ever gathers rows.
+    """
+
+    def __init__(self, cfg: LlamaConfig, rank: int = 8,
+                 targets: tuple[str, ...] = ("wq", "wv"),
+                 dtype=jnp.bfloat16):
+        if not targets:
+            raise ValueError("LoRA targets must be non-empty (empty targets "
+                             "would silently alias every adapter to the "
+                             "reserved base row)")
+        for t in targets:
+            if t not in _TARGET_OUT:
+                raise ValueError(f"unsupported LoRA target {t!r}")
+        self.cfg = cfg
+        self.rank = rank
+        self.targets = tuple(targets)
+        self.dtype = dtype
+        self._names: dict[str, int] = {}
+        L = cfg.n_layers
+        # index 0 = the zero adapter (base model).
+        self._host: dict[str, dict[str, list[np.ndarray]]] = {
+            t: {"A": [np.zeros((L, _target_in_dim(cfg, t), rank), np.float32)],
+                "B": [np.zeros((L, rank, _TARGET_OUT[t](cfg)), np.float32)]}
+            for t in targets
+        }
+        self._stacked: Optional[dict[str, dict[str, jnp.ndarray]]] = None
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def n_adapters(self) -> int:
+        """Including the reserved zero adapter at index 0."""
+        return len(next(iter(self._host.values()))["A"]) if self._host else 1
+
+    def index_of(self, name: Optional[str]) -> int:
+        if name is None:
+            return 0
+        if name not in self._names:
+            raise KeyError(
+                f"unknown LoRA adapter {name!r}; loaded: {sorted(self._names)}")
+        return self._names[name]
+
+    @property
+    def names(self) -> list[str]:
+        return sorted(self._names)
+
+    # -------------------------------------------------------- registration
+
+    def register(self, name: str, weights: dict[str, dict[str, np.ndarray]],
+                 alpha: Optional[float] = None) -> int:
+        """Add an adapter. ``weights[leaf] = {"A": [L, in, r], "B": [L, r, out]}``
+        (missing targets act as zero). ``alpha/r`` scaling folds into B."""
+        if name in self._names:
+            raise ValueError(f"adapter {name!r} already registered")
+        scale = (alpha / self.rank) if alpha is not None else 1.0
+        L = self.cfg.n_layers
+        for t in self.targets:
+            if t in weights:
+                a = np.asarray(weights[t]["A"], np.float32)
+                b = np.asarray(weights[t]["B"], np.float32) * scale
+                want_a = (L, _target_in_dim(self.cfg, t), self.rank)
+                want_b = (L, self.rank, _TARGET_OUT[t](self.cfg))
+                if a.shape != want_a or b.shape != want_b:
+                    raise ValueError(
+                        f"{name}/{t}: A{a.shape}/B{b.shape} != "
+                        f"{want_a}/{want_b}")
+            else:
+                a = np.zeros((L, _target_in_dim(self.cfg, t), self.rank),
+                             np.float32)
+                b = np.zeros((L, self.rank, _TARGET_OUT[t](self.cfg)),
+                             np.float32)
+            self._host[t]["A"].append(a)
+            self._host[t]["B"].append(b)
+        idx = self.n_adapters - 1
+        self._names[name] = idx
+        self._stacked = None  # re-stack lazily
+        return idx
+
+    def load_peft_dir(self, name: str, adapter_dir: str | Path) -> int:
+        """Register an HF PEFT adapter directory (safetensors)."""
+        from safetensors import safe_open
+
+        adapter_dir = Path(adapter_dir)
+        acfg = json.loads((adapter_dir / "adapter_config.json").read_text())
+        if int(acfg.get("r", self.rank)) != self.rank:
+            raise ValueError(
+                f"adapter rank {acfg.get('r')} != registry rank {self.rank}")
+        # Serving an adapter with some of its deltas dropped would silently
+        # degrade outputs — refuse modules the registry doesn't cover.
+        declared = set(acfg.get("target_modules") or [])
+        covered = {p for p, leaf in _PEFT_NAMES.items()
+                   if leaf in self.targets}
+        uncovered = declared - covered
+        if uncovered:
+            raise ValueError(
+                f"adapter targets {sorted(uncovered)} not covered by "
+                f"registry targets {self.targets} — refusing a partial "
+                f"adapter")
+        alpha = float(acfg.get("lora_alpha", self.rank))
+        f = safe_open(str(adapter_dir / "adapter_model.safetensors"),
+                      framework="numpy")
+        keys = list(f.keys())
+        weights: dict[str, dict[str, list]] = {}
+        L = self.cfg.n_layers
+        for peft_name, leaf in _PEFT_NAMES.items():
+            if leaf not in self.targets:
+                continue
+            a_layers, b_layers = [], []
+            for i in range(L):
+                a_key = next((k for k in keys
+                              if f"layers.{i}.self_attn.{peft_name}.lora_A" in k),
+                             None)
+                if a_key is None:
+                    break
+                b_key = next(k for k in keys
+                             if f"layers.{i}.self_attn.{peft_name}.lora_B" in k)
+                # PEFT stores [r, in] and [out, r]; ours are [in, r]/[r, out].
+                a_layers.append(f.get_tensor(a_key).T)
+                b_layers.append(f.get_tensor(b_key).T)
+            if a_layers:
+                if len(a_layers) != L:
+                    raise ValueError(
+                        f"{name}/{leaf}: adapter covers {len(a_layers)} of "
+                        f"{L} layers")
+                weights[leaf] = {"A": np.stack(a_layers),
+                                 "B": np.stack(b_layers)}
+        return self.register(name, weights, alpha=alpha)
+
+    # ------------------------------------------------------------ the tree
+
+    def stacked(self) -> dict[str, dict[str, jnp.ndarray]]:
+        """Device pytree ``{leaf: {"A": [L, N, in, r], "B": [L, N, r, out]}}``
+        (layer axis LEADING so it scans with the other layer leaves)."""
+        if self._stacked is None:
+            self._stacked = {
+                t: {"A": jnp.asarray(np.stack(self._host[t]["A"], axis=1),
+                                     self.dtype),
+                    "B": jnp.asarray(np.stack(self._host[t]["B"], axis=1),
+                                     self.dtype)}
+                for t in self.targets
+            }
+        return self._stacked
+
+
+def apply_lora(x: jnp.ndarray, lp_lora: dict, leaf: str,
+               adapter_ids: jnp.ndarray) -> jnp.ndarray:
+    """Per-row adapter contribution for one layer: ``x [B, T, in]`` ->
+    ``[B, T, out]``. ``lp_lora[leaf] = {"A": [N, in, r], "B": [N, r, out]}``
+    (the layer axis was consumed by the scan); rows gather their adapter."""
+    if lp_lora is None or leaf not in lp_lora:
+        return 0.0
+    a = lp_lora[leaf]["A"][adapter_ids]  # [B, in, r]
+    b = lp_lora[leaf]["B"][adapter_ids]  # [B, r, out]
+    low = jnp.einsum("bti,bir->btr", x, a.astype(x.dtype))
+    return jnp.einsum("btr,bro->bto", low, b.astype(x.dtype))

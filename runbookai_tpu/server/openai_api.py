@@ -170,9 +170,15 @@ def make_handler(bridge: _EngineBridge, model_name: str,
 
         def do_GET(self) -> None:  # noqa: N802 — http.server API
             if self.path == "/v1/models":
-                self._json(200, {"object": "list", "data": [{
-                    "id": model_name, "object": "model",
-                    "owned_by": "runbookai-tpu"}]})
+                models = [{"id": model_name, "object": "model",
+                           "owned_by": "runbookai-tpu"}]
+                if client.core.lora is not None:
+                    # vLLM-style: LoRA adapters are served as model names.
+                    models += [{"id": n, "object": "model",
+                                "owned_by": "runbookai-tpu",
+                                "parent": model_name}
+                               for n in client.core.lora.names]
+                self._json(200, {"object": "list", "data": models})
             elif self.path == "/healthz":
                 m = dict(client.core.metrics)
                 self._json(200, {"status": "ok", "model": model_name,
@@ -191,6 +197,21 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 if not messages:
                     raise ValueError("messages is required")
                 system, history, user = messages_to_prompt_parts(messages)
+                # vLLM-style multi-LoRA: a request whose model equals a
+                # registered adapter name routes through that adapter.
+                requested = body.get("model")
+                adapter = None
+                if requested and requested != model_name:
+                    names = (client.core.lora.names
+                             if client.core.lora is not None else [])
+                    if requested in names:
+                        adapter = requested
+                    else:
+                        # vLLM semantics: unknown model names are errors,
+                        # not silent base-model serving.
+                        self._error(404, f"model {requested!r} not found; "
+                                         f"served: {[model_name] + names}")
+                        return
                 # Client-supplied values: coercion failures are 400s too.
                 sampling = SamplingParams(
                     temperature=float(body.get("temperature",
@@ -214,14 +235,15 @@ def make_handler(bridge: _EngineBridge, model_name: str,
 
             try:
                 if body.get("stream"):
-                    self._stream_response(ids, sampling)
+                    self._stream_response(ids, sampling, adapter)
                 else:
                     # The engine-side timeout ABORTS a stalled request
                     # (frees slot + KV pages) before raising; the bridge
                     # timeout is just a belt over a wedged loop thread.
                     out = bridge.run(
                         client.engine.generate(ids, sampling,
-                                               timeout_s=request_timeout),
+                                               timeout_s=request_timeout,
+                                               adapter=adapter),
                         timeout=request_timeout + 30)
                     if out.finish_reason.value == "aborted":
                         # Admission fail-fast (prompt can never fit) or
@@ -241,7 +263,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             except BrokenPipeError:
                 pass  # client went away; engine abort handled in stream path
 
-        def _stream_response(self, ids, sampling) -> None:
+        def _stream_response(self, ids, sampling, adapter=None) -> None:
             from runbookai_tpu.model.jax_tpu import stream_text
 
             self.send_response(200)
@@ -269,7 +291,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             # Shared with JaxTpuClient.chat_stream: one copy of the
             # incremental-UTF-8 / stop-token handling for all surfaces.
             agen = stream_text(client.engine, client.tokenizer, ids,
-                               sampling, state=state)
+                               sampling, state=state, adapter=adapter)
             try:
                 for piece in bridge.stream(agen, timeout=request_timeout):
                     send_chunk(_chunk_payload(

@@ -69,6 +69,12 @@ class LLMConfig(BaseModel):
     temperature: float = 0.0
     top_p: float = 1.0
     top_k: int = 0  # 0 = disabled; composes with top_p
+    # Multi-LoRA serving: adapter name -> HF PEFT directory. Adapters load
+    # at startup into one stacked tree; requests (or OpenAI calls whose
+    # "model" equals an adapter name) select per-row adapters.
+    lora_adapters: dict[str, str] = Field(default_factory=dict)
+    lora_rank: int = 8
+    lora_targets: tuple[str, ...] = ("wq", "wv")
     # Paged KV cache (engine):
     page_size: int = 16  # tokens per KV page
     num_pages: int = 2048  # page pool size (static for XLA)
