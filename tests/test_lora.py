@@ -222,3 +222,36 @@ def test_prefix_cache_is_adapter_namespaced(setup):
     tuned_again = _greedy(core, prompt, adapter="adapter0")
     assert tuned_again == tuned
     assert core.metrics["cached_prefix_tokens"] > 0  # reuse did happen
+
+
+def test_lora_under_tp_sharded_serving(setup):
+    """LoRA composes with TP-sharded serving: the adapter arrays replicate
+    (XLA default for unannotated operands) and greedy outputs match the
+    unsharded engine."""
+    from runbookai_tpu.parallel.mesh import build_mesh
+    from runbookai_tpu.parallel.sharding import param_shardings
+
+    tok, params = setup
+    reg = _registry(1)
+    prompt = tok.encode("hello world")
+
+    def serve(p, mesh):
+        core = _make_core(tok, p, reg, slots=2)
+        if mesh is not None:
+            core = EngineCore(CFG, p, tok, EngineConfig(
+                page_size=4, num_pages=128, max_batch_slots=2,
+                prefill_chunk=16, max_seq_len=128, kv_dtype=jnp.float32,
+                block_pages=8, speculative=False),
+                mesh=mesh, lora_registry=reg)
+        req = EngineRequest(prompt_ids=list(prompt),
+                            sampling=SamplingParams(max_new_tokens=6,
+                                                    stop_token_ids=()),
+                            adapter="adapter0")
+        core.submit(req)
+        core.run_until_idle()
+        return req.out_ids
+
+    ref = serve(params, None)
+    mesh = build_mesh(data=1, model=2)
+    sharded = jax.tree.map(jax.device_put, params, param_shardings(CFG, mesh))
+    assert serve(sharded, mesh) == ref
