@@ -462,13 +462,23 @@ def dense_causal_attention(cfg: LlamaConfig, b: int, t: int):
     return attn_fn
 
 
-def transformer_layer(hidden, lp, cfg: LlamaConfig, positions, attn_fn):
+def transformer_layer(hidden, lp, cfg: LlamaConfig, positions, attn_fn,
+                      lora_lp=None, adapter_ids=None):
     """One pre-norm attention + SwiGLU block — shared by every forward path
-    (dense training, sequence-parallel ring, pipeline stages)."""
+    (dense training, sequence-parallel ring, pipeline stages). ``lora_lp``
+    (one layer's stacked adapters) + ``adapter_ids`` apply per-row LoRA,
+    exactly as the serving forward does — the fine-tuning path trains the
+    same tree serving gathers from."""
     b, t = hidden.shape[:2]
     hd, n_kv, n_q = cfg.head_dim, cfg.n_kv_heads, cfg.n_heads
+    if lora_lp is not None:
+        from runbookai_tpu.models.lora import apply_lora
     x = rms_norm(hidden, lp["attn_norm"], cfg.norm_eps)
     q, k, v = qmm(x, lp["wq"]), qmm(x, lp["wk"]), qmm(x, lp["wv"])
+    if lora_lp is not None:
+        q = q + apply_lora(x, lora_lp, "wq", adapter_ids)
+        k = k + apply_lora(x, lora_lp, "wk", adapter_ids)
+        v = v + apply_lora(x, lora_lp, "wv", adapter_ids)
     if cfg.qkv_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = apply_rope(q.reshape(b, t, n_q, hd), positions, cfg.rope_theta,
@@ -477,7 +487,10 @@ def transformer_layer(hidden, lp, cfg: LlamaConfig, positions, attn_fn):
                    cfg.rope_scaling)
     v = v.reshape(b, t, n_kv, hd)
     ctx = attn_fn(q, k, v).reshape(b, t, n_q * hd)
-    hidden = hidden + qmm(ctx, lp["wo"])
+    o = qmm(ctx, lp["wo"])
+    if lora_lp is not None:
+        o = o + apply_lora(ctx, lora_lp, "wo", adapter_ids)
+    hidden = hidden + o
     y = rms_norm(hidden, lp["mlp_norm"], cfg.norm_eps)
     return hidden + ffn_block(y, lp, cfg)
 
@@ -495,6 +508,7 @@ def forward_train(
     tokens: jnp.ndarray,
     positions: Optional[jnp.ndarray] = None,  # [B, T] absolute positions
     attn_fn=None,  # (q [B,T,n_q,hd], k [B,T,n_kv,hd], v) -> [B,T,n_q,hd]
+    adapter_ids: Optional[jnp.ndarray] = None,  # [B] LoRA rows
 ) -> jnp.ndarray:
     """Training-mode forward: dense causal attention over [B, T], no KV cache.
 
@@ -513,9 +527,15 @@ def forward_train(
         attn_fn = dense_causal_attention(cfg, b, t)
 
     h = params["embed"][tokens]
+    lora = params.get("lora")
+    if lora is not None and adapter_ids is None:
+        adapter_ids = jnp.zeros((b,), jnp.int32)
 
-    def layer_step(hidden, lp):
-        return transformer_layer(hidden, lp, cfg, positions, attn_fn), None
+    def layer_step(hidden, layer_in):
+        lp, lp_lora = layer_in
+        return transformer_layer(hidden, lp, cfg, positions, attn_fn,
+                                 lora_lp=lp_lora,
+                                 adapter_ids=adapter_ids), None
 
-    h, _ = jax.lax.scan(layer_step, h, params["layers"])
+    h, _ = jax.lax.scan(layer_step, h, (params["layers"], lora))
     return lm_head_logits(params, cfg, h)

@@ -128,6 +128,21 @@ class LoraRegistry:
         self._stacked = None  # re-stack lazily
         return idx
 
+    def update_adapter(self, name: str,
+                       weights: dict[str, dict[str, np.ndarray]]) -> None:
+        """Replace ONE adapter's weights in place (float32 host invariant)
+        — the write-back path for a fine-tuned adapter. Other rows are
+        untouched, so concurrent trainers/registrations can't clobber each
+        other through a stale full-tree snapshot."""
+        idx = self.index_of(name)
+        for t in self.targets:
+            if t in weights:
+                self._host[t]["A"][idx] = np.asarray(weights[t]["A"],
+                                                     np.float32)
+                self._host[t]["B"][idx] = np.asarray(weights[t]["B"],
+                                                     np.float32)
+        self._stacked = None
+
     def load_peft_dir(self, name: str, adapter_dir: str | Path) -> int:
         """Register an HF PEFT adapter directory (safetensors)."""
         from safetensors import safe_open

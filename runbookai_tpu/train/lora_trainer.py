@@ -1,0 +1,152 @@
+"""LoRA fine-tuning: train low-rank adapters with the base model frozen.
+
+The trainable tree IS the serving tree: adapters live in the same stacked
+``{leaf: {"A": [L, N, in, r], "B": [L, N, r, out]}}`` layout that
+:mod:`runbookai_tpu.models.lora` serves from, so a tuned adapter drops
+straight into a :class:`LoraRegistry` (or exports to HF PEFT format) with
+no conversion. Gradients flow ONLY into the selected adapter row — the
+base params are a closed-over constant of the compiled step, never updated
+and never carrying optimizer state (the memory point of LoRA: Adam moments
+for rank-r factors instead of the full model).
+
+Memory note for big models: the base forward runs exactly as serving does
+(bf16/int8 weights usable as-is), activations rematerialize under
+``jax.checkpoint``, and the optimizer state is ~2 × rank-r bytes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from runbookai_tpu.models.llama import LlamaConfig, forward_train
+from runbookai_tpu.models.lora import LoraRegistry
+from runbookai_tpu.train.trainer import masked_cross_entropy
+
+
+class LoraTrainer:
+    """Compiled LoRA fine-tuning step over a frozen base model.
+
+    ``adapter_name`` selects which registry row trains; the rest of the
+    stacked tree (including the reserved zero row) receives zero gradients
+    through the gather and is bit-unchanged by Adam (zero grads -> zero
+    moments -> zero updates).
+    """
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        base_params: Any,
+        registry: LoraRegistry,
+        adapter_name: str,
+        learning_rate: float = 1e-4,
+        pad_id: int = 0,
+        remat: bool = True,
+    ):
+        self.cfg = cfg
+        self.registry = registry
+        self.adapter_name = adapter_name
+        self.adapter_idx = registry.index_of(adapter_name)
+        # Float32 MASTER copy (fresh buffers): the registry may hold bf16
+        # for serving, where ~1e-4 Adam updates round to zero ulp and
+        # training silently stalls; and the compiled step DONATES the tree
+        # each update, so training on the registry's cached stacked()
+        # arrays would delete buffers live serving engines still hold.
+        self.lora_tree = jax.tree.map(
+            # jnp.array COPIES (asarray would alias same-dtype buffers and
+            # the donation would delete the registry's cache).
+            lambda x: jnp.array(x, jnp.float32), registry.stacked())
+        # A freshly registered adapter is all-zero — a saddle point (with
+        # A=0 AND B=0 every LoRA gradient vanishes). Standard LoRA init:
+        # A ~ N(0, 1/in), B = 0 — output starts at exactly zero (base
+        # behavior) but dB is nonzero from step one.
+        key = jax.random.PRNGKey(0)
+        for t, leaves in self.lora_tree.items():
+            a_row = leaves["A"][:, self.adapter_idx]
+            b_row = leaves["B"][:, self.adapter_idx]
+            if not (jnp.any(a_row) or jnp.any(b_row)):
+                key, sub = jax.random.split(key)
+                init = (jax.random.normal(sub, a_row.shape, jnp.float32)
+                        / jnp.sqrt(jnp.float32(a_row.shape[1])))
+                leaves["A"] = leaves["A"].at[:, self.adapter_idx].set(init)
+        self.tx = optax.adam(learning_rate)
+        self.opt_state = self.tx.init(self.lora_tree)
+        base = {k: v for k, v in base_params.items() if k != "lora"}
+
+        def loss_fn(lora_tree, tokens, adapter_ids):
+            p = dict(base)
+            p["lora"] = lora_tree
+            logits = forward_train(p, cfg, tokens[:, :-1],
+                                   adapter_ids=adapter_ids)
+            return masked_cross_entropy(logits, tokens[:, 1:], pad_id)
+
+        if remat:
+            loss_fn = jax.checkpoint(loss_fn)
+
+        def step_fn(lora_tree, opt_state, tokens, adapter_ids):
+            loss, grads = jax.value_and_grad(loss_fn)(lora_tree, tokens,
+                                                      adapter_ids)
+            updates, opt_state = self.tx.update(grads, opt_state)
+            lora_tree = optax.apply_updates(lora_tree, updates)
+            return lora_tree, opt_state, loss
+
+        self._step = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def train_step(self, tokens) -> float:
+        tokens = jnp.asarray(np.asarray(tokens), jnp.int32)
+        adapter_ids = jnp.full((tokens.shape[0],), self.adapter_idx,
+                               jnp.int32)
+        self.lora_tree, self.opt_state, loss = self._step(
+            self.lora_tree, self.opt_state, tokens, adapter_ids)
+        return float(loss)
+
+    def publish(self) -> None:
+        """Push ONLY the trained adapter's row back into the registry so
+        live engines can ``refresh_lora()`` and serve it — other rows (and
+        adapters registered after this trainer was built) are untouched."""
+        self.registry.update_adapter(self.adapter_name, {
+            t: {"A": np.asarray(self.lora_tree[t]["A"][:, self.adapter_idx],
+                                np.float32),
+                "B": np.asarray(self.lora_tree[t]["B"][:, self.adapter_idx],
+                                np.float32)}
+            for t in self.registry.targets})
+
+    def export_peft(self, out_dir, alpha: Optional[float] = None) -> None:
+        """Write the trained adapter as an HF PEFT directory.
+
+        The registry folds ``alpha/r`` into B at load; export divides it
+        back out (default alpha = r, i.e. scale 1.0)."""
+        import json
+        from pathlib import Path
+
+        from safetensors.numpy import save_file
+
+        out = Path(out_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        alpha = float(alpha if alpha is not None else self.registry.rank)
+        inv_scale = self.registry.rank / alpha
+        host = jax.tree.map(np.asarray, self.lora_tree)
+        peft_of = {"wq": "q_proj", "wk": "k_proj", "wv": "v_proj",
+                   "wo": "o_proj"}
+        tensors = {}
+        for t in self.registry.targets:
+            a = host[t]["A"][:, self.adapter_idx]  # [L, in, r]
+            b = host[t]["B"][:, self.adapter_idx]  # [L, r, out]
+            for i in range(self.cfg.n_layers):
+                base = (f"base_model.model.model.layers.{i}."
+                        f"self_attn.{peft_of[t]}")
+                tensors[f"{base}.lora_A.weight"] = np.ascontiguousarray(
+                    a[i].T.astype(np.float32))  # [r, in]
+                tensors[f"{base}.lora_B.weight"] = np.ascontiguousarray(
+                    (b[i] * inv_scale).T.astype(np.float32))  # [out, r]
+        save_file(tensors, str(out / "adapter_model.safetensors"))
+        (out / "adapter_config.json").write_text(json.dumps({
+            "r": self.registry.rank, "lora_alpha": alpha,
+            "target_modules": sorted(peft_of[t]
+                                     for t in self.registry.targets),
+            "peft_type": "LORA",
+        }, indent=2))
