@@ -466,3 +466,41 @@ async def test_step_exception_fails_live_requests(setup):
     assert out2.finish_reason is not None
     assert out2.decode_tokens >= 1
     await eng.stop()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_scheduler_fuzz_invariants(setup, seed):
+    """Randomized submit/step/abort interleavings against a tiny page pool:
+    whatever the order, every request reaches a terminal state, slots free,
+    and the pool drains back to full (minus the reserved null page)."""
+    tok, params = setup
+    rng = np.random.default_rng(seed)
+    core = make_core(tok, params, num_pages=24, max_batch_slots=3,
+                     max_seq_len=64, admit_headroom_tokens=4)
+    live: list[EngineRequest] = []
+    for _ in range(40):
+        op = rng.choice(["submit", "step", "step", "abort"])
+        if op == "submit" and len(live) < 10:
+            n = int(rng.integers(1, 40))
+            req = EngineRequest(
+                prompt_ids=rng.integers(0, 250, size=n).tolist(),
+                sampling=SamplingParams(
+                    temperature=float(rng.choice([0.0, 0.8])),
+                    top_k=int(rng.choice([0, 4])),
+                    max_new_tokens=int(rng.integers(1, 12)),
+                    stop_token_ids=()),
+                priority=int(rng.integers(0, 3)))
+            core.submit(req)
+            live.append(req)
+        elif op == "abort" and live:
+            victim = live[int(rng.integers(0, len(live)))]
+            core.abort(victim.request_id)  # may be False if finished — fine
+        else:
+            core.step()
+    core.run_until_idle(max_steps=2000)
+    assert not core.has_work
+    for req in live:
+        assert req.finish_reason is not None, req.state
+    assert all(s is None for s in core._slots)
+    assert not core.kv.seqs
+    assert core.kv.allocator.free_pages == 24 - 1
