@@ -160,10 +160,11 @@ class JaxTpuClient(BaseLLMClient):
         """Tiny random-init client on the byte tokenizer (CPU tests)."""
         tokenizer = load_tokenizer(None)
         cfg, params = load_or_init(model_name, None, dtype=jnp.float32)
-        ecfg = EngineConfig(
-            page_size=4, num_pages=256, max_batch_slots=4, prefill_chunk=32,
-            max_seq_len=max_seq_len, kv_dtype=jnp.float32, **engine_kw,
-        )
+        ecfg_kw = dict(page_size=4, num_pages=256, max_batch_slots=4,
+                       prefill_chunk=32, max_seq_len=max_seq_len,
+                       kv_dtype=jnp.float32)
+        ecfg_kw.update(engine_kw)  # tests may override any default
+        ecfg = EngineConfig(**ecfg_kw)
         masker = JsonMaskProvider(tokenizer, schemas=orchestrator_schemas(),
                                   limits=schema_limits)
         core = EngineCore(cfg, params, tokenizer, ecfg,

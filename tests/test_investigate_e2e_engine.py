@@ -1,0 +1,60 @@
+"""Flagship e2e: `runbook investigate` fully on the in-tree engine.
+
+The whole structured investigation — triage, hypothesis cycles, conclusion
+— runs against the REAL tiny serving engine (random weights) with
+schema-guided decoding and simulated (fixture-backed) tools: the
+no-hosted-API, no-GPU flow BASELINE.md config 3 measures on hardware.
+Random weights can't produce *correct* content; what this pins is that
+every phase round-trips schema-valid JSON through the grammar-constrained
+decoder and the FSM reaches a terminal conclusion without any fallback to
+a hosted model.
+"""
+
+import pytest
+
+from runbookai_tpu.agent.orchestrator import (
+    InvestigationOrchestrator,
+    ToolExecutor,
+)
+from runbookai_tpu.agent.state_machine import InvestigationStateMachine
+from runbookai_tpu.model.jax_tpu import JaxTpuClient
+from runbookai_tpu.tools.registry import get_runtime_tools
+from runbookai_tpu.utils.config import Config
+
+
+@pytest.fixture(scope="module")
+def llm():
+    client = JaxTpuClient.for_testing(max_new_tokens=192, max_seq_len=4096,
+                                      num_pages=1024, prefill_chunk=64)
+    yield client
+
+
+async def test_structured_investigation_end_to_end_on_engine(llm):
+    config = Config()  # defaults: simulated fixture-backed providers
+    tools = {t.name: t for t in get_runtime_tools(config)}
+    machine = InvestigationStateMachine(incident_id="PD-424242",
+                                        max_hypotheses=2, max_depth=1,
+                                        max_iterations=2)
+    orch = InvestigationOrchestrator(llm, ToolExecutor(tools),
+                                     machine=machine)
+
+    triage = await orch.run_triage(
+        "PD-424242", "checkout latency p99 elevated after deploy")
+    # Guided decoding guarantees a schema-parseable triage even from
+    # random weights: fields exist with in-range types.
+    assert triage.severity is not None
+    assert isinstance(triage.affected_services, list)
+
+    for _ in range(3):
+        progressed = await orch.run_investigation_cycle()
+        if not progressed:
+            break
+
+    conclusion = await orch.run_conclusion("checkout latency p99 elevated")
+    assert conclusion is not None
+    assert isinstance(conclusion.root_cause, str)
+    assert machine.incident_id == "PD-424242"
+    # The engine actually served every phase (prefill+decode happened).
+    m = llm.core.metrics
+    assert m["prefill_tokens"] > 200
+    assert m["decode_tokens"] + m.get("grammar_forced_tokens", 0) > 20
