@@ -405,3 +405,24 @@ def test_hypothesis_confidence_fraction_scaling():
                            status="confirmed", confidence=0.85)]
     assert "85%" in render_tree(nodes, color=False)
     assert "(85%)" in render_summary(nodes, color=False)
+
+
+def test_cli_chat_raw_streams(tmp_path, monkeypatch, capsys):
+    """chat --raw streams through the LLMClient event protocol (mock
+    fallback here; true token streaming on the jax-tpu provider)."""
+    from runbookai_tpu.cli.main import main
+
+    monkeypatch.chdir(tmp_path)
+    inputs = iter(["hello there", ""])
+    monkeypatch.setattr("builtins.input", lambda *a: next(inputs))
+    assert main(["chat", "--raw"]) == 0
+    out = capsys.readouterr().out
+    assert "streaming model chat" in out
+
+
+def test_cli_serve_requires_engine_provider(tmp_path, monkeypatch, capsys):
+    from runbookai_tpu.cli.main import main
+
+    monkeypatch.chdir(tmp_path)
+    # Default config is the mock provider: serve must refuse, not crash.
+    assert main(["serve", "--port", "0"]) == 1
