@@ -278,6 +278,13 @@ class EngineCore:
                     f"request names adapter {req.adapter!r} but the engine "
                     f"has no LoRA registry")
             req.adapter_idx = self.lora.index_of(req.adapter)
+            # Hot-loaded adapter: the registry knows the name before the
+            # params tree has its row. An out-of-range gather would CLAMP
+            # inside jit and silently serve the wrong adapter — refresh
+            # here instead (submit runs under the same lock as step()).
+            rows = next(iter(self.params["lora"].values()))["A"].shape[1]
+            if req.adapter_idx >= rows:
+                self.refresh_lora()
         if req.guided_state is None and req.sampling.guided and self.mask_fn:
             pass  # guided_state initialized lazily by the mask provider
         req.state = RequestState.WAITING

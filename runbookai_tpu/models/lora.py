@@ -22,6 +22,7 @@ parity target is the multi-LoRA feature of vLLM-class serving frameworks.
 from __future__ import annotations
 
 import json
+import threading
 from pathlib import Path
 from typing import Any, Optional
 
@@ -68,6 +69,9 @@ class LoraRegistry:
         self.targets = tuple(targets)
         self.dtype = dtype
         self._names: dict[str, int] = {}
+        # Hot-loading mutates the registry from HTTP handler threads while
+        # the engine reads it — one lock covers every mutation + stack.
+        self._mutex = threading.Lock()
         L = cfg.n_layers
         # index 0 = the zero adapter (base model).
         self._host: dict[str, dict[str, list[np.ndarray]]] = {
@@ -102,6 +106,10 @@ class LoraRegistry:
                  alpha: Optional[float] = None) -> int:
         """Add an adapter. ``weights[leaf] = {"A": [L, in, r], "B": [L, r, out]}``
         (missing targets act as zero). ``alpha/r`` scaling folds into B."""
+        with self._mutex:
+            return self._register_locked(name, weights, alpha)
+
+    def _register_locked(self, name, weights, alpha) -> int:
         if name in self._names:
             raise ValueError(f"adapter {name!r} already registered")
         scale = (alpha / self.rank) if alpha is not None else 1.0
@@ -134,14 +142,15 @@ class LoraRegistry:
         — the write-back path for a fine-tuned adapter. Other rows are
         untouched, so concurrent trainers/registrations can't clobber each
         other through a stale full-tree snapshot."""
-        idx = self.index_of(name)
-        for t in self.targets:
-            if t in weights:
-                self._host[t]["A"][idx] = np.asarray(weights[t]["A"],
-                                                     np.float32)
-                self._host[t]["B"][idx] = np.asarray(weights[t]["B"],
-                                                     np.float32)
-        self._stacked = None
+        with self._mutex:
+            idx = self.index_of(name)
+            for t in self.targets:
+                if t in weights:
+                    self._host[t]["A"][idx] = np.asarray(weights[t]["A"],
+                                                         np.float32)
+                    self._host[t]["B"][idx] = np.asarray(weights[t]["B"],
+                                                         np.float32)
+            self._stacked = None
 
     def load_peft_dir(self, name: str, adapter_dir: str | Path) -> int:
         """Register an HF PEFT adapter directory (safetensors)."""
@@ -198,15 +207,16 @@ class LoraRegistry:
     def stacked(self) -> dict[str, dict[str, jnp.ndarray]]:
         """Device pytree ``{leaf: {"A": [L, N, in, r], "B": [L, N, r, out]}}``
         (layer axis LEADING so it scans with the other layer leaves)."""
-        if self._stacked is None:
-            self._stacked = {
-                t: {"A": jnp.asarray(np.stack(self._host[t]["A"], axis=1),
-                                     self.dtype),
-                    "B": jnp.asarray(np.stack(self._host[t]["B"], axis=1),
-                                     self.dtype)}
-                for t in self.targets
-            }
-        return self._stacked
+        with self._mutex:
+            if self._stacked is None:
+                self._stacked = {
+                    t: {"A": jnp.asarray(np.stack(self._host[t]["A"], axis=1),
+                                         self.dtype),
+                        "B": jnp.asarray(np.stack(self._host[t]["B"], axis=1),
+                                         self.dtype)}
+                    for t in self.targets
+                }
+            return self._stacked
 
 
 def apply_lora(x: jnp.ndarray, lp_lora: dict, leaf: str,

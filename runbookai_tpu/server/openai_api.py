@@ -168,6 +168,13 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             self._json(code, {"error": {"message": message,
                                         "type": "invalid_request_error"}})
 
+        def _read_json(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
+            return body
+
         def do_GET(self) -> None:  # noqa: N802 — http.server API
             if self.path == "/v1/models":
                 models = [{"id": model_name, "object": "model",
@@ -187,12 +194,14 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 self._error(404, f"no route {self.path}")
 
         def do_POST(self) -> None:  # noqa: N802
+            if self.path == "/v1/adapters":
+                self._load_adapter()
+                return
             if self.path != "/v1/chat/completions":
                 self._error(404, f"no route {self.path}")
                 return
             try:
-                length = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(length) or b"{}")
+                body = self._read_json()
                 messages = body.get("messages") or []
                 if not messages:
                     raise ValueError("messages is required")
@@ -262,6 +271,51 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 self._error(504, "generation timed out")
             except BrokenPipeError:
                 pass  # client went away; engine abort handled in stream path
+
+        def _load_adapter(self) -> None:
+            """Hot-load a LoRA adapter into the running engine:
+            ``POST /v1/adapters {"name": ..., "path": <PEFT dir>}``. The
+            registry re-stacks and the engine swaps its params tree under
+            the engine lock, so in-flight dispatches finish on the old
+            tree and the next dispatch serves the new adapter."""
+            if client.core.lora is None:
+                self._error(400, "engine has no LoRA registry (configure "
+                                 "llm.lora_rank/lora_targets)")
+                return
+            try:
+                body = self._read_json()
+                name, path = body["name"], body["path"]
+                if not isinstance(name, str) or not isinstance(path, str):
+                    raise ValueError("name and path must be strings")
+            except (ValueError, TypeError, KeyError,
+                    json.JSONDecodeError) as e:
+                self._error(400, f"expected {{name, path}}: {e}")
+                return
+            try:
+                client.core.lora.load_peft_dir(name, path)
+            except (OSError, TypeError, ValueError, KeyError) as e:
+                self._error(400, str(e))
+                return
+            # Pre-stack on THIS thread (registry caches it) so the locked
+            # section below only swaps the params dict — the engine loop
+            # and in-flight streams stall for microseconds, not a
+            # host-to-device restack. (Even without the refresh, submit()
+            # detects a stale row count and refreshes safely.)
+            client.core.lora.stacked()
+
+            async def _refresh():
+                with client.engine._lock:
+                    client.core.refresh_lora()
+
+            try:
+                bridge.run(_refresh(), timeout=60)
+            except (TimeoutError, _FutTimeout):
+                self._error(504, f"adapter {name!r} registered but the "
+                                 f"engine refresh timed out; it activates "
+                                 f"on the next request")
+                return
+            self._json(200, {"loaded": name,
+                             "adapters": client.core.lora.names})
 
         def _stream_response(self, ids, sampling, adapter=None) -> None:
             from runbookai_tpu.model.jax_tpu import stream_text

@@ -324,3 +324,83 @@ def test_lora_finetune_from_scratch_breaks_zero_saddle(setup):
     batch = rng.integers(1, CFG.vocab_size, size=(4, 24))
     losses = [trainer.train_step(batch) for _ in range(10)]
     assert losses[-1] < losses[0] - 1e-4, f"saddle: {losses[0]} -> {losses[-1]}"
+
+
+def test_hot_load_adapter_over_http(tmp_path, setup):
+    """POST /v1/adapters loads a PEFT dir into the RUNNING server; the new
+    adapter immediately serves by model name."""
+    import urllib.error
+    import urllib.request
+
+    from safetensors.numpy import save_file
+
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.server.openai_api import OpenAIServer
+
+    tok, params = setup
+    # PEFT dir on disk
+    rng = np.random.default_rng(9)
+    tensors = {}
+    for i in range(CFG.n_layers):
+        base = f"base_model.model.model.layers.{i}.self_attn.q_proj"
+        tensors[f"{base}.lora_A.weight"] = rng.normal(
+            size=(RANK, CFG.dim)).astype(np.float32)
+        tensors[f"{base}.lora_B.weight"] = rng.normal(
+            size=(CFG.n_heads * CFG.head_dim, RANK)).astype(np.float32)
+    save_file(tensors, str(tmp_path / "adapter_model.safetensors"))
+    (tmp_path / "adapter_config.json").write_text(json.dumps(
+        {"r": RANK, "lora_alpha": RANK, "target_modules": ["q_proj"]}))
+
+    reg = LoraRegistry(CFG, rank=RANK, targets=("wq", "wv"),
+                       dtype=jnp.float32)
+    client = JaxTpuClient.for_testing(max_new_tokens=8, lora_registry=reg)
+    srv = OpenAIServer(client, model_name="llama3-test", port=0)
+    srv.start_background()
+    try:
+        def post(path, payload):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}{path}",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return json.loads(r.read())
+
+        # Unknown adapter name 404s before the load...
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post("/v1/chat/completions",
+                 {"model": "hot", "messages": [{"role": "user",
+                                                "content": "x"}]})
+        assert e.value.code == 404
+
+        out = post("/v1/adapters", {"name": "hot", "path": str(tmp_path)})
+        assert out["adapters"] == ["hot"]
+
+        base_text = post("/v1/chat/completions", {
+            "max_tokens": 8,
+            "messages": [{"role": "user", "content": "hi"}]})
+        hot_text = post("/v1/chat/completions", {
+            "model": "hot", "max_tokens": 8,
+            "messages": [{"role": "user", "content": "hi"}]})
+        assert (base_text["choices"][0]["message"]["content"]
+                != hot_text["choices"][0]["message"]["content"])
+    finally:
+        srv.shutdown()
+
+
+def test_submit_refreshes_stale_lora_rows(setup):
+    """An adapter registered AFTER engine construction must serve correctly
+    on the very next submit (stale params['lora'] would clamp the gather
+    in-jit and silently serve the wrong adapter)."""
+    tok, params = setup
+    reg = _registry(1)
+    core = _make_core(tok, params, reg)
+    prompt = tok.encode("post-start adapter")
+    before = _greedy(core, prompt)  # engine built with 2 rows (zero + a0)
+
+    reg.register("late", _rand_adapter(777))  # rows now 3; engine stale
+    late = _greedy(core, prompt, adapter="late")
+    a0 = _greedy(core, prompt, adapter="adapter0")
+    assert late != before and late != a0
+    # And it matches a fresh engine that knew the adapter from the start.
+    fresh = _greedy(_make_core(tok, params, reg), prompt, adapter="late")
+    assert late == fresh
