@@ -449,7 +449,8 @@ def cmd_serve(args) -> int:
         return 1
     client = JaxTpuClient.from_config(config.llm)
     server = OpenAIServer(client, model_name=config.llm.model,
-                          host=args.host, port=args.port)
+                          host=args.host, port=args.port,
+                          allow_runtime_adapters=args.allow_adapter_loading)
     print(f"serving {config.llm.model} at http://{args.host}:{server.port}/v1 "
           f"(POST /v1/chat/completions, GET /v1/models, /healthz)")
     try:
@@ -726,6 +727,8 @@ def build_parser() -> argparse.ArgumentParser:
         "serve", help="OpenAI-compatible HTTP endpoint over the engine")
     serve.add_argument("--host", default="127.0.0.1")
     serve.add_argument("--port", type=int, default=8000)
+    serve.add_argument("--allow-adapter-loading", action="store_true",
+                       help="enable POST /v1/adapters (operator action)")
     serve.set_defaults(fn=cmd_serve)
 
     bench = sub.add_parser("bench", help="serving benchmark (one JSON line)")

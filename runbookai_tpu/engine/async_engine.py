@@ -105,6 +105,17 @@ class AsyncEngine:
         with self._lock:
             self.core.step()
 
+    async def refresh_lora(self) -> None:
+        """Swap in the registry's latest stacked adapters between steps.
+        The lock wait happens in a worker thread so the event loop (and
+        every in-flight stream) stays live while a step finishes."""
+
+        def _locked_refresh() -> None:
+            with self._lock:
+                self.core.refresh_lora()
+
+        await asyncio.to_thread(_locked_refresh)
+
     async def generate(
         self,
         prompt_ids: list[int],

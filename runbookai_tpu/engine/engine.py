@@ -935,10 +935,19 @@ class EngineCore:
         ids = req.all_out_ids  # includes tokens folded by preemption
         stop_ids = set(req.sampling.stop_token_ids) | {self.tokenizer.eos_id, self.tokenizer.eot_id}
         text_ids = ids[:-1] if ids and ids[-1] in stop_ids else ids
+        text = self.tokenizer.decode(text_ids)
+        if req.finish_reason == FinishReason.STOP_STRING:
+            # OpenAI semantics: the matched stop sequence is NOT part of
+            # the returned content (clients split on it).
+            cut = min((i for i in (text.find(s)
+                                   for s in req.sampling.stop_strings)
+                       if i >= 0), default=-1)
+            if cut >= 0:
+                text = text[:cut]
         return EngineOutput(
             request_id=req.request_id,
             token_ids=list(ids),
-            text=self.tokenizer.decode(text_ids),
+            text=text,
             finish_reason=req.finish_reason or FinishReason.ABORTED,
             ttft_ms=req.ttft_ms,
             decode_tokens=req.num_generated,

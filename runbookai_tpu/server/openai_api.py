@@ -145,7 +145,8 @@ def _chunk_payload(model: str, delta: dict, finish: Optional[str],
 
 
 def make_handler(bridge: _EngineBridge, model_name: str,
-                 request_timeout: float):
+                 request_timeout: float,
+                 allow_runtime_adapters: bool = False):
     from runbookai_tpu.engine.request import SamplingParams
 
     client = bridge.client
@@ -222,6 +223,16 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                                          f"served: {[model_name] + names}")
                         return
                 # Client-supplied values: coercion failures are 400s too.
+                stop = body.get("stop") or []
+                if isinstance(stop, str):
+                    stop = [stop]
+                if not all(isinstance(s, str) for s in stop):
+                    raise ValueError("stop must be a string or list of strings")
+                if len(stop) > 4:
+                    raise ValueError("at most 4 stop sequences")
+                n = int(body.get("n", 1))
+                if not 1 <= n <= 8:
+                    raise ValueError("n must be in [1, 8]")
                 sampling = SamplingParams(
                     temperature=float(body.get("temperature",
                                                client.temperature)),
@@ -231,6 +242,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                                        or client.max_new_tokens),
                     stop_token_ids=(client.tokenizer.eot_id,
                                     client.tokenizer.eos_id),
+                    stop_strings=tuple(stop),
                 )
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 self._error(400, str(e))
@@ -244,29 +256,61 @@ def make_handler(bridge: _EngineBridge, model_name: str,
 
             try:
                 if body.get("stream"):
+                    if n != 1:
+                        self._error(400, "stream with n > 1 is unsupported")
+                        return
                     self._stream_response(ids, sampling, adapter)
                 else:
                     # The engine-side timeout ABORTS a stalled request
                     # (frees slot + KV pages) before raising; the bridge
                     # timeout is just a belt over a wedged loop thread.
-                    out = bridge.run(
-                        client.engine.generate(ids, sampling,
-                                               timeout_s=request_timeout,
-                                               adapter=adapter),
-                        timeout=request_timeout + 30)
-                    if out.finish_reason.value == "aborted":
+                    # n > 1 choices submit concurrently: the engine batches
+                    # them in one decode dispatch and the shared prompt
+                    # prefix rides the page cache.
+                    async def _gen_n():
+                        # return_exceptions: every sibling runs to its own
+                        # terminal state (each generate aborts itself on
+                        # its engine-side timeout) — nothing keeps decoding
+                        # unobserved after an error response.
+                        return await asyncio.gather(*[
+                            client.engine.generate(
+                                ids, sampling, timeout_s=request_timeout,
+                                adapter=adapter)
+                            for _ in range(n)], return_exceptions=True)
+
+                    outs = bridge.run(_gen_n(), timeout=request_timeout + 60)
+                    if any(isinstance(o, BaseException) for o in outs):
+                        err = next(o for o in outs
+                                   if isinstance(o, BaseException))
+                        if isinstance(err, (TimeoutError, _FutTimeout)):
+                            self._error(504, "generation timed out")
+                        else:
+                            raise err
+                        return
+                    if any(o.finish_reason.value == "aborted" for o in outs):
                         # Admission fail-fast (prompt can never fit) or
                         # mid-decode abort: an error, not a completion.
                         self._error(503, "request aborted by the engine "
                                          "(insufficient KV capacity)")
                         return
-                    finish = ("length" if out.finish_reason.value
-                              == "max_tokens" else "stop")
-                    self._json(200, _completion_payload(
-                        model_name, out.text,
+
+                    def choice(i, o):
+                        return {"index": i,
+                                "message": {"role": "assistant",
+                                            "content": o.text},
+                                "finish_reason": ("length"
+                                                  if o.finish_reason.value
+                                                  == "max_tokens"
+                                                  else "stop")}
+
+                    payload = _completion_payload(
+                        model_name, "",
                         {"prompt_tokens": len(ids),
-                         "completion_tokens": out.decode_tokens},
-                        finish))
+                         "completion_tokens": sum(o.decode_tokens
+                                                  for o in outs)})
+                    payload["choices"] = [choice(i, o)
+                                          for i, o in enumerate(outs)]
+                    self._json(200, payload)
             except (TimeoutError, _FutTimeout):
                 self._error(504, "generation timed out")
             except BrokenPipeError:
@@ -278,6 +322,12 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             registry re-stacks and the engine swaps its params tree under
             the engine lock, so in-flight dispatches finish on the old
             tree and the next dispatch serves the new adapter."""
+            if not allow_runtime_adapters:
+                # Loading arbitrary server-side paths is an operator
+                # action; gate it (vLLM gates its equivalent the same way).
+                self._error(403, "runtime adapter loading is disabled; "
+                                 "start with --allow-adapter-loading")
+                return
             if client.core.lora is None:
                 self._error(400, "engine has no LoRA registry (configure "
                                  "llm.lora_rank/lora_targets)")
@@ -294,21 +344,21 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             try:
                 client.core.lora.load_peft_dir(name, path)
             except (OSError, TypeError, ValueError, KeyError) as e:
-                self._error(400, str(e))
+                # No raw OS error text: it would leak filesystem detail.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "adapter load %r failed: %s", name, e)
+                self._error(400, f"could not load adapter {name!r} "
+                                 f"({type(e).__name__})")
                 return
-            # Pre-stack on THIS thread (registry caches it) so the locked
-            # section below only swaps the params dict — the engine loop
-            # and in-flight streams stall for microseconds, not a
-            # host-to-device restack. (Even without the refresh, submit()
-            # detects a stale row count and refreshes safely.)
+            # Pre-stack on THIS thread (registry caches it); the engine
+            # refresh then runs in a worker thread (loop stays live) and
+            # only swaps the params dict. Even without it, submit()
+            # detects a stale row count and refreshes safely.
             client.core.lora.stacked()
-
-            async def _refresh():
-                with client.engine._lock:
-                    client.core.refresh_lora()
-
             try:
-                bridge.run(_refresh(), timeout=60)
+                bridge.run(client.engine.refresh_lora(), timeout=60)
             except (TimeoutError, _FutTimeout):
                 self._error(504, f"adapter {name!r} registered but the "
                                  f"engine refresh timed out; it activates "
@@ -381,11 +431,13 @@ class OpenAIServer:
     """Lifecycle wrapper: build, serve_forever (or background), shutdown."""
 
     def __init__(self, client, model_name: str, host: str = "127.0.0.1",
-                 port: int = 8000, request_timeout: float = 600.0):
+                 port: int = 8000, request_timeout: float = 600.0,
+                 allow_runtime_adapters: bool = False):
         self.bridge = _EngineBridge(client)
         self.httpd = ThreadingHTTPServer(
             (host, port), make_handler(self.bridge, model_name,
-                                       request_timeout))
+                                       request_timeout,
+                                       allow_runtime_adapters))
         self.model_name = model_name
 
     @property

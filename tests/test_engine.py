@@ -504,3 +504,29 @@ def test_scheduler_fuzz_invariants(setup, seed):
     assert all(s is None for s in core._slots)
     assert not core.kv.seqs
     assert core.kv.allocator.free_pages == 24 - 1
+
+
+def test_stop_string_trimmed_from_output(setup):
+    """OpenAI semantics: the matched stop sequence is not in the text."""
+    tok, params = setup
+    core = make_core(tok, params, num_pages=128)
+    # Greedy output of this prompt/model is deterministic; pick its first
+    # generated char as the stop string so the match happens immediately.
+    probe = EngineRequest(prompt_ids=tok.encode("hello"),
+                          sampling=SamplingParams(max_new_tokens=6,
+                                                  stop_token_ids=()))
+    core.submit(probe)
+    core.run_until_idle()
+    first_char = core.output_for(probe).text[:1]
+    assert first_char
+
+    core2 = make_core(tok, params, num_pages=128)
+    req = EngineRequest(prompt_ids=tok.encode("hello"),
+                        sampling=SamplingParams(max_new_tokens=6,
+                                                stop_token_ids=(),
+                                                stop_strings=(first_char,)))
+    core2.submit(req)
+    core2.run_until_idle()
+    out = core2.output_for(req)
+    assert req.finish_reason == FinishReason.STOP_STRING
+    assert first_char not in out.text  # trimmed, OpenAI-style

@@ -354,7 +354,8 @@ def test_hot_load_adapter_over_http(tmp_path, setup):
     reg = LoraRegistry(CFG, rank=RANK, targets=("wq", "wv"),
                        dtype=jnp.float32)
     client = JaxTpuClient.for_testing(max_new_tokens=8, lora_registry=reg)
-    srv = OpenAIServer(client, model_name="llama3-test", port=0)
+    srv = OpenAIServer(client, model_name="llama3-test", port=0,
+                       allow_runtime_adapters=True)
     srv.start_background()
     try:
         def post(path, payload):
@@ -374,6 +375,11 @@ def test_hot_load_adapter_over_http(tmp_path, setup):
 
         out = post("/v1/adapters", {"name": "hot", "path": str(tmp_path)})
         assert out["adapters"] == ["hot"]
+        # Bad path: generic 400, no filesystem detail echoed.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post("/v1/adapters", {"name": "x", "path": "/nonexistent"})
+        assert e.value.code == 400
+        assert "/nonexistent" not in e.value.read().decode()
 
         base_text = post("/v1/chat/completions", {
             "max_tokens": 8,
@@ -404,3 +410,26 @@ def test_submit_refreshes_stale_lora_rows(setup):
     # And it matches a fresh engine that knew the adapter from the start.
     fresh = _greedy(_make_core(tok, params, reg), prompt, adapter="late")
     assert late == fresh
+
+
+def test_adapter_loading_gated_by_default(setup):
+    import urllib.error
+    import urllib.request
+
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.server.openai_api import OpenAIServer
+
+    client = JaxTpuClient.for_testing(max_new_tokens=4,
+                                      lora_registry=_registry(0))
+    srv = OpenAIServer(client, model_name="llama3-test", port=0)
+    srv.start_background()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/adapters",
+            data=json.dumps({"name": "x", "path": "/tmp"}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 403
+    finally:
+        srv.shutdown()

@@ -149,3 +149,19 @@ async def test_generate_timeout_aborts_request():
     assert not core.has_work
     assert core.finished and core.finished[-1].finish_reason is not None
     await client.shutdown()
+
+
+def test_n_choices_and_stop_param(server):
+    with _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 6, "n": 3, "temperature": 0.9, "stop": ["\x00"],
+    }) as r:
+        body = json.loads(r.read())
+    assert len(body["choices"]) == 3
+    assert [c["index"] for c in body["choices"]] == [0, 1, 2]
+    assert body["usage"]["completion_tokens"] >= 3
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "x"}], "n": 99})
+    assert e.value.code == 400
