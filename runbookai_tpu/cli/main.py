@@ -448,11 +448,27 @@ def cmd_serve(args) -> int:
               file=sys.stderr)
         return 1
     client = JaxTpuClient.from_config(config.llm)
+    embedder = None
+    emb_cfg = config.knowledge.embedder
+    # Real weights only: with model_path unset, bge random-inits — serving
+    # noise labeled as bge embeddings would silently corrupt any vector
+    # index built against the endpoint. (Test configs use bge-test.)
+    if emb_cfg.enabled and (emb_cfg.model_path
+                            or "test" in emb_cfg.model):
+        from runbookai_tpu.knowledge.embedder import Embedder
+
+        embedder = Embedder.from_config(emb_cfg)
+    elif emb_cfg.enabled:
+        print("note: /v1/embeddings disabled — set knowledge.embedder."
+              "model_path to serve real bge embeddings", file=sys.stderr)
     server = OpenAIServer(client, model_name=config.llm.model,
                           host=args.host, port=args.port,
-                          allow_runtime_adapters=args.allow_adapter_loading)
+                          allow_runtime_adapters=args.allow_adapter_loading,
+                          embedder=embedder)
     print(f"serving {config.llm.model} at http://{args.host}:{server.port}/v1 "
-          f"(POST /v1/chat/completions, GET /v1/models, /healthz)")
+          f"(POST /v1/chat/completions"
+          + (", /v1/embeddings" if embedder else "")
+          + ", GET /v1/models, /healthz)")
     try:
         server.serve_forever()
     except KeyboardInterrupt:

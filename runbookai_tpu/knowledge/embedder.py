@@ -45,6 +45,14 @@ class Embedder:
         self._jnp = jnp
         self.stats = {"texts": 0, "tokens": 0, "cache_hits": 0, "batches": 0}
 
+    @classmethod
+    def from_config(cls, emb_cfg) -> "Embedder":
+        """Shared factory for the knowledge retriever and the serving
+        endpoint — one place maps EmbedderConfig fields to kwargs."""
+        return cls(model_name=emb_cfg.model, model_path=emb_cfg.model_path,
+                   max_length=emb_cfg.max_length,
+                   batch_size=emb_cfg.batch_size)
+
     @staticmethod
     def _key(text: str) -> str:
         return hashlib.md5(text.encode()).hexdigest()

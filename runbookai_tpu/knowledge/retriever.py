@@ -227,12 +227,7 @@ def create_retriever(config, embedder: Optional[Any] = None) -> KnowledgeRetriev
     if embedder is None and kcfg.embedder.enabled:
         from runbookai_tpu.knowledge.embedder import Embedder
 
-        embedder = Embedder(
-            model_name=kcfg.embedder.model,
-            model_path=kcfg.embedder.model_path,
-            max_length=kcfg.embedder.max_length,
-            batch_size=kcfg.embedder.batch_size,
-        )
+        embedder = Embedder.from_config(kcfg.embedder)
     hybrid = HybridRetriever(
         store, vectors=vectors, embedder=embedder,
         rrf_k=kcfg.rrf_k, fts_weight=kcfg.fts_weight, vector_weight=kcfg.vector_weight,

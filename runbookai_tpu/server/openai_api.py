@@ -146,10 +146,12 @@ def _chunk_payload(model: str, delta: dict, finish: Optional[str],
 
 def make_handler(bridge: _EngineBridge, model_name: str,
                  request_timeout: float,
-                 allow_runtime_adapters: bool = False):
+                 allow_runtime_adapters: bool = False,
+                 embedder=None):
     from runbookai_tpu.engine.request import SamplingParams
 
     client = bridge.client
+    _embed_mutex = threading.Lock()
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -197,6 +199,9 @@ def make_handler(bridge: _EngineBridge, model_name: str,
         def do_POST(self) -> None:  # noqa: N802
             if self.path == "/v1/adapters":
                 self._load_adapter()
+                return
+            if self.path == "/v1/embeddings":
+                self._embeddings()
                 return
             if self.path != "/v1/chat/completions":
                 self._error(404, f"no route {self.path}")
@@ -316,6 +321,55 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             except BrokenPipeError:
                 pass  # client went away; engine abort handled in stream path
 
+        def _embeddings(self) -> None:
+            """OpenAI embeddings API over the on-device bge encoder (the
+            same encoder the knowledge index uses)."""
+            if embedder is None:
+                self._error(400, "no embedder configured "
+                                 "(knowledge.embedder.enabled + model_path)")
+                return
+            emb_model = getattr(embedder.cfg, "name", "bge")
+            try:
+                body = self._read_json()
+                requested = body.get("model")
+                if requested and requested != emb_model:
+                    # Same policy as chat: no silent model substitution.
+                    self._error(404, f"model {requested!r} not found; "
+                                     f"embeddings model: {emb_model}")
+                    return
+                texts = body.get("input")
+                if isinstance(texts, str):
+                    texts = [texts]
+                if (not isinstance(texts, list) or not texts
+                        or not all(isinstance(t, str) for t in texts)):
+                    raise ValueError(
+                        "input must be a string or list of strings")
+                if len(texts) > 256:
+                    raise ValueError("at most 256 inputs per request")
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._error(400, str(e))
+                return
+            try:
+                # One request at a time: encode bursts contend with decode
+                # for the device, and the Embedder's cache/stats aren't
+                # thread-safe across handler threads.
+                with _embed_mutex:
+                    vecs = embedder.embed_texts(texts)
+                n_tokens = embedder.estimate_tokens(texts)
+                self._json(200, {
+                    "object": "list",
+                    "model": emb_model,
+                    "data": [{"object": "embedding", "index": i,
+                              "embedding": [float(x) for x in v]}
+                             for i, v in enumerate(vecs)],
+                    "usage": {"prompt_tokens": n_tokens,
+                              "total_tokens": n_tokens},
+                })
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # noqa: BLE001 — compute failures -> 500
+                self._error(500, f"embedding failed ({type(e).__name__})")
+
         def _load_adapter(self) -> None:
             """Hot-load a LoRA adapter into the running engine:
             ``POST /v1/adapters {"name": ..., "path": <PEFT dir>}``. The
@@ -432,12 +486,12 @@ class OpenAIServer:
 
     def __init__(self, client, model_name: str, host: str = "127.0.0.1",
                  port: int = 8000, request_timeout: float = 600.0,
-                 allow_runtime_adapters: bool = False):
+                 allow_runtime_adapters: bool = False, embedder=None):
         self.bridge = _EngineBridge(client)
         self.httpd = ThreadingHTTPServer(
             (host, port), make_handler(self.bridge, model_name,
                                        request_timeout,
-                                       allow_runtime_adapters))
+                                       allow_runtime_adapters, embedder))
         self.model_name = model_name
 
     @property

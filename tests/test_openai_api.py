@@ -165,3 +165,30 @@ def test_n_choices_and_stop_param(server):
         _post(server, "/v1/chat/completions", {
             "messages": [{"role": "user", "content": "x"}], "n": 99})
     assert e.value.code == 400
+
+
+def test_embeddings_endpoint():
+    from runbookai_tpu.knowledge.embedder import Embedder
+    from runbookai_tpu.server.openai_api import OpenAIServer
+
+    client = JaxTpuClient.for_testing(max_new_tokens=4)
+    srv = OpenAIServer(client, model_name="llama3-test", port=0,
+                       embedder=Embedder())  # tiny bge-test, random init
+    srv.start_background()
+    try:
+        with _post(srv, "/v1/embeddings",
+                   {"input": ["checkout latency", "pod crashloop"]}) as r:
+            body = json.loads(r.read())
+        assert len(body["data"]) == 2
+        v0 = body["data"][0]["embedding"]
+        assert len(v0) == 32  # bge-test dim
+        import math
+        norm = math.sqrt(sum(x * x for x in v0))
+        assert abs(norm - 1.0) < 1e-3  # L2-normalized CLS
+        assert body["usage"]["prompt_tokens"] > 0
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv, "/v1/embeddings", {"input": []})
+        assert e.value.code == 400
+    finally:
+        srv.shutdown()
