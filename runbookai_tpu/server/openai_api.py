@@ -238,6 +238,21 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 n = int(body.get("n", 1))
                 if not 1 <= n <= 8:
                     raise ValueError("n must be in [1, 8]")
+                # response_format json_object -> grammar-constrained
+                # decoding (the engine's guided JSON automaton): the
+                # output is GUARANTEED parseable, not just prompted-for.
+                rf = body.get("response_format") or {}
+                if not isinstance(rf, dict):
+                    # {"response_format": "json_object"} is a common client
+                    # mistake; coercing to text would silently drop the
+                    # JSON guarantee the caller asked for.
+                    raise ValueError("response_format must be an object "
+                                     "like {\"type\": \"json_object\"}")
+                rf_type = rf.get("type", "text")
+                if rf_type not in ("text", "json_object"):
+                    raise ValueError(
+                        "response_format.type must be text or json_object")
+                guided = "json" if rf_type == "json_object" else None
                 sampling = SamplingParams(
                     temperature=float(body.get("temperature",
                                                client.temperature)),
@@ -248,6 +263,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     stop_token_ids=(client.tokenizer.eot_id,
                                     client.tokenizer.eos_id),
                     stop_strings=tuple(stop),
+                    guided=guided,
                 )
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 self._error(400, str(e))

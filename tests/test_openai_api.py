@@ -192,3 +192,22 @@ def test_embeddings_endpoint():
         assert e.value.code == 400
     finally:
         srv.shutdown()
+
+
+def test_response_format_json_object(server):
+    # Guided decoding makes even a random-weights model emit strict JSON.
+    with _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "describe the incident"}],
+        "max_tokens": 48, "response_format": {"type": "json_object"},
+    }) as r:
+        body = json.loads(r.read())
+    content = body["choices"][0]["message"]["content"]
+    json.loads(content)  # must parse strictly
+
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "x"}],
+            "response_format": {"type": "json_schema"},
+        })
+    assert e.value.code == 400
