@@ -223,6 +223,20 @@ class EngineCore:
         # automaton and returns True when the grammar has completed.
         self.mask_fn = mask_fn
         self.advance_fn = advance_fn
+        # fp8 KV halves pool bytes (double the pooled tokens per chip) at
+        # ~1e-2 relative K/V error; the Pallas kernels are unproven under
+        # Mosaic with fp8 refs, so that combination downgrades to the XLA
+        # gather path until measured on hardware. The caller's config is
+        # copied, not mutated, and the downgrade is logged.
+        if (jnp.dtype(self.ecfg.kv_dtype).itemsize == 1
+                and self.ecfg.attn_impl == "pallas"):
+            import dataclasses as _dc
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "fp8 KV cache: serving via the XLA attention path "
+                "(pallas+fp8 unproven under Mosaic)")
+            self.ecfg = _dc.replace(self.ecfg, attn_impl="xla")
 
         # Sharded serving: with a mesh, the KV pool shards its kv-head axis
         # over the TP (``model``) axis alongside the Megatron param shardings

@@ -112,13 +112,15 @@ class JaxTpuClient(BaseLLMClient):
         )
         import jax
 
+        kv_dtype = (jnp.float8_e4m3fn
+                    if llm_cfg.kv_cache_dtype == "fp8" else dtype)
         ecfg = EngineConfig(
             page_size=llm_cfg.page_size,
             num_pages=llm_cfg.num_pages,
             max_batch_slots=llm_cfg.max_batch_slots,
             prefill_chunk=llm_cfg.prefill_chunk,
             max_seq_len=min(llm_cfg.max_seq_len, cfg.max_seq_len),
-            kv_dtype=dtype,
+            kv_dtype=kv_dtype,
             decode_steps_per_dispatch=llm_cfg.decode_steps,
             # The Pallas ragged-paged kernels are the TPU hot path (VERDICT r1
             # weak #3); the XLA gather path stays the portable fallback. On a

@@ -239,8 +239,10 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 if not 1 <= n <= 8:
                     raise ValueError("n must be in [1, 8]")
                 # response_format json_object -> grammar-constrained
-                # decoding (the engine's guided JSON automaton): the
-                # output is GUARANTEED parseable, not just prompted-for.
+                # decoding (the engine's guided JSON automaton): output is
+                # a valid-JSON prefix by construction, and a COMPLETE
+                # parseable document whenever finish_reason != "length"
+                # (max_tokens can still truncate mid-document).
                 rf = body.get("response_format") or {}
                 if not isinstance(rf, dict):
                     # {"response_format": "json_object"} is a common client
