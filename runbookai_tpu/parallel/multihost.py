@@ -1,0 +1,91 @@
+"""Multi-host bootstrap: one call turns N processes into one global mesh.
+
+SURVEY.md §5.8: the reference's "distributed backend" is HTTPS to SaaS — the
+TPU-native equivalent at multi-host scale is ``jax.distributed`` (one
+process per host, each owning its local chips) plus the same
+``jax.sharding.Mesh`` axes this repo uses single-host. After
+:func:`initialize`, ``jax.devices()`` is the GLOBAL device list and
+``build_mesh`` lays axes out so that the fastest-varying axes (``model``,
+``seq``) stay within a host's ICI domain while ``data`` (gradient/eval
+batching — one psum per step) crosses hosts over DCN, matching the
+scaling-book guidance that high-frequency collectives must ride ICI.
+
+Coordinator discovery follows the TPU-pod convention: every process reads
+the same env (set by GKE/QR metadata or the launcher) —
+
+    RUNBOOK_COORDINATOR   host:port of process 0 (or JAX_COORDINATOR_ADDRESS)
+    RUNBOOK_NUM_PROCESSES world size             (or JAX_NUM_PROCESSES)
+    RUNBOOK_PROCESS_ID    this process's rank    (or JAX_PROCESS_ID)
+
+On Cloud TPU VMs all three are optional: ``jax.distributed.initialize()``
+auto-discovers from the TPU metadata server.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> dict:
+    """Join (or create) the multi-process JAX runtime. Idempotent; a no-op
+    single-process fallback when no coordinator is configured or
+    discoverable, so single-host code paths need no branching.
+
+    Returns a summary dict (``process_index``, ``process_count``,
+    ``local_devices``, ``global_devices``) for logs/health endpoints.
+    """
+    coordinator = coordinator or os.environ.get(
+        "RUNBOOK_COORDINATOR") or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num_processes = num_processes if num_processes is not None else int(
+        os.environ.get("RUNBOOK_NUM_PROCESSES")
+        or os.environ.get("JAX_NUM_PROCESSES") or 0) or None
+    process_id = process_id if process_id is not None else (
+        int(os.environ.get("RUNBOOK_PROCESS_ID")
+            or os.environ.get("JAX_PROCESS_ID") or -1))
+    if process_id < 0:
+        process_id = None
+
+    already = jax.process_count() > 1
+    if not already and (coordinator or _on_cloud_tpu_pod()):
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return summary()
+
+
+def _on_cloud_tpu_pod() -> bool:
+    """Cloud TPU pod VMs auto-discover peers from instance metadata; the
+    launcher env markers below are what libtpu's own bootstrap keys off."""
+    return bool(os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",")
+                or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+
+
+def summary() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def assert_batch_divisible(global_batch: int, data_axis_size: int) -> int:
+    """Per-process batch share for the host-sharded input pipeline: each
+    process feeds only its local slice of the ``data`` axis (global arrays
+    assemble via ``jax.make_array_from_process_local_data``)."""
+    if global_batch % data_axis_size:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by data axis "
+            f"{data_axis_size}")
+    per_data_shard = global_batch // data_axis_size
+    if data_axis_size % jax.process_count() == 0:
+        shards_here = data_axis_size // jax.process_count()
+        return per_data_shard * shards_here
+    return global_batch  # data axis within one process: feed everything

@@ -104,3 +104,19 @@ def test_qwen2_bias_shardings_and_tp_forward():
     got = forward_train(sharded, qcfg, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_multihost_initialize_single_process_noop():
+    """Without a coordinator the bootstrap is a safe no-op and reports the
+    single-process topology (the multi-host path needs real pods; its
+    config plumbing is what this pins)."""
+    from runbookai_tpu.parallel import multihost
+
+    info = multihost.initialize()
+    assert info["process_count"] == 1
+    assert info["process_index"] == 0
+    assert info["global_devices"] == 8  # the virtual CPU mesh
+    # Batch sharding helper: data axis 4 on one process feeds everything.
+    assert multihost.assert_batch_divisible(8, 4) == 8
+    with pytest.raises(ValueError):
+        multihost.assert_batch_divisible(7, 4)
