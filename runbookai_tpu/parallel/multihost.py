@@ -50,14 +50,27 @@ def initialize(coordinator: Optional[str] = None,
     if process_id < 0:
         process_id = None
 
-    already = jax.process_count() > 1
-    if not already and (coordinator or _on_cloud_tpu_pod()):
+    # Probe the distributed client WITHOUT touching the backend:
+    # jax.process_count() would initialize the local runtime first, after
+    # which jax.distributed.initialize() is an error — the exact multi-host
+    # path this module exists for would always fail at bootstrap.
+    if not _distributed_client_active() and (coordinator
+                                             or _on_cloud_tpu_pod()):
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
             process_id=process_id,
         )
     return summary()
+
+
+def _distributed_client_active() -> bool:
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:  # noqa: BLE001 — private API moved; assume inactive
+        return False
 
 
 def _on_cloud_tpu_pod() -> bool:
@@ -85,7 +98,11 @@ def assert_batch_divisible(global_batch: int, data_axis_size: int) -> int:
             f"global batch {global_batch} not divisible by data axis "
             f"{data_axis_size}")
     per_data_shard = global_batch // data_axis_size
-    if data_axis_size % jax.process_count() == 0:
-        shards_here = data_axis_size // jax.process_count()
-        return per_data_shard * shards_here
-    return global_batch  # data axis within one process: feed everything
+    if data_axis_size % jax.process_count():
+        # A fallback here would silently feed duplicated data; indivisible
+        # topologies are config errors.
+        raise ValueError(
+            f"data axis {data_axis_size} not divisible by process count "
+            f"{jax.process_count()}")
+    shards_here = data_axis_size // jax.process_count()
+    return per_data_shard * shards_here
