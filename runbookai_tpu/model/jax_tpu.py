@@ -128,8 +128,10 @@ class JaxTpuClient(BaseLLMClient):
             # (ops/paged_attention_pallas.py) — forward_impl itself falls
             # back to XLA attention only when GQA heads don't divide the
             # model axis (where the pool replicates anyway).
-            attn_impl=("pallas"
-                       if jax.default_backend() in ("tpu", "axon") else "xla"),
+            attn_impl=(llm_cfg.attn_impl if llm_cfg.attn_impl != "auto"
+                       else ("pallas"
+                             if jax.default_backend() in ("tpu", "axon")
+                             else "xla")),
         )
         lora_registry = None
         if getattr(llm_cfg, "lora_adapters", None):

@@ -148,6 +148,14 @@ CONFIGS: dict[str, LlamaConfig] = {
         n_heads=28, n_kv_heads=4, ffn_dim=18_944, rope_theta=1_000_000.0,
         max_seq_len=32_768, qkv_bias=True, family="qwen2",
     ),
+    # Qwen2.5-7B ships the same architecture/dims as Qwen2-7B (vocab,
+    # qkv biases, theta) — served under its own name for HF parity.
+    "qwen2.5-7b-instruct": LlamaConfig(
+        name="qwen2.5-7b-instruct", vocab_size=152_064, dim=3584,
+        n_layers=28, n_heads=28, n_kv_heads=4, ffn_dim=18_944,
+        rope_theta=1_000_000.0, max_seq_len=32_768, qkv_bias=True,
+        family="qwen2",
+    ),
     "qwen2-test": LlamaConfig(
         name="qwen2-test", vocab_size=262, dim=64, n_layers=2, n_heads=4,
         n_kv_heads=2, ffn_dim=128, max_seq_len=8192, rope_theta=10_000.0,

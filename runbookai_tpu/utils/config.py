@@ -75,6 +75,10 @@ class LLMConfig(BaseModel):
     lora_adapters: dict[str, str] = Field(default_factory=dict)
     lora_rank: int = 8
     lora_targets: tuple[str, ...] = ("wq", "wv")
+    # Decode attention implementation: "auto" picks the Pallas kernels on
+    # TPU and the XLA gather path elsewhere; explicit values override (e.g.
+    # force "xla" when debugging a Mosaic issue on hardware).
+    attn_impl: Literal["auto", "pallas", "xla"] = "auto"
     # KV cache precision: "auto" follows the activation dtype (bf16);
     # "fp8" (float8_e4m3) halves pool bytes — double the pooled tokens
     # per chip — at ~1e-2 relative K/V error.

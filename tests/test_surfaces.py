@@ -426,3 +426,12 @@ def test_cli_serve_requires_engine_provider(tmp_path, monkeypatch, capsys):
     monkeypatch.chdir(tmp_path)
     # Default config is the mock provider: serve must refuse, not crash.
     assert main(["serve", "--port", "0"]) == 1
+
+
+def test_llm_config_knobs():
+    from runbookai_tpu.models.llama import CONFIGS
+    from runbookai_tpu.utils.config import LLMConfig
+
+    assert CONFIGS["qwen2.5-7b-instruct"].family == "qwen2"
+    assert LLMConfig().attn_impl == "auto"
+    assert LLMConfig(attn_impl="xla").attn_impl == "xla"
