@@ -14,7 +14,7 @@ import re
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Any, Optional
+from typing import Optional
 
 _SERVICE_RE = re.compile(
     r"\b([a-z][a-z0-9]*(?:-[a-z0-9]+)+)\b"  # kebab-case names like payment-api

@@ -10,7 +10,7 @@ open instruction-tuned model rather than hosted frontier models.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 from runbookai_tpu.agent.types import RetrievedKnowledge
 

@@ -7,7 +7,6 @@ mentioned in the conversation.
 
 from __future__ import annotations
 
-from typing import Optional
 
 from runbookai_tpu.knowledge.store.graph import ServiceGraph
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 import csv
 import json
 from pathlib import Path
-from typing import Any, Iterable
+from typing import Any
 
 
 def _read_rows(path: str | Path) -> list[dict[str, Any]]:

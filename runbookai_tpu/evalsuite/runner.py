@@ -18,11 +18,11 @@ import json
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 from runbookai_tpu.agent.orchestrator import InvestigationOrchestrator, ToolExecutor
 from runbookai_tpu.agent.state_machine import InvestigationStateMachine
-from runbookai_tpu.evalsuite.scoring import CaseScore, EvalCase, score_investigation_result
+from runbookai_tpu.evalsuite.scoring import EvalCase, score_investigation_result
 from runbookai_tpu.tools import simulated as sim_tools
 from runbookai_tpu.tools.registry import ToolRegistry
 

@@ -12,7 +12,7 @@ import json
 import time
 import uuid
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any
 
 from runbookai_tpu.providers.operability import ContextClaim, Provenance
 

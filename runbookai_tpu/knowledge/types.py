@@ -10,7 +10,7 @@ from __future__ import annotations
 import hashlib
 import time
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Optional
 
 KNOWLEDGE_TYPES = (
     "runbook", "postmortem", "known-issue", "architecture", "troubleshooting",

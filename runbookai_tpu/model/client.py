@@ -14,7 +14,7 @@ from __future__ import annotations
 import asyncio
 from typing import Any, AsyncIterator, Optional, Protocol, runtime_checkable
 
-from runbookai_tpu.agent.types import LLMResponse, ToolCall
+from runbookai_tpu.agent.types import LLMResponse
 
 
 @runtime_checkable

@@ -9,7 +9,7 @@ decoding so the structured orchestrator receives schema-parseable output.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 import jax.numpy as jnp
 

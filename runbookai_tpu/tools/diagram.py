@@ -9,7 +9,6 @@ always registered.
 
 from __future__ import annotations
 
-import math
 from typing import Any
 
 from runbookai_tpu.tools.registry import ToolRegistry, object_schema

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from typing import Any, Optional
+from typing import Any
 
 from runbookai_tpu.tools.registry import ToolRegistry, object_schema
 

@@ -10,7 +10,7 @@ the batch sharded over ``data`` and parameters Megatron-sharded over
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp
