@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from runbookai_tpu.agent.types import LLMResponse
@@ -110,8 +111,6 @@ class JaxTpuClient(BaseLLMClient):
             model_cfg_name, llm_cfg.model_path, dtype=dtype, shardings=shardings,
             quantize_int8=quantize,
         )
-        import jax
-
         kv_dtype = (jnp.float8_e4m3fn
                     if llm_cfg.kv_cache_dtype == "fp8" else dtype)
         ecfg = EngineConfig(
