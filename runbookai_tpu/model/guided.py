@@ -74,7 +74,7 @@ class JsonMachine:
     admits, a random model will eventually emit.
     """
 
-    def __init__(self, max_depth: int = 32):
+    def __init__(self, max_depth: int = 32, budget: int | None = None):
         self.mode = _VALUE
         self.stack: list[int] = []  # 123 for '{', 91 for '['
         self.literal: bytes = b""
@@ -87,6 +87,42 @@ class JsonMachine:
         self.u8_lo = 0x80  # allowed range for the next continuation byte
         self.u8_hi = 0xBF
         self.hex_rem = 0  # remaining \uXXXX hex digits
+        # Optional byte budget: past it the machine enters WRAP-UP — only
+        # completion-directed bytes stay admissible (close the current
+        # string, no new elements, close containers), so a free-form value
+        # embedded in a schema cannot absorb the whole token budget while
+        # still ending as strictly-valid JSON. None = unbounded (the
+        # standalone "json" grammar keeps its historical behavior).
+        self.budget = budget
+
+    def _wrapup_allows(self, b: int) -> bool:
+        """Completion-directed admissibility once the byte budget is spent.
+        Every state keeps at least one legal byte admissible, so wrap-up can
+        never deadlock the machine — it only forbids bytes that grow the
+        document (string content, new elements, deeper nesting)."""
+        mode = self.mode
+        if mode == _STRING:
+            if self.u8_need:  # must finish the in-flight UTF-8 character
+                return self.u8_lo <= b <= self.u8_hi
+            return b == 0x22  # close the string
+        if mode == _STR_ESC:
+            if self.hex_rem:
+                return True  # finish the \uXXXX escape
+            return b == 0x6E  # 'n' — shortest escape, then close
+        if mode == _NUMBER:
+            if self.num_state in _NUM_COMPLETE:
+                # number may end: only structural continuation, no growth
+                return b not in b"0123456789.eE+-"
+            return b in b"0123456789"  # reach a terminal digit state
+        if mode == _LITERAL:
+            return True  # bounded by the literal itself
+        if mode == _VALUE:
+            # shortest values only: a digit, an empty string, or closing an
+            # empty container ('}' / ']' stay subject to normal validity)
+            return b in b'"0}]'
+        if mode == _AFTER:
+            return b != 0x2C  # no ',' — close out instead
+        return True
 
     @property
     def in_string(self) -> bool:
@@ -104,13 +140,21 @@ class JsonMachine:
             and self.num_state in _NUM_COMPLETE
         )
 
+    # Longest token byte-expansion we bucket budget head-room to: a mask
+    # cached at one head-room value is only reused where no admissible
+    # token can CROSS the wrap-up boundary mid-token (same hazard — and
+    # same fix — as _StringFrame's max_str_len head-room bucketing).
+    _BUDGET_BUCKET = 32
+
     def signature(self) -> tuple:
         return (self.mode, tuple(self.stack), self.literal, self.lit_pos,
                 self.complete, self.dead, self.num_state,
-                self.u8_need, self.u8_lo, self.u8_hi, self.hex_rem)
+                self.u8_need, self.u8_lo, self.u8_hi, self.hex_rem,
+                None if self.budget is None
+                else max(0, min(self.budget, self._BUDGET_BUCKET)))
 
     def copy(self) -> "JsonMachine":
-        m = JsonMachine(self.max_depth)
+        m = JsonMachine(self.max_depth, self.budget)
         m.mode, m.stack = self.mode, list(self.stack)
         m.literal, m.lit_pos = self.literal, self.lit_pos
         m.complete, m.dead = self.complete, self.dead
@@ -129,12 +173,20 @@ class JsonMachine:
         else:
             self.mode = _AFTER
 
-    def advance(self, byte: int) -> bool:
-        """Consume one byte; returns False (and goes dead) on violation."""
+    def advance(self, byte: int, _redo: bool = False) -> bool:
+        """Consume one byte; returns False (and goes dead) on violation.
+        ``_redo`` marks internal re-interpretation of the SAME byte (number
+        termination, array-first fallthrough) — budget bookkeeping must run
+        once per real byte, not per interpretation."""
         if self.dead:
             return False
         b = byte
         mode = self.mode
+        if self.budget is not None and not _redo:
+            if self.budget <= 0 and not self._wrapup_allows(b):
+                self.dead = True
+                return False
+            self.budget -= 1
 
         if mode == _STRING:
             if self.u8_need:  # inside a multi-byte UTF-8 character
@@ -232,7 +284,7 @@ class JsonMachine:
                 return self._die()
             self._close_value()
             self.complete = not self.stack and self.mode == _AFTER
-            return self.advance(b)
+            return self.advance(b, _redo=True)
         if mode == _LITERAL:
             if self.lit_pos < len(self.literal) and b == self.literal[self.lit_pos]:
                 self.lit_pos += 1
@@ -282,7 +334,7 @@ class JsonMachine:
                 self.complete = not self.stack
                 return True
             self.mode = _VALUE
-            return self.advance(b)
+            return self.advance(b, _redo=True)
 
         if mode in (_OBJ_KEY, _OBJ_KEY_REQ):
             if b == 0x22:

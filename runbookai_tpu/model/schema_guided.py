@@ -370,15 +370,30 @@ class _StringFrame:
 
 
 class _NumberFrame:
-    __slots__ = ("state",)
+    __slots__ = ("state", "count")
     # states: start, neg (after '-'), zero (leading 0), int, frac0, frac,
     #         exp0 (after e/E), exp1 (after exp sign), exp
 
     def __init__(self):
         self.state = "start"
+        self.count = 0  # consumed bytes; capped by limits.max_num_len
 
     def advance(self, b: int, lim):
         s = self.state
+        # Numbers are otherwise an UNBOUNDED sink: digits stay admissible
+        # forever, so a high-temperature model can burn its whole token
+        # budget inside one numeric field (caught by the schema fuzz
+        # sweep). Once the cap is reached in a state where the number can
+        # legally END, further digits are rejected as _REDO — the byte is
+        # re-offered to the parent, which admits only structural bytes, so
+        # generation must move on. Non-terminating states (start/neg/
+        # frac0/exp0/exp1) stay exempt: refusing digits there would kill
+        # the machine.
+        if (b in _DIGITS and self.count >= lim.max_num_len
+                and s in ("zero", "int", "frac", "exp")):
+            return _REDO
+        if b not in _WS:
+            self.count += 1
         if s == "start":
             if b in _WS:
                 return _CONT
@@ -451,6 +466,7 @@ class _NumberFrame:
     def copy(self):
         f = _NumberFrame.__new__(_NumberFrame)
         f.state = self.state
+        f.count = self.count
         return f
 
 
@@ -505,8 +521,9 @@ class _AnyFrame:
 
     __slots__ = ("m", "started", "require_object")
 
-    def __init__(self, require_object: bool = False):
-        self.m = JsonMachine()
+    def __init__(self, require_object: bool = False,
+                 budget: int | None = None):
+        self.m = JsonMachine(budget=budget)
         self.started = False
         self.require_object = require_object
 
@@ -535,7 +552,7 @@ class _AnyFrame:
         return f
 
 
-def _make_frame(node: SNode):
+def _make_frame(node: SNode, lim=None):
     if isinstance(node, SObject):
         return _ObjectFrame(node)
     if isinstance(node, SArray):
@@ -549,7 +566,8 @@ def _make_frame(node: SNode):
     if isinstance(node, SNumber):
         return _NumberFrame()
     if isinstance(node, SAny):
-        return _AnyFrame(node.require_object)
+        return _AnyFrame(node.require_object,
+                         budget=lim.max_any_bytes if lim else None)
     raise TypeError(node)
 
 
@@ -566,6 +584,11 @@ class SchemaLimits:
 
     max_str_len: int = 512  # content bytes per string
     max_array_items: int = 32
+    max_num_len: int = 24  # bytes per number (wider than any float repr)
+    # Free-form (dict/Any) fields embed a generic JsonMachine; this byte
+    # budget flips it into wrap-up mode (close out, no new elements) so
+    # one unbounded field can't absorb the whole token budget.
+    max_any_bytes: int = 768
     # Longest token byte-expansion in the vocab — the mask-cache bucket for
     # string head-room. The provider overrides this from the real table; a
     # too-small value would cache a mask admitting a token that overflows
@@ -581,7 +604,7 @@ class SchemaMachine:
         self.schema = schema
         self.name = name
         self.limits = limits or SchemaLimits()
-        self.stack: list = [_make_frame(schema)]
+        self.stack: list = [_make_frame(schema, self.limits)]
         self.complete = False
         self.dead = False
 
@@ -598,6 +621,13 @@ class SchemaMachine:
             elif isinstance(fr, _ArrayFrame):
                 s = fr.sig()
                 sigs.append(s + (fr.count >= self.limits.max_array_items,))
+            elif isinstance(fr, _NumberFrame):
+                # Head-room bucketing (like strings): a mask cached at one
+                # head-room must never be reused where a multi-digit token
+                # could cross the cap mid-token.
+                room = max(0, self.limits.max_num_len - fr.count)
+                sigs.append(fr.sig()
+                            + (min(room, self.limits.max_token_bytes),))
             else:
                 sigs.append(fr.sig())
         return ("schema", self.name, self.complete, self.dead, tuple(sigs))
@@ -637,7 +667,7 @@ class SchemaMachine:
             if res == _DEAD:
                 return self._die()
             if isinstance(res, tuple) and res[0] == _PUSH:
-                self.stack.append(_make_frame(res[1]))
+                self.stack.append(_make_frame(res[1], self.limits))
                 continue  # re-offer the byte to the new child
             if res == _DONE:
                 self.stack.pop()
