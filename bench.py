@@ -71,6 +71,14 @@ def emit(value: float, unit: str, details: dict) -> None:
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
 
 
+def _parses(text: str) -> bool:
+    try:
+        json.loads(text)
+        return True
+    except ValueError:
+        return False
+
+
 def looks_oom(message: str) -> bool:
     return any(s in message for s in _OOM_MARKERS)
 
@@ -311,16 +319,20 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         # TTFT stays ~flat under load (p50_ttft_ms in details tracks this).
         prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH", slots)),
     )
-    core = EngineCore(cfg, params, tok, ecfg)
+    from runbookai_tpu.model.guided import JsonMaskProvider
+
+    masker = JsonMaskProvider(tok)
+    core = EngineCore(cfg, params, tok, ecfg,
+                      mask_fn=masker.mask, advance_fn=masker.advance)
 
     rng = np.random.default_rng(0)
 
-    def make_req(max_new=new_tokens):
+    def make_req(max_new=new_tokens, guided=None):
         prompt = rng.integers(0, 256, size=prompt_len).tolist()
         return EngineRequest(
             prompt_ids=prompt,
             sampling=SamplingParams(temperature=0.0, max_new_tokens=max_new,
-                                    stop_token_ids=()),
+                                    stop_token_ids=(), guided=guided),
         )
 
     # Warmup: compile every program shape the measured run will hit — the
@@ -375,6 +387,34 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         "mfu": round(mfu, 4) if mfu is not None else None,
         "peak_flops_per_chip": peak,
     }
+    if on_accel and os.environ.get("BENCH_GUIDED", "1") != "0":
+        # Secondary metric: guided JSON decoding through the SAME engine —
+        # proves the grammar masks + fast-forward on hardware and gives a
+        # guided-tok/s figure next to the free-decode headline.
+        try:
+            # Warmup: the masked-sampling program and the fast-forward fold
+            # are NEW jit signatures — compile them outside the timed
+            # window (the same compile-in-window trap the headline warmup
+            # fixes for prefill/decode).
+            core.submit(make_req(max_new=8, guided="json"))
+            core.run_until_idle()
+            t0 = time.perf_counter()
+            greqs = [make_req(max_new=96, guided="json") for _ in range(2)]
+            for r in greqs:
+                core.submit(r)
+            core.run_until_idle()
+            g_wall = time.perf_counter() - t0
+            g_tokens = sum(r.num_generated for r in greqs)
+            details["guided_json"] = {
+                "tokens": g_tokens,
+                "tok_s": round(g_tokens / max(g_wall, 1e-9), 2),
+                "grammar_forced_tokens":
+                    core.metrics.get("grammar_forced_tokens", 0),
+                "parseable": all(_parses(core.output_for(r).text)
+                                 for r in greqs),
+            }
+        except Exception as e:  # noqa: BLE001
+            details["guided_json"] = {"error": str(e)[-300:]}
     if on_accel and os.environ.get("BENCH_BGE", "1") != "0":
         # Optional secondary metric: never let it discard the measured
         # headline (an OOM here would otherwise look like an 8B failure).
