@@ -129,3 +129,32 @@ def test_batch_sweep_keeps_best_and_survives_failures(monkeypatch, capsys):
     assert "error" in sweep[-1]
     # env restored for any later runs in-process
     assert "BENCH_SLOTS" not in os.environ
+
+
+def test_batch_sweep_respects_pinned_env(monkeypatch, capsys):
+    # A user-pinned BENCH_REQUESTS (any sweep var) disables the sweep and
+    # must never be clobbered.
+    import bench as bench_mod
+
+    def fake_probe(watchdog_s, t0):
+        return ({"ok": True, "platform": "tpu", "kind": "TPU v5 lite",
+                 "n": 1}, {"probe_attempts": []})
+
+    calls = []
+
+    def fake_spawn(model, on_accel, probe, timeout_s):
+        if not on_accel:
+            return bench_mod.make_result(100.0, "tok/s", {"model": model})
+        calls.append(os.environ.get("BENCH_REQUESTS"))
+        return bench_mod.make_result(200.0, "tok/s", {
+            "model": model, "batch_slots": 8, "p50_ttft_ms": 50.0})
+
+    monkeypatch.setattr(bench_mod, "diagnose_and_probe", fake_probe)
+    monkeypatch.setattr(bench_mod, "_spawn_inner", fake_spawn)
+    monkeypatch.setenv("BENCH_REQUESTS", "32")
+    monkeypatch.delenv("BENCH_SLOTS", raising=False)
+    bench_mod.main()
+    result = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "batch_sweep" not in result["details"]  # sweep disabled
+    assert calls == ["32"]  # one accel run, user's value intact
+    assert os.environ["BENCH_REQUESTS"] == "32"
