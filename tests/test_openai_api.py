@@ -211,3 +211,41 @@ def test_response_format_json_object(server):
             "response_format": {"type": "json_schema"},
         })
     assert e.value.code == 400
+
+
+def test_concurrent_mixed_traffic(server):
+    """Chat, SSE streams, and guided-JSON requests all in flight at once:
+    every request completes with a well-formed response (one-off 24-way
+    soak ran clean; this lighter version pins it in CI)."""
+    results, errors = [], []
+
+    def worker(i):
+        try:
+            if i % 3 == 0:
+                with _post(server, "/v1/chat/completions", {
+                    "messages": [{"role": "user", "content": f"q{i}"}],
+                    "max_tokens": 5}) as r:
+                    json.loads(r.read())
+            elif i % 3 == 1:
+                with _post(server, "/v1/chat/completions", {
+                    "messages": [{"role": "user", "content": f"s{i}"}],
+                    "max_tokens": 5, "stream": True}) as r:
+                    assert r.read().decode().rstrip().endswith("[DONE]")
+            else:
+                with _post(server, "/v1/chat/completions", {
+                    "messages": [{"role": "user", "content": f"g{i}"}],
+                    "max_tokens": 40,
+                    "response_format": {"type": "json_object"}}) as r:
+                    body = json.loads(r.read())
+                    json.loads(body["choices"][0]["message"]["content"])
+            results.append(i)
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(9)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    assert len(results) == 9
