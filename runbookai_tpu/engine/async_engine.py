@@ -160,7 +160,13 @@ class AsyncEngine:
                 await asyncio.wait_for(done, timeout_s)
             except asyncio.TimeoutError:
                 with self._lock:
-                    self.core.abort(req.request_id)
+                    aborted = self.core.abort(req.request_id)
+                # Race: the request can finish in the window between
+                # wait_for timing out and the abort taking the lock. abort
+                # returns False for already-finished requests — a completed
+                # generation must not be reported as a timeout.
+                if not aborted and req.finish_reason not in (None, "aborted"):
+                    return self.core.output_for(req)
                 raise TimeoutError(
                     f"generation exceeded {timeout_s}s (request aborted)")
         return self.core.output_for(req)

@@ -74,7 +74,8 @@ class JsonMachine:
     admits, a random model will eventually emit.
     """
 
-    def __init__(self, max_depth: int = 32, budget: int | None = None):
+    def __init__(self, max_depth: int = 32, budget: int | None = None,
+                 budget_bucket: int | None = None):
         self.mode = _VALUE
         self.stack: list[int] = []  # 123 for '{', 91 for '['
         self.literal: bytes = b""
@@ -94,6 +95,16 @@ class JsonMachine:
         # still ending as strictly-valid JSON. None = unbounded (the
         # standalone "json" grammar keeps its historical behavior).
         self.budget = budget
+        # Head-room bucket for mask caching: must be STRICTLY greater than
+        # the vocab's longest token byte-expansion, else a mask cached at a
+        # high budget is reused at budget == bucket where a longest-token
+        # whose final byte is re-interpreted (number-terminating ',') sees
+        # the post-decrement budget hit 0 and diverges — admitting a token
+        # in one state that kills the machine in the other. Callers with a
+        # measured vocab pass max_token_bytes; +1 buys the strict margin.
+        self.budget_bucket = max(
+            self._BUDGET_BUCKET,
+            (budget_bucket + 1) if budget_bucket is not None else 0)
 
     def _wrapup_allows(self, b: int) -> bool:
         """Completion-directed admissibility once the byte budget is spent.
@@ -111,8 +122,11 @@ class JsonMachine:
             return b == 0x6E  # 'n' — shortest escape, then close
         if mode == _NUMBER:
             if self.num_state in _NUM_COMPLETE:
-                # number may end: only structural continuation, no growth
-                return b not in b"0123456789.eE+-"
+                # number may end: only structural continuation, no growth.
+                # ',' is excluded — it would be re-interpreted in AFTER mode
+                # as "next element", growing the document past the budget
+                # ('}', ']' and ws remain admissible so no deadlock).
+                return b not in b"0123456789.eE+-,"
             return b in b"0123456789"  # reach a terminal digit state
         if mode == _LITERAL:
             return True  # bounded by the literal itself
@@ -151,10 +165,11 @@ class JsonMachine:
                 self.complete, self.dead, self.num_state,
                 self.u8_need, self.u8_lo, self.u8_hi, self.hex_rem,
                 None if self.budget is None
-                else max(0, min(self.budget, self._BUDGET_BUCKET)))
+                else max(0, min(self.budget, self.budget_bucket)))
 
     def copy(self) -> "JsonMachine":
         m = JsonMachine(self.max_depth, self.budget)
+        m.budget_bucket = self.budget_bucket  # already-resolved; no re-+1
         m.mode, m.stack = self.mode, list(self.stack)
         m.literal, m.lit_pos = self.literal, self.lit_pos
         m.complete, m.dead = self.complete, self.dead
@@ -182,11 +197,16 @@ class JsonMachine:
             return False
         b = byte
         mode = self.mode
-        if self.budget is not None and not _redo:
+        if self.budget is not None:
+            # Admissibility is checked per INTERPRETATION (so a byte that
+            # terminates a number and is re-offered in AFTER mode is
+            # re-checked against the new mode — the redo path must not
+            # bypass wrap-up), but the budget decrements once per real byte.
             if self.budget <= 0 and not self._wrapup_allows(b):
                 self.dead = True
                 return False
-            self.budget -= 1
+            if not _redo:
+                self.budget -= 1
 
         if mode == _STRING:
             if self.u8_need:  # inside a multi-byte UTF-8 character

@@ -522,8 +522,9 @@ class _AnyFrame:
     __slots__ = ("m", "started", "require_object")
 
     def __init__(self, require_object: bool = False,
-                 budget: int | None = None):
-        self.m = JsonMachine(budget=budget)
+                 budget: int | None = None,
+                 budget_bucket: int | None = None):
+        self.m = JsonMachine(budget=budget, budget_bucket=budget_bucket)
         self.started = False
         self.require_object = require_object
 
@@ -567,7 +568,8 @@ def _make_frame(node: SNode, lim=None):
         return _NumberFrame()
     if isinstance(node, SAny):
         return _AnyFrame(node.require_object,
-                         budget=lim.max_any_bytes if lim else None)
+                         budget=lim.max_any_bytes if lim else None,
+                         budget_bucket=lim.max_token_bytes if lim else None)
     raise TypeError(node)
 
 

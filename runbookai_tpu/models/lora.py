@@ -114,6 +114,11 @@ class LoraRegistry:
             raise ValueError(f"adapter {name!r} already registered")
         scale = (alpha / self.rank) if alpha is not None else 1.0
         L = self.cfg.n_layers
+        # Validate EVERY target before appending ANY row: a mid-loop shape
+        # failure must not leave earlier targets with an extra row (the
+        # per-target row counts would diverge and jit-time gather clamping
+        # would then silently serve the wrong adapter).
+        staged: list[tuple[str, np.ndarray, np.ndarray]] = []
         for t in self.targets:
             if t in weights:
                 a = np.asarray(weights[t]["A"], np.float32)
@@ -129,6 +134,8 @@ class LoraRegistry:
                              np.float32)
                 b = np.zeros((L, self.rank, _TARGET_OUT[t](self.cfg)),
                              np.float32)
+            staged.append((t, a, b))
+        for t, a, b in staged:
             self._host[t]["A"].append(a)
             self._host[t]["B"].append(b)
         idx = self.n_adapters - 1

@@ -39,14 +39,28 @@ def messages_to_prompt_parts(messages: list[dict[str, Any]]):
         if isinstance(content, list):  # content-part arrays
             content = "".join(p.get("text", "") for p in content
                               if isinstance(p, dict))
-        if role == "system":
+        if role in ("system", "developer"):
+            # 'developer' is OpenAI's successor to 'system' — same slot.
             system = content if not system else f"{system}\n{content}"
         elif role in ("user", "assistant"):
             turns.append((role, content))
-    if turns and turns[-1][0] == "user":
-        user = turns.pop()[1]
-    else:
-        user = ""
+        elif role == "tool":
+            # Tool-result round-trips: fold the result into the transcript
+            # as a user-visible observation (our chat template has no
+            # separate tool role) instead of silently dropping it.
+            tool_id = m.get("tool_call_id") or m.get("name") or "tool"
+            turns.append(("user", f"[tool result {tool_id}]\n{content}"))
+        else:
+            raise ValueError(f"unsupported message role {role!r}")
+    if turns and turns[-1][0] == "assistant":
+        # Assistant-prefill (trailing assistant message) is not supported
+        # by the chat template; rendering an empty user turn would degrade
+        # the prompt silently. Refuse loudly (maps to HTTP 400). A
+        # system-only request stays valid (empty user turn, as before).
+        raise ValueError(
+            "the last non-system message must be a user or tool message; "
+            "assistant prefill is not supported")
+    user = turns.pop()[1] if turns else ""
     return system, turns, user
 
 

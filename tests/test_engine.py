@@ -530,3 +530,30 @@ def test_stop_string_trimmed_from_output(setup):
     out = core2.output_for(req)
     assert req.finish_reason == FinishReason.STOP_STRING
     assert first_char not in out.text  # trimmed, OpenAI-style
+
+
+async def test_timeout_race_with_finished_request_returns_output(setup):
+    """If the request finishes in the window between wait_for timing out
+    and the abort acquiring the lock, the completed generation must be
+    returned, not reported as a timeout (advisor r3). Simulated by forcing
+    wait_for to raise AFTER the request has actually completed."""
+    tok, params = setup
+    core = make_core(tok, params)
+    eng = AsyncEngine(core)
+    await eng.start()
+
+    real_wait_for = asyncio.wait_for
+
+    async def late_timeout(awaitable, timeout):
+        await real_wait_for(awaitable, 30)  # let it genuinely finish
+        raise asyncio.TimeoutError  # then pretend the window elapsed
+
+    import unittest.mock as mock
+    with mock.patch("runbookai_tpu.engine.async_engine.asyncio.wait_for",
+                    late_timeout):
+        out = await eng.generate(
+            tok.encode("hello"), SamplingParams(max_new_tokens=3),
+            timeout_s=0.01)
+    await eng.stop()
+    assert len(out.token_ids) >= 1
+    assert out.finish_reason not in (None, "aborted")

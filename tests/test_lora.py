@@ -433,3 +433,21 @@ def test_adapter_loading_gated_by_default(setup):
         assert e.value.code == 403
     finally:
         srv.shutdown()
+
+
+def test_register_is_atomic_on_validation_failure(setup):
+    """A later target failing shape validation must not leave earlier
+    targets with an extra appended row (row-count divergence would make
+    jit-time gather clamping silently serve the wrong adapter)."""
+    reg = _registry(1)
+    rows_before = {t: len(reg._host[t]["A"]) for t in reg.targets}
+    bad = _rand_adapter(7)
+    bad["wv"]["B"] = bad["wv"]["B"][:, :, :-1]  # wq valid, wv invalid
+    with pytest.raises(ValueError):
+        reg.register("broken", bad)
+    rows_after = {t: len(reg._host[t]["A"]) for t in reg.targets}
+    assert rows_after == rows_before
+    assert "broken" not in reg.names
+    # Registry still fully functional after the rejected registration.
+    reg.register("adapterX", _rand_adapter(8))
+    assert len({len(reg._host[t]["A"]) for t in reg.targets}) == 1

@@ -249,3 +249,39 @@ def test_concurrent_mixed_traffic(server):
         t.join(timeout=300)
     assert not errors, errors
     assert len(results) == 9
+
+
+def test_tool_role_messages_enter_transcript():
+    """Tool-result round-trips must not be silently dropped (advisor r3)."""
+    system, history, user = messages_to_prompt_parts([
+        {"role": "user", "content": "check disk"},
+        {"role": "assistant", "content": "calling df"},
+        {"role": "tool", "tool_call_id": "call_1", "content": "97% full"},
+    ])
+    assert history == [("user", "check disk"), ("assistant", "calling df")]
+    assert "97% full" in user and "call_1" in user
+
+
+def test_trailing_assistant_message_is_rejected():
+    """Assistant prefill is unsupported; an empty user turn would degrade
+    the prompt silently — refuse with ValueError (HTTP 400 at the route)."""
+    with pytest.raises(ValueError):
+        messages_to_prompt_parts([
+            {"role": "user", "content": "hi"},
+            {"role": "assistant", "content": "prefill:"},
+        ])
+    with pytest.raises(ValueError):
+        messages_to_prompt_parts([{"role": "function", "content": "x"}])
+
+
+def test_system_only_and_developer_role_still_accepted():
+    """system-only requests served before (empty user turn) must keep
+    working, and OpenAI's 'developer' role folds into the system slot."""
+    system, history, user = messages_to_prompt_parts(
+        [{"role": "system", "content": "be terse"}])
+    assert system == "be terse" and history == [] and user == ""
+    system, _, user = messages_to_prompt_parts([
+        {"role": "developer", "content": "you are a bot"},
+        {"role": "user", "content": "hi"},
+    ])
+    assert system == "you are a bot" and user == "hi"

@@ -308,3 +308,77 @@ async def test_every_schema_parses_across_sampling_regimes(schema_name):
             assert set(doc) == want_keys, (schema_name, temp, set(doc))
     finally:
         await client.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# wrap-up budget hardening (advisor r3 findings)                        #
+# --------------------------------------------------------------------- #
+
+
+def test_wrapup_budget_not_escapable_via_number_comma():
+    """A ',' terminating a number is re-interpreted in AFTER mode; the redo
+    path must not bypass the wrap-up check — otherwise ',0' (arrays) and
+    ',"":0' (objects) cycles grow the document forever past the budget."""
+    from runbookai_tpu.model.guided import JsonMachine
+
+    m = JsonMachine(budget=4)
+    for b in b"[1,2":
+        assert m.advance(b)
+    assert m.budget <= 0
+    # The escape: keep appending ',0' — must die, not run forever.
+    c = m.copy()
+    for _ in range(8):
+        if not (c.advance(ord(",")) and c.advance(ord("0"))):
+            break
+    else:
+        raise AssertionError("unbounded ',0' cycle survived wrap-up")
+    # No deadlock: the close is still admissible and completes the doc.
+    c2 = m.copy()
+    assert c2.advance(ord("]")) and c2.is_complete
+
+    m2 = JsonMachine(budget=6)
+    for b in b'{"a":1':
+        assert m2.advance(b)
+    assert m2.budget <= 0
+    c3 = m2.copy()
+    assert not (c3.advance(ord(",")) and c3.advance(ord('"')))
+    c4 = m2.copy()
+    assert c4.advance(ord("}")) and c4.is_complete
+
+
+def test_budget_bucket_sized_from_vocab_longest_token():
+    """Masks cached at one budget head-room must not be reused where a
+    longer-than-bucket token could cross the wrap-up boundary mid-token:
+    the bucket tracks the measured longest token, not a hard-coded 32."""
+    from runbookai_tpu.model.guided import JsonMachine
+
+    m = JsonMachine(budget=100, budget_bucket=64)
+    # STRICTLY greater than the longest token: at budget == longest-token a
+    # token whose final byte is re-interpreted (number ',') sees the
+    # post-decrement budget hit 0 and diverges from budget > bucket.
+    assert m.budget_bucket == 65
+    assert m.copy().budget_bucket == 65  # survives copy
+    # Distinct budgets below the bucket hash to distinct signatures.
+    a = JsonMachine(budget=40, budget_bucket=64).signature()
+    b = JsonMachine(budget=50, budget_bucket=64).signature()
+    assert a != b
+    # budget == longest token and budget > bucket must NOT share a mask:
+    # b'1'*63 + b',' is refused at budget 64 but admitted at budget 70.
+    m64 = JsonMachine(budget=64, budget_bucket=64)
+    m70 = JsonMachine(budget=70, budget_bucket=64)
+    assert m64.signature() != m70.signature()
+    tok = b"1" * 63 + b","
+    admit = []
+    for mm in (m64, m70):
+        for byte in b"[":
+            assert mm.advance(byte)
+        ok = all(mm.advance(byte) for byte in tok)
+        admit.append(ok or not mm.dead)
+    # (divergent admissibility is fine — the signatures differ, so the
+    # mask cache never conflates them)
+    # _AnyFrame plumbs the provider's max_token_bytes through.
+    from runbookai_tpu.model.schema_guided import _AnyFrame
+
+    fr = _AnyFrame(budget=100, budget_bucket=48)
+    assert fr.m.budget_bucket == 49
+    assert fr.copy().m.budget_bucket == 49
