@@ -36,8 +36,11 @@ def main():
     wq = quantize_tensor(w)
     wq = {"q": wq["q"], "s": wq["s"]}
 
+    from runbookai_tpu.ops.qmm_pallas import qmm_pallas, qmm_pallas_eligible
+
     bf16_mm = jax.jit(lambda x, w: x @ w)
     q_mm = jax.jit(qmm)
+    interp = jax.default_backend() == "cpu"
 
     for b in (8, 16, 32):
         x = jax.random.normal(key, (b, d_in), jnp.bfloat16)
@@ -45,9 +48,14 @@ def main():
         t_q = timeit(q_mm, x, wq)
         bytes_bf = d_in * d_out * 2
         bytes_q = d_in * d_out * 1
+        assert qmm_pallas_eligible(b, d_in, d_out)
+        t_p = timeit(lambda x, q, s: qmm_pallas(x, q, s, interpret=interp),
+                     x, wq["q"], wq["s"].reshape(1, d_out),
+                     iters=5 if interp else 50)
         print(f"b={b:3d}  bf16 {t_bf*1e3:7.3f} ms ({bytes_bf/t_bf/1e9:6.1f} GB/s)"
-              f"   int8 {t_q*1e3:7.3f} ms ({bytes_q/t_q/1e9:6.1f} GB/s eff)"
-              f"   speedup {t_bf/t_q:4.2f}x")
+              f"   int8-xla {t_q*1e3:7.3f} ms ({bytes_q/t_q/1e9:6.1f} GB/s eff)"
+              f"   int8-pallas {t_p*1e3:7.3f} ms ({bytes_q/t_p/1e9:6.1f} GB/s eff)"
+              f"   pallas-vs-bf16 {t_bf/t_p:4.2f}x")
 
     # Scan-stacked variant: weights indexed per layer inside lax.scan, the
     # exact access pattern of the decode forward.
@@ -55,20 +63,25 @@ def main():
     wq_l = {"q": jnp.broadcast_to(wq["q"], (L,) + wq["q"].shape),
             "s": jnp.broadcast_to(wq["s"], (L,) + wq["s"].shape)}
 
-    @jax.jit
-    def scan_qmm(x, wq_l):
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("impl",))
+    def scan_qmm(x, wq_l, impl="xla"):
         def step(h, lw):
             # Feed the matmul back into the carry so the dot stays live
             # (a *0 trick would let XLA dead-code-eliminate the compute).
-            out = qmm(h, {"q": lw["q"], "s": lw["s"]})
+            out = qmm(h, {"q": lw["q"], "s": lw["s"]}, impl=impl)
             return h + 1e-6 * out[:, :h.shape[1]], None
         h, _ = jax.lax.scan(step, x, wq_l)
         return h
 
     x = jax.random.normal(key, (8, d_in), jnp.bfloat16)
-    t = timeit(scan_qmm, x, wq_l, iters=20)
-    print(f"scan({L} layers) int8  {t*1e3:7.3f} ms "
-          f"({L*bytes_q/t/1e9:6.1f} GB/s eff)")
+    for impl in ("xla", "pallas"):
+        iters = 20 if not (interp and impl == "pallas") else 2
+        t = timeit(lambda a, b: scan_qmm(a, b, impl=impl), x, wq_l,
+                   iters=iters)
+        print(f"scan({L} layers) int8-{impl:6s}  {t*1e3:7.3f} ms "
+              f"({L*bytes_q/t/1e9:6.1f} GB/s eff)")
 
 
 if __name__ == "__main__":

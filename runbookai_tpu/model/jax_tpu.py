@@ -131,6 +131,14 @@ class JaxTpuClient(BaseLLMClient):
                        else ("pallas"
                              if jax.default_backend() in ("tpu", "axon")
                              else "xla")),
+            # The Pallas quantized matmul streams int8 weight tiles (half
+            # the bf16 HBM bytes, the decode bound) — on-TPU default for
+            # int8 weights; meaningless for unquantized ones.
+            qmm_impl=(llm_cfg.qmm_impl if llm_cfg.qmm_impl != "auto"
+                      else ("pallas"
+                            if quantize and jax.default_backend()
+                            in ("tpu", "axon")
+                            else "xla")),
         )
         lora_registry = None
         if getattr(llm_cfg, "lora_adapters", None):

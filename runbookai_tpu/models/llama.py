@@ -15,6 +15,7 @@ Design (TPU-first, no reference counterpart — RunbookAI calls hosted APIs):
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Optional
@@ -288,7 +289,7 @@ def init_params_quantized(key: jax.Array, cfg: LlamaConfig,
     return _build_params(key, cfg, dtype, qdense)
 
 
-def qmm(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+def qmm(x: jnp.ndarray, w: Any, impl: str = "xla") -> jnp.ndarray:
     """Matmul that accepts int8 weight-only quantized weights.
 
     Quantized leaves are ``{"q": int8 [.., in, out], "s": f32 [.., 1, out]}``
@@ -296,13 +297,35 @@ def qmm(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     activation dtype (int8→bf16 cast is exact) and the per-output-channel
     scale applies to the result — identical math to dequantize-first, since
     the scale is constant along the contraction.
+
+    ``impl="pallas"`` streams the int8 tiles through the Pallas kernel
+    (:mod:`runbookai_tpu.ops.qmm_pallas`) at decode/verify shapes — the
+    convert happens in-register, so HBM moves half the bf16 bytes by
+    construction instead of by fusion luck. Shapes the kernel does not
+    cover (chunked prefill M, ragged dims, unquantized leaves) fall back
+    to the XLA expression below, same math.
     """
     if isinstance(w, dict):
+        if impl == "pallas" and w["q"].ndim == 2:
+            from runbookai_tpu.ops.qmm_pallas import (
+                qmm_pallas,
+                qmm_pallas_eligible,
+            )
+
+            lead = x.shape[:-1]
+            k_dim, n = w["q"].shape
+            if qmm_pallas_eligible(math.prod(lead), k_dim, n):
+                out = qmm_pallas(
+                    x.reshape(-1, k_dim), w["q"], w["s"].reshape(1, n),
+                    interpret=jax.default_backend() == "cpu",
+                )
+                return out.reshape(*lead, n)
         return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
     return x @ w
 
 
-def ffn_block(y: jnp.ndarray, lp: dict, cfg: LlamaConfig) -> jnp.ndarray:
+def ffn_block(y: jnp.ndarray, lp: dict, cfg: LlamaConfig,
+              qmm_impl: str = "xla") -> jnp.ndarray:
     """SwiGLU FFN (dense) or Mixtral MoE, by config — shared by the paged
     serving forward, the dense training forward, and the pipeline stages.
     Residual is added by the caller."""
@@ -311,8 +334,9 @@ def ffn_block(y: jnp.ndarray, lp: dict, cfg: LlamaConfig) -> jnp.ndarray:
 
         return moe_ffn(y, lp["router"], lp["w_gate"], lp["w_up"],
                        lp["w_down"], cfg.top_k_experts, cfg.capacity_factor)
-    return qmm(jax.nn.silu(qmm(y, lp["w_gate"])) * qmm(y, lp["w_up"]),
-               lp["w_down"])
+    mm = partial(qmm, impl=qmm_impl)
+    return mm(jax.nn.silu(mm(y, lp["w_gate"])) * mm(y, lp["w_up"]),
+              lp["w_down"])
 
 
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
@@ -335,6 +359,7 @@ def forward_impl(
     attn_impl: str = "xla",
     mesh=None,
     adapter_ids: Optional[jnp.ndarray] = None,  # [B] int32 LoRA rows
+    qmm_impl: str = "xla",
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward chunk. Returns (logits [B, T, vocab] f32, kv_k', kv_v').
 
@@ -356,10 +381,21 @@ def forward_impl(
     if lora is not None:
         from runbookai_tpu.models.lora import apply_lora  # deferred: cycle
 
+    # The Pallas qmm runs per-device code; under a TP mesh the layer
+    # matmuls are partitioned by XLA SPMD (sharding annotations, not
+    # shard_map), so the kernel path is single-model-shard only. DP-only
+    # meshes keep it: the weights are replicated per device.
+    if qmm_impl == "pallas" and mesh is not None:
+        from runbookai_tpu.parallel.mesh import MODEL_AXIS
+
+        if mesh.shape.get(MODEL_AXIS, 1) > 1:
+            qmm_impl = "xla"
+    mm = partial(qmm, impl=qmm_impl)
+
     def layer_step(hidden, layer_in):
         lp, lp_lora, k_pages, v_pages = layer_in
         x = rms_norm(hidden, lp["attn_norm"], cfg.norm_eps)
-        q, k, v = qmm(x, lp["wq"]), qmm(x, lp["wk"]), qmm(x, lp["wv"])
+        q, k, v = mm(x, lp["wq"]), mm(x, lp["wk"]), mm(x, lp["wv"])
         if lp_lora is not None:
             q = q + apply_lora(x, lp_lora, "wq", adapter_ids)
             k = k + apply_lora(x, lp_lora, "wk", adapter_ids)
@@ -431,13 +467,13 @@ def forward_impl(
                 page_size=page_size, block_pages=block_pages,
             )
         ctx = attn.reshape(b, t, cfg.n_heads * hd)
-        o = qmm(ctx, lp["wo"])
+        o = mm(ctx, lp["wo"])
         if lp_lora is not None:
             o = o + apply_lora(ctx, lp_lora, "wo", adapter_ids)
         hidden = hidden + o
 
         y = rms_norm(hidden, lp["mlp_norm"], cfg.norm_eps)
-        hidden = hidden + ffn_block(y, lp, cfg)
+        hidden = hidden + ffn_block(y, lp, cfg, qmm_impl=qmm_impl)
         return hidden, (k_pages, v_pages)
 
     h, (kv_k_new, kv_v_new) = jax.lax.scan(
@@ -450,7 +486,8 @@ def forward_impl(
 
 
 forward = partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages",
-                                            "attn_impl", "mesh"))(forward_impl)
+                                            "attn_impl", "mesh",
+                                            "qmm_impl"))(forward_impl)
 
 
 def dense_causal_attention(cfg: LlamaConfig, b: int, t: int):

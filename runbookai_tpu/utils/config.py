@@ -79,6 +79,10 @@ class LLMConfig(BaseModel):
     # TPU and the XLA gather path elsewhere; explicit values override (e.g.
     # force "xla" when debugging a Mosaic issue on hardware).
     attn_impl: Literal["auto", "pallas", "xla"] = "auto"
+    # Quantized-matmul implementation (int8 weights only): "pallas" streams
+    # int8 tiles through ops/qmm_pallas.py — structural half-bytes on the
+    # decode weight reads; "auto" picks it on TPU for int8 weights.
+    qmm_impl: Literal["auto", "pallas", "xla"] = "auto"
     # KV cache precision: "auto" follows the activation dtype (bf16);
     # "fp8" (float8_e4m3) halves pool bytes — double the pooled tokens
     # per chip — at ~1e-2 relative K/V error.

@@ -170,3 +170,81 @@ def test_70b_int8_tp8_memory_plan_fits_v5e():
     budget = hbm - weights_per_chip - 1.5 * 1024**3     # runtime headroom
     tokens = budget / kv_per_token
     assert tokens > 80_000  # >80k pooled tokens/chip, e.g. 10 x 8k contexts
+
+
+# --------------------------------------------------------------------- #
+# Pallas quantized matmul (ops/qmm_pallas.py)                           #
+# --------------------------------------------------------------------- #
+
+
+def test_qmm_pallas_kernel_matches_xla_expression():
+    """The streamed-int8 kernel computes exactly (x @ q) * s."""
+    from runbookai_tpu.ops.qmm_pallas import qmm_pallas, qmm_pallas_eligible
+
+    key = jax.random.PRNGKey(0)
+    for m, k, n in [(8, 512, 1024), (3, 256, 512), (32, 1024, 1536),
+                    (13, 96, 128)]:
+        assert qmm_pallas_eligible(m, k, n)
+        w = jax.random.normal(key, (k, n), jnp.float32) / k**0.5
+        wq = quantize_tensor(w)
+        x = jax.random.normal(jax.random.PRNGKey(1), (m, k), jnp.float32)
+        ref = (x @ wq["q"].astype(x.dtype)) * wq["s"].astype(x.dtype)
+        got = qmm_pallas(x, wq["q"], wq["s"].reshape(1, n), interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_qmm_pallas_eligibility_boundaries():
+    from runbookai_tpu.ops.qmm_pallas import MAX_PALLAS_M, qmm_pallas_eligible
+
+    assert qmm_pallas_eligible(1, 32, 128)
+    assert not qmm_pallas_eligible(1, 33, 128)  # K not tileable
+    assert not qmm_pallas_eligible(1, 32, 64)  # N below one lane tile
+    assert not qmm_pallas_eligible(MAX_PALLAS_M + 1, 4096, 14336)  # prefill M
+
+
+def test_qmm_dispatch_uses_kernel_only_when_eligible():
+    """qmm(impl='pallas') must route eligible decode shapes through the
+    kernel and silently keep the XLA expression elsewhere — same math."""
+    from runbookai_tpu.models.llama import qmm
+
+    key = jax.random.PRNGKey(2)
+    # Eligible: [B, T, K] @ [K, N] with N % 128 == 0.
+    w = quantize_tensor(jax.random.normal(key, (256, 512), jnp.float32))
+    x = jax.random.normal(key, (4, 2, 256), jnp.float32)
+    a = qmm(x, w, impl="pallas")
+    b = qmm(x, w, impl="xla")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=2e-5)
+    # Ineligible (N=64): must still be correct via fallback.
+    w2 = quantize_tensor(jax.random.normal(key, (256, 64), jnp.float32))
+    np.testing.assert_allclose(np.asarray(qmm(x, w2, impl="pallas")),
+                               np.asarray(qmm(x, w2, impl="xla")),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_engine_decode_matches_across_qmm_impls():
+    """Greedy engine decode with qmm_impl='pallas' reproduces the XLA
+    path's tokens on a config whose projections are kernel-eligible."""
+    from runbookai_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(name="qmm-test", vocab_size=262, dim=128, n_layers=2,
+                      n_heads=4, n_kv_heads=2, ffn_dim=256, max_seq_len=512,
+                      rope_theta=10_000.0)
+    tok = ByteTokenizer()
+    params = quantize_params(init_params(jax.random.PRNGKey(3), cfg,
+                                         dtype=jnp.float32))
+    prompt = tok.encode("paged attention decode parity")
+    outs = {}
+    for impl in ("xla", "pallas"):
+        core = EngineCore(cfg, params, tok, EngineConfig(
+            page_size=4, num_pages=64, max_batch_slots=2, prefill_chunk=16,
+            max_seq_len=256, kv_dtype=jnp.float32, speculative=False,
+            qmm_impl=impl))
+        req = EngineRequest(prompt_ids=list(prompt),
+                            sampling=SamplingParams(max_new_tokens=8,
+                                                    stop_token_ids=()))
+        core.submit(req)
+        core.run_until_idle()
+        outs[impl] = req.out_ids
+    assert outs["pallas"] == outs["xla"], outs
