@@ -298,11 +298,15 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     # pool next to 8.5GB of weights). Uses the device's reported bytes_limit
     # when available, else the v5e 16GB spec sheet.
     page_size = 16
+    # BENCH_KV=fp8 halves page bytes (doubles pooled tokens) and now keeps
+    # the Pallas attention path (engine probe-gates the combination).
+    kv_dtype = (jnp.float8_e4m3fn if os.environ.get("BENCH_KV") == "fp8"
+                else dtype)
     if on_accel:
         from runbookai_tpu.models.quant import weight_bytes
 
         page_bytes = (page_size * cfg.n_layers * 2 * cfg.n_kv_heads
-                      * cfg.head_dim * jnp.dtype(dtype).itemsize)
+                      * cfg.head_dim * jnp.dtype(kv_dtype).itemsize)
         try:
             hbm = jax.devices()[0].memory_stats()["bytes_limit"]
         except Exception:  # noqa: BLE001 — plugin may not expose stats
@@ -313,8 +317,13 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
             num_pages = fit
     ecfg = EngineConfig(
         page_size=page_size, num_pages=num_pages, max_batch_slots=slots,
-        prefill_chunk=128, max_seq_len=2048, kv_dtype=dtype, block_pages=16,
+        prefill_chunk=128, max_seq_len=2048, kv_dtype=kv_dtype, block_pages=16,
         attn_impl=os.environ.get("BENCH_ATTN", "pallas" if on_accel else "xla"),
+        # Streamed-int8 matmul kernel (ops/qmm_pallas.py): the decode
+        # bound is weight bytes/step; this makes the halved byte count
+        # structural instead of an XLA fusion gamble.
+        qmm_impl=os.environ.get(
+            "BENCH_QMM", "pallas" if (on_accel and quantized) else "xla"),
         # Batch all concurrent prompts' prefill chunks into one dispatch so
         # TTFT stays ~flat under load (p50_ttft_ms in details tracks this).
         prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH", slots)),
@@ -370,7 +379,11 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         "platform": probe.get("platform"),
         "device_kind": probe.get("kind"),
         "devices": probe.get("n"),
-        "attn_impl": ecfg.attn_impl,
+        # Report the CORE's resolved config, not the caller's: the engine
+        # probe-gates pallas kernels and may have downgraded either impl.
+        "attn_impl": core.ecfg.attn_impl,
+        "qmm_impl": core.ecfg.qmm_impl,
+        "kv_dtype": str(jnp.dtype(kv_dtype).name),
         "requests": n_requests,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,

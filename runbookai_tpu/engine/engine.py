@@ -29,6 +29,7 @@ Static-shape tricks:
 
 from __future__ import annotations
 
+import functools
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -196,6 +197,89 @@ def _prefill_step(
     return logits[rows, last_idx], kv_k, kv_v
 
 
+@functools.lru_cache(maxsize=8)
+def _probe_pallas_fp8_cached(backend: str, n_kv: int, n_q: int,
+                             head_dim: int, page_size: int,
+                             kv_dtype_name: str, act_dtype_name: str) -> bool:
+    """Tiny compiles of BOTH attention kernels at the engine's real
+    grouping/dtypes prove (or disprove) Mosaic support for the sub-byte
+    KV load before real traffic hits it. Representative matters: serving
+    dispatches the chunk kernel first (prefill, t>1) and then decode with
+    the model's true GQA group and activation dtype — a probe narrower
+    than that can pass while the first real dispatch crashes. Cached per
+    process — tests build many engines."""
+    try:
+        from runbookai_tpu.ops.paged_attention_pallas import (
+            paged_chunk_attention,
+            paged_decode_attention,
+        )
+
+        kv_dtype = jnp.dtype(kv_dtype_name)
+        act_dtype = jnp.dtype(act_dtype_name)
+        interp = backend == "cpu"
+        kv = jnp.zeros((2 * page_size, n_kv, head_dim), kv_dtype)
+        tables = jnp.zeros((1, 2), jnp.int32)
+
+        q1 = jnp.zeros((1, n_q, head_dim), act_dtype)
+        out = paged_decode_attention(q1, kv, kv, tables,
+                                     jnp.ones((1,), jnp.int32),
+                                     page_size=page_size, interpret=interp)
+        jax.block_until_ready(out)
+
+        t = 4
+        qt = jnp.zeros((1, t, n_q, head_dim), act_dtype)
+        positions = jnp.arange(t, dtype=jnp.int32)[None]
+        out = paged_chunk_attention(qt, kv, kv, tables,
+                                    jnp.full((1,), t, jnp.int32), positions,
+                                    page_size=page_size, interpret=interp)
+        jax.block_until_ready(out)
+        return True
+    except Exception:  # noqa: BLE001 — any Mosaic/lowering failure
+        return False
+
+
+def _probe_pallas_fp8(model_cfg, ecfg, act_dtype) -> bool:
+    return _probe_pallas_fp8_cached(jax.default_backend(),
+                                    model_cfg.n_kv_heads,
+                                    model_cfg.n_heads,
+                                    model_cfg.head_dim, ecfg.page_size,
+                                    jnp.dtype(ecfg.kv_dtype).name,
+                                    jnp.dtype(act_dtype).name)
+
+
+@functools.lru_cache(maxsize=8)
+def _probe_qmm_pallas_cached(backend: str, m: int, k: int, n: int,
+                             act_dtype_name: str) -> bool:
+    """One compile of the int8 qmm kernel at the model's real (K, N)
+    proves the Mosaic int8 widen+dot lowering before serving relies on
+    it. One shape is representative: the lowering concern is the int8
+    load/convert pattern, not a particular multiple-of-128 tile count."""
+    try:
+        from runbookai_tpu.ops.qmm_pallas import qmm_pallas
+
+        x = jnp.zeros((m, k), jnp.dtype(act_dtype_name))
+        q = jnp.zeros((k, n), jnp.int8)
+        s = jnp.zeros((1, n), jnp.float32)
+        jax.block_until_ready(
+            qmm_pallas(x, q, s, interpret=backend == "cpu"))
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _probe_qmm_pallas(model_cfg, ecfg, act_dtype) -> bool:
+    from runbookai_tpu.ops.qmm_pallas import qmm_pallas_eligible
+
+    m = ecfg.max_batch_slots
+    k, n = model_cfg.dim, model_cfg.ffn_dim
+    if not qmm_pallas_eligible(m, k, n):
+        # The kernel would never engage on this model's main matmuls —
+        # qmm falls back per-shape, so there is nothing to probe.
+        return True
+    return _probe_qmm_pallas_cached(jax.default_backend(), m, k, n,
+                                    jnp.dtype(act_dtype).name)
+
+
 class EngineCore:
     """Synchronous stepping core. Drive with :meth:`step` until idle."""
 
@@ -230,19 +314,40 @@ class EngineCore:
         self.mask_fn = mask_fn
         self.advance_fn = advance_fn
         # fp8 KV halves pool bytes (double the pooled tokens per chip) at
-        # ~1e-2 relative K/V error; the Pallas kernels are unproven under
-        # Mosaic with fp8 refs, so that combination downgrades to the XLA
-        # gather path until measured on hardware. The caller's config is
-        # copied, not mutated, and the downgrade is logged.
+        # ~1e-2 relative K/V error. The Pallas kernels read fp8 pages
+        # directly (widened in-VMEM on load); on accelerator backends a
+        # tiny probe compile proves Mosaic accepts the fp8 convert before
+        # the first real dispatch — an actual failure downgrades to the
+        # XLA gather path with a warning instead of crashing serving. The
+        # caller's config is copied, not mutated.
+        act_dtype = self.params["embed"].dtype
         if (jnp.dtype(self.ecfg.kv_dtype).itemsize == 1
-                and self.ecfg.attn_impl == "pallas"):
+                and self.ecfg.attn_impl == "pallas"
+                and not _probe_pallas_fp8(model_cfg, self.ecfg, act_dtype)):
             import dataclasses as _dc
             import logging
 
             logging.getLogger(__name__).warning(
-                "fp8 KV cache: serving via the XLA attention path "
-                "(pallas+fp8 unproven under Mosaic)")
+                "fp8 KV cache: Mosaic rejected the fp8 Pallas attention "
+                "probe on this backend; serving via the XLA gather path")
             self.ecfg = _dc.replace(self.ecfg, attn_impl="xla")
+        # Same guard for the int8 qmm kernel: a Mosaic rejection downgrades
+        # to the mathematically identical XLA expression instead of
+        # crashing the first dispatch.
+        if self.ecfg.qmm_impl == "pallas":
+            from runbookai_tpu.models.quant import is_quantized
+
+            has_q = any(is_quantized(v)
+                        for v in self.params["layers"].values())
+            if has_q and not _probe_qmm_pallas(model_cfg, self.ecfg,
+                                               act_dtype):
+                import dataclasses as _dc
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "int8 weights: Mosaic rejected the Pallas qmm probe "
+                    "on this backend; using the XLA matmul expression")
+                self.ecfg = _dc.replace(self.ecfg, qmm_impl="xla")
 
         # Sharded serving: with a mesh, the KV pool shards its kv-head axis
         # over the TP (``model``) axis alongside the Megatron param shardings

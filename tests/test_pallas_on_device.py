@@ -232,3 +232,89 @@ def test_lora_engine_on_device():
     base = run(None, use_reg=False)
     assert run(None, use_reg=True) == base   # zero adapter exactness
     assert run("tuned", use_reg=True) != base
+
+
+@pytest.mark.parametrize("ctx_lens", [[PS * 2 + 5], [1, PS * 4 - 1, PS]])
+def test_decode_kernel_fp8_kv_on_device(ctx_lens):
+    """Mosaic compiles the decode kernel with fp8 K/V refs (the in-VMEM
+    widen) and matches the XLA gather path on the same fp8 pool."""
+    rng = np.random.default_rng(2)
+    n_kv, group, hd = 2, 2, 128
+    b = len(ctx_lens)
+    k_flat, v_flat = _pool(rng, num_pages=32, n_kv=n_kv, hd=hd,
+                           dtype=jnp.float8_e4m3fn)
+    tables = _tables(ctx_lens, max_pages=8)
+    ctx = jnp.asarray(ctx_lens, jnp.int32)
+    q = jnp.asarray(rng.normal(size=(b, n_kv * group, hd)), jnp.bfloat16)
+
+    got = paged_decode_attention(q, k_flat, v_flat, tables, ctx,
+                                 page_size=PS, interpret=False)
+    want = paged_attention(q[:, None], k_flat, v_flat, tables, ctx,
+                           (ctx - 1)[:, None], page_size=PS)[:, 0]
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_chunk_kernel_fp8_kv_on_device():
+    rng = np.random.default_rng(3)
+    n_kv, group, hd, t = 2, 2, 128, 8
+    ctx_lens = [PS * 2 + 8, PS + 5]
+    b = len(ctx_lens)
+    k_flat, v_flat = _pool(rng, num_pages=32, n_kv=n_kv, hd=hd,
+                           dtype=jnp.float8_e4m3fn)
+    tables = _tables(ctx_lens, max_pages=8)
+    ctx = jnp.asarray(ctx_lens, jnp.int32)
+    positions = jnp.stack(
+        [jnp.arange(c - t, c, dtype=jnp.int32) for c in ctx_lens])
+    q = jnp.asarray(rng.normal(size=(b, t, n_kv * group, hd)), jnp.bfloat16)
+
+    got = paged_chunk_attention(q, k_flat, v_flat, tables, ctx, positions,
+                                page_size=PS, interpret=False, q_block=4)
+    want = paged_attention(q, k_flat, v_flat, tables, ctx, positions,
+                           page_size=PS)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_qmm_pallas_kernel_on_device():
+    """Mosaic compiles the int8 qmm kernel and matches the XLA expression
+    at a decode shape (the r4 dequant-fusion lever)."""
+    from runbookai_tpu.models.quant import quantize_tensor
+    from runbookai_tpu.ops.qmm_pallas import qmm_pallas
+
+    key = jax.random.PRNGKey(0)
+    m, k, n = 8, 4096, 4096
+    w = jax.random.normal(key, (k, n), jnp.float32) / k**0.5
+    wq = quantize_tensor(w)
+    x = jax.random.normal(jax.random.PRNGKey(1), (m, k), jnp.bfloat16)
+    ref = (x @ wq["q"].astype(x.dtype)) * wq["s"].astype(x.dtype)
+    got = qmm_pallas(x, wq["q"], wq["s"].reshape(1, n), interpret=False)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=5e-2, rtol=5e-2)
+
+
+def test_fp8_engine_pallas_on_device():
+    """Serving engine with fp8 KV + Pallas attention end-to-end on chip:
+    the init probe must keep the kernel path and decode must complete."""
+    from runbookai_tpu.engine.engine import EngineConfig, EngineCore
+    from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+    from runbookai_tpu.models.llama import CONFIGS, init_params
+    from runbookai_tpu.utils.tokens import ByteTokenizer
+
+    cfg = CONFIGS["llama3-test"]
+    tok = ByteTokenizer()
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.bfloat16)
+    core = EngineCore(cfg, params, tok, EngineConfig(
+        page_size=16, num_pages=64, max_batch_slots=2, prefill_chunk=16,
+        max_seq_len=128, kv_dtype=jnp.float8_e4m3fn, attn_impl="pallas",
+        speculative=False))
+    assert core.ecfg.attn_impl == "pallas", "probe downgraded on device"
+    req = EngineRequest(prompt_ids=tok.encode("fp8 on device"),
+                        sampling=SamplingParams(max_new_tokens=8,
+                                                stop_token_ids=()))
+    core.submit(req)
+    core.run_until_idle()
+    assert len(req.out_ids) == 8
