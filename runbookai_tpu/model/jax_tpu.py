@@ -97,10 +97,21 @@ class JaxTpuClient(BaseLLMClient):
         dtype = jnp.float32 if llm_cfg.dtype == "float32" else jnp.bfloat16
         if llm_cfg.mesh.device_count > 1:
             from runbookai_tpu.models.llama import CONFIGS
+            from runbookai_tpu.parallel.kv_split import plan_kv_split
             from runbookai_tpu.parallel.mesh import build_mesh
             from runbookai_tpu.parallel.sharding import param_shardings
 
-            mesh = build_mesh(llm_cfg.mesh.data, llm_cfg.mesh.model)
+            # KV layout planning: tp past the GQA head count factors onto
+            # (model=kv_shards, seq=pg_shards) so the page pool shards by
+            # the FULL tp (parallel/kv_split.py) instead of replicating.
+            plan = (plan_kv_split(CONFIGS[llm_cfg.model],
+                                  llm_cfg.mesh.model)
+                    if llm_cfg.model in CONFIGS else None)
+            if plan is not None and plan.split:
+                mesh = build_mesh(llm_cfg.mesh.data, model=plan.kv_shards,
+                                  seq=plan.pg_shards)
+            else:
+                mesh = build_mesh(llm_cfg.mesh.data, llm_cfg.mesh.model)
             if model_cfg_name in CONFIGS:
                 shardings = param_shardings(CONFIGS[model_cfg_name], mesh)
                 if quantize:

@@ -381,6 +381,16 @@ def forward_impl(
     if lora is not None:
         from runbookai_tpu.models.lora import apply_lora  # deferred: cycle
 
+    # KV page-split serving (parallel/kv_split.py): a serving mesh with a
+    # seq axis shards the page pool's token axis past the GQA head count;
+    # page writes and attention then run as shard_map with a flash-partial
+    # merge across the seq axis.
+    kv_split_active = False
+    if mesh is not None:
+        from runbookai_tpu.parallel.mesh import SEQ_AXIS
+
+        kv_split_active = mesh.shape.get(SEQ_AXIS, 1) > 1
+
     # The Pallas qmm runs per-device code; under a TP mesh the layer
     # matmuls are partitioned by XLA SPMD (sharding annotations, not
     # shard_map), so the kernel path is single-model-shard only. DP-only
@@ -388,7 +398,7 @@ def forward_impl(
     if qmm_impl == "pallas" and mesh is not None:
         from runbookai_tpu.parallel.mesh import MODEL_AXIS
 
-        if mesh.shape.get(MODEL_AXIS, 1) > 1:
+        if mesh.shape.get(MODEL_AXIS, 1) > 1 or kv_split_active:
             qmm_impl = "xla"
     mm = partial(qmm, impl=qmm_impl)
 
@@ -411,12 +421,22 @@ def forward_impl(
         # Scatter the whole batch's K/V into the page pool in one scatter
         # (program size stays flat as max_batch_slots grows; disjoint page
         # ownership makes flattened destinations collision-free).
-        k_pages = write_kv_pages_batch(k_pages, k, positions, page_tables,
-                                       page_size)
-        v_pages = write_kv_pages_batch(v_pages, v, positions, page_tables,
-                                       page_size)
+        if kv_split_active:
+            from runbookai_tpu.parallel.kv_split import (
+                write_kv_pages_batch_kv_split,
+            )
 
-        use_pallas = attn_impl == "pallas"
+            k_pages = write_kv_pages_batch_kv_split(
+                mesh, k_pages, k, positions, page_tables, page_size)
+            v_pages = write_kv_pages_batch_kv_split(
+                mesh, v_pages, v, positions, page_tables, page_size)
+        else:
+            k_pages = write_kv_pages_batch(k_pages, k, positions,
+                                           page_tables, page_size)
+            v_pages = write_kv_pages_batch(v_pages, v, positions,
+                                           page_tables, page_size)
+
+        use_pallas = attn_impl == "pallas" and not kv_split_active
         shardable = False
         if use_pallas and mesh is not None:
             from runbookai_tpu.ops.paged_attention_pallas import tp_shardable
@@ -461,6 +481,14 @@ def forward_impl(
                     q, k_pages, v_pages, page_tables, ctx_lens, positions,
                     page_size=page_size, interpret=interp,
                 )
+        elif kv_split_active:
+            from runbookai_tpu.parallel.kv_split import (
+                paged_attention_kv_split,
+            )
+
+            attn = paged_attention_kv_split(
+                mesh, q, k_pages, v_pages, page_tables, ctx_lens,
+                positions, page_size=page_size, block_pages=block_pages)
         else:
             attn = paged_attention(
                 q, k_pages, v_pages, page_tables, ctx_lens, positions,

@@ -29,28 +29,40 @@ from runbookai_tpu.parallel.mesh import MODEL_AXIS
 
 
 def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> dict[str, Any]:
-    """Pytree of NamedShardings matching ``init_params`` structure."""
+    """Pytree of NamedShardings matching ``init_params`` structure.
+
+    With a KV page-split serving mesh (``seq`` axis > 1 —
+    ``parallel/kv_split.py``), the full tp factor is ``model × seq``:
+    column/row-parallel leaves shard over the combined tuple axis
+    (model-major, so query heads stay adjacent to their GQA kv head),
+    while ``wk``/``wv`` shard over ``model`` only — every page shard of a
+    kv group needs that group's K/V projections.
+    """
+    from runbookai_tpu.parallel.mesh import SEQ_AXIS
 
     def ns(*spec) -> NamedSharding:
         return NamedSharding(mesh, P(*spec))
 
-    tp = mesh.shape.get(MODEL_AXIS, 1)
+    pg = mesh.shape.get(SEQ_AXIS, 1)
+    kv_sh = mesh.shape.get(MODEL_AXIS, 1)
+    tp = kv_sh * pg
+    TP_AXES = (MODEL_AXIS, SEQ_AXIS) if pg > 1 else MODEL_AXIS
     vocab_ok = cfg.vocab_size % tp == 0
     heads_ok = cfg.n_heads % tp == 0
     ffn_ok = cfg.ffn_dim % tp == 0
-    kv_ok = cfg.n_kv_heads % tp == 0
+    kv_ok = cfg.n_kv_heads % kv_sh == 0
 
-    col = ns(None, None, MODEL_AXIS) if heads_ok else ns()
+    col = ns(None, None, TP_AXES) if heads_ok else ns()
     shardings: dict[str, Any] = {
-        "embed": ns(MODEL_AXIS, None) if vocab_ok else ns(),
+        "embed": ns(TP_AXES, None) if vocab_ok else ns(),
         "layers": {
             "wq": col,
             "wk": ns(None, None, MODEL_AXIS) if kv_ok else ns(),
             "wv": ns(None, None, MODEL_AXIS) if kv_ok else ns(),
-            "wo": ns(None, MODEL_AXIS, None) if heads_ok else ns(),
-            "w_gate": ns(None, None, MODEL_AXIS) if ffn_ok else ns(),
-            "w_up": ns(None, None, MODEL_AXIS) if ffn_ok else ns(),
-            "w_down": ns(None, MODEL_AXIS, None) if ffn_ok else ns(),
+            "wo": ns(None, TP_AXES, None) if heads_ok else ns(),
+            "w_gate": ns(None, None, TP_AXES) if ffn_ok else ns(),
+            "w_up": ns(None, None, TP_AXES) if ffn_ok else ns(),
+            "w_down": ns(None, TP_AXES, None) if ffn_ok else ns(),
             "attn_norm": ns(),
             "mlp_norm": ns(),
         },
@@ -68,29 +80,33 @@ def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> dict[str, Any]:
     if cfg.qkv_bias:
         # Biases follow their projection's output axis (column-parallel).
         shardings["layers"]["bq"] = (
-            ns(None, MODEL_AXIS) if heads_ok else ns())
+            ns(None, TP_AXES) if heads_ok else ns())
         shardings["layers"]["bk"] = ns(None, MODEL_AXIS) if kv_ok else ns()
         shardings["layers"]["bv"] = ns(None, MODEL_AXIS) if kv_ok else ns()
     if not cfg.tie_embeddings:
-        shardings["lm_head"] = ns(None, MODEL_AXIS) if vocab_ok else ns()
+        shardings["lm_head"] = ns(None, TP_AXES) if vocab_ok else ns()
     return shardings
 
 
 def kv_pool_sharding(cfg: LlamaConfig, mesh: Mesh) -> NamedSharding:
-    tp = mesh.shape.get(MODEL_AXIS, 1)
-    if cfg.n_kv_heads % tp == 0:
-        return NamedSharding(mesh, P(None, None, MODEL_AXIS, None))
-    # GQA with tp > n_kv_heads (e.g. 70B n_kv=8 on TP16): the pool — and
-    # wk/wv — replicate, costing tp× the KV memory. That silently defeats
-    # the TP memory plan, so say so; the supported layout for 70B-on-16 is
-    # tp=8 × dp=2 (int8 weights ≈ 8.75GB/chip + sharded KV). A head×seq 2D
-    # KV mesh is the documented extension path.
-    import warnings
+    """[L, tokens, n_kv, hd] placement for the paged pool.
 
-    warnings.warn(
-        f"KV pool cannot shard: n_kv_heads={cfg.n_kv_heads} not divisible by "
-        f"tp={tp}; replicating the full page pool on every chip. Use tp ≤ "
-        f"{cfg.n_kv_heads} (e.g. tp=8 × dp=2 on a 16-chip slice).",
-        stacklevel=2,
-    )
-    return NamedSharding(mesh, P())
+    Heads shard over ``model``; with a KV page-split mesh the token axis
+    additionally shards over ``seq`` (``parallel/kv_split.py``), so
+    per-chip KV bytes shrink by the FULL tp factor even past the GQA
+    head count — tp16 on 70B (n_kv=8) runs model=8 × seq=2 instead of
+    replicating the pool (the r3 warning path is gone; ``plan_kv_split``
+    decides the factorization and raises on unservable layouts).
+    """
+    from runbookai_tpu.parallel.mesh import SEQ_AXIS
+
+    kv_sh = mesh.shape.get(MODEL_AXIS, 1)
+    pg = mesh.shape.get(SEQ_AXIS, 1)
+    if cfg.n_kv_heads % kv_sh != 0:
+        raise ValueError(
+            f"n_kv_heads={cfg.n_kv_heads} not divisible by the mesh model "
+            f"axis ({kv_sh}); factor the extra parallelism onto the seq "
+            f"axis via parallel.kv_split.plan_kv_split")
+    if pg > 1:
+        return NamedSharding(mesh, P(None, SEQ_AXIS, MODEL_AXIS, None))
+    return NamedSharding(mesh, P(None, None, MODEL_AXIS, None))

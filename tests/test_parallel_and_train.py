@@ -36,7 +36,13 @@ def test_param_shardings_divisibility():
     assert sh4["embed"].spec == jax.sharding.PartitionSpec()
     assert sh4["layers"]["wk"].spec == jax.sharding.PartitionSpec()
     assert "model" in str(sh4["layers"]["wq"].spec)
-    assert kv_pool_sharding(CFG, mesh4).spec == jax.sharding.PartitionSpec()
+    # tp past n_kv_heads on a bare model axis no longer silently
+    # replicates the pool (the r3 warning path): it raises, pointing at
+    # the planned (model × seq) KV page-split layout.
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="plan_kv_split"):
+        kv_pool_sharding(CFG, mesh4)
 
 
 def test_tp_forward_matches_single_device():

@@ -248,3 +248,38 @@ def test_engine_decode_matches_across_qmm_impls():
         core.run_until_idle()
         outs[impl] = req.out_ids
     assert outs["pallas"] == outs["xla"], outs
+
+
+def test_70b_int8_tp16_kv_split_memory_plan():
+    """tp=16 on 70B (past the 8 kv heads) now plans as model=8 × seq=2
+    (parallel/kv_split.py): weights shard 16-way, the KV pool's TOKEN
+    axis picks up the extra factor, and per-chip KV bytes shrink by the
+    FULL tp — the r3 replication warning is gone."""
+    from runbookai_tpu.models.llama import CONFIGS
+    from runbookai_tpu.parallel.kv_split import plan_kv_split
+
+    cfg = CONFIGS["llama3-70b-instruct"]
+    plan = plan_kv_split(cfg, 16)
+    assert (plan.kv_shards, plan.pg_shards) == (8, 2) and plan.split
+
+    hbm = 16 * 1024**3
+    tp = plan.tp
+    layer_matmul = cfg.matmul_params - cfg.dim * cfg.vocab_size
+    # wq/wo/FFN shard 16-way; wk/wv only 8-way (model axis). wk/wv are
+    # 2 * dim * n_kv * hd per layer — a small slice of layer params.
+    wkv = cfg.n_layers * 2 * cfg.dim * cfg.n_kv_heads * cfg.head_dim
+    int8_shard = (layer_matmul - wkv) / tp + wkv / plan.kv_shards
+    scales = layer_matmul / cfg.dim * 4 / tp
+    embed = cfg.vocab_size * cfg.dim * 2 / tp
+    head = cfg.vocab_size * cfg.dim * 2 / tp
+    norms = (cfg.n_layers * 2 + 1) * cfg.dim * 4
+    weights_per_chip = int8_shard + scales + embed + head + norms
+    assert weights_per_chip < 6 * 1024**3  # ~2x headroom vs the tp8 plan
+
+    # KV pool: heads /8 AND tokens /2 -> per-token bytes on a chip halve
+    # relative to the tp8 plan.
+    kv_per_token = (cfg.n_layers * 2 * (cfg.n_kv_heads // plan.kv_shards)
+                    * cfg.head_dim * 2) / plan.pg_shards
+    budget = hbm - weights_per_chip - 1.5 * 1024**3
+    tokens = budget / kv_per_token
+    assert tokens > 200_000  # >200k pooled tokens/chip at tp16

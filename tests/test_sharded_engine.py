@@ -109,3 +109,108 @@ def test_sharded_prefix_cache_reuse(setup):
     b = greedy(core, [shared + tok.encode("q2")], max_new=4)[0]
     assert a.finish_reason is not None and b.finish_reason is not None
     assert core.metrics["cached_prefix_tokens"] > 0
+
+
+# --------------------------------------------------------------------- #
+# KV page-split serving (tp > n_kv_heads — parallel/kv_split.py)        #
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def kvsplit_setup():
+    """llama3-test has n_kv=2, n_heads=4 → tp=4 plans as model=2 × seq=2
+    (group 2, pg_shards 2). Per-chip KV bytes shrink by the FULL tp."""
+    from runbookai_tpu.parallel.kv_split import plan_kv_split
+
+    tok = ByteTokenizer()
+    params = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+    plan = plan_kv_split(CFG, 4)
+    assert (plan.kv_shards, plan.pg_shards) == (2, 2) and plan.split
+    mesh = build_mesh(1, model=plan.kv_shards, seq=plan.pg_shards)
+    sharded = jax.tree.map(jax.device_put, params,
+                           param_shardings(CFG, mesh))
+    return tok, params, mesh, sharded
+
+
+def test_kv_split_pool_shards_by_full_tp(kvsplit_setup):
+    from runbookai_tpu.parallel.mesh import SEQ_AXIS
+
+    tok, params, mesh, sharded = kvsplit_setup
+    core = make_core(tok, sharded, mesh=mesh)
+    spec = core._kv_k.sharding.spec
+    assert spec[1] == SEQ_AXIS and spec[2] == MODEL_AXIS, spec
+    ratio = (core._kv_k.nbytes
+             // core._kv_k.addressable_shards[0].data.nbytes)
+    assert ratio == 4, "per-chip KV bytes must shrink by the full tp"
+
+
+def test_kv_split_engine_matches_unsharded_greedy(kvsplit_setup):
+    """Full continuous-batching cycle on the page-split mesh reproduces
+    the unsharded engine's greedy tokens (r3 VERDICT weak #6)."""
+    tok, params, mesh, sharded = kvsplit_setup
+    prompts = [
+        tok.encode("investigate high latency in checkout"),
+        tok.encode("pods crashlooping in payments namespace"),
+        tok.encode("error rate spike after deploy"),
+    ]
+    ref = greedy(make_core(tok, params), prompts)
+    got = greedy(make_core(tok, sharded, mesh=mesh), prompts)
+    for r, g in zip(ref, got):
+        assert g.out_ids == r.out_ids
+        assert g.finish_reason == r.finish_reason
+
+
+def test_kv_split_plan_boundaries():
+    from runbookai_tpu.parallel.kv_split import plan_kv_split
+
+    class Cfg70B:
+        n_kv_heads = 8
+        n_heads = 64
+
+    p = plan_kv_split(Cfg70B, 16)
+    assert (p.kv_shards, p.pg_shards) == (8, 2) and p.split
+    p8 = plan_kv_split(Cfg70B, 8)
+    assert (p8.kv_shards, p8.pg_shards) == (8, 1) and not p8.split
+    # group=8 caps the page split at 8 → tp 128 ok, beyond raises
+    assert plan_kv_split(Cfg70B, 64).pg_shards == 8
+    with pytest.raises(ValueError):
+        plan_kv_split(Cfg70B, 256)
+
+
+def test_kv_split_write_never_wraps_into_foreign_slots():
+    """Regression (r4 review): a foreign page's destination is NEGATIVE on
+    higher seq shards; .at[].set(mode='drop') drops only OOB-HIGH indices
+    while negative ones wrap Python-style — a write to page 0 must not
+    corrupt shard 1's mirror slot."""
+    import numpy as np
+
+    from runbookai_tpu.ops.attention import write_kv_pages_batch
+    from runbookai_tpu.parallel.kv_split import (
+        write_kv_pages_batch_kv_split,
+    )
+
+    mesh = build_mesh(1, model=2, seq=2)
+    ps, num_pages, n_kv, hd = 4, 8, 2, 8
+    tokens = num_pages * ps
+    pool = jnp.zeros((tokens, n_kv, hd), jnp.float32)
+    new_kv = jnp.ones((1, 2, n_kv, hd), jnp.float32)
+    pos = jnp.asarray([[0, 1]], jnp.int32)
+    tables = jnp.asarray([[1, 0, 0, 0]], jnp.int32)  # page 1 -> shard 0
+    want = write_kv_pages_batch(pool, new_kv, pos, tables, ps)
+    got = write_kv_pages_batch_kv_split(mesh, pool, new_kv, pos, tables, ps)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # The mirror slots on shard 1 (tokens 16+4..) must remain zero.
+    assert float(jnp.abs(got[tokens // 2:]).max()) == 0.0
+
+
+def test_kv_split_rejects_ragged_page_pool():
+    from runbookai_tpu.parallel.kv_split import paged_attention_kv_split
+
+    mesh = build_mesh(1, model=2, seq=2)
+    ps, n_kv, hd = 4, 2, 8
+    k = jnp.zeros((63 * ps, n_kv, hd), jnp.float32)  # 63 pages, pg=2
+    with pytest.raises(ValueError, match="divide"):
+        paged_attention_kv_split(
+            mesh, jnp.zeros((1, 1, 4, hd), jnp.float32), k, k,
+            jnp.zeros((1, 4), jnp.int32), jnp.ones((1,), jnp.int32),
+            jnp.zeros((1, 1), jnp.int32), page_size=ps)
