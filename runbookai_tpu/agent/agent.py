@@ -70,6 +70,7 @@ class Agent:
         cache_size: int = 100,
         tokenizer: Optional[Any] = None,
         context_managers: Optional[list] = None,
+        stream_tokens: bool = True,
     ):
         self.llm = llm
         self.tools = {t.name: t for t in tools}
@@ -87,6 +88,32 @@ class Agent:
         # primed before the loop, re-observed as services/symptoms surface, and
         # injected into every system prompt via their system_prompt_block().
         self.context_managers = list(context_managers or [])
+        # Token streaming (reference streams AgentEvents into a live Ink
+        # tree, src/cli.tsx:116): every LLM call in the loop emits
+        # ``token`` delta events as the model samples, so surfaces paint
+        # text tens of seconds before the full decode lands. Deltas are
+        # the RAW sampled stream (tool-call markup included — it cannot
+        # be parsed out until the document completes); the parsed
+        # response still arrives in the usual answer/tool_call events.
+        self.stream_tokens = stream_tokens and hasattr(llm, "chat_stream")
+
+    async def _chat_events(self, system: str, prompt: str, tools=None):
+        """LLM chat as an event stream: ``token`` AgentEvents per sampled
+        delta, then one ``_response`` AgentEvent carrying the parsed
+        LLMResponse (consumed by :meth:`run`, never surfaced)."""
+        if not self.stream_tokens:
+            resp = await self.llm.chat(system, prompt, tools)
+            yield AgentEvent("_response", {"response": resp})
+            return
+        resp = None
+        async for ev in self.llm.chat_stream(system, prompt, tools):
+            if ev.get("type") == "text":
+                yield AgentEvent("token", {"delta": ev.get("delta", "")})
+            elif ev.get("type") == "done":
+                resp = ev.get("response")
+        if resp is None:  # stream ended without a done event
+            resp = await self.llm.chat(system, prompt, tools)
+        yield AgentEvent("_response", {"response": resp})
 
     # ------------------------------------------------------------------ run
 
@@ -144,7 +171,11 @@ class Agent:
                                   for cm in self.context_managers) if b]
             return build_system_prompt([*(extra_context or []), *blocks])
 
-        # Knowledge-only fast path (reference agent.ts:356-390).
+        # Knowledge-only fast path (reference agent.ts:356-390). This is a
+        # PROBE — the response is discarded when the model answers
+        # KNOWLEDGE_INSUFFICIENT — so it must buffer, not stream: live
+        # deltas would paint the sentinel and an abandoned draft answer
+        # ahead of the real one.
         if knowledge_block and is_procedural_query(query):
             resp = await self.llm.chat(
                 system_prompt(),
@@ -187,7 +218,13 @@ class Agent:
                 yield AgentEvent("phase", {"name": "thinking",
                                            "detail": f"iteration {iteration + 1}"})
 
-            resp = await self.llm.chat(system_prompt(), prompt, tool_schemas)
+            resp = None
+            async for ev in self._chat_events(system_prompt(), prompt,
+                                              tool_schemas):
+                if ev.kind == "_response":
+                    resp = ev.data["response"]
+                else:
+                    yield ev
             if resp.thinking:
                 pad.append_thinking(resp.thinking)
                 memory.observe(resp.thinking)
@@ -271,12 +308,16 @@ class Agent:
 
         if final_text is None:
             # Iteration budget exhausted: one synthesis call without tools.
-            resp = await self.llm.chat(
-                system_prompt(),
-                build_final_answer_prompt(query, pad.build_tiered_context(),
-                                          knowledge_block,
-                                          memory.to_prompt_block()),
-            )
+            resp = None
+            async for ev in self._chat_events(
+                    system_prompt(),
+                    build_final_answer_prompt(query, pad.build_tiered_context(),
+                                              knowledge_block,
+                                              memory.to_prompt_block())):
+                if ev.kind == "_response":
+                    resp = ev.data["response"]
+                else:
+                    yield ev
             final_text = resp.content
 
         if hypotheses and hypotheses.nodes:

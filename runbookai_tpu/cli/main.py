@@ -25,9 +25,22 @@ from runbookai_tpu.utils.config import (
 )
 
 
+_token_line_open = False
+
+
 def _print_event(ev) -> None:
     from runbookai_tpu.demo.runner import render_event
 
+    global _token_line_open
+    if ev.kind == "token":
+        # Live token deltas paint inline (raw model output — tool-call
+        # markup included); the parsed answer still renders afterwards.
+        print(ev.data.get("delta", ""), end="", flush=True)
+        _token_line_open = True
+        return
+    if _token_line_open:
+        print(flush=True)  # close the streamed line before a normal event
+        _token_line_open = False
     print(render_event(ev), flush=True)
 
 

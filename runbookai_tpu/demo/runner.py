@@ -104,4 +104,8 @@ def render_event(ev: AgentEvent) -> str:
         return "\n✔ done"
     if k == "error":
         return f"  ✗ {d}"
+    if k == "token":
+        # Inline-streaming consumers (cli._print_event) never reach here;
+        # line-based consumers get the raw delta without debug wrapping.
+        return d.get("delta", "")
     return f"  [{k}] {d}"
