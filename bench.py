@@ -302,6 +302,19 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     # the Pallas attention path (engine probe-gates the combination).
     kv_dtype = (jnp.float8_e4m3fn if os.environ.get("BENCH_KV") == "fp8"
                 else dtype)
+    # Draft-model weights load BEFORE the page fit so the HBM budget
+    # subtracts them (and the fixed draft pool) — BENCH_DRAFT on a full
+    # chip must shrink the target pool, not OOM.
+    draft_name = os.environ.get("BENCH_DRAFT")
+    dcfg = dparams = None
+    DRAFT_POOL_PAGES = 256
+    if draft_name:
+        dcfg = CONFIGS[draft_name]
+        if on_accel:
+            dparams = init_params_quantized(jax.random.PRNGKey(1), dcfg,
+                                            dtype=dtype)
+        else:
+            dparams = init_params(jax.random.PRNGKey(1), dcfg, dtype=dtype)
     if on_accel:
         from runbookai_tpu.models.quant import weight_bytes
 
@@ -312,6 +325,12 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         except Exception:  # noqa: BLE001 — plugin may not expose stats
             hbm = 16 * 1024**3
         budget = hbm - weight_bytes(params) - int(2.0 * 1024**3)
+        if dparams is not None:
+            draft_page_bytes = (page_size * dcfg.n_layers * 2
+                                * dcfg.n_kv_heads * dcfg.head_dim
+                                * jnp.dtype(dtype).itemsize)
+            budget -= weight_bytes(dparams)
+            budget -= DRAFT_POOL_PAGES * draft_page_bytes
         fit = max(256, int(budget // page_bytes))
         if fit < num_pages:
             num_pages = fit
@@ -330,9 +349,23 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     )
     from runbookai_tpu.model.guided import JsonMaskProvider
 
+    # Opt-in draft-model speculation (BENCH_DRAFT=<config name>): only
+    # meaningful with REAL weights (random draft ≠ random target gives
+    # ~0 acceptance); reports acceptance via spec_drafted/spec_accepted.
+    # Weights were loaded above so the page fit accounts for them.
+    draft_worker = None
+    if dparams is not None:
+        from runbookai_tpu.engine.draft import DraftWorker
+
+        draft_worker = DraftWorker(
+            dcfg, dparams, max_batch_slots=slots,
+            max_seq_len=ecfg.max_seq_len, page_size=page_size,
+            num_pages=DRAFT_POOL_PAGES, attn_impl=ecfg.attn_impl)
+
     masker = JsonMaskProvider(tok)
     core = EngineCore(cfg, params, tok, ecfg,
-                      mask_fn=masker.mask, advance_fn=masker.advance)
+                      mask_fn=masker.mask, advance_fn=masker.advance,
+                      draft_worker=draft_worker)
 
     rng = np.random.default_rng(0)
 
@@ -396,6 +429,10 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         "total_throughput_tok_s": round(total_tokens / wall, 2),
         "decode_steps": m["decode_steps"],
         "preemptions": m["preemptions"],
+        "spec_drafted": m.get("spec_drafted", 0),
+        "spec_accepted": m.get("spec_accepted", 0),
+        "draft_model": draft_name,
+        "draft_tokens": m.get("draft_tokens", 0),
         "matmul_params": cfg.matmul_params,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "peak_flops_per_chip": peak,

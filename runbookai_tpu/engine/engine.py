@@ -295,6 +295,7 @@ class EngineCore:
         tracer=None,
         mesh=None,
         lora_registry=None,
+        draft_worker=None,
     ):
         self.cfg = model_cfg
         self.ecfg = engine_cfg or EngineConfig()
@@ -307,6 +308,10 @@ class EngineCore:
             self.params = dict(params)
             self.params["lora"] = lora_registry.stacked()
         self.tokenizer = tokenizer
+        # Draft-model speculation (engine/draft.py): the worker drafts k-1
+        # tokens per spec round; prompt-lookup remains the fallback for
+        # requests it cannot cover.
+        self.draft = draft_worker
         self.tracer = tracer if tracer is not None else get_tracer()
         # Guided decoding hooks: mask_fn returns the allowed-token mask for a
         # request (or None), advance_fn feeds a sampled token to the grammar
@@ -531,6 +536,8 @@ class EngineCore:
         # Publish the victim's full pages before freeing: re-admission will
         # match its own prefix and recompute only the tail.
         self.kv.release(victim.request_id, token_ids=self._kv_valid_tokens(victim))
+        if self.draft is not None:
+            self.draft.release(victim.request_id)
         self._fold_into_prompt(victim, prefill_pos=0)
         victim.state = RequestState.WAITING
         self.waiting.insert(0, victim)
@@ -560,6 +567,8 @@ class EngineCore:
         if req in self.prefilling:
             self.prefilling.remove(req)
         self.kv.release(req.request_id, token_ids=self._kv_valid_tokens(req))
+        if self.draft is not None:
+            self.draft.release(req.request_id)
         self._last_token.pop(req.request_id, None)
         self.finished.append(req)
         if req.done_event is not None:
@@ -965,7 +974,18 @@ class EngineCore:
         if (k > 1 and self.ecfg.speculative
                 and all(r.sampling.temperature == 0.0 and not r.sampling.guided
                         for r in self.decoding)):
-            drafts = {r.request_id: self._draft_for(r, k - 1) for r in self.decoding}
+            if self.draft is not None:
+                committed = [(r.request_id,
+                              r.prompt_ids[: r.prefill_pos] + r.out_ids)
+                             for r in self.decoding]
+                drafts = self.draft.draft(committed, k - 1)
+                for r in self.decoding:  # prompt-lookup fallback
+                    if not drafts.get(r.request_id):
+                        drafts[r.request_id] = self._draft_for(r, k - 1)
+                self.metrics.update(self.draft.metrics)
+            else:
+                drafts = {r.request_id: self._draft_for(r, k - 1)
+                          for r in self.decoding}
             # Worth it only when most of the batch drafts (nonempty decoding
             # list makes this imply at least one draft): an undrafted request
             # gets 1 token from a spec dispatch vs k from multi-step.

@@ -160,11 +160,21 @@ class JaxTpuClient(BaseLLMClient):
                 targets=tuple(llm_cfg.lora_targets), dtype=dtype)
             for name, path in llm_cfg.lora_adapters.items():
                 lora_registry.load_peft_dir(name, path)
+        draft_worker = None
+        if llm_cfg.draft_model:
+            from runbookai_tpu.engine.draft import DraftWorker
+
+            dcfg, dparams = load_or_init(
+                llm_cfg.draft_model, llm_cfg.draft_model_path, dtype=dtype)
+            draft_worker = DraftWorker(
+                dcfg, dparams, max_batch_slots=ecfg.max_batch_slots,
+                max_seq_len=ecfg.max_seq_len, page_size=ecfg.page_size,
+                attn_impl=ecfg.attn_impl)
         masker = JsonMaskProvider(tokenizer, schemas=orchestrator_schemas())
         core = EngineCore(
             cfg, params, tokenizer, ecfg,
             mask_fn=masker.mask, advance_fn=masker.advance, mesh=mesh,
-            lora_registry=lora_registry,
+            lora_registry=lora_registry, draft_worker=draft_worker,
         )
         return cls(
             core, tokenizer,

@@ -75,6 +75,12 @@ class LLMConfig(BaseModel):
     lora_adapters: dict[str, str] = Field(default_factory=dict)
     lora_rank: int = 8
     lora_targets: tuple[str, ...] = ("wq", "wv")
+    # Draft-model speculative decoding: name a small in-family config
+    # (e.g. "llama3-1b-bench" drafting for 8B) and optionally its weights.
+    # The draft runs k-1 greedy steps in one dispatch; the target verifies
+    # in one T=k forward. None = prompt-lookup speculation only.
+    draft_model: Optional[str] = None
+    draft_model_path: Optional[str] = None
     # Decode attention implementation: "auto" picks the Pallas kernels on
     # TPU and the XLA gather path elsewhere; explicit values override (e.g.
     # force "xla" when debugging a Mosaic issue on hardware).

@@ -110,3 +110,84 @@ def test_spec_respects_max_new_tokens(setup):
     core = make_core(tok, params)
     req = run_greedy(core, tok.encode("loop loop loop loop loop"), 7)
     assert len(req.all_out_ids) == 7  # acceptance must not overshoot budget
+
+
+# --------------------------------------------------------------------- #
+# Draft-model speculation (engine/draft.py)                             #
+# --------------------------------------------------------------------- #
+
+
+def _draft_worker(cfg, params, **kw):
+    from runbookai_tpu.engine.draft import DraftWorker
+
+    defaults = dict(max_batch_slots=4, max_seq_len=256, page_size=4,
+                    num_pages=128, prefill_chunk=8)
+    defaults.update(kw)
+    return DraftWorker(cfg, params, **defaults)
+
+
+def test_draft_model_self_draft_accepts_everything(setup):
+    """Draft == target: every drafted token must agree with the verify
+    forward, so acceptance is ~100% and outputs are untouched."""
+    tok, params = setup
+    prompt = tok.encode("novel text with no repeats whatsoever here")
+    base = make_core(tok, params)
+    base.ecfg.speculative = False
+    want = run_greedy(base, prompt, 16).out_ids
+
+    core = make_core(tok, params)
+    core.draft = _draft_worker(CFG, params)
+    req = run_greedy(core, prompt, 16)
+    assert req.out_ids == want
+    m = core.metrics
+    assert m["draft_tokens"] > 0, "draft model never drafted"
+    assert m["spec_accepted"] > 0, "self-drafts must be accepted"
+    # Perfect drafts: acceptance rate of the drafted tokens is high.
+    assert m["spec_accepted"] >= 0.8 * min(m["spec_drafted"], 15)
+
+
+def test_draft_model_wrong_draft_is_harmless(setup):
+    """A DIFFERENT draft model (other random init) produces garbage
+    drafts; spec decoding must still emit exactly the target's greedy
+    tokens — speculation is an execution strategy, not a sampling
+    change."""
+    tok, params = setup
+    other = init_params(jax.random.PRNGKey(99), CFG, dtype=jnp.float32)
+    prompt = tok.encode("the system is degraded in us-east-1")
+    base = make_core(tok, params)
+    base.ecfg.speculative = False
+    want = run_greedy(base, prompt, 12).out_ids
+
+    core = make_core(tok, params)
+    core.draft = _draft_worker(CFG, other)
+    req = run_greedy(core, prompt, 12)
+    assert req.out_ids == want
+
+
+def test_draft_worker_releases_with_request(setup):
+    tok, params = setup
+    core = make_core(tok, params)
+    core.draft = _draft_worker(CFG, params)
+    req = run_greedy(core, tok.encode("release bookkeeping check"), 8)
+    assert req.finish_reason is not None
+    assert core.draft.ctx == {} and core.draft.kv.seqs == {}
+
+
+def test_draft_worker_pool_exhaustion_falls_back(setup):
+    """A draft pool too small to cover the context returns no draft; the
+    engine falls back to prompt-lookup and output is unchanged."""
+    tok, params = setup
+    prompt = tok.encode("restart the api; restart the api; restart")
+    base = make_core(tok, params)
+    base.ecfg.speculative = False
+    want = run_greedy(base, prompt, 10).out_ids
+
+    core = make_core(tok, params)
+    core.draft = _draft_worker(CFG, params, num_pages=4)  # 16 tokens max
+    req = run_greedy(core, prompt, 10)
+    assert req.out_ids == want
+    # The worker never produced a draft (pool too small); fallback
+    # prompt-lookup carried the speculation. The dead-set itself is
+    # cleaned up by the release hook at finish.
+    assert core.metrics.get("draft_tokens", 0) == 0
+    assert core.draft.ctx == {} and core.draft.kv.seqs == {}
