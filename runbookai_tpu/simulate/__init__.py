@@ -1,0 +1,28 @@
+"""Incident simulator: generated fault scenarios for the fixture providers.
+
+Reference parity: ``scripts/simulate/setup-incidents.sh`` provisions real
+broken infrastructure (a failing Lambda + forced CloudWatch alarm, optional
+live PagerDuty incident) so investigations run against something the agent
+has never seen (``docs/SIMULATE_INCIDENTS.md``). This repo's equivalent is
+credential-free and TPU-CI-friendly: a seeded generator perturbs the
+simulated-provider fixtures (``tools/simulated.py``) into NOVEL failure
+states — random topology, random root cause, fault-specific telemetry —
+so every e2e investigation faces an incident that exists in no checked-in
+fixture, with machine-checkable ground truth for the eval suite.
+"""
+
+from runbookai_tpu.simulate.generator import (
+    FAULT_TYPES,
+    Scenario,
+    generate_scenario,
+    generate_scenarios,
+    to_eval_case,
+)
+
+__all__ = [
+    "FAULT_TYPES",
+    "Scenario",
+    "generate_scenario",
+    "generate_scenarios",
+    "to_eval_case",
+]
