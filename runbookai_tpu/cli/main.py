@@ -408,6 +408,21 @@ def cmd_checkpoint(args) -> int:
     return 1
 
 
+def _live_eval_report(args, cases, name: str) -> int:
+    """Shared run-live-and-report tail for eval and simulate eval."""
+    from runbookai_tpu.cli.runtime import build_runtime
+    from runbookai_tpu.evalsuite.runner import run_live, write_reports
+
+    runtime = build_runtime(_load(args), interactive=False)
+    report = asyncio.run(run_live(
+        cases, lambda: runtime.llm, name=name,
+        concurrency=args.concurrency))
+    summary_path = write_reports([report], args.out)
+    print(json.dumps(report.to_dict() | {"summary_path": str(summary_path)},
+                     indent=2, default=str))
+    return 0 if report.pass_rate >= getattr(args, "min_pass_rate", 0.0) else 1
+
+
 def cmd_eval(args) -> int:
     from runbookai_tpu.evalsuite.runner import (
         load_fixtures_file,
@@ -436,18 +451,12 @@ def cmd_eval(args) -> int:
     cases = load_fixtures_file(args.fixtures)
     if args.offline:
         report = run_offline(cases, name=args.name)
-    else:
-        from runbookai_tpu.cli.runtime import build_runtime
-
-        config = _load(args)
-        runtime = build_runtime(config, interactive=False)
-        report = asyncio.run(run_live(
-            cases, lambda: runtime.llm, name=args.name,
-            concurrency=args.concurrency))
-    summary_path = write_reports([report], args.out)
-    print(json.dumps(report.to_dict() | {"summary_path": str(summary_path)},
-                     indent=2, default=str))
-    return 0 if report.pass_rate >= args.min_pass_rate else 1
+        summary_path = write_reports([report], args.out)
+        print(json.dumps(report.to_dict()
+                         | {"summary_path": str(summary_path)},
+                         indent=2, default=str))
+        return 0 if report.pass_rate >= args.min_pass_rate else 1
+    return _live_eval_report(args, cases, name=args.name)
 
 
 def cmd_simulate(args) -> int:
@@ -466,6 +475,11 @@ def cmd_simulate(args) -> int:
         for name in sorted(FAULT_TYPES):
             print(name)
         return 0
+
+    if getattr(args, "fault", None) and args.fault not in FAULT_TYPES:
+        print(f"unknown fault type {args.fault!r}; valid: "
+              f"{', '.join(sorted(FAULT_TYPES))}", file=sys.stderr)
+        return 1
 
     if args.sim_cmd == "generate":
         scenarios = generate_scenarios(args.n, seed=args.seed,
@@ -491,9 +505,13 @@ def cmd_simulate(args) -> int:
         for block in (config.providers.aws, config.providers.kubernetes,
                       config.observability.datadog,
                       config.observability.prometheus,
-                      config.incident.pagerduty):
+                      config.incident.pagerduty,
+                      config.providers.github):
             block.enabled = True
             block.simulated = True
+        # No simulated gitlab twin: a real client here would query live
+        # infra for a synthetic incident.
+        config.providers.gitlab.enabled = False
         import tempfile
 
         with tempfile.NamedTemporaryFile("w", suffix=".json",
@@ -518,21 +536,10 @@ def cmd_simulate(args) -> int:
         return 0
 
     if args.sim_cmd == "eval":
-        from runbookai_tpu.cli.runtime import build_runtime
-        from runbookai_tpu.evalsuite.runner import run_live, write_reports
-
         scenarios = generate_scenarios(args.n, seed=args.seed,
                                        fault_type=args.fault)
         cases = [to_eval_case(s) for s in scenarios]
-        runtime = build_runtime(_load(args), interactive=False)
-        report = asyncio.run(run_live(
-            cases, lambda: runtime.llm, name="simulated-incidents",
-            concurrency=args.concurrency))
-        summary_path = write_reports([report], args.out)
-        print(json.dumps(report.to_dict()
-                         | {"summary_path": str(summary_path)},
-                         indent=2, default=str))
-        return 0
+        return _live_eval_report(args, cases, name="simulated-incidents")
 
     print("unknown simulate subcommand", file=sys.stderr)
     return 1
@@ -549,6 +556,23 @@ def cmd_serve(args) -> int:
               file=sys.stderr)
         return 1
     client = JaxTpuClient.from_config(config.llm)
+    # Surface the serving memory plan (engine/memory_plan.py) so operators
+    # see what their context/batch choice costs before traffic arrives.
+    from runbookai_tpu.models.llama import CONFIGS as _MODEL_CONFIGS
+
+    if config.llm.model in _MODEL_CONFIGS:
+        from runbookai_tpu.engine.memory_plan import plan_serving
+
+        plan = plan_serving(
+            _MODEL_CONFIGS[config.llm.model],
+            max_seq_len=min(config.llm.max_seq_len,
+                            _MODEL_CONFIGS[config.llm.model].max_seq_len),
+            batch=config.llm.max_batch_slots,
+            tp=max(1, config.llm.mesh.model),
+            weights="int8" if config.llm.dtype == "int8" else "bf16",
+            kv_dtype_bytes=1 if config.llm.kv_cache_dtype == "fp8" else 2,
+        )
+        print(f"memory plan: {plan.explain()}", file=sys.stderr)
     embedder = None
     emb_cfg = config.knowledge.embedder
     # Real weights only: with model_path unset, bge random-inits — serving
@@ -846,6 +870,7 @@ def build_parser() -> argparse.ArgumentParser:
     sim_eval.add_argument("--seed", type=int, default=0)
     sim_eval.add_argument("--fault", default=None)
     sim_eval.add_argument("--concurrency", type=int, default=4)
+    sim_eval.add_argument("--min-pass-rate", type=float, default=0.0)
     sim_eval.add_argument("--out", default=".runbook/eval-reports")
     sim.set_defaults(fn=cmd_simulate)
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import random
+import threading
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -49,7 +50,11 @@ _BASE_EPOCH = 1_767_225_600  # 2026-01-01T00:00:00Z
 # Seed-derived clock base, set per generate_scenario call: same seed →
 # byte-identical scenarios (files regenerate reproducibly; the
 # determinism test cannot flake across a wall-clock second boundary).
+# Module-global + lock (not a threaded-through parameter) keeps the
+# eleven fault templates' signatures flat; generation is cheap enough
+# that serializing concurrent callers costs nothing.
 _ts_base = [_BASE_EPOCH]
+_gen_lock = threading.Lock()
 
 
 def _ts(minutes_ago: float) -> str:
@@ -299,6 +304,11 @@ FAULT_TYPES: dict[str, Any] = {
 
 def generate_scenario(seed: int, fault_type: str | None = None) -> Scenario:
     """One seeded scenario: novel topology + fault + full signal chain."""
+    with _gen_lock:
+        return _generate_locked(seed, fault_type)
+
+
+def _generate_locked(seed: int, fault_type: str | None) -> Scenario:
     rng = random.Random(seed)
     _ts_base[0] = _BASE_EPOCH + rng.randrange(0, 300 * 24 * 3600)
     edge = rng.choice(_EDGE)
@@ -363,7 +373,7 @@ def generate_scenario(seed: int, fault_type: str | None = None) -> Scenario:
         "metrics": {f"{edge}.request.latency.p99": {
             "unit": "ms",
             "points": [[_ts(start + 30), base], [_ts(start + 15), base + 20],
-                       [_ts(start - 2), spike], [_ts(start - 10), spike],
+                       [_ts(start - 10), spike], [_ts(start - 2), spike],
                        [_ts(5), spike - rng.randint(0, 200)]]}},
         "events": [], "monitors": [
             {"name": f"{edge} p99 latency", "status": "Alert",

@@ -122,9 +122,12 @@ def get_runtime_tools(config, registry: Optional[ToolRegistry] = None,
 
             incident_tools.register(reg, config)
     if config.providers.github.enabled or config.providers.gitlab.enabled:
-        from runbookai_tpu.tools import code as code_tools
+        if config.providers.github.enabled and config.providers.github.simulated:
+            simulated_tools.register_code(reg, sim)
+        else:
+            from runbookai_tpu.tools import code as code_tools
 
-        code_tools.register(reg, config)
+            code_tools.register(reg, config)
     if knowledge is not None:
         from runbookai_tpu.tools import knowledge_tool
 

@@ -372,3 +372,39 @@ def register_incident(reg: ToolRegistry, sim: SimulatedCloud, inc_cfg) -> None:
                        "content": {"type": "string"}}, ["incident_id", "content"]),
         add_note, category="incident", risk=RiskLevel.LOW,
     )
+
+
+def register_code(reg: ToolRegistry, sim: SimulatedCloud) -> None:
+    """Fixture-backed github_query (recent_prs / fix_candidates) — serves
+    the ``github`` fixtures block (deploy-culprit PRs in generated
+    incident scenarios; see simulate/generator.py)."""
+
+    async def github_query(args):
+        repos = sim.fixtures.get("github", {})
+        action = args.get("action", "recent_prs")
+        service = args.get("service") or args.get("repo") or ""
+        keywords = [str(k).lower() for k in (args.get("keywords") or [])]
+        out = []
+        for repo, prs in repos.items():
+            if service and service not in repo:
+                continue
+            for pr in prs:
+                if action == "fix_candidates" and keywords:
+                    hay = (pr.get("title", "") + " "
+                           + pr.get("diff_hint", "")).lower()
+                    if not any(k in hay for k in keywords):
+                        continue
+                out.append({"repo": repo, **pr})
+        limit = int(args.get("limit") or 10)
+        return {"action": action, "results": out[:limit]}
+
+    reg.define(
+        "github_query",
+        "GitHub queries. action: recent_prs|recent_commits|fix_candidates "
+        "(fix_candidates finds merged PRs matching incident keywords).",
+        object_schema({"action": {"type": "string"}, "repo": {"type": "string"},
+                       "keywords": {"type": "array"},
+                       "service": {"type": "string"},
+                       "limit": {"type": "number"}}, ["action"]),
+        github_query, category="code",
+    )

@@ -130,6 +130,7 @@ class GitProviderConfig(BaseModel):
     token: Optional[str] = None
     base_url: Optional[str] = None
     repos: list[str] = Field(default_factory=list)
+    simulated: bool = False  # fixture-backed github_query (no token)
 
 
 class OperabilityContextConfig(BaseModel):

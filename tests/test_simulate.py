@@ -120,3 +120,24 @@ def test_to_eval_case_scores_against_truth():
     assert score_investigation_result(case, good).total \
         > score_investigation_result(case, bad).total
     assert score_investigation_result(case, good).passed
+
+
+async def test_simulated_github_serves_deploy_culprit_pr():
+    """Deploy-caused faults plant a culprit PR in fixtures['github']; the
+    simulated github_query tool must actually serve it (it was dead data
+    before — no tool could reach the block)."""
+    s = generate_scenario(11, fault_type="bad_deploy_5xx")
+    root = s.truth["root_cause_service"]
+    assert s.fixtures["github"], "deploy fault must plant a culprit PR"
+
+    reg = ToolRegistry()
+    sim = sim_tools.SimulatedCloud(s.fixtures)
+    sim_tools.register_code(reg, sim)
+    tool = {t.name: t for t in reg.all()}["github_query"]
+    out = await tool.execute({"action": "recent_prs", "service": root})
+    assert out["results"], out
+    assert out["results"][0]["repo"] == root
+    # fix_candidates filters by keyword against title+diff_hint.
+    out2 = await tool.execute({"action": "fix_candidates",
+                               "keywords": ["feature-flag"]})
+    assert out2["results"]
