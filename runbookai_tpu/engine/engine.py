@@ -280,6 +280,21 @@ def _probe_qmm_pallas(model_cfg, ecfg, act_dtype) -> bool:
                                     jnp.dtype(act_dtype).name)
 
 
+_TOPK_LOGPROBS = 20  # OpenAI's top_logprobs ceiling; one compiled shape
+
+
+@partial(jax.jit, static_argnames=())
+def _token_logprobs(logits, toks):
+    """Per-row logprob of the sampled token + top-K alternatives, computed
+    on device so only [B, K+1] floats cross the host link (fetching the
+    full [B, vocab] row per token would dwarf the decode step itself)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    rows = jnp.arange(logp.shape[0])
+    chosen = logp[rows, toks]
+    top_lp, top_ids = jax.lax.top_k(logp, _TOPK_LOGPROBS)
+    return chosen, top_ids, top_lp
+
+
 class EngineCore:
     """Synchronous stepping core. Drive with :meth:`step` until idle."""
 
@@ -504,6 +519,11 @@ class EngineCore:
                                           hash_seed=req.adapter_idx)
             req.state = RequestState.PREFILL
             req.prefill_pos = cached
+            if not req.folded_out_ids:
+                # First admission only: a preempted request re-matching
+                # its OWN published pages is recompute avoidance, not a
+                # prompt-cache hit the client should be billed less for.
+                req.cached_tokens = cached
             self.metrics["cached_prefix_tokens"] += cached
             self.prefilling.append(req)
             in_flight += 1
@@ -722,6 +742,11 @@ class EngineCore:
                 jnp.asarray(top_ks),
             )
             toks_host = np.asarray(jax.device_get(toks))
+            lp_pairs = [(i, req) for i, req in done_rows
+                        if req.sampling.logprobs]
+            if lp_pairs:
+                self._append_logprob_entries(
+                    lp_pairs, toks_host, _token_logprobs(last_logits, toks))
             for i, req in done_rows:
                 # Publish the prompt's full pages so concurrent/following
                 # requests with the same prefix skip their prefill.
@@ -739,6 +764,31 @@ class EngineCore:
         self.metrics["prefill_time_s"] += time.perf_counter() - t0
 
     # ---------------------------------------------------------------- decode
+
+    @staticmethod
+    def _append_logprob_entries(pairs, toks_h, scored) -> None:
+        """Attach one {token_id, logprob, top} record per (row, request)
+        pair from a scored batch (single host fetch for the triple)."""
+        chosen, top_ids, top_lp = jax.device_get(scored)
+        chosen, top_ids, top_lp = (np.asarray(chosen), np.asarray(top_ids),
+                                   np.asarray(top_lp))
+        for i, req in pairs:
+            n = min(req.sampling.logprobs, _TOPK_LOGPROBS)
+            req.out_logprobs.append({
+                "token_id": int(toks_h[i]),
+                "logprob": float(chosen[i]),
+                "top": [(int(t), float(p))
+                        for t, p in zip(top_ids[i, :n], top_lp[i, :n])],
+            })
+
+    def _score_logprobs(self, last_logits, toks, toks_h) -> None:
+        """Top-K logprobs for requests that asked (k==1 dispatches only —
+        _pick_k forces that). Raw model distribution, pre-mask."""
+        pairs = [(r.slot, r) for r in self.decoding if r.sampling.logprobs]
+        if not pairs:
+            return
+        self._append_logprob_entries(pairs, toks_h,
+                                     _token_logprobs(last_logits, toks))
 
     def _emit_token(self, req: EngineRequest, token: int) -> None:
         """Record a sampled token and apply finish rules."""
@@ -767,7 +817,8 @@ class EngineCore:
         """Decode tokens per dispatch: 1 when any guided request needs
         per-token masks, else the largest power of two ≤ config that fits
         every sequence's remaining max_seq headroom."""
-        if any(r.sampling.guided for r in self.decoding):
+        if any(r.sampling.guided or r.sampling.logprobs
+               for r in self.decoding):
             return 1
         k = max(1, self.ecfg.decode_steps_per_dispatch)
         remaining = min(self.ecfg.max_seq_len - r.ctx_len for r in self.decoding)
@@ -890,6 +941,8 @@ class EngineCore:
             return
         if not (self.mask_fn and self.advance_fn and req.sampling.guided):
             return
+        if req.sampling.logprobs:
+            return  # forced runs surface no logits to score
         if req.sampling.stop_strings:
             # Forced runs would bypass the stop-string tail scan; rare for
             # guided requests, so just leave them on the per-token path.
@@ -972,7 +1025,9 @@ class EngineCore:
         # Prompt-lookup speculation for all-greedy batches: one T=k verify
         # forward replaces k sequential decode steps when any draft exists.
         if (k > 1 and self.ecfg.speculative
-                and all(r.sampling.temperature == 0.0 and not r.sampling.guided
+                and all(r.sampling.temperature == 0.0
+                        and not r.sampling.guided
+                        and not r.sampling.logprobs
                         for r in self.decoding)):
             if self.draft is not None:
                 committed = [(r.request_id,
@@ -1026,7 +1081,7 @@ class EngineCore:
         with self.tracer.span("engine.decode", k=k,
                               batch=len(self.decoding)), annotate("decode"):
             if k == 1:
-                toks, _, self._kv_k, self._kv_v = _decode_step(
+                toks, last_logits, self._kv_k, self._kv_v = _decode_step(
                     self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
                     self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
                     jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks), sub,
@@ -1037,6 +1092,7 @@ class EngineCore:
                     qmm_impl=self.ecfg.qmm_impl,
                 )
                 toks_host = np.asarray(jax.device_get(toks))[:, None]  # [B, 1]
+                self._score_logprobs(last_logits, toks, toks_host[:, 0])
             else:
                 toks, self._kv_k, self._kv_v = _decode_multi(
                     self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
@@ -1093,6 +1149,20 @@ class EngineCore:
                        if i >= 0), default=-1)
             if cut >= 0:
                 text = text[:cut]
+        logprobs = None
+        if req.sampling.logprobs:
+            # OpenAI invariant: logprobs.content aligns 1:1 with the
+            # tokens of message.content — entries for the stripped stop
+            # token / cut stop-string tail must not leak through.
+            logprobs = list(req.out_logprobs[: len(text_ids)])
+            if req.finish_reason == FinishReason.STOP_STRING:
+                kept, acc = 0, ""
+                for e in logprobs:
+                    nxt = acc + self.tokenizer.decode([e["token_id"]])
+                    if len(nxt) > len(text):
+                        break
+                    acc, kept = nxt, kept + 1
+                logprobs = logprobs[:kept]
         return EngineOutput(
             request_id=req.request_id,
             token_ids=list(ids),
@@ -1101,4 +1171,6 @@ class EngineCore:
             ttft_ms=req.ttft_ms,
             decode_tokens=req.num_generated,
             elapsed_s=time.perf_counter() - req.arrival_time,
+            logprobs=logprobs,
+            cached_tokens=req.cached_tokens,
         )

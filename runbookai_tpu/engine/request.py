@@ -39,6 +39,11 @@ class SamplingParams:
     # name registered with the mask provider selects a compiled schema
     # grammar ("triage", "evaluation", ... — model.schema_guided).
     guided: Optional[str] = None
+    # Top-N token logprobs per sampled token (0 = off). Forces single-step
+    # decode dispatches (the multi-step scan never surfaces logits) and
+    # disables speculation/grammar fast-forward for the request; values
+    # come from the RAW model distribution (pre-grammar-mask).
+    logprobs: int = 0
 
 
 @dataclass
@@ -77,6 +82,11 @@ class EngineRequest:
     # Preemption-by-recompute does NOT re-call this for folded tokens, so
     # a stream sees every token exactly once.
     on_token: Optional[Any] = None
+    # Per emitted token, when sampling.logprobs > 0: dicts of
+    # {"token_id", "logprob", "top": [(token_id, logprob), ...]}.
+    out_logprobs: list = field(default_factory=list)
+    # Prompt tokens served from the prefix cache at admission.
+    cached_tokens: int = 0
 
     @property
     def ctx_len(self) -> int:
@@ -107,3 +117,7 @@ class EngineOutput:
     ttft_ms: Optional[float]
     decode_tokens: int
     elapsed_s: float
+    # Present when sampling.logprobs > 0 (same entries as out_logprobs).
+    logprobs: Optional[list] = None
+    # Prompt tokens served from the prefix cache (usage detail).
+    cached_tokens: int = 0

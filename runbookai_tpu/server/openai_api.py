@@ -126,6 +126,23 @@ class _EngineBridge:
         self._thread.join(timeout=5)
 
 
+def _logprob_entry(tokenizer, e: dict, top_n: int) -> dict:
+    """Engine logprob record → OpenAI chat-completions schema entry."""
+
+    def token_fields(tid: int) -> dict:
+        text = tokenizer.decode([tid])
+        return {"token": text, "bytes": list(text.encode("utf-8"))}
+
+    out = token_fields(e["token_id"]) | {"logprob": e["logprob"]}
+    if top_n:
+        out["top_logprobs"] = [
+            token_fields(t) | {"logprob": lp}
+            for t, lp in e["top"][:top_n]]
+    else:
+        out["top_logprobs"] = []
+    return out
+
+
 def _completion_payload(model: str, content: str, usage: dict,
                         finish: str = "stop") -> dict:
     return {
@@ -269,6 +286,13 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     raise ValueError(
                         "response_format.type must be text or json_object")
                 guided = "json" if rf_type == "json_object" else None
+                want_logprobs = bool(body.get("logprobs"))
+                top_logprobs = int(body.get("top_logprobs") or 0)
+                if top_logprobs and not want_logprobs:
+                    raise ValueError(
+                        "top_logprobs requires logprobs: true")
+                if not 0 <= top_logprobs <= 20:
+                    raise ValueError("top_logprobs must be 0..20")
                 sampling = SamplingParams(
                     temperature=float(body.get("temperature",
                                                client.temperature)),
@@ -280,6 +304,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                                     client.tokenizer.eos_id),
                     stop_strings=tuple(stop),
                     guided=guided,
+                    logprobs=((top_logprobs or 1) if want_logprobs else 0),
                 )
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 self._error(400, str(e))
@@ -295,6 +320,13 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 if body.get("stream"):
                     if n != 1:
                         self._error(400, "stream with n > 1 is unsupported")
+                        return
+                    if sampling.logprobs:
+                        # Same honest-subset policy as stream+n: the SSE
+                        # path pipes through the text streamer, which has
+                        # no per-token logprob channel (yet).
+                        self._error(400,
+                                    "stream with logprobs is unsupported")
                         return
                     self._stream_response(ids, sampling, adapter)
                 else:
@@ -332,19 +364,27 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                         return
 
                     def choice(i, o):
-                        return {"index": i,
-                                "message": {"role": "assistant",
-                                            "content": o.text},
-                                "finish_reason": ("length"
-                                                  if o.finish_reason.value
-                                                  == "max_tokens"
-                                                  else "stop")}
+                        c = {"index": i,
+                             "message": {"role": "assistant",
+                                         "content": o.text},
+                             "finish_reason": ("length"
+                                               if o.finish_reason.value
+                                               == "max_tokens"
+                                               else "stop")}
+                        if o.logprobs is not None:
+                            c["logprobs"] = {"content": [
+                                _logprob_entry(client.tokenizer, e,
+                                               top_logprobs)
+                                for e in o.logprobs]}
+                        return c
 
                     payload = _completion_payload(
                         model_name, "",
                         {"prompt_tokens": len(ids),
                          "completion_tokens": sum(o.decode_tokens
                                                   for o in outs)})
+                    payload["usage"]["prompt_tokens_details"] = {
+                        "cached_tokens": max(o.cached_tokens for o in outs)}
                     payload["choices"] = [choice(i, o)
                                           for i, o in enumerate(outs)]
                     self._json(200, payload)

@@ -557,3 +557,38 @@ async def test_timeout_race_with_finished_request_returns_output(setup):
     await eng.stop()
     assert len(out.token_ids) >= 1
     assert out.finish_reason not in (None, "aborted")
+
+
+def test_logprobs_align_with_visible_content(setup):
+    """Engine logprobs must align 1:1 with message-content tokens: the
+    stripped stop token's entry may not leak through (r4 review)."""
+    tok, params = setup
+    core = make_core(tok, params)
+    probe = EngineRequest(prompt_ids=tok.encode("align"),
+                          sampling=SamplingParams(temperature=0.0,
+                                                  max_new_tokens=4,
+                                                  stop_token_ids=(),
+                                                  logprobs=2))
+    core.submit(probe)
+    core.run_until_idle()
+    out = core.output_for(probe)
+    assert out.logprobs is not None and len(out.logprobs) == 4
+    assert [e["token_id"] for e in out.logprobs] == probe.out_ids
+    for e in out.logprobs:
+        assert e["logprob"] <= 0.0 and len(e["top"]) == 2
+
+    # Now make the 3rd greedy token a stop token: the engine strips it
+    # from the text, and the logprobs list must shrink with it.
+    stop_tok = probe.out_ids[2]
+    core2 = make_core(tok, params)
+    req = EngineRequest(prompt_ids=tok.encode("align"),
+                        sampling=SamplingParams(temperature=0.0,
+                                                max_new_tokens=8,
+                                                stop_token_ids=(stop_tok,),
+                                                logprobs=1))
+    core2.submit(req)
+    core2.run_until_idle()
+    out2 = core2.output_for(req)
+    assert req.out_ids[-1] == stop_tok
+    assert len(out2.logprobs) == len(req.out_ids) - 1
+    assert out2.text == tok.decode(req.out_ids[:-1])

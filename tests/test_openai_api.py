@@ -285,3 +285,49 @@ def test_system_only_and_developer_role_still_accepted():
         {"role": "user", "content": "hi"},
     ])
     assert system == "you are a bot" and user == "hi"
+
+
+def test_logprobs_contract(server):
+    """OpenAI logprobs schema: choices[].logprobs.content[] entries with
+    token/logprob/bytes and top_logprobs; usage carries cached_tokens."""
+    import math
+
+    with _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "lp"}],
+        "max_tokens": 5, "logprobs": True, "top_logprobs": 3,
+    }) as r:
+        body = json.loads(r.read())
+    content = body["choices"][0]["logprobs"]["content"]
+    assert len(content) == 5
+    for entry in content:
+        assert set(entry) >= {"token", "logprob", "bytes", "top_logprobs"}
+        assert entry["logprob"] <= 0.0
+        assert isinstance(entry["bytes"], list)
+        assert len(entry["top_logprobs"]) == 3
+        # top alternatives are sorted descending and include real probs
+        tops = [t["logprob"] for t in entry["top_logprobs"]]
+        assert tops == sorted(tops, reverse=True)
+        # the sampled (greedy) token IS the argmax -> matches top-1
+        assert math.isclose(entry["logprob"], tops[0], abs_tol=1e-5)
+    assert "prompt_tokens_details" in body["usage"]
+    assert body["usage"]["prompt_tokens_details"]["cached_tokens"] >= 0
+
+
+def test_logprobs_off_by_default_and_validation(server):
+    import urllib.error
+
+    with _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "x"}], "max_tokens": 3,
+    }) as r:
+        body = json.loads(r.read())
+    assert "logprobs" not in body["choices"][0]
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "x"}],
+            "max_tokens": 3, "top_logprobs": 3})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "x"}],
+            "max_tokens": 3, "logprobs": True, "stream": True})
+    assert e.value.code == 400
