@@ -1156,12 +1156,16 @@ class EngineCore:
             # token / cut stop-string tail must not leak through.
             logprobs = list(req.out_logprobs[: len(text_ids)])
             if req.finish_reason == FinishReason.STOP_STRING:
-                kept, acc = 0, ""
+                # Byte-accurate trim via id_to_bytes: per-token decode()
+                # would yield U+FFFD for multi-byte characters split
+                # across tokens and miscount against the joint text.
+                budget = len(text.encode("utf-8"))
+                kept = acc = 0
                 for e in logprobs:
-                    nxt = acc + self.tokenizer.decode([e["token_id"]])
-                    if len(nxt) > len(text):
+                    acc += len(self.tokenizer.id_to_bytes(e["token_id"]))
+                    if acc > budget:
                         break
-                    acc, kept = nxt, kept + 1
+                    kept += 1
                 logprobs = logprobs[:kept]
         return EngineOutput(
             request_id=req.request_id,

@@ -130,8 +130,13 @@ def _logprob_entry(tokenizer, e: dict, top_n: int) -> dict:
     """Engine logprob record → OpenAI chat-completions schema entry."""
 
     def token_fields(tid: int) -> dict:
-        text = tokenizer.decode([tid])
-        return {"token": text, "bytes": list(text.encode("utf-8"))}
+        # id_to_bytes round-trips tokens that are PARTIAL UTF-8 sequences
+        # (byte-level BPE splits characters across tokens); decode([tid])
+        # would corrupt them to U+FFFD and the bytes field exists so
+        # clients can reassemble exactly these splits.
+        raw = tokenizer.id_to_bytes(tid)
+        return {"token": raw.decode("utf-8", errors="replace"),
+                "bytes": list(raw)}
 
     out = token_fields(e["token_id"]) | {"logprob": e["logprob"]}
     if top_n:

@@ -4,8 +4,9 @@ Parity target: reference ``src/slack/gateway.ts`` — mention command parser
 (:95 — ``@runbookAI <infra|knowledge|deploy|investigate> …``), authorization
 (channels/users/threaded :190), event dedupe cache (:70), request execution
 through the agent (:312), HTTP events mode with signature verification;
-``startSlackGateway`` (:531). Socket mode requires the Slack SDK (not baked
-in) and is gated with a clear error; HTTP events mode is stdlib-only.
+``startSlackGateway`` (:531). Both transports are stdlib-only: HTTP events
+mode with signature verification, and Socket Mode over the vendored RFC
+6455 client (``server/slack_socket.py`` — no public endpoint needed).
 """
 
 from __future__ import annotations
@@ -186,11 +187,6 @@ def make_http_handler(gateway: SlackGateway):
 
 
 def run_slack_gateway(config, mode: str = "http", port: int = 3940) -> None:
-    if mode == "socket":
-        raise SystemExit(
-            "socket mode needs the slack_sdk package (not available in this "
-            "environment); use --mode http with an events subscription")
-
     from runbookai_tpu.cli.runtime import build_agent, build_orchestrator, build_runtime
 
     runtime = build_runtime(config, interactive=False)
@@ -218,6 +214,19 @@ def run_slack_gateway(config, mode: str = "http", port: int = 3940) -> None:
         return answer or "(no answer)"
 
     gateway = SlackGateway(config=config, run_request=run_request)
+    if mode == "socket":
+        # Socket Mode: outbound WebSocket (vendored RFC 6455 client —
+        # server/slack_socket.py), no public endpoint or signing secret
+        # needed; same mention handler as http-events.
+        from runbookai_tpu.server.slack_socket import run_socket_mode
+
+        def handle(event: dict) -> None:
+            asyncio.run(gateway.handle_event(
+                event, event.get("event_ts", "")))
+
+        print("slack gateway (socket mode) connecting…")
+        run_socket_mode(config, handle)
+        return
     server = ThreadingHTTPServer(("0.0.0.0", port), make_http_handler(gateway))
     print(f"slack gateway (http events) on :{port}")
     server.serve_forever()

