@@ -184,8 +184,14 @@ def cmd_investigate(args) -> int:
     runtime = build_runtime(config, interactive=not args.yes)
     orch = build_orchestrator(runtime, incident_id=args.incident_id,
                               execute_remediation=args.execute)
-    orch.event_sink = _print_event
+    # Live hypothesis tree repaints under the event stream on TTYs
+    # (reference cli.tsx:116 Ink tree); pipes get plain line events.
+    from runbookai_tpu.cli.live_view import LiveTreeSink
+
+    live = LiveTreeSink(orch.machine, fallback=_print_event)
+    orch.event_sink = live
     result = asyncio.run(orch.investigate(args.incident_id, args.description or ""))
+    live.finish()
     store = CheckpointStore(f"{config.runbook_dir}/checkpoints")
     store.save_machine(orch.machine, label="final")
     hypotheses = list(orch.machine.hypotheses.values())

@@ -31,8 +31,10 @@ import socket
 import ssl
 import struct
 import threading
+import time
 import urllib.parse
 import urllib.request
+from collections import deque
 from typing import Any, Callable, Optional
 
 _WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
@@ -73,6 +75,10 @@ class MiniWebSocket:
              "Connection: Upgrade\r\n"
              f"Sec-WebSocket-Key: {key}\r\n"
              "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        # Post-handshake: a generous idle timeout (Slack pings well inside
+        # it) so a genuinely dead connection is detected and treated as a
+        # drop instead of blocking forever or keeping the 30s dial budget.
+        raw.settimeout(120.0)
         ws = cls(raw)
         status, headers = ws._read_http_response()
         if status != 101:
@@ -213,22 +219,37 @@ class SocketModeClient:
         self._connect = connect
         self.max_reconnects = max_reconnects
         self._stop = False
-        self.acked: list[str] = []  # envelope ids, newest last (observable)
+        # Recent envelope ids, newest last (tests observe these; bounded —
+        # the gateway runs for days at Slack event volume).
+        self.acked: deque[str] = deque(maxlen=512)
 
     def stop(self) -> None:
         self._stop = True
 
     def run(self) -> None:
-        """Blocking receive loop with reconnect-on-disconnect."""
+        """Blocking receive loop with reconnect-on-disconnect.
+
+        Connection establishment is fallible routine (Slack refreshes
+        connections ~hourly; transient DNS/5xx happen): failures back off
+        exponentially (1s → 30s) instead of crashing the gateway, and the
+        backoff resets after any successfully-established connection."""
         reconnects = 0
+        backoff = 1.0
         while not self._stop and reconnects <= self.max_reconnects:
-            url = self._open(self.app_token)
-            ws = self._connect(url)
+            try:
+                url = self._open(self.app_token)
+                ws = self._connect(url)
+            except Exception:  # noqa: BLE001 — URLError/OSError/Conn...
+                reconnects += 1
+                time.sleep(min(backoff, 30.0))
+                backoff = min(backoff * 2, 30.0)
+                continue
+            backoff = 1.0
             try:
                 if self._run_connection(ws):
                     reconnects += 1
                     continue
-                return  # clean stop / server close without refresh request
+                return  # clean stop / server close after stop()
             finally:
                 ws.close()
 
@@ -237,8 +258,10 @@ class SocketModeClient:
         while not self._stop:
             try:
                 opcode, payload = ws.recv()
-            except ConnectionError:
-                return True  # dropped: treat as refresh
+            except OSError:
+                # ConnectionError, socket.timeout, ssl errors alike:
+                # the connection is gone — refresh it.
+                return True
             if opcode == OP_CLOSE:
                 # An unsolicited server close (no disconnect envelope —
                 # e.g. a Slack-side deploy or an LB reset) must reconnect,

@@ -137,7 +137,7 @@ def test_socket_mode_handshake_envelopes_acks_and_reconnect():
     fake.thread.join(timeout=10)
 
     assert [e["envelope_id"] for e in fake.received] == ["env-1", "env-2"]
-    assert client.acked == ["env-1", "env-2"]
+    assert list(client.acked) == ["env-1", "env-2"]
     assert len(events) == 2
     assert events[0]["type"] == "app_mention"
     assert "investigate INC-1" in events[0]["text"]
@@ -180,7 +180,7 @@ def test_large_server_frame_through_envelope_loop():
     )
     client.run()
     assert events and len(events[0]["text"]) == 300
-    assert client.acked == ["big-1"]
+    assert list(client.acked) == ["big-1"]
 
 
 def test_large_client_frame_masking_roundtrip():

@@ -435,3 +435,45 @@ def test_llm_config_knobs():
     assert CONFIGS["qwen2.5-7b-instruct"].family == "qwen2"
     assert LLMConfig().attn_impl == "auto"
     assert LLMConfig(attn_impl="xla").attn_impl == "xla"
+
+
+def test_live_tree_sink_repaints_during_run():
+    """TTY mode: the hypothesis tree erases + repaints under the event
+    stream (reference Ink live tree); non-TTY falls back to line events."""
+    import io
+
+    from runbookai_tpu.agent.state_machine import InvestigationStateMachine
+    from runbookai_tpu.agent.types import AgentEvent
+    from runbookai_tpu.cli.live_view import LiveTreeSink
+
+    machine = InvestigationStateMachine(incident_id="INC-9")
+    out = io.StringIO()
+    lines: list = []
+    sink = LiveTreeSink(machine, fallback=lambda ev: lines.append(ev.kind),
+                        out=out, enabled=True)
+
+    sink(AgentEvent("phase_change", {"phase": "triage"}))
+    assert "\x1b[" not in out.getvalue()  # nothing painted yet (no hyps)
+
+    machine.add_hypothesis("db pool exhausted", priority=8)
+    sink(AgentEvent("hypothesis_created", {"id": "H1"}))
+    first = out.getvalue()
+    assert "db pool exhausted" in first
+
+    machine.add_hypothesis("bad deploy", priority=5)
+    sink(AgentEvent("hypothesis_created", {"id": "H2"}))
+    second = out.getvalue()[len(first):]
+    # The repaint erased the old block (cursor-up F + clear 0J) and the
+    # new tree carries BOTH hypotheses.
+    assert "\x1b[" in second and "F\x1b[0J" in second
+    assert "bad deploy" in second and "db pool exhausted" in second
+    assert lines == ["phase_change", "hypothesis_created",
+                     "hypothesis_created"]
+
+    # Non-TTY: pure passthrough, zero ANSI.
+    out2 = io.StringIO()
+    plain: list = []
+    sink2 = LiveTreeSink(machine, fallback=lambda ev: plain.append(ev.kind),
+                         out=out2, enabled=False)
+    sink2(AgentEvent("hypothesis_created", {"id": "H3"}))
+    assert out2.getvalue() == "" and plain == ["hypothesis_created"]
