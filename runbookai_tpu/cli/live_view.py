@@ -51,9 +51,16 @@ class LiveTreeSink:
         # erase sequence counts PHYSICAL rows, so a wrapped line would
         # make cursor-up undershoot and leave stale fragments behind.
         # The final full-color tree prints after the run (cmd_investigate).
-        width = max(20, shutil.get_terminal_size((100, 24)).columns - 1)
+        cols, rows = shutil.get_terminal_size((100, 24))
+        width = max(20, cols - 1)
         text = render_tree(hyps, color=False)
         lines = [ln[:width] for ln in text.splitlines()]
+        # Height clamp: a footer taller than the screen would scroll its
+        # top off and the cursor-up erase could no longer reach it —
+        # keep the most recent tail on screen.
+        max_rows = max(4, rows - 2)
+        if len(lines) > max_rows:
+            lines = lines[-max_rows:]
         self.out.write("\n".join(lines) + "\n")
         self._tree_lines = len(lines)
 

@@ -200,7 +200,8 @@ def _prefill_step(
 @functools.lru_cache(maxsize=8)
 def _probe_pallas_fp8_cached(backend: str, n_kv: int, n_q: int,
                              head_dim: int, page_size: int,
-                             kv_dtype_name: str, act_dtype_name: str) -> bool:
+                             kv_dtype_name: str, act_dtype_name: str,
+                             kv_split: bool = False) -> bool:
     """Tiny compiles of BOTH attention kernels at the engine's real
     grouping/dtypes prove (or disprove) Mosaic support for the sub-byte
     KV load before real traffic hits it. Representative matters: serving
@@ -233,18 +234,36 @@ def _probe_pallas_fp8_cached(backend: str, n_kv: int, n_q: int,
                                     jnp.full((1,), t, jnp.int32), positions,
                                     page_size=page_size, interpret=interp)
         jax.block_until_ready(out)
+        if kv_split:
+            # The page-split mesh dispatches the PARTIAL kernel (extra
+            # outputs, SMEM shard scalar, clamped index maps) — probing
+            # only the full-pool kernel would not cover the program that
+            # actually runs.
+            from runbookai_tpu.ops.paged_attention_pallas import (
+                paged_decode_attention_partial,
+            )
+
+            out = paged_decode_attention_partial(
+                q1, kv, kv, tables, jnp.ones((1,), jnp.int32),
+                jnp.int32(0), page_size=page_size, pages_local=1,
+                interpret=interp)
+            jax.block_until_ready(out)
         return True
     except Exception:  # noqa: BLE001 — any Mosaic/lowering failure
         return False
 
 
-def _probe_pallas_fp8(model_cfg, ecfg, act_dtype) -> bool:
+def _probe_pallas_fp8(model_cfg, ecfg, act_dtype, mesh=None) -> bool:
+    from runbookai_tpu.parallel.mesh import SEQ_AXIS
+
+    kv_split = mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1
     return _probe_pallas_fp8_cached(jax.default_backend(),
                                     model_cfg.n_kv_heads,
                                     model_cfg.n_heads,
                                     model_cfg.head_dim, ecfg.page_size,
                                     jnp.dtype(ecfg.kv_dtype).name,
-                                    jnp.dtype(act_dtype).name)
+                                    jnp.dtype(act_dtype).name,
+                                    kv_split=kv_split)
 
 
 @functools.lru_cache(maxsize=8)
@@ -343,7 +362,8 @@ class EngineCore:
         act_dtype = self.params["embed"].dtype
         if (jnp.dtype(self.ecfg.kv_dtype).itemsize == 1
                 and self.ecfg.attn_impl == "pallas"
-                and not _probe_pallas_fp8(model_cfg, self.ecfg, act_dtype)):
+                and not _probe_pallas_fp8(model_cfg, self.ecfg, act_dtype,
+                                          mesh=mesh)):
             import dataclasses as _dc
             import logging
 

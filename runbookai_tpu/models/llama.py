@@ -484,11 +484,21 @@ def forward_impl(
         elif kv_split_active:
             from runbookai_tpu.parallel.kv_split import (
                 paged_attention_kv_split,
+                paged_decode_attention_kv_split_pallas,
             )
 
-            attn = paged_attention_kv_split(
-                mesh, q, k_pages, v_pages, page_tables, ctx_lens,
-                positions, page_size=page_size, block_pages=block_pages)
+            if attn_impl == "pallas" and t == 1:
+                # Decode hot loop on the Pallas partial kernel (ownership-
+                # masked local pages + seq-axis flash merge); chunked
+                # prefill stays on the XLA kv-split path (compute-bound).
+                attn = paged_decode_attention_kv_split_pallas(
+                    mesh, q[:, 0], k_pages, v_pages, page_tables, ctx_lens,
+                    page_size=page_size,
+                    interpret=jax.default_backend() == "cpu")[:, None]
+            else:
+                attn = paged_attention_kv_split(
+                    mesh, q, k_pages, v_pages, page_tables, ctx_lens,
+                    positions, page_size=page_size, block_pages=block_pages)
         else:
             attn = paged_attention(
                 q, k_pages, v_pages, page_tables, ctx_lens, positions,

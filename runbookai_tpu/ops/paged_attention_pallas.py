@@ -37,6 +37,58 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _flash_page_accumulate(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+                           base, ctx, n_kv: int, group: int,
+                           page_size: int) -> None:
+    """Shared online-softmax accumulation of one K/V page into the
+    (m, l, acc) scratch — the body of BOTH decode kernels (full-pool and
+    kv-split partial), kept in one place so masking/numerics fixes cannot
+    diverge. Masked positions are explicitly zeroed in p (exp underflow
+    handles them too, but the explicit mask keeps l exact by construction)."""
+    q = q_ref[0].astype(jnp.float32)  # [n_q, hd]
+    hd = q.shape[-1]
+    scale = 1.0 / (hd ** 0.5)
+    pos = base + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+    valid = pos < ctx  # [1, page_size]
+
+    m_prev = m_ref[:, :1]  # [n_q, 1]
+    l_prev = l_ref[:, :1]
+    acc_prev = acc_ref[:]
+
+    s_rows = []
+    v_heads = []
+    for h in range(n_kv):
+        k_h = k_ref[0, :, h, :].astype(jnp.float32)  # [ps, hd]
+        q_h = q[h * group : (h + 1) * group]  # [group, hd]
+        s_h = jax.lax.dot_general(
+            q_h * scale, k_h, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [group, ps]
+        s_rows.append(jnp.where(valid, s_h, NEG_INF))
+        v_heads.append(v_ref[0, :, h, :].astype(jnp.float32))  # [ps, hd]
+    s = jnp.concatenate(s_rows, axis=0)  # [n_q, ps] (kv-major head order)
+
+    m_blk = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_blk)
+    alpha = jnp.exp(m_prev - m_new)
+    p_blk = jnp.where(jnp.concatenate([valid] * (n_kv * group), axis=0),
+                      jnp.exp(s - m_new), 0.0)
+    l_new = l_prev * alpha + jnp.sum(p_blk, axis=1, keepdims=True)
+
+    pv_rows = []
+    for h in range(n_kv):
+        p_h = p_blk[h * group : (h + 1) * group]
+        pv_rows.append(jax.lax.dot_general(
+            p_h, v_heads[h], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ))  # [group, hd]
+    pv = jnp.concatenate(pv_rows, axis=0)  # [n_q, hd]
+
+    acc_ref[:] = acc_prev * alpha + pv
+    m_ref[:, :1] = m_new
+    l_ref[:, :1] = l_new
+
+
 def _decode_kernel(
     # scalar prefetch:
     page_tables_ref,  # [B, P] int32 (SMEM)
@@ -70,48 +122,8 @@ def _decode_kernel(
 
     @pl.when(base < ctx)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32)  # [n_q, hd]
-        hd = q.shape[-1]
-        scale = 1.0 / (hd ** 0.5)
-        pos = base + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
-        valid = pos < ctx  # [1, page_size]
-
-        m_prev = m_ref[:, :1]  # [n_q, 1]
-        l_prev = l_ref[:, :1]
-        acc_prev = acc_ref[:]
-
-        # Per-kv-head score blocks (n_kv is small and static -> unrolled).
-        s_rows = []
-        v_heads = []
-        for h in range(n_kv):
-            k_h = k_ref[0, :, h, :].astype(jnp.float32)  # [ps, hd]
-            q_h = q[h * group : (h + 1) * group]  # [group, hd]
-            s_h = jax.lax.dot_general(
-                q_h * scale, k_h, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # [group, ps]
-            s_rows.append(jnp.where(valid, s_h, NEG_INF))
-            v_heads.append(v_ref[0, :, h, :].astype(jnp.float32))  # [ps, hd]
-        s = jnp.concatenate(s_rows, axis=0)  # [n_q, ps] (kv-major head order)
-
-        m_blk = jnp.max(s, axis=1, keepdims=True)  # [n_q, 1]
-        m_new = jnp.maximum(m_prev, m_blk)
-        alpha = jnp.exp(m_prev - m_new)
-        p_blk = jnp.exp(s - m_new)  # [n_q, ps]
-        l_new = l_prev * alpha + jnp.sum(p_blk, axis=1, keepdims=True)
-
-        pv_rows = []
-        for h in range(n_kv):
-            p_h = p_blk[h * group : (h + 1) * group]  # [group, ps]
-            pv_rows.append(jax.lax.dot_general(
-                p_h, v_heads[h], (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ))  # [group, hd]
-        pv = jnp.concatenate(pv_rows, axis=0)  # [n_q, hd]
-
-        acc_ref[:] = acc_prev * alpha + pv
-        m_ref[:, :1] = m_new
-        l_ref[:, :1] = l_new
+        _flash_page_accumulate(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+                               base, ctx, n_kv, group, page_size)
 
     @pl.when(p == pages_per_seq - 1)
     def _finalize():
@@ -339,6 +351,122 @@ def paged_chunk_attention(
         interpret=interpret,
     )(page_tables, ctx_lens, q_start, q, k_pages, v_pages)
     return out[:, :t]
+
+
+def _decode_kernel_partial(
+    # scalar prefetch:
+    page_tables_ref,  # [B, P] int32 GLOBAL page ids (SMEM)
+    ctx_lens_ref,  # [B] int32 (SMEM)
+    shard_ref,  # [1] int32 — this device's page-shard index (SMEM)
+    # blocks:
+    q_ref,  # [1, n_q, hd]
+    k_ref,  # [1, page_size, n_kv, hd]  (LOCAL pool slice)
+    v_ref,
+    # outputs (un-normalized partials for the cross-shard merge):
+    acc_out,  # [1, n_q, hd] f32
+    m_out,  # [1, n_q, 128] f32
+    l_out,  # [1, n_q, 128] f32
+    # scratch:
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    page_size: int,
+    n_kv: int,
+    group: int,
+    pages_per_seq: int,
+    pages_local: int,
+):
+    """KV page-split variant of :func:`_decode_kernel`: the pool ref is
+    this device's page SLICE, pages not owned here are skipped (their
+    shard contributes them), and the outputs are the flash partials
+    ``(acc, m, l)`` — the shard_map wrapper merges across the ``seq``
+    axis (``parallel/kv_split.py`` math) and normalizes."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ctx = ctx_lens_ref[b]
+    base = p * page_size
+    owned = (page_tables_ref[b, p] // pages_local) == shard_ref[0]
+
+    @pl.when((base < ctx) & owned)
+    def _accumulate():
+        _flash_page_accumulate(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+                               base, ctx, n_kv, group, page_size)
+
+    @pl.when(p == pages_per_seq - 1)
+    def _finalize():
+        acc_out[0] = acc_ref[:]
+        m_out[0] = m_ref[:]
+        l_out[0] = l_ref[:]
+
+
+def paged_decode_attention_partial(
+    q: jnp.ndarray,  # [B, n_q, hd]
+    k_local: jnp.ndarray,  # [pages_local * page_size, n_kv, hd]
+    v_local: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, P] GLOBAL page ids
+    ctx_lens: jnp.ndarray,  # [B]
+    my_pg: jnp.ndarray,  # scalar int32 page-shard index
+    page_size: int,
+    pages_local: int,
+    interpret: bool = False,
+):
+    """Flash partials over a LOCAL page slice; returns (acc, m, l) with
+    m/l padded to lane width (column 0 is the value)."""
+    b, n_q, hd = q.shape
+    n_kv = k_local.shape[1]
+    group = n_q // n_kv
+    pages_per_seq = page_tables.shape[1]
+    k_pages = k_local.reshape(-1, page_size, n_kv, hd)
+    v_pages = v_local.reshape(-1, page_size, n_kv, hd)
+
+    def kv_map(b_, p_, pt, cl, sh):
+        # Foreign pages clamp to slot 0 — the ownership predicate skips
+        # their accumulation, so the fetched block is never read.
+        local = pt[b_, p_] - sh[0] * pages_local
+        return (jnp.clip(local, 0, pages_local - 1), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, n_q, hd), lambda b_, p_, pt, cl, sh: (b_, 0, 0)),
+            pl.BlockSpec((1, page_size, n_kv, hd), kv_map),
+            pl.BlockSpec((1, page_size, n_kv, hd), kv_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n_q, hd), lambda b_, p_, pt, cl, sh: (b_, 0, 0)),
+            pl.BlockSpec((1, n_q, 128), lambda b_, p_, pt, cl, sh: (b_, 0, 0)),
+            pl.BlockSpec((1, n_q, 128), lambda b_, p_, pt, cl, sh: (b_, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n_q, 128), jnp.float32),
+            pltpu.VMEM((n_q, 128), jnp.float32),
+            pltpu.VMEM((n_q, hd), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel_partial, page_size=page_size, n_kv=n_kv, group=group,
+        pages_per_seq=pages_per_seq, pages_local=pages_local,
+    )
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n_q, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, n_q, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, n_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(page_tables, ctx_lens, my_pg.reshape(1), q, k_pages, v_pages)
+    return acc, m[..., 0], l[..., 0]
 
 
 # --------------------------------------------------------------------- TP ---

@@ -241,6 +241,56 @@ def paged_attention_kv_split(
     )(q, k_flat, v_flat, page_tables, ctx_lens, q_positions)
 
 
+def paged_decode_attention_kv_split_pallas(
+    mesh: Mesh,
+    q: jnp.ndarray,  # [B, n_q, hd] (T=1 decode shape, heads (model,seq))
+    k_flat: jnp.ndarray,
+    v_flat: jnp.ndarray,
+    page_tables: jnp.ndarray,
+    ctx_lens: jnp.ndarray,
+    page_size: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Decode attention on the page-split pool via the Pallas partial
+    kernel: each device runs ``_decode_kernel_partial`` over its OWN page
+    slice (ownership-masked, locally-indexed scalar-prefetch maps) and
+    the flash partials merge across ``seq`` exactly like the XLA path."""
+    from runbookai_tpu.ops.paged_attention_pallas import (
+        paged_decode_attention_partial,
+    )
+
+    pg_shards = mesh.shape.get(SEQ_AXIS, 1)
+    num_pages = k_flat.shape[0] // page_size
+    if num_pages % pg_shards != 0:
+        raise ValueError(
+            f"num_pages={num_pages} must divide by pg_shards={pg_shards}")
+    pages_local = num_pages // pg_shards
+
+    def local_fn(q_l, k_l, v_l, tables, ctx):
+        my_pg = jax.lax.axis_index(SEQ_AXIS)
+        nql = q_l.shape[1]
+        q_full = jax.lax.all_gather(q_l, SEQ_AXIS, axis=1, tiled=True)
+        acc, m, l = paged_decode_attention_partial(
+            q_full, k_l, v_l, tables, ctx, my_pg.astype(jnp.int32),
+            page_size=page_size, pages_local=pages_local,
+            interpret=interpret)
+        m_g = jax.lax.pmax(m, SEQ_AXIS)
+        corr = jnp.exp(m - m_g)
+        l_g = jax.lax.psum(l * corr, SEQ_AXIS)
+        acc_g = jax.lax.psum(acc * corr[..., None], SEQ_AXIS)
+        out = (acc_g / jnp.maximum(l_g[..., None], 1e-30)).astype(q_l.dtype)
+        return jax.lax.dynamic_slice_in_dim(out, my_pg * nql, nql, axis=1)
+
+    heads = P(None, (MODEL_AXIS, SEQ_AXIS), None)
+    kv_spec = P(SEQ_AXIS, MODEL_AXIS, None)
+    return jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(heads, kv_spec, kv_spec, P(None, None), P(None)),
+        out_specs=heads,
+        check_vma=False,  # pallas out_shapes carry no vma info
+    )(q, k_flat, v_flat, page_tables, ctx_lens)
+
+
 # ----------------------------------------------------------------- write
 
 def write_kv_pages_batch_kv_split(

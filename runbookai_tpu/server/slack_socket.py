@@ -26,6 +26,7 @@ from __future__ import annotations
 import base64
 import hashlib
 import json
+import logging
 import os
 import socket
 import ssl
@@ -218,13 +219,17 @@ class SocketModeClient:
         self._open = connections_open
         self._connect = connect
         self.max_reconnects = max_reconnects
-        self._stop = False
+        self._stop_event = threading.Event()
         # Recent envelope ids, newest last (tests observe these; bounded —
         # the gateway runs for days at Slack event volume).
         self.acked: deque[str] = deque(maxlen=512)
 
+    @property
+    def _stop(self) -> bool:
+        return self._stop_event.is_set()
+
     def stop(self) -> None:
-        self._stop = True
+        self._stop_event.set()
 
     def run(self) -> None:
         """Blocking receive loop with reconnect-on-disconnect.
@@ -239,9 +244,15 @@ class SocketModeClient:
             try:
                 url = self._open(self.app_token)
                 ws = self._connect(url)
-            except Exception:  # noqa: BLE001 — URLError/OSError/Conn...
+            except Exception as e:  # noqa: BLE001 — URLError/OSError/Conn...
+                logging.getLogger(__name__).warning(
+                    "socket-mode connect failed (%s: %s); retrying in %.0fs",
+                    type(e).__name__, e, min(backoff, 30.0))
                 reconnects += 1
-                time.sleep(min(backoff, 30.0))
+                # Event-based sleep: stop() interrupts the backoff instead
+                # of delaying shutdown by up to 30s.
+                if self._stop_event.wait(min(backoff, 30.0)):
+                    return
                 backoff = min(backoff * 2, 30.0)
                 continue
             backoff = 1.0
@@ -281,8 +292,13 @@ class SocketModeClient:
             env_id = env.get("envelope_id")
             if env_id:
                 # Ack FIRST: Slack redelivers unacked envelopes within
-                # seconds, and the handler may run an investigation.
-                ws.send_text(json.dumps({"envelope_id": env_id}))
+                # seconds, and the handler may run an investigation. A
+                # connection dying between recv and ack is a drop like
+                # any other — reconnect, don't crash.
+                try:
+                    ws.send_text(json.dumps({"envelope_id": env_id}))
+                except OSError:
+                    return True
                 self.acked.append(env_id)
             if etype == "events_api":
                 event = (env.get("payload") or {}).get("event") or {}

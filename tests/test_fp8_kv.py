@@ -107,7 +107,7 @@ def test_probe_downgrade_on_mosaic_failure(monkeypatch):
 
     engine_mod._probe_pallas_fp8_cached.cache_clear()
     monkeypatch.setattr(
-        engine_mod, "_probe_pallas_fp8", lambda cfg, ecfg, act: False)
+        engine_mod, "_probe_pallas_fp8", lambda cfg, ecfg, act, mesh=None: False)
     tok = ByteTokenizer()
     params = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
     core = EngineCore(CFG, params, tok, EngineConfig(

@@ -318,3 +318,42 @@ def test_fp8_engine_pallas_on_device():
     core.submit(req)
     core.run_until_idle()
     assert len(req.out_ids) == 8
+
+
+def test_kv_split_partial_kernel_on_device():
+    """Mosaic compiles the ownership-masked partial decode kernel; the
+    two-shard merge (host-side here, psum under shard_map in serving)
+    equals the full-pool kernel."""
+    from runbookai_tpu.ops.paged_attention_pallas import (
+        paged_decode_attention_partial,
+    )
+
+    rng = np.random.default_rng(7)
+    n_kv, group, hd = 2, 2, 128
+    ctx_lens = [PS * 2 + 5, PS]
+    b = len(ctx_lens)
+    num_pages, pg = 32, 2
+    k_flat, v_flat = _pool(rng, num_pages=num_pages, n_kv=n_kv, hd=hd)
+    tables = _tables(ctx_lens, max_pages=8)
+    ctx = jnp.asarray(ctx_lens, jnp.int32)
+    q = jnp.asarray(rng.normal(size=(b, n_kv * group, hd)), jnp.bfloat16)
+
+    want = paged_decode_attention(q, k_flat, v_flat, tables, ctx,
+                                  page_size=PS, interpret=False)
+    pages_local = num_pages // pg
+    tokens_local = pages_local * PS
+    parts = []
+    for s in range(pg):
+        k_l = k_flat[s * tokens_local:(s + 1) * tokens_local]
+        v_l = v_flat[s * tokens_local:(s + 1) * tokens_local]
+        parts.append(paged_decode_attention_partial(
+            q, k_l, v_l, tables, ctx, jnp.int32(s), page_size=PS,
+            pages_local=pages_local, interpret=False))
+    m_g = jnp.maximum(parts[0][1], parts[1][1])
+    corr = [jnp.exp(p[1] - m_g) for p in parts]
+    l_g = sum(c * p[2] for c, p in zip(corr, parts))
+    acc_g = sum(c[..., None] * p[0] for c, p in zip(corr, parts))
+    got = acc_g / jnp.maximum(l_g[..., None], 1e-30)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=2e-2, rtol=2e-2)
