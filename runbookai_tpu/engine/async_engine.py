@@ -177,6 +177,7 @@ class AsyncEngine:
         sampling: Optional[SamplingParams] = None,
         priority: int = 0,
         adapter: Optional[str] = None,
+        request_sink: Optional[list] = None,
     ):
         """Async iterator of token ids as the engine samples them.
 
@@ -190,6 +191,11 @@ class AsyncEngine:
         req = EngineRequest(prompt_ids=prompt_ids,
                             sampling=sampling or SamplingParams(),
                             priority=priority, adapter=adapter)
+        if request_sink is not None:
+            # Streaming consumers that need per-token request state
+            # (logprob entries accumulate on the engine worker thread;
+            # CPython list appends are atomic, so index reads are safe).
+            request_sink.append(req)
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
 

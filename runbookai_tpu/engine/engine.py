@@ -198,16 +198,18 @@ def _prefill_step(
 
 
 @functools.lru_cache(maxsize=8)
-def _probe_pallas_fp8_cached(backend: str, n_kv: int, n_q: int,
-                             head_dim: int, page_size: int,
-                             kv_dtype_name: str, act_dtype_name: str,
-                             kv_split: bool = False) -> bool:
-    """Tiny compiles of BOTH attention kernels at the engine's real
-    grouping/dtypes prove (or disprove) Mosaic support for the sub-byte
-    KV load before real traffic hits it. Representative matters: serving
-    dispatches the chunk kernel first (prefill, t>1) and then decode with
-    the model's true GQA group and activation dtype — a probe narrower
-    than that can pass while the first real dispatch crashes. Cached per
+def _probe_pallas_attn_cached(backend: str, n_kv: int, n_q: int,
+                              head_dim: int, page_size: int,
+                              kv_dtype_name: str, act_dtype_name: str,
+                              kv_split: bool = False) -> bool:
+    """Tiny compiles of the attention kernels that will ACTUALLY run, at
+    the engine's real grouping/dtypes, prove (or disprove) Mosaic support
+    before real traffic hits them. Representative matters: serving
+    dispatches the chunk kernel first (prefill, t>1), then decode with
+    the model's true GQA group and activation dtype — and on a page-split
+    mesh the PARTIAL kernel with per-shard head counts; a probe narrower
+    than that can pass while the first real dispatch crashes. Callers
+    pass PER-SHARD n_kv/n_q (the shard_map-local shapes). Cached per
     process — tests build many engines."""
     try:
         from runbookai_tpu.ops.paged_attention_pallas import (
@@ -253,17 +255,22 @@ def _probe_pallas_fp8_cached(backend: str, n_kv: int, n_q: int,
         return False
 
 
-def _probe_pallas_fp8(model_cfg, ecfg, act_dtype, mesh=None) -> bool:
-    from runbookai_tpu.parallel.mesh import SEQ_AXIS
+def _probe_pallas_attn(model_cfg, ecfg, act_dtype, mesh=None) -> bool:
+    from runbookai_tpu.parallel.mesh import MODEL_AXIS, SEQ_AXIS
 
     kv_split = mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1
-    return _probe_pallas_fp8_cached(jax.default_backend(),
-                                    model_cfg.n_kv_heads,
-                                    model_cfg.n_heads,
-                                    model_cfg.head_dim, ecfg.page_size,
-                                    jnp.dtype(ecfg.kv_dtype).name,
-                                    jnp.dtype(act_dtype).name,
-                                    kv_split=kv_split)
+    # shard_map runs the kernels at PER-SHARD head counts — probe those.
+    kv_sh = mesh.shape.get(MODEL_AXIS, 1) if mesh is not None else 1
+    kv_sh = max(1, min(kv_sh, model_cfg.n_kv_heads))
+    if model_cfg.n_kv_heads % kv_sh or model_cfg.n_heads % kv_sh:
+        kv_sh = 1  # unshardable heads replicate; kernel sees full shapes
+    return _probe_pallas_attn_cached(jax.default_backend(),
+                                     model_cfg.n_kv_heads // kv_sh,
+                                     model_cfg.n_heads // kv_sh,
+                                     model_cfg.head_dim, ecfg.page_size,
+                                     jnp.dtype(ecfg.kv_dtype).name,
+                                     jnp.dtype(act_dtype).name,
+                                     kv_split=kv_split)
 
 
 @functools.lru_cache(maxsize=8)
@@ -360,16 +367,25 @@ class EngineCore:
         # XLA gather path with a warning instead of crashing serving. The
         # caller's config is copied, not mutated.
         act_dtype = self.params["embed"].dtype
-        if (jnp.dtype(self.ecfg.kv_dtype).itemsize == 1
-                and self.ecfg.attn_impl == "pallas"
-                and not _probe_pallas_fp8(model_cfg, self.ecfg, act_dtype,
-                                          mesh=mesh)):
+        from runbookai_tpu.parallel.mesh import SEQ_AXIS as _SEQ
+
+        _kv_split_mesh = mesh is not None and mesh.shape.get(_SEQ, 1) > 1
+        # Probe whenever the dispatched kernels include constructs newer
+        # than the proven baseline: sub-byte KV loads (fp8) and/or the
+        # page-split PARTIAL kernel (clamped index maps, SMEM shard
+        # scalar, multi-output finalize).
+        if (self.ecfg.attn_impl == "pallas"
+                and (jnp.dtype(self.ecfg.kv_dtype).itemsize == 1
+                     or _kv_split_mesh)
+                and not _probe_pallas_attn(model_cfg, self.ecfg, act_dtype,
+                                           mesh=mesh)):
             import dataclasses as _dc
             import logging
 
             logging.getLogger(__name__).warning(
-                "fp8 KV cache: Mosaic rejected the fp8 Pallas attention "
-                "probe on this backend; serving via the XLA gather path")
+                "Mosaic rejected the Pallas attention probe for this "
+                "config (kv_dtype=%s, kv_split=%s); serving via the XLA "
+                "path", jnp.dtype(self.ecfg.kv_dtype).name, _kv_split_mesh)
             self.ecfg = _dc.replace(self.ecfg, attn_impl="xla")
         # Same guard for the int8 qmm kernel: a Mosaic rejection downgrades
         # to the mathematically identical XLA expression instead of

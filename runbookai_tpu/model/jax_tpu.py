@@ -33,7 +33,8 @@ from runbookai_tpu.utils.tokens import load_tokenizer
 
 async def stream_text(engine, tokenizer, prompt_ids, sampling,
                       state: Optional[dict] = None, priority: int = 0,
-                      adapter: Optional[str] = None):
+                      adapter: Optional[str] = None,
+                      request_sink: Optional[list] = None):
     """Token stream -> text-piece stream, shared by every streaming surface
     (client ``chat_stream``, OpenAI SSE endpoint): incremental UTF-8 decode
     over per-token bytes (multi-byte chars split across tokens never yield
@@ -46,7 +47,8 @@ async def stream_text(engine, tokenizer, prompt_ids, sampling,
     decoder = codecs.getincrementaldecoder("utf-8")("replace")
     async for tok in engine.generate_stream(prompt_ids, sampling,
                                             priority=priority,
-                                            adapter=adapter):
+                                            adapter=adapter,
+                                            request_sink=request_sink):
         if state is not None:
             state["n_tokens"] = state.get("n_tokens", 0) + 1
         if tok in stop_ids:

@@ -71,8 +71,7 @@ def _flash_page_accumulate(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
     m_blk = jnp.max(s, axis=1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_blk)
     alpha = jnp.exp(m_prev - m_new)
-    p_blk = jnp.where(jnp.concatenate([valid] * (n_kv * group), axis=0),
-                      jnp.exp(s - m_new), 0.0)
+    p_blk = jnp.where(valid, jnp.exp(s - m_new), 0.0)  # [1,ps] broadcasts
     l_new = l_prev * alpha + jnp.sum(p_blk, axis=1, keepdims=True)
 
     pv_rows = []

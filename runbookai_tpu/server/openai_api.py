@@ -326,14 +326,8 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     if n != 1:
                         self._error(400, "stream with n > 1 is unsupported")
                         return
-                    if sampling.logprobs:
-                        # Same honest-subset policy as stream+n: the SSE
-                        # path pipes through the text streamer, which has
-                        # no per-token logprob channel (yet).
-                        self._error(400,
-                                    "stream with logprobs is unsupported")
-                        return
-                    self._stream_response(ids, sampling, adapter)
+                    self._stream_response(ids, sampling, adapter,
+                                          top_logprobs=top_logprobs)
                 else:
                     # The engine-side timeout ABORTS a stalled request
                     # (frees slot + KV pages) before raising; the bridge
@@ -498,7 +492,8 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             self._json(200, {"loaded": name,
                              "adapters": client.core.lora.names})
 
-        def _stream_response(self, ids, sampling, adapter=None) -> None:
+        def _stream_response(self, ids, sampling, adapter=None,
+                             top_logprobs: int = 0) -> None:
             from runbookai_tpu.model.jax_tpu import stream_text
 
             self.send_response(200)
@@ -525,18 +520,50 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             state: dict = {}
             # Shared with JaxTpuClient.chat_stream: one copy of the
             # incremental-UTF-8 / stop-token handling for all surfaces.
+            # With logprobs, the live EngineRequest rides along (entries
+            # accumulate on the engine thread; list reads are safe) and
+            # each chunk carries the entries for tokens consumed since the
+            # last chunk — OpenAI streams logprobs in the deltas.
+            req_sink: list = []
             agen = stream_text(client.engine, client.tokenizer, ids,
-                               sampling, state=state, adapter=adapter)
+                               sampling, state=state, adapter=adapter,
+                               request_sink=req_sink)
+            lp_sent = 0
+
+            def chunk_logprobs() -> Optional[dict]:
+                nonlocal lp_sent
+                if not sampling.logprobs or not req_sink:
+                    return None
+                entries = req_sink[0].out_logprobs
+                upto = min(len(entries),
+                           state.get("n_tokens", 0)
+                           - (1 if state.get("saw_stop") else 0))
+                if upto <= lp_sent:
+                    return None
+                out = {"content": [
+                    _logprob_entry(client.tokenizer, e, top_logprobs)
+                    for e in entries[lp_sent:upto]]}
+                lp_sent = upto
+                return out
+
             try:
                 for piece in bridge.stream(agen, timeout=request_timeout):
-                    send_chunk(_chunk_payload(
-                        model_name, {"content": piece}, None, chunk_id))
+                    payload = _chunk_payload(
+                        model_name, {"content": piece}, None, chunk_id)
+                    lp = chunk_logprobs()
+                    if lp is not None:
+                        payload["choices"][0]["logprobs"] = lp
+                    send_chunk(payload)
                 # max_tokens truncation reports "length", like non-stream.
                 finish = ("length"
                           if not state.get("saw_stop")
                           and state.get("n_tokens", 0)
                           >= sampling.max_new_tokens else "stop")
-                send_chunk(_chunk_payload(model_name, {}, finish, chunk_id))
+                final = _chunk_payload(model_name, {}, finish, chunk_id)
+                lp_tail = chunk_logprobs()  # entries past the last piece
+                if lp_tail is not None:
+                    final["choices"][0]["logprobs"] = lp_tail
+                send_chunk(final)
                 send_terminator()
             except (BrokenPipeError, ConnectionResetError):
                 # Client disconnected mid-stream: close the generator so

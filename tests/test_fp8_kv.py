@@ -105,9 +105,9 @@ def test_probe_downgrade_on_mosaic_failure(monkeypatch):
     the first real dispatch."""
     from runbookai_tpu.engine import engine as engine_mod
 
-    engine_mod._probe_pallas_fp8_cached.cache_clear()
+    engine_mod._probe_pallas_attn_cached.cache_clear()
     monkeypatch.setattr(
-        engine_mod, "_probe_pallas_fp8", lambda cfg, ecfg, act, mesh=None: False)
+        engine_mod, "_probe_pallas_attn", lambda cfg, ecfg, act, mesh=None: False)
     tok = ByteTokenizer()
     params = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
     core = EngineCore(CFG, params, tok, EngineConfig(

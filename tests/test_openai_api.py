@@ -326,8 +326,33 @@ def test_logprobs_off_by_default_and_validation(server):
             "messages": [{"role": "user", "content": "x"}],
             "max_tokens": 3, "top_logprobs": 3})
     assert e.value.code == 400
-    with pytest.raises(urllib.error.HTTPError) as e:
-        _post(server, "/v1/chat/completions", {
-            "messages": [{"role": "user", "content": "x"}],
-            "max_tokens": 3, "logprobs": True, "stream": True})
-    assert e.value.code == 400
+    # stream + logprobs is supported (entries ride the SSE chunks).
+
+
+def test_streaming_logprobs(server):
+    """SSE chunks carry logprobs.content entries for the delta tokens;
+    the total across chunks covers the generated tokens."""
+    with _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "stream lp"}],
+        "max_tokens": 6, "stream": True, "logprobs": True,
+        "top_logprobs": 2,
+    }) as r:
+        raw = r.read().decode()
+    events = [line[len("data: "):] for line in raw.splitlines()
+              if line.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    entries = [e for c in chunks
+               for e in c["choices"][0].get("logprobs", {}).get("content",
+                                                               [])]
+    assert entries, "no logprob entries streamed"
+    for e in entries:
+        assert e["logprob"] <= 0.0
+        assert len(e["top_logprobs"]) == 2
+        assert isinstance(e["bytes"], list)
+    # The strong invariant: entry bytes reconstruct EXACTLY the streamed
+    # content (stop tokens excluded on both sides) — 1:1 alignment.
+    content = "".join(c["choices"][0]["delta"].get("content", "")
+                      for c in chunks)
+    rebuilt = b"".join(bytes(e["bytes"]) for e in entries)
+    assert rebuilt.decode("utf-8", errors="replace") == content
