@@ -126,25 +126,47 @@ class InvestigationOrchestrator:
         Schema support is probed from ``inspect.signature`` once per client
         (ADVICE r2: catching TypeError from the call masked genuine
         TypeErrors raised inside synchronous adapters' argument handling)."""
+        # Token streaming (reference streams into the live Ink tree): when
+        # a sink is listening and the client can stream, emit token deltas
+        # as each phase document decodes — the CLI paints them under the
+        # live hypothesis tree. The joined text is byte-identical to the
+        # buffered path.
+        if self.event_sink is not None and hasattr(self.llm,
+                                                   "complete_stream"):
+            parts: list[str] = []
+            kwargs = ({"schema": schema}
+                      if schema is not None
+                      and self._supports_schema(self.llm.complete_stream)
+                      else {})
+            async for piece in self.llm.complete_stream(prompt, **kwargs):
+                parts.append(piece)
+                # Transient: straight to the sink, NOT self.events — a
+                # long investigation would otherwise store every delta.
+                self.event_sink(AgentEvent("token", {"delta": piece}))
+            return "".join(parts)
         if schema is not None and self._supports_schema():
             return await self.llm.complete(prompt, schema=schema)
         return await self.llm.complete(prompt)
 
-    def _supports_schema(self) -> bool:
-        cached = getattr(self, "_schema_ok", None)
-        if cached is None:
+    def _supports_schema(self, method=None) -> bool:
+        """Does ``method`` (default: ``llm.complete``) accept ``schema=``?
+        Probed per METHOD — an adapter may implement complete(prompt,
+        **kw) but complete_stream(prompt) without it."""
+        method = method if method is not None else self.llm.complete
+        cache: dict = getattr(self, "_schema_ok", None) or {}
+        self._schema_ok = cache
+        key = getattr(method, "__qualname__", repr(method))
+        if key not in cache:
             import inspect
 
             try:
-                sig = inspect.signature(self.llm.complete)
-                params = sig.parameters
-                cached = "schema" in params or any(
+                params = inspect.signature(method).parameters
+                cache[key] = "schema" in params or any(
                     p.kind is inspect.Parameter.VAR_KEYWORD
                     for p in params.values())
             except (TypeError, ValueError):  # builtins/partials w/o signature
-                cached = False
-            self._schema_ok = cached
-        return cached
+                cache[key] = False
+        return cache[key]
 
     # ------------------------------------------------------------------ main
 

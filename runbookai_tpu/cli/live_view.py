@@ -70,6 +70,14 @@ class LiveTreeSink:
         if not self.enabled:
             self.fallback(ev)
             return
+        if getattr(ev, "kind", "") == "token":
+            # Streaming deltas: erase the tree once and let tokens paint
+            # inline; repainting per token would flicker. The tree comes
+            # back on the next structural event.
+            self._erase_tree()
+            self.fallback(ev)
+            self.out.flush()
+            return
         self._erase_tree()
         self.fallback(ev)
         self._paint_tree()

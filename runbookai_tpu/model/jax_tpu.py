@@ -270,6 +270,17 @@ class JaxTpuClient(BaseLLMClient):
             usage={"prompt_tokens": len(ids),
                    "completion_tokens": state.get("n_tokens", 0)})}
 
+    def _completion_request(self, prompt: str, guided: Optional[bool],
+                            schema: Optional[str]):
+        """(ids, sampling) for a completion — ONE place for the guided
+        default / prompt build / grammar pick, so the buffered and
+        streaming paths cannot drift (their text must stay identical)."""
+        use_guided = self.guided_json if guided is None else guided
+        ids = self.tokenizer.encode(
+            build_completion_prompt(prompt, fmt=self.chat_format))
+        grammar = (schema or "json") if use_guided else None
+        return ids, self._sampling(guided=grammar)
+
     async def complete(self, prompt: str, guided: Optional[bool] = None,
                        schema: Optional[str] = None) -> str:
         """Plain completion; guided JSON masking on by default (config) since
@@ -277,12 +288,21 @@ class JaxTpuClient(BaseLLMClient):
         names a compiled grammar (``"triage"``, ``"evaluation"``, … — see
         :func:`~runbookai_tpu.model.schema_guided.orchestrator_schemas`)
         that constrains the output to exactly that document shape."""
-        use_guided = self.guided_json if guided is None else guided
-        ids = self.tokenizer.encode(
-            build_completion_prompt(prompt, fmt=self.chat_format))
-        grammar = (schema or "json") if use_guided else None
-        out = await self.engine.generate(ids, self._sampling(guided=grammar))
+        ids, sampling = self._completion_request(prompt, guided, schema)
+        out = await self.engine.generate(ids, sampling)
         return out.text
+
+    async def complete_stream(self, prompt: str,
+                              guided: Optional[bool] = None,
+                              schema: Optional[str] = None):
+        """Streaming twin of :meth:`complete`: yields text deltas as the
+        engine samples (grammar fast-forwarded runs arrive as one burst).
+        The orchestrator uses it to paint phase documents live under the
+        hypothesis tree."""
+        ids, sampling = self._completion_request(prompt, guided, schema)
+        async for piece in stream_text(self.engine, self.tokenizer, ids,
+                                       sampling):
+            yield piece
 
     async def shutdown(self) -> None:
         await self.engine.stop()

@@ -175,3 +175,32 @@ async def test_log_analysis_merges_regex_and_llm(executor):
     assert "novel_llm_category" in merged.error_categories
     statements = [h.statement for h in merged.suggested_hypotheses]
     assert "bad deploy config" in statements
+
+
+async def test_orchestrator_streams_tokens_to_sink():
+    """With a sink + a streaming-capable client, phase documents stream
+    token deltas to the sink (not into self.events), and the joined text
+    still parses into the same structured result."""
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+
+    client = JaxTpuClient.for_testing(max_new_tokens=200, max_seq_len=2048,
+                                      num_pages=512)
+    try:
+        sunk = []
+        reg = ToolRegistry()
+        sim = sim_tools.SimulatedCloud()
+        sim_tools.register_aws(reg, sim)
+        sim_tools.register_kubernetes(reg, sim)
+        orch = InvestigationOrchestrator(
+            client, ToolExecutor({t.name: t for t in reg.all()}),
+            machine=InvestigationStateMachine(
+                incident_id="INC-stream", max_iterations=2),
+            event_sink=sunk.append)
+        result = await orch.investigate("INC-stream", "checkout latency")
+        kinds = [e.kind for e in sunk]
+        assert "token" in kinds, "no token deltas reached the sink"
+        # Deltas are sink-only: the stored event list stays structural.
+        assert all(e.kind != "token" for e in orch.events)
+        assert result.root_cause is not None
+    finally:
+        await client.shutdown()
