@@ -37,6 +37,7 @@ from runbookai_tpu.agent.tool_cache import LRUToolCache
 from runbookai_tpu.agent.tool_summarizer import summarize_tool_result
 from runbookai_tpu.agent.types import (
     AgentEvent,
+    LLMResponse,
     RetrievedKnowledge,
     RiskLevel,
     Tool,
@@ -106,13 +107,26 @@ class Agent:
             yield AgentEvent("_response", {"response": resp})
             return
         resp = None
+        parts: list[str] = []
         async for ev in self.llm.chat_stream(system, prompt, tools):
             if ev.get("type") == "text":
-                yield AgentEvent("token", {"delta": ev.get("delta", "")})
+                delta = ev.get("delta", "")
+                parts.append(delta)
+                yield AgentEvent("token", {"delta": delta})
             elif ev.get("type") == "done":
                 resp = ev.get("response")
-        if resp is None:  # stream ended without a done event
-            resp = await self.llm.chat(system, prompt, tools)
+        if resp is None:
+            # Stream ended without a 'done' event. The user has already
+            # seen the streamed deltas — re-sampling via chat() could
+            # paint a DIFFERENT answer over them (and doubles inference
+            # cost), so parse the accumulated raw text into the response
+            # instead (ADVICE r4).
+            from runbookai_tpu.model.chat_template import parse_assistant_output
+
+            content, tool_calls, thinking = parse_assistant_output(
+                "".join(parts))
+            resp = LLMResponse(content=content, tool_calls=tool_calls,
+                               thinking=thinking)
         yield AgentEvent("_response", {"response": resp})
 
     # ------------------------------------------------------------------ run

@@ -131,13 +131,17 @@ class InvestigationOrchestrator:
         # as each phase document decodes — the CLI paints them under the
         # live hypothesis tree. The joined text is byte-identical to the
         # buffered path.
-        if self.event_sink is not None and hasattr(self.llm,
-                                                   "complete_stream"):
+        # Streaming must not silently drop the schema constraint: if the
+        # client's complete_stream can't take schema= but this call needs
+        # one, prefer the buffered schema-guided complete() below —
+        # unconstrained phase documents are worse than unstreamed ones
+        # (ADVICE r4).
+        if (self.event_sink is not None
+                and hasattr(self.llm, "complete_stream")
+                and (schema is None
+                     or self._supports_schema(self.llm.complete_stream))):
             parts: list[str] = []
-            kwargs = ({"schema": schema}
-                      if schema is not None
-                      and self._supports_schema(self.llm.complete_stream)
-                      else {})
+            kwargs = {"schema": schema} if schema is not None else {}
             async for piece in self.llm.complete_stream(prompt, **kwargs):
                 parts.append(piece)
                 # Transient: straight to the sink, NOT self.events — a

@@ -275,17 +275,29 @@ def _probe_pallas_attn(model_cfg, ecfg, act_dtype, mesh=None) -> bool:
 
 @functools.lru_cache(maxsize=8)
 def _probe_qmm_pallas_cached(backend: str, m: int, k: int, n: int,
-                             act_dtype_name: str) -> bool:
+                             act_dtype_name: str, mesh=None) -> bool:
     """One compile of the int8 qmm kernel at the model's real (K, N)
     proves the Mosaic int8 widen+dot lowering before serving relies on
     it. One shape is representative: the lowering concern is the int8
-    load/convert pattern, not a particular multiple-of-128 tile count."""
+    load/convert pattern, not a particular multiple-of-128 tile count.
+
+    With a multi-device ``mesh`` the operands are committed replicated on
+    it first, so the probe exercises the same GSPMD partitioning of the
+    Mosaic custom call that the engine's compiled steps will — a DP-only
+    mesh keeps qmm_impl="pallas" (llama.forward_paged only downgrades for
+    MODEL>1 / kv-split), and a partitioning failure must surface here,
+    not at the first real dispatch."""
     try:
         from runbookai_tpu.ops.qmm_pallas import qmm_pallas
 
         x = jnp.zeros((m, k), jnp.dtype(act_dtype_name))
         q = jnp.zeros((k, n), jnp.int8)
         s = jnp.zeros((1, n), jnp.float32)
+        if mesh is not None and mesh.size > 1:
+            from runbookai_tpu.parallel.mesh import replicated
+
+            rep = replicated(mesh)
+            x, q, s = (jax.device_put(a, rep) for a in (x, q, s))
         jax.block_until_ready(
             qmm_pallas(x, q, s, interpret=backend == "cpu"))
         return True
@@ -293,7 +305,7 @@ def _probe_qmm_pallas_cached(backend: str, m: int, k: int, n: int,
         return False
 
 
-def _probe_qmm_pallas(model_cfg, ecfg, act_dtype) -> bool:
+def _probe_qmm_pallas(model_cfg, ecfg, act_dtype, mesh=None) -> bool:
     from runbookai_tpu.ops.qmm_pallas import qmm_pallas_eligible
 
     m = ecfg.max_batch_slots
@@ -302,8 +314,10 @@ def _probe_qmm_pallas(model_cfg, ecfg, act_dtype) -> bool:
         # The kernel would never engage on this model's main matmuls —
         # qmm falls back per-shape, so there is nothing to probe.
         return True
+    if mesh is not None and mesh.size <= 1:
+        mesh = None  # single-device mesh == no mesh for partitioning
     return _probe_qmm_pallas_cached(jax.default_backend(), m, k, n,
-                                    jnp.dtype(act_dtype).name)
+                                    jnp.dtype(act_dtype).name, mesh=mesh)
 
 
 _TOPK_LOGPROBS = 20  # OpenAI's top_logprobs ceiling; one compiled shape
@@ -396,7 +410,7 @@ class EngineCore:
             has_q = any(is_quantized(v)
                         for v in self.params["layers"].values())
             if has_q and not _probe_qmm_pallas(model_cfg, self.ecfg,
-                                               act_dtype):
+                                               act_dtype, mesh=mesh):
                 import dataclasses as _dc
                 import logging
 

@@ -382,6 +382,14 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                         {"prompt_tokens": len(ids),
                          "completion_tokens": sum(o.decode_tokens
                                                   for o in outs)})
+                    # prompt_tokens is counted ONCE for n>1 (the choices
+                    # share one prompt), so cached_tokens must stay a
+                    # subset of it: max() = how much of that one counted
+                    # prompt was cache-served. Later choices hitting the
+                    # prefix the first published is internal dedupe, not
+                    # request-level caching — summing it would report
+                    # cached > prompt_tokens (negative uncached math for
+                    # OpenAI-schema clients).
                     payload["usage"]["prompt_tokens_details"] = {
                         "cached_tokens": max(o.cached_tokens for o in outs)}
                     payload["choices"] = [choice(i, o)

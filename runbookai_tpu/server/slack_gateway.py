@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import threading
 import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -53,15 +54,21 @@ class DedupeCache:
         self.ttl = ttl_s
         self.max_size = max_size
         self._seen: dict[str, float] = {}
+        # Socket mode dispatches each envelope on its own thread; the
+        # check-then-set below must be atomic or a Slack redelivery racing
+        # the original starts a duplicate investigation (ADVICE r4).
+        self._lock = threading.Lock()
 
     def seen(self, event_id: str) -> bool:
         now = time.time()
-        if len(self._seen) > self.max_size:
-            self._seen = {k: v for k, v in self._seen.items() if now - v < self.ttl}
-        if event_id in self._seen and now - self._seen[event_id] < self.ttl:
-            return True
-        self._seen[event_id] = now
-        return False
+        with self._lock:
+            if len(self._seen) > self.max_size:
+                self._seen = {k: v for k, v in self._seen.items()
+                              if now - v < self.ttl}
+            if event_id in self._seen and now - self._seen[event_id] < self.ttl:
+                return True
+            self._seen[event_id] = now
+            return False
 
 
 @dataclass

@@ -269,3 +269,39 @@ def test_kv_split_engine_pallas_matches_unsharded(kvsplit_setup):
     got = greedy(make_core(tok, sharded, mesh=mesh, attn_impl="pallas"),
                  prompts)
     assert got[0].out_ids == ref[0].out_ids
+
+
+def test_qmm_probe_runs_under_multidevice_mesh():
+    """ADVICE r4 medium: a DP-only multi-device mesh keeps qmm_impl=
+    'pallas' in the model forward, so the init-time probe must compile
+    the kernel under THAT mesh (replicated operands, GSPMD partitioning)
+    — a partitioning failure has to downgrade at init, not crash the
+    first dispatch."""
+    from runbookai_tpu.engine.engine import (
+        _probe_qmm_pallas_cached,
+    )
+
+    mesh = build_mesh(data=8)
+    assert mesh.size == 8
+    assert _probe_qmm_pallas_cached(
+        "cpu", 8, 256, 512, "bfloat16", mesh=mesh)
+
+
+def test_engine_int8_dp_mesh_serves(setup):
+    """int8 weights + multi-device DP-only mesh + qmm auto path: engine
+    construction runs the mesh-aware probe and the first dispatch must
+    not crash (the ADVICE r4 failure mode)."""
+    from runbookai_tpu.models.quant import quantize_params
+
+    from runbookai_tpu.parallel.mesh import replicated
+
+    tok, params, mesh, _ = setup
+    dp_mesh = build_mesh(data=2)
+    qparams = quantize_params(params)
+    rep = jax.tree.map(
+        lambda a: jax.device_put(a, replicated(dp_mesh)), qparams)
+    prompts = [tok.encode("dp int8 qmm probe parity")]
+    ref = greedy(make_core(tok, qparams), prompts)
+    got = greedy(make_core(tok, rep, mesh=dp_mesh, qmm_impl="pallas"),
+                 prompts)
+    assert got[0].out_ids == ref[0].out_ids
