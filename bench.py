@@ -54,6 +54,15 @@ def peak_flops_per_chip(device_kind: str) -> float | None:
     return None
 
 
+# The last throughput figure ever measured on real TPU hardware (r3,
+# BENCHLOG.md: llama3-8b int8, slots=8, Pallas attention). Surfaced in the
+# CPU-fallback artifact so a toy number is never mistaken for the chip's.
+LAST_BANKED_TPU = {
+    "value": 209.9, "unit": "tok/s",
+    "source": "BENCHLOG.md round 3 (llama3-8b-instruct int8, slots=8)",
+}
+
+
 def make_result(value: float, unit: str, details: dict) -> dict:
     return {
         "metric": "decode_tokens_per_sec_per_chip",
@@ -288,11 +297,25 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     cfg = CONFIGS[model_name]
     dtype = jnp.bfloat16 if on_accel else jnp.float32
     quantized = on_accel and model_name == "llama3-8b-instruct"
-    if quantized:
+    # Real-weights on-ramp (VERDICT r4 #3): $RUNBOOK_WEIGHTS is picked up
+    # automatically, switching the quality axis from "unmeasured" to
+    # measurable; otherwise random-init (identical compute, no-egress env).
+    from runbookai_tpu.utils.weights import discover_weights, quality_marker
+
+    weights_path = discover_weights(model_name)
+    if weights_path:
+        from runbookai_tpu.models.hf_loader import load_or_init
+        from runbookai_tpu.utils.tokens import load_tokenizer
+
+        cfg, params = load_or_init(model_name, weights_path, dtype=dtype,
+                                   quantize_int8=quantized)
+        tok = load_tokenizer(weights_path)
+    elif quantized:
         params = init_params_quantized(jax.random.PRNGKey(0), cfg, dtype=dtype)
+        tok = ByteTokenizer()
     else:
         params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
-    tok = ByteTokenizer()
+        tok = ByteTokenizer()
     # HBM-aware page budget: cap the KV pool so weights + pool + working set
     # fit the chip (the slots=16 experiment OOM'd by preallocating an 8GB
     # pool next to 8.5GB of weights). Uses the device's reported bytes_limit
@@ -409,6 +432,11 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     details = {
         "model": model_name,
         "weights": "int8" if quantized else str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
+        # Quality axis honesty: random-init weights give real THROUGHPUT
+        # numbers but meaningless quality/acceptance — say so in the
+        # artifact until a real checkpoint is discovered.
+        "quality": quality_marker(weights_path),
+        "weights_path": weights_path,
         "platform": probe.get("platform"),
         "device_kind": probe.get("kind"),
         "devices": probe.get("n"),
@@ -594,6 +622,11 @@ def main() -> None:
         det["cpu_sanity"] = sanity_line
         if not on_accel:
             det["headline_is_cpu_fallback"] = True
+            # A toy-model CPU number over a hardware baseline is noise
+            # dressed as a ratio (VERDICT r4 weak #5): null it and surface
+            # the last banked TPU figure so the artifact can't be misread.
+            result["vs_baseline"] = None
+            det["hardware_headline"] = dict(LAST_BANKED_TPU)
         print(json.dumps(result), flush=True)
 
     if not on_accel and cpu_sanity is not None and \

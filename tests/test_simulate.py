@@ -141,3 +141,59 @@ async def test_simulated_github_serves_deploy_culprit_pr():
     out2 = await tool.execute({"action": "fix_candidates",
                                "keywords": ["feature-flag"]})
     assert out2["results"]
+
+
+# ------------------------------------------------------ real-infra seam
+
+
+def test_provision_plan_covers_every_fault_family():
+    """VERDICT r4 #8: every generated fault family must map onto a real
+    break/teardown recipe (a new family without one raises at plan time,
+    not silently)."""
+    from runbookai_tpu.simulate.generator import FAULT_TYPES
+    from runbookai_tpu.simulate.provision import provision_plan
+
+    for i, fault in enumerate(sorted(FAULT_TYPES)):
+        s = generate_scenario(100 + i, fault_type=fault)
+        plan = provision_plan(s)
+        assert plan.break_steps, fault
+        assert plan.teardown_steps, fault
+        rendered = plan.render()
+        assert s.scenario_id in rendered
+        # teardown printed before break: interrupted applies stay
+        # reversible by hand.
+        assert rendered.index("teardown") < rendered.index("break:")
+
+
+def test_provision_refuses_gracefully_without_credentials(monkeypatch):
+    from runbookai_tpu.simulate import provision as prov
+
+    monkeypatch.setattr(prov, "aws_credentials_available", lambda: None)
+    s = generate_scenario(7, fault_type="throttling_quota")
+    plan, status = prov.provision(s, apply=True)
+    assert plan.break_steps
+    assert status.startswith("refused")
+
+
+def test_provision_dry_run_never_touches_boto3(monkeypatch):
+    import sys
+
+    from runbookai_tpu.simulate import provision as prov
+
+    monkeypatch.setitem(sys.modules, "boto3", None)  # import would fail
+    s = generate_scenario(8, fault_type="network_partition")
+    plan, status = prov.provision(s, apply=False)
+    assert "dry-run" in status
+
+
+def test_apply_refuses_on_unresolved_operator_inputs(monkeypatch):
+    """Even WITH credentials, apply must refuse while any break step
+    still needs site-specific input — never crash boto3 mid-recipe."""
+    from runbookai_tpu.simulate import provision as prov
+
+    monkeypatch.setattr(prov, "aws_credentials_available", lambda: "env")
+    s = generate_scenario(9, fault_type="cert_expiry")
+    plan = prov.provision_plan(s)
+    status = prov.apply_plan(plan)
+    assert status.startswith("refused: steps need operator input")
+    assert "Certificate/PrivateKey" in status
