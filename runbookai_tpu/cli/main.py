@@ -414,8 +414,13 @@ def cmd_checkpoint(args) -> int:
     return 1
 
 
-def _live_eval_report(args, cases, name: str) -> int:
-    """Shared run-live-and-report tail for eval and simulate eval."""
+def _live_eval_report(args, cases, name: str,
+                      case_labels: Optional[dict] = None) -> int:
+    """Shared run-live-and-report tail for eval and simulate eval.
+
+    ``case_labels`` (case_id -> {label: value}) adds grouped pass rates —
+    simulate eval reports per-fault-family and per-adversarial-split
+    accuracy with it (VERDICT r4 #4)."""
     from runbookai_tpu.cli.runtime import build_runtime
     from runbookai_tpu.evalsuite.runner import run_live, write_reports
 
@@ -423,10 +428,34 @@ def _live_eval_report(args, cases, name: str) -> int:
     report = asyncio.run(run_live(
         cases, lambda: runtime.llm, name=name,
         concurrency=args.concurrency))
+    out = report.to_dict()
+    if case_labels:
+        out["breakdown"] = _pass_rate_breakdown(report.cases, case_labels)
     summary_path = write_reports([report], args.out)
-    print(json.dumps(report.to_dict() | {"summary_path": str(summary_path)},
+    out_path = Path(args.out) / f"{name}.json"
+    if case_labels and out_path.exists():
+        # The per-case file write_reports produced, plus the breakdown.
+        out_path.write_text(json.dumps(out, indent=2, default=str))
+    print(json.dumps(out | {"summary_path": str(summary_path)},
                      indent=2, default=str))
     return 0 if report.pass_rate >= getattr(args, "min_pass_rate", 0.0) else 1
+
+
+def _pass_rate_breakdown(case_results: list, case_labels: dict) -> dict:
+    """{label_kind: {label_value: {passed, total, pass_rate}}}."""
+    out: dict = {}
+    for c in case_results:
+        labels = case_labels.get(c.get("case_id"), {})
+        for kind, value in labels.items():
+            bucket = out.setdefault(kind, {}).setdefault(
+                str(value), {"passed": 0, "total": 0})
+            bucket["total"] += 1
+            bucket["passed"] += bool(c.get("passed"))
+    for kind in out.values():
+        for bucket in kind.values():
+            bucket["pass_rate"] = round(
+                bucket["passed"] / max(1, bucket["total"]), 4)
+    return out
 
 
 def cmd_eval(args) -> int:
@@ -488,8 +517,9 @@ def cmd_simulate(args) -> int:
         return 1
 
     if args.sim_cmd == "generate":
-        scenarios = generate_scenarios(args.n, seed=args.seed,
-                                       fault_type=args.fault)
+        scenarios = generate_scenarios(
+            args.n, seed=args.seed, fault_type=args.fault,
+            adversarial=getattr(args, "adversarial", None))
         paths = write_scenarios(scenarios, args.out)
         for s, p in zip(scenarios, paths):
             line = f"{s.scenario_id}  {s.truth['fault_type']:22s}  {p}"
@@ -542,10 +572,18 @@ def cmd_simulate(args) -> int:
         return 0
 
     if args.sim_cmd == "eval":
-        scenarios = generate_scenarios(args.n, seed=args.seed,
-                                       fault_type=args.fault)
+        scenarios = generate_scenarios(
+            args.n, seed=args.seed, fault_type=args.fault,
+            adversarial=getattr(args, "adversarial", None))
         cases = [to_eval_case(s) for s in scenarios]
-        return _live_eval_report(args, cases, name="simulated-incidents")
+        # Per-family + adversarial-split accuracy (VERDICT r4 #4): the
+        # breakdown is what separates reasoning from keyword overlap.
+        labels = {s.scenario_id: {
+            "fault_family": s.truth["fault_type"],
+            "adversarial": s.truth.get("adversarial", "none"),
+        } for s in scenarios}
+        return _live_eval_report(args, cases, name="simulated-incidents",
+                                 case_labels=labels)
 
     if args.sim_cmd == "provision":
         # Real-infrastructure mode (reference setup-incidents.sh). The
@@ -882,6 +920,12 @@ def build_parser() -> argparse.ArgumentParser:
     sim_gen.add_argument("--out", default=".runbook/simulate")
     sim_gen.add_argument("--reveal", action="store_true",
                          help="print ground truth with each scenario")
+    sim_gen.add_argument(
+        "--adversarial", default=None,
+        choices=["misleading_symptom", "two_fault", "signal_dropout", "mix"],
+        help="harden scenarios: stale red-herring signals on a non-culprit "
+             "service, a concurrent second fault, or a dropped telemetry "
+             "modality")
     sim_sub.add_parser("faults", help="list fault types")
     sim_inv = sim_sub.add_parser("investigate",
                                  help="run the agent against a scenario")
@@ -895,6 +939,10 @@ def build_parser() -> argparse.ArgumentParser:
     sim_eval.add_argument("--concurrency", type=int, default=4)
     sim_eval.add_argument("--min-pass-rate", type=float, default=0.0)
     sim_eval.add_argument("--out", default=".runbook/eval-reports")
+    sim_eval.add_argument(
+        "--adversarial", default=None,
+        choices=["misleading_symptom", "two_fault", "signal_dropout", "mix"],
+        help="run the hardened split (reported separately in breakdown)")
     sim_prov = sim_sub.add_parser(
         "provision",
         help="real-infra mode: map a scenario onto actual AWS breakage "

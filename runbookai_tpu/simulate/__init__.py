@@ -12,6 +12,7 @@ fixture, with machine-checkable ground truth for the eval suite.
 """
 
 from runbookai_tpu.simulate.generator import (
+    ADVERSARIAL_MODES,
     FAULT_TYPES,
     Scenario,
     generate_scenario,
@@ -20,6 +21,7 @@ from runbookai_tpu.simulate.generator import (
 )
 
 __all__ = [
+    "ADVERSARIAL_MODES",
     "FAULT_TYPES",
     "Scenario",
     "generate_scenario",

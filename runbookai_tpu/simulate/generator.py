@@ -302,10 +302,163 @@ FAULT_TYPES: dict[str, Any] = {
 
 # ------------------------------------------------------------- generator
 
-def generate_scenario(seed: int, fault_type: str | None = None) -> Scenario:
-    """One seeded scenario: novel topology + fault + full signal chain."""
+# Adversarial variants (VERDICT r4 next-round #4): the base templates and
+# causal_query's patterns were written by the same hand, so a keyword-
+# overlap "investigation" can score well without reasoning. These modes
+# are built to break that strategy:
+#   misleading_symptom — a louder, WRONG-family signal chain on a visible
+#     non-culprit service, STALE relative to incident start (the tell a
+#     parrot ignores); parroting the loudest log names the decoy and
+#     scores 0 on keywords/services.
+#   two_fault — an independent second fault on an off-chain service; the
+#     paged incident (and scoring) is the primary's, so "found A fault"
+#     is not "found THE fault".
+#   signal_dropout — a whole telemetry modality is missing, with a meta
+#     signal explaining why (broken log shipper / alarm delivery);
+#     the answer must be inferred from the remaining modalities.
+ADVERSARIAL_MODES = ("misleading_symptom", "two_fault", "signal_dropout")
+
+
+def generate_scenario(seed: int, fault_type: str | None = None,
+                      adversarial: str | None = None) -> Scenario:
+    """One seeded scenario: novel topology + fault + full signal chain.
+
+    ``adversarial`` picks a hardening transform from
+    :data:`ADVERSARIAL_MODES` (or ``"mix"`` to rotate by seed)."""
     with _gen_lock:
-        return _generate_locked(seed, fault_type)
+        s = _generate_locked(seed, fault_type)
+        if adversarial:
+            mode = adversarial
+            if mode == "mix":
+                mode = ADVERSARIAL_MODES[seed % len(ADVERSARIAL_MODES)]
+            if mode not in ADVERSARIAL_MODES:
+                raise ValueError(f"unknown adversarial mode {mode!r}; "
+                                 f"valid: {ADVERSARIAL_MODES + ('mix',)}")
+            rng = random.Random(seed ^ 0xADE5A1)
+            s = _ADVERSARIAL[mode](s, rng)
+        return s
+
+
+def _apply_misleading_symptom(s: Scenario, rng: random.Random) -> Scenario:
+    """Red-herring signal chain on a non-culprit service.
+
+    The decoy's fault family differs from the real one and its signals
+    are LOUDER (bigger alarm value, FATAL logs) but stale: alarm state
+    changed hours before the incident, log timestamps predate it, and a
+    recovery event closes the story. An agent that checks timestamps
+    walks past it; a keyword parrot reports the decoy and scores 0."""
+    root = s.truth["root_cause_service"]
+    chain = s.truth["chain"]
+    decoy = chain[0] if chain[0] != root else (
+        chain[1] if len(chain) > 2 and chain[1] != root else chain[-1])
+    if decoy == root:  # degenerate 2-chain with root at the edge
+        decoy = chain[-1]
+    decoy_fault = rng.choice(sorted(set(FAULT_TYPES)
+                                    - {s.truth["fault_type"]}))
+    f = FAULT_TYPES[decoy_fault](decoy, None, rng)
+    metric, threshold, value = f["alarm_metric"]
+    stale = 190 + rng.randint(0, 90)  # minutes before the real incident
+    s.fixtures["cloudwatch_alarms"].insert(0, {
+        "alarmName": f"{decoy}-{metric}", "state": "ALARM",
+        "metric": metric, "threshold": threshold,
+        # Louder than the real alarm — the parrot's first pick.
+        "currentValue": value if not isinstance(value, (int, float))
+        else value * 3,
+        "stateChangedAt": _ts(stale), "service": decoy})
+    s.fixtures["cloudwatch_logs"][f"/ecs/{decoy}"] = [
+        {"ts": _ts(stale + 2 + i), "level": "FATAL" if i == 0 else lvl,
+         "message": msg}
+        for i, (lvl, msg) in enumerate(f["logs"])]
+    # The decoy story CLOSES before the incident starts: self-recovery
+    # event visible in datadog — the tell that it is history, not cause.
+    s.fixtures["datadog"]["events"].append(
+        {"ts": _ts(stale - 12), "title": f"{decoy} recovered",
+         "tags": [f"service:{decoy}", "auto-recovery"],
+         "text": f"{f['pd']} — self-recovered; no action taken"})
+    s.truth["adversarial"] = "misleading_symptom"
+    s.truth["decoy_service"] = decoy
+    s.truth["decoy_fault_type"] = decoy_fault
+    s.truth["decoy_keywords"] = f["keywords"]
+    return s
+
+
+def _apply_two_fault(s: Scenario, rng: random.Random) -> Scenario:
+    """Independent concurrent fault on an off-chain service.
+
+    Both faults are live RIGHT NOW; only the primary is what the page is
+    about (the query and PD incident are unchanged), so naming the
+    secondary is finding A fault, not THE fault. Scoring stays anchored
+    to the primary's root cause; the secondary rides in truth for
+    per-split reporting."""
+    chain = s.truth["chain"]
+    candidates = sorted((set(_MID) | set(_BACKEND)) - set(chain))
+    second_svc = rng.choice(candidates)
+    second_fault = rng.choice(sorted(set(FAULT_TYPES)
+                                     - {s.truth["fault_type"]}))
+    f = FAULT_TYPES[second_fault](second_svc, None, rng)
+    metric, threshold, value = f["alarm_metric"]
+    start = rng.randint(10, 45)
+    s.fixtures["cloudwatch_alarms"].append({
+        "alarmName": f"{second_svc}-{metric}", "state": "ALARM",
+        "metric": metric, "threshold": threshold, "currentValue": value,
+        "stateChangedAt": _ts(start), "service": second_svc})
+    s.fixtures["cloudwatch_logs"][f"/ecs/{second_svc}"] = [
+        {"ts": _ts(start + 1 + i), "level": lvl, "message": msg}
+        for i, (lvl, msg) in enumerate(f["logs"])]
+    s.fixtures["kubernetes"]["pods"].append(
+        {"name": f"{second_svc}-{rng.randrange(16**6):06x}-0",
+         "namespace": "prod", "status": f["pods"],
+         "restarts": rng.randint(3, 11) if f["pods"] != "Running" else 0,
+         "age": f"{start + 30}m"})
+    s.fixtures["aws"]["ecs"].append(
+        {"service": second_svc, "status": "ACTIVE",
+         "runningCount": 2 if f["pods"] != "Running" else 3,
+         "desiredCount": 3, "pendingCount": 0})
+    s.truth["adversarial"] = "two_fault"
+    s.truth["secondary"] = {"fault_type": second_fault,
+                            "service": second_svc,
+                            "root_cause": f["root_cause"]}
+    return s
+
+
+def _apply_signal_dropout(s: Scenario, rng: random.Random) -> Scenario:
+    """Drop a whole telemetry modality, with a meta signal saying why.
+
+    Logs/alarms/metrics vanish the way they do in real incidents (broken
+    shipper, alarm delivery outage) — the investigation must cross to the
+    surviving modalities instead of failing on the empty one."""
+    root = s.truth["root_cause_service"]
+    dropped = rng.choice(("logs", "alarms", "metrics"))
+    if dropped == "logs":
+        s.fixtures["cloudwatch_logs"].pop(f"/ecs/{root}", None)
+        s.fixtures["kubernetes"]["events"].append(
+            {"ts": _ts(30), "type": "Warning", "reason": "DaemonSetDegraded",
+             "object": "daemonset/fluent-bit",
+             "message": f"log shipper degraded on nodes running {root}; "
+                        f"/ecs/{root} not receiving entries"})
+    elif dropped == "alarms":
+        s.fixtures["cloudwatch_alarms"] = []
+        s.fixtures["datadog"]["events"].append(
+            {"ts": _ts(35), "title": "CloudWatch alarm delivery degraded",
+             "tags": ["provider:aws", "alarms"],
+             "text": "alarm actions delayed/dropped; rely on raw metrics "
+                     "and prometheus alerts"})
+    else:
+        s.fixtures["datadog"]["metrics"] = {}
+        s.fixtures["datadog"]["events"].append(
+            {"ts": _ts(35), "title": "datadog agent fleet degraded",
+             "tags": ["provider:datadog"],
+             "text": "metric intake gap; dashboards empty for ~1h"})
+    s.truth["adversarial"] = "signal_dropout"
+    s.truth["dropped"] = dropped
+    return s
+
+
+_ADVERSARIAL = {
+    "misleading_symptom": _apply_misleading_symptom,
+    "two_fault": _apply_two_fault,
+    "signal_dropout": _apply_signal_dropout,
+}
 
 
 def _generate_locked(seed: int, fault_type: str | None) -> Scenario:
@@ -434,8 +587,10 @@ def _generate_locked(seed: int, fault_type: str | None) -> Scenario:
 
 
 def generate_scenarios(n: int, seed: int = 0,
-                       fault_type: str | None = None) -> list[Scenario]:
-    return [generate_scenario(seed + i, fault_type) for i in range(n)]
+                       fault_type: str | None = None,
+                       adversarial: str | None = None) -> list[Scenario]:
+    return [generate_scenario(seed + i, fault_type, adversarial=adversarial)
+            for i in range(n)]
 
 
 def to_eval_case(s: Scenario):

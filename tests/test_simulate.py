@@ -197,3 +197,114 @@ def test_apply_refuses_on_unresolved_operator_inputs(monkeypatch):
     status = prov.apply_plan(plan)
     assert status.startswith("refused: steps need operator input")
     assert "Certificate/PrivateKey" in status
+
+
+# ------------------------------------------------- adversarial variants
+
+
+def test_misleading_symptom_decoy_is_stale_and_wrong_family():
+    s = generate_scenario(2, fault_type="db_pool_exhaustion",
+                          adversarial="misleading_symptom")
+    t = s.truth
+    assert t["adversarial"] == "misleading_symptom"
+    decoy, root = t["decoy_service"], t["root_cause_service"]
+    assert decoy != root
+    assert t["decoy_fault_type"] != t["fault_type"]
+    # Decoy alarm exists and is STALE (changed before the real incident).
+    decoy_alarms = [a for a in s.fixtures["cloudwatch_alarms"]
+                    if a["service"] == decoy]
+    real_alarms = [a for a in s.fixtures["cloudwatch_alarms"]
+                   if a["service"] == root]
+    assert decoy_alarms and real_alarms
+    assert decoy_alarms[0]["stateChangedAt"] < real_alarms[0]["stateChangedAt"]
+    # The recovery event closes the decoy's story.
+    assert any("recovered" in e["title"]
+               for e in s.fixtures["datadog"]["events"])
+
+
+def test_keyword_overlap_alone_scores_zero_on_misleading_split():
+    """THE discriminator (VERDICT r4 #4): a strategy that parrots the
+    loudest alarm's service + log text passes every base scenario and
+    FAILS the misleading split — simulate eval now measures reasoning,
+    not keyword overlap."""
+    from runbookai_tpu.evalsuite.scoring import score_investigation_result
+
+    def parrot(s):
+        def loud(a):
+            v = a.get("currentValue", 0)
+            return v if isinstance(v, (int, float)) else 0
+        a = max(s.fixtures["cloudwatch_alarms"], key=loud)
+        svc = a["service"]
+        text = " ".join(l["message"] for l in
+                        s.fixtures["cloudwatch_logs"].get(f"/ecs/{svc}", [])[:2])
+        return {"root_cause": f"{svc}: {text}", "confidence": "high",
+                "affected_services": [svc], "summary": text}
+
+    for seed in (1, 2, 5):  # decoy alarm outshouts the real one
+        base = generate_scenario(seed, fault_type="db_pool_exhaustion")
+        adv = generate_scenario(seed, fault_type="db_pool_exhaustion",
+                                adversarial="misleading_symptom")
+        assert score_investigation_result(to_eval_case(base),
+                                          parrot(base)).passed
+        adv_score = score_investigation_result(to_eval_case(adv),
+                                               parrot(adv))
+        assert not adv_score.passed, (seed, adv_score)
+        assert adv_score.dimensions["root_cause"] == 0.0
+
+
+def test_two_fault_secondary_is_off_chain_and_scored_to_primary():
+    s = generate_scenario(5, fault_type="cert_expiry",
+                          adversarial="two_fault")
+    t = s.truth
+    sec = t["secondary"]
+    assert sec["service"] not in t["chain"]
+    assert sec["fault_type"] != t["fault_type"]
+    # Secondary signals are live in fixtures.
+    assert any(a["service"] == sec["service"]
+               for a in s.fixtures["cloudwatch_alarms"])
+    assert f"/ecs/{sec['service']}" in s.fixtures["cloudwatch_logs"]
+    # Scoring stays anchored to the primary: naming only the secondary
+    # must not pass.
+    from runbookai_tpu.evalsuite.scoring import score_investigation_result
+
+    case = to_eval_case(s)
+    assert case.expected_services == [t["root_cause_service"]]
+    wrong = {"root_cause": sec["root_cause"], "confidence": "high",
+             "affected_services": [sec["service"]],
+             "summary": sec["root_cause"]}
+    assert not score_investigation_result(case, wrong).passed
+
+
+def test_signal_dropout_removes_modality_with_meta_signal():
+    seen = set()
+    for seed in range(12):
+        s = generate_scenario(seed, fault_type="memory_leak_oom",
+                              adversarial="signal_dropout")
+        dropped = s.truth["dropped"]
+        seen.add(dropped)
+        root = s.truth["root_cause_service"]
+        if dropped == "logs":
+            assert f"/ecs/{root}" not in s.fixtures["cloudwatch_logs"]
+            assert any(e["reason"] == "DaemonSetDegraded"
+                       for e in s.fixtures["kubernetes"]["events"])
+        elif dropped == "alarms":
+            assert s.fixtures["cloudwatch_alarms"] == []
+            assert s.fixtures["prometheus"]["alerts"]  # survives
+        else:
+            assert s.fixtures["datadog"]["metrics"] == {}
+    assert seen == {"logs", "alarms", "metrics"}  # all modalities exercised
+
+
+def test_adversarial_generation_is_deterministic():
+    for mode in ("misleading_symptom", "two_fault", "signal_dropout", "mix"):
+        a = generate_scenario(9, adversarial=mode)
+        b = generate_scenario(9, adversarial=mode)
+        assert a.to_json() == b.to_json()
+
+
+def test_mix_rotates_modes_by_seed():
+    from runbookai_tpu.simulate.generator import ADVERSARIAL_MODES
+
+    modes = {generate_scenario(s, adversarial="mix").truth["adversarial"]
+             for s in range(6)}
+    assert modes == set(ADVERSARIAL_MODES)
