@@ -125,3 +125,70 @@ def test_engine_serves_33k_context():
     assert req.finish_reason is not None
     assert req.ctx_len > 33_000
     assert len(req.out_ids) == 4
+
+
+# --------------------------------------------------------------------- #
+# long context ON THE KV-SPLIT MESH (VERDICT r4 #6)                     #
+# --------------------------------------------------------------------- #
+#
+# The 128k plans (8b tp4, 70b tp16 = kv8 x pg2) rest on the page-axis
+# sequence sharding in parallel/kv_split.py. These tests run the SAME
+# factorization scaled down (tp4 on n_kv=2 -> kv2 x pg2, so pg_shards>1
+# exactly like the 70b plan) on the virtual 8-device CPU mesh, proving
+# the plan's collectives + page math serve past the rope knee — not just
+# the single-device 33k case.
+
+
+def _serve_long_kv_split(prompt_len: int, max_seq: int, tp: int = 4,
+                         new_tokens: int = 4,
+                         prefill_chunk: int = 1024) -> EngineRequest:
+    from runbookai_tpu.parallel.kv_split import plan_kv_split
+    from runbookai_tpu.parallel.mesh import build_mesh
+    from runbookai_tpu.parallel.sharding import param_shardings
+
+    cfg = _longctx_cfg(max_seq)
+    plan = plan_kv_split(cfg, tp)
+    assert plan.pg_shards > 1, plan  # the 70b-style page split is live
+    mesh = build_mesh(1, model=plan.kv_shards, seq=plan.pg_shards)
+    tok = ByteTokenizer()
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    sharded = jax.tree.map(jax.device_put, params,
+                           param_shardings(cfg, mesh))
+    core = EngineCore(cfg, sharded, tok, EngineConfig(
+        page_size=16, num_pages=prompt_len // 16 + 64, max_batch_slots=1,
+        prefill_chunk=prefill_chunk, max_seq_len=max_seq,
+        kv_dtype=jnp.float32, block_pages=64, speculative=False,
+        prefill_batch=1), mesh=mesh)
+    prompt = np.random.default_rng(0).integers(3, 250,
+                                               size=prompt_len).tolist()
+    req = EngineRequest(prompt_ids=prompt,
+                        sampling=SamplingParams(temperature=0.0,
+                                                max_new_tokens=new_tokens,
+                                                stop_token_ids=()))
+    core.submit(req)
+    core.run_until_idle()
+    return req
+
+
+def test_kv_split_serves_past_rope_knee_matches_unsharded():
+    """9k context on the kv2 x pg2 mesh: chunked prefill + page-split
+    decode past the rope knee, greedy-identical to the single-device
+    engine (the 128k plan's mechanics at test scale)."""
+    ref = _serve_long(9_100, max_seq=10_240)
+    got = _serve_long_kv_split(9_100, max_seq=10_240)
+    assert got.finish_reason is not None
+    assert got.ctx_len > 9_100
+    assert got.out_ids == ref.out_ids
+
+
+@pytest.mark.skipif(not os.environ.get("RUNBOOK_LONGCTX"),
+                    reason="33k kv-split proof is slow on CPU; "
+                           "set RUNBOOK_LONGCTX=1")
+def test_kv_split_engine_serves_33k_context():
+    """>32k served with pg_shards>1 (the 70b-128k factorization, scaled):
+    greedy parity vs the unsharded XLA engine at the same 33k prompt."""
+    ref = _serve_long(33_000, max_seq=34_816)
+    got = _serve_long_kv_split(33_000, max_seq=34_816)
+    assert got.finish_reason is not None
+    assert got.ctx_len > 33_000
+    assert got.out_ids == ref.out_ids
