@@ -256,6 +256,21 @@ class InvestigationOrchestrator:
                 except Exception as exc:  # noqa: BLE001 — move to next source
                     self.machine.record_error(f"{tool}: {exc}")
 
+        # Deterministic cross-modality triage first (signal_triage tool):
+        # dates signals against the incident start, discounts stale/
+        # recovered stories, ranks candidates by symptom topology — the
+        # phase document starts from analyzed evidence, not raw noise.
+        if "signal_triage" in self.executor.available():
+            try:
+                tri = await self.executor.execute(
+                    "signal_triage", {"incident_id": incident_id})
+                if isinstance(tri, dict) and tri.get("report"):
+                    blocks.append("Signal triage (deterministic "
+                                  "cross-modality analysis):\n"
+                                  + str(tri["report"])[:2000])
+            except Exception as exc:  # noqa: BLE001 — analysis is optional
+                self.machine.record_error(f"signal_triage: {exc}")
+
         # Fallback chain (orchestrator :815-869) — stop at first real signal.
         chain = [
             ("search_knowledge", {"query": description or incident_id or "incident"}),

@@ -1,0 +1,258 @@
+"""Cross-modality signal triage: active vs stale vs recovered, ranked.
+
+The adversarial simulator splits (``simulate/generator.py``) encode the
+failure modes of keyword-matching investigations: a louder-but-stale
+red herring on a visible service, an unrelated concurrent fault, a
+missing telemetry modality. This module is the deterministic reasoning
+that defeats them — the same checks a good on-call walks through before
+believing any single signal:
+
+1. **Timeline.** Every signal is dated against the paged incident's
+   start. Signals that predate it by more than a margin are STALE;
+   a matching recovery/resolved event afterwards marks the story
+   RECOVERED. Historical noise stops outranking live evidence.
+2. **Topology.** Log lines mentioning calls to other services define a
+   symptom graph; candidates are ranked by reachability from the PAGED
+   service and by position: a service whose active symptoms point at
+   another symptomatic service is a relay, not a root. The
+   downstream-most service with severe active evidence wins.
+3. **Modality accounting.** Empty/missing modalities are reported as
+   facts ("no log group for X; log shipper degraded") instead of being
+   silently absent, so the investigation pivots to what survives.
+
+No reference counterpart: ``causal-query.ts`` patterns fire on keywords
+alone (SURVEY §2.1); this is the layer the adversarial eval showed was
+missing. Exposed as the ``signal_triage`` tool and injected into the
+orchestrator's triage context.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional
+
+STALE_MARGIN_MIN = 45.0  # older than incident start by this → historical
+
+_SEVERE = ("ERROR", "FATAL", "CRITICAL")
+# Alarm metrics that describe SYMPTOMS (propagation), not causes.
+_SYMPTOM_METRICS = ("TargetResponseTime", "Latency", "ResponseTime")
+_CALL_RE = re.compile(
+    r"(?:upstream call to|call to|backend|outbound call to)\s+"
+    r"([a-z0-9][a-z0-9-]+)", re.IGNORECASE)
+
+
+@dataclass
+class SignalNote:
+    service: str
+    kind: str       # alarm | log | pod | prom
+    at: Optional[str]
+    status: str     # active | stale | recovered
+    severity: str   # severe | symptom | info
+    summary: str
+    why: str = ""
+
+
+@dataclass
+class TriageReport:
+    incident_start: Optional[str]
+    paged_service: Optional[str]
+    candidates: list[dict[str, Any]] = field(default_factory=list)
+    signals: list[SignalNote] = field(default_factory=list)
+    modality_notes: list[str] = field(default_factory=list)
+
+    def render(self) -> str:
+        lines = [f"signal triage (incident start {self.incident_start}, "
+                 f"paged service {self.paged_service}):"]
+        if self.modality_notes:
+            lines.append("  missing/degraded telemetry:")
+            lines += [f"    - {n}" for n in self.modality_notes]
+        lines.append("  root-cause candidates, best first:")
+        for c in self.candidates[:5]:
+            lines.append(f"    {c['service']}  score={c['score']:.1f}  "
+                         f"({'; '.join(c['reasons'])})")
+        discounted = [s for s in self.signals if s.status != "active"]
+        if discounted:
+            lines.append("  discounted signals (historical, NOT live "
+                         "evidence):")
+            for s in discounted[:6]:
+                lines.append(f"    - [{s.status}] {s.service} {s.kind}: "
+                             f"{s.summary[:80]} ({s.why})")
+        return "\n".join(lines)
+
+
+def _before(ts: Optional[str], ref: Optional[str],
+            margin_min: float = 0.0) -> bool:
+    """ts < ref - margin, on ISO-8601Z strings (lexicographic-safe)."""
+    if not ts or not ref:
+        return False
+    if margin_min:
+        import time as _t
+
+        try:
+            ref_s = _t.mktime(_t.strptime(ref, "%Y-%m-%dT%H:%M:%SZ"))
+            ts_s = _t.mktime(_t.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+            return ts_s < ref_s - margin_min * 60
+        except ValueError:
+            return ts < ref
+    return ts < ref
+
+
+def triage_signals(
+    *,
+    alarms: Iterable[dict] = (),
+    logs: Optional[dict[str, list[dict]]] = None,
+    dd_events: Iterable[dict] = (),
+    pods: Iterable[dict] = (),
+    prom_alerts: Iterable[dict] = (),
+    incident: Optional[dict] = None,
+    known_services: Iterable[str] = (),
+    stale_margin_min: float = STALE_MARGIN_MIN,
+) -> TriageReport:
+    """Classify every signal and rank root-cause candidates."""
+    logs = logs or {}
+    incident = incident or {}
+    start = incident.get("createdAt")
+    paged = incident.get("service")
+    report = TriageReport(incident_start=start, paged_service=paged)
+
+    # Recovery stories: service -> latest recovery-event timestamp.
+    recovered_at: dict[str, str] = {}
+    for ev in dd_events:
+        title = str(ev.get("title", ""))
+        text = f"{title} {ev.get('text', '')}".lower()
+        if "recover" in text or "resolved" in text:
+            for svc in _services_in(f"{title} {ev.get('tags', '')}",
+                                    known_services):
+                recovered_at[svc] = max(ev.get("ts", ""),
+                                        recovered_at.get(svc, ""))
+
+    def classify(svc: str, ts: Optional[str]) -> tuple[str, str]:
+        if ts and svc in recovered_at and ts <= recovered_at[svc]:
+            return "recovered", (f"a recovery event at {recovered_at[svc]} "
+                                 f"closes this story")
+        if _before(ts, start, stale_margin_min):
+            return "stale", (f"predates incident start {start} by "
+                             f">{stale_margin_min:.0f}m")
+        return "active", ""
+
+    edges: set[tuple[str, str]] = set()
+    svc_names = set(known_services) | {a.get("service", "") for a in alarms}
+    svc_names |= {g.split("/")[-1] for g in logs}
+    svc_names.discard("")
+
+    for a in alarms:
+        svc = a.get("service") or str(a.get("alarmName", "")).split("-")[0]
+        status, why = classify(svc, a.get("stateChangedAt"))
+        severity = ("symptom" if any(m in str(a.get("metric", ""))
+                                     for m in _SYMPTOM_METRICS) else "severe")
+        report.signals.append(SignalNote(
+            svc, "alarm", a.get("stateChangedAt"), status, severity,
+            f"{a.get('metric')}={a.get('currentValue')} "
+            f"(threshold {a.get('threshold')})", why))
+
+    for group, entries in logs.items():
+        svc = group.split("/")[-1]
+        for e in entries:
+            level = str(e.get("level", "")).upper()
+            msg = str(e.get("message", ""))
+            status, why = classify(svc, e.get("ts"))
+            severity = ("severe" if level in _SEVERE
+                        else "symptom" if "timing out" in msg
+                        or "timeout" in msg.lower() else "info")
+            report.signals.append(SignalNote(
+                svc, "log", e.get("ts"), status, severity,
+                f"{level}: {msg}", why))
+            if status == "active":
+                for callee in _services_in(msg, svc_names):
+                    if callee != svc:
+                        edges.add((svc, callee))
+
+    for p in pods:
+        svc = str(p.get("name", "")).rsplit("-", 2)[0]
+        bad = p.get("status") not in (None, "Running") or p.get("restarts", 0)
+        if bad:
+            report.signals.append(SignalNote(
+                svc, "pod", None, "active", "severe",
+                f"{p.get('status')} restarts={p.get('restarts', 0)}"))
+
+    for al in prom_alerts:
+        svc = (al.get("labels") or {}).get("service", "")
+        status, why = classify(svc, al.get("activeAt"))
+        report.signals.append(SignalNote(
+            svc, "prom", al.get("activeAt"), status, "severe",
+            f"{al.get('name')} {al.get('state')}", why))
+
+    # Modality accounting: say what is MISSING, with its meta-signal.
+    if not list(alarms):
+        report.modality_notes.append(
+            "no CloudWatch alarms at all — alarm delivery may be degraded; "
+            "rely on prometheus/metrics")
+    symptomatic = {s.service for s in report.signals if s.status == "active"}
+    for svc in sorted(symptomatic):
+        if f"/ecs/{svc}" not in logs and any(
+                s.service == svc and s.kind in ("alarm", "prom", "pod")
+                for s in report.signals):
+            report.modality_notes.append(
+                f"no log group for {svc} despite other live signals — "
+                f"check the log shipper before concluding from silence")
+
+    # Rank: severe active evidence, reachability from the paged service,
+    # relay discount (symptoms pointing at another symptomatic service).
+    reachable = _reachable(paged, edges) if paged else set()
+    scores: dict[str, float] = {}
+    reasons: dict[str, list[str]] = {}
+    for s in report.signals:
+        if s.status != "active" or not s.service:
+            continue
+        w = {"severe": 2.0, "symptom": 0.5, "info": 0.2}[s.severity]
+        w *= {"pod": 1.5, "alarm": 1.0, "log": 1.0, "prom": 0.8}[s.kind]
+        scores[s.service] = scores.get(s.service, 0.0) + w
+    for svc in list(scores):
+        r = reasons.setdefault(svc, [])
+        sev = sum(1 for s in report.signals
+                  if s.service == svc and s.status == "active"
+                  and s.severity == "severe")
+        r.append(f"{sev} severe live signals")
+        if svc in reachable or svc == paged:
+            scores[svc] += 2.0
+            r.append("on the paged incident's symptom path")
+        else:
+            r.append("NOT on the paged symptom path — may be an "
+                     "unrelated concurrent fault")
+        if any(src == svc and dst in scores for src, dst in edges):
+            scores[svc] *= 0.4
+            r.append("its symptoms point at another symptomatic "
+                     "service (relay, not root)")
+        in_edges = sum(1 for src, dst in edges if dst == svc)
+        if in_edges:
+            scores[svc] += 1.5 * in_edges
+            r.append(f"{in_edges} service(s) report failures calling it")
+        stale_n = sum(1 for s in report.signals
+                      if s.service == svc and s.status != "active")
+        if stale_n:
+            r.append(f"{stale_n} older signals discounted as historical")
+    report.candidates = sorted(
+        ({"service": svc, "score": round(sc, 2), "reasons": reasons[svc]}
+         for svc, sc in scores.items()),
+        key=lambda c: -c["score"])
+    return report
+
+
+def _services_in(text: str, known: Iterable[str]) -> list[str]:
+    found = [m.group(1) for m in _CALL_RE.finditer(text)]
+    known_set = set(known)
+    out = [f for f in found if not known_set or f in known_set]
+    for svc in known_set:
+        if svc and svc in text and svc not in out:
+            out.append(svc)
+    return out
+
+
+def _reachable(start: Optional[str], edges: set[tuple[str, str]]) -> set:
+    seen = {start} if start else set()
+    while True:
+        nxt = [dst for src, dst in edges if src in seen and dst not in seen]
+        if not nxt:
+            return seen
+        seen.update(nxt)

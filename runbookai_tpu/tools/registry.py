@@ -89,6 +89,10 @@ def get_runtime_tools(config, registry: Optional[ToolRegistry] = None,
     if aws_cfg.enabled:
         if aws_cfg.simulated:
             simulated_tools.register_aws(reg, sim)
+            # Deterministic cross-modality analysis over the same
+            # fixtures (agent/signal_triage.py) — the stale/decoy/
+            # dropout-aware layer the adversarial eval exercises.
+            simulated_tools.register_triage(reg, sim)
         else:
             from runbookai_tpu.tools import aws as aws_tools
 

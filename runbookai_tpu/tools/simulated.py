@@ -408,3 +408,44 @@ def register_code(reg: ToolRegistry, sim: SimulatedCloud) -> None:
                        "limit": {"type": "number"}}, ["action"]),
         github_query, category="code",
     )
+
+
+def register_triage(reg: ToolRegistry, sim: SimulatedCloud) -> None:
+    """Cross-modality signal triage over the fixture providers.
+
+    The pure logic lives in :mod:`runbookai_tpu.agent.signal_triage`;
+    this adapter feeds it everything the fixture cloud knows. Real
+    providers can reuse the same module by collecting the equivalent
+    alarm/log/event lists from live queries."""
+
+    async def signal_triage(args):
+        from runbookai_tpu.agent.signal_triage import triage_signals
+
+        fx = sim.fixtures
+        incidents = fx.get("pagerduty") or []
+        iid = args.get("incident_id")
+        inc = next((i for i in incidents if i.get("id") == iid), None) \
+            if iid else None
+        inc = inc or (incidents[0] if incidents else {})
+        rep = triage_signals(
+            alarms=fx.get("cloudwatch_alarms", []),
+            logs=fx.get("cloudwatch_logs", {}),
+            dd_events=fx.get("datadog", {}).get("events", []),
+            pods=fx.get("kubernetes", {}).get("pods", []),
+            prom_alerts=fx.get("prometheus", {}).get("alerts", []),
+            incident=inc,
+            known_services=[e.get("service")
+                            for e in fx.get("aws", {}).get("ecs", [])],
+        )
+        return {"report": rep.render(), "candidates": rep.candidates[:5],
+                "modality_notes": rep.modality_notes}
+
+    reg.define(
+        "signal_triage",
+        "Cross-modality signal triage: dates every alarm/log/event against "
+        "the incident start (live vs stale vs recovered), builds the "
+        "symptom graph, flags missing telemetry, and ranks root-cause "
+        "candidate services. Run this FIRST when investigating.",
+        object_schema({"incident_id": {"type": "string"}}),
+        signal_triage, category="analysis",
+    )
