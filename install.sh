@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# runbookai-tpu installer (reference parity: docs/install.sh).
+#
+# Creates an isolated venv, installs the package with its CLI entry
+# point, and smoke-checks the install. JAX is NOT pinned here: install
+# the jax build matching your accelerator (see docs/DISTRIBUTED.md) —
+# on TPU VMs, the libtpu-bundled wheel; on CPU, plain `pip install jax`.
+set -euo pipefail
+
+PREFIX="${RUNBOOK_PREFIX:-$HOME/.runbookai-tpu}"
+PYTHON="${PYTHON:-python3}"
+
+echo "runbookai-tpu installer"
+echo "  prefix: $PREFIX"
+
+if ! "$PYTHON" -c 'import sys; sys.exit(sys.version_info < (3, 10))'; then
+  echo "error: python >= 3.10 required (got $("$PYTHON" -V 2>&1))" >&2
+  exit 1
+fi
+
+SRC_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+"$PYTHON" -m venv "$PREFIX/venv"
+"$PREFIX/venv/bin/pip" install --quiet --upgrade pip
+"$PREFIX/venv/bin/pip" install --quiet -e "$SRC_DIR"
+
+if ! "$PREFIX/venv/bin/python" -c 'import jax' 2>/dev/null; then
+  echo "note: jax is not installed in the venv. Install the build for"
+  echo "      your platform, e.g.:  $PREFIX/venv/bin/pip install jax"
+fi
+
+"$PREFIX/venv/bin/runbook" --help >/dev/null
+mkdir -p "$PREFIX/bin"
+ln -sf "$PREFIX/venv/bin/runbook" "$PREFIX/bin/runbook"
+
+echo "installed. Add to PATH:  export PATH=\"$PREFIX/bin:\$PATH\""
+echo "then:                    runbook init && runbook demo --fast"

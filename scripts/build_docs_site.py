@@ -1,0 +1,195 @@
+#!/usr/bin/env python3
+"""Build the static docs site from README.md + docs/*.md (stdlib only).
+
+Reference parity: RunbookAI ships a rendered docs site next to its
+markdown (``docs/index.html``, ``docs-site/``); this generator produces
+the same product surface for this framework — one self-contained
+``docs-site/index.html`` with a sidebar, client-side section switching
+(plain anchors, no JS framework), and a subset-markdown renderer good
+enough for the operator docs suite (headings, fenced code, tables,
+lists, links, emphasis, blockquotes).
+
+Usage:  python scripts/build_docs_site.py [--out docs-site]
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import re
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+_INLINE = (
+    (re.compile(r"`([^`]+)`"), lambda m: f"<code>{m.group(1)}</code>"),
+    (re.compile(r"\*\*([^*]+)\*\*"), lambda m: f"<strong>{m.group(1)}</strong>"),
+    (re.compile(r"(?<!\*)\*([^*]+)\*(?!\*)"), lambda m: f"<em>{m.group(1)}</em>"),
+    (re.compile(r"\[([^\]]+)\]\(([^)]+)\)"),
+     lambda m: f'<a href="{m.group(2)}">{m.group(1)}</a>'),
+)
+
+
+def _inline(text: str) -> str:
+    # Escape first; code spans re-enter as tags afterwards.
+    out = html.escape(text, quote=False)
+    for rx, sub in _INLINE:
+        out = rx.sub(sub, out)
+    return out
+
+
+def md_to_html(md: str) -> str:
+    """Subset-markdown → HTML, line oriented, stdlib only."""
+    lines = md.splitlines()
+    out: list[str] = []
+    i = 0
+    in_list: str | None = None
+
+    def close_list() -> None:
+        nonlocal in_list
+        if in_list:
+            out.append(f"</{in_list}>")
+            in_list = None
+
+    while i < len(lines):
+        line = lines[i]
+        if line.startswith("```"):
+            close_list()
+            code: list[str] = []
+            i += 1
+            while i < len(lines) and not lines[i].startswith("```"):
+                code.append(lines[i])
+                i += 1
+            out.append("<pre><code>"
+                       + html.escape("\n".join(code)) + "</code></pre>")
+            i += 1
+            continue
+        m = re.match(r"^(#{1,4})\s+(.*)$", line)
+        if m:
+            close_list()
+            depth = len(m.group(1))
+            text = m.group(2)
+            anchor = re.sub(r"[^a-z0-9]+", "-", text.lower()).strip("-")
+            out.append(f'<h{depth} id="{anchor}">{_inline(text)}</h{depth}>')
+            i += 1
+            continue
+        if line.startswith("|") and i + 1 < len(lines) \
+                and re.match(r"^\|[\s:|-]+\|?$", lines[i + 1]):
+            close_list()
+            headers = [c.strip() for c in line.strip("|").split("|")]
+            out.append("<table><thead><tr>"
+                       + "".join(f"<th>{_inline(h)}</th>" for h in headers)
+                       + "</tr></thead><tbody>")
+            i += 2
+            while i < len(lines) and lines[i].startswith("|"):
+                cells = [c.strip() for c in lines[i].strip("|").split("|")]
+                out.append("<tr>" + "".join(
+                    f"<td>{_inline(c)}</td>" for c in cells) + "</tr>")
+                i += 1
+            out.append("</tbody></table>")
+            continue
+        m = re.match(r"^(\s*)[-*]\s+(.*)$", line)
+        if m:
+            if in_list != "ul":
+                close_list()
+                out.append("<ul>")
+                in_list = "ul"
+            out.append(f"<li>{_inline(m.group(2))}</li>")
+            i += 1
+            continue
+        m = re.match(r"^\s*\d+\.\s+(.*)$", line)
+        if m:
+            if in_list != "ol":
+                close_list()
+                out.append("<ol>")
+                in_list = "ol"
+            out.append(f"<li>{_inline(m.group(1))}</li>")
+            i += 1
+            continue
+        if line.startswith(">"):
+            close_list()
+            out.append(f"<blockquote>{_inline(line.lstrip('> '))}"
+                       f"</blockquote>")
+            i += 1
+            continue
+        if not line.strip():
+            close_list()
+            i += 1
+            continue
+        close_list()
+        # Paragraph: join soft-wrapped lines.
+        para = [line]
+        while (i + 1 < len(lines) and lines[i + 1].strip()
+               and not re.match(r"^(#|```|\||[-*]\s|\d+\.\s|>)",
+                                lines[i + 1])):
+            i += 1
+            para.append(lines[i])
+        out.append(f"<p>{_inline(' '.join(para))}</p>")
+        i += 1
+    close_list()
+    return "\n".join(out)
+
+
+_PAGE = """<!doctype html>
+<html lang="en"><head><meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>runbookai-tpu docs</title>
+<style>
+:root {{ --fg:#1a1f29; --bg:#ffffff; --muted:#5b6472; --line:#e4e7ec;
+         --accent:#155eef; --code-bg:#f4f5f7; }}
+@media (prefers-color-scheme: dark) {{
+  :root {{ --fg:#e7eaf0; --bg:#10141b; --muted:#9aa4b2; --line:#273040;
+           --accent:#7aa5ff; --code-bg:#1a202b; }} }}
+* {{ box-sizing: border-box; }}
+body {{ margin:0; font:16px/1.6 system-ui,-apple-system,Segoe UI,sans-serif;
+       color:var(--fg); background:var(--bg); display:flex; }}
+nav {{ width:240px; min-height:100vh; border-right:1px solid var(--line);
+      padding:24px 16px; position:sticky; top:0; align-self:flex-start; }}
+nav h1 {{ font-size:16px; margin:0 0 12px; }}
+nav a {{ display:block; padding:6px 8px; border-radius:6px;
+        color:var(--muted); text-decoration:none; font-size:14px; }}
+nav a:hover {{ color:var(--fg); background:var(--code-bg); }}
+main {{ flex:1; max-width:860px; padding:32px 40px 96px; }}
+section {{ border-bottom:1px solid var(--line); padding-bottom:32px;
+          margin-bottom:32px; }}
+h1,h2,h3 {{ line-height:1.25; }}
+code {{ background:var(--code-bg); padding:2px 5px; border-radius:4px;
+       font:13px/1.5 ui-monospace,SFMono-Regular,Menlo,monospace; }}
+pre {{ background:var(--code-bg); padding:14px 16px; border-radius:8px;
+      overflow-x:auto; }}
+pre code {{ background:none; padding:0; }}
+table {{ border-collapse:collapse; width:100%; font-size:14px; }}
+th,td {{ border:1px solid var(--line); padding:6px 10px; text-align:left; }}
+blockquote {{ border-left:3px solid var(--accent); margin:0;
+             padding:2px 14px; color:var(--muted); }}
+a {{ color:var(--accent); }}
+</style></head><body>
+<nav><h1>runbookai-tpu</h1>{nav}</nav>
+<main>{sections}</main>
+</body></html>
+"""
+
+
+def build(out_dir: Path) -> Path:
+    pages = [("README", ROOT / "README.md")]
+    pages += sorted(
+        ((p.stem, p) for p in (ROOT / "docs").glob("*.md")),
+        key=lambda kv: kv[0])
+    nav, sections = [], []
+    for name, path in pages:
+        sid = f"doc-{name.lower()}"
+        nav.append(f'<a href="#{sid}">{html.escape(name)}</a>')
+        sections.append(f'<section id="{sid}">'
+                        + md_to_html(path.read_text()) + "</section>")
+    out_dir.mkdir(parents=True, exist_ok=True)
+    out = out_dir / "index.html"
+    out.write_text(_PAGE.format(nav="\n".join(nav),
+                                sections="\n".join(sections)))
+    return out
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=str(ROOT / "docs-site"))
+    args = ap.parse_args()
+    print(build(Path(args.out)))

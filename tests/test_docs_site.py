@@ -1,0 +1,33 @@
+"""Docs-site generator (reference parity: rendered docs/index.html)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_build_docs_site_renders_all_docs(tmp_path):
+    out = tmp_path / "site"
+    subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "build_docs_site.py"),
+         "--out", str(out)], check=True, capture_output=True)
+    h = (out / "index.html").read_text()
+    assert 'id="doc-readme"' in h
+    for doc in (ROOT / "docs").glob("*.md"):
+        assert f'id="doc-{doc.stem.lower()}"' in h, doc
+    # Code fences render escaped (no raw markdown backticks leak).
+    assert "<pre><code>" in h and "```" not in h
+
+
+def test_md_to_html_subset():
+    sys.path.insert(0, str(ROOT / "scripts"))
+    from build_docs_site import md_to_html
+
+    h = md_to_html("# T\n\npara **b** `c`\n\n- a\n- b\n\n"
+                   "| h |\n|---|\n| v |\n\n```\nx < y\n```")
+    assert '<h1 id="t">T</h1>' in h
+    assert "<strong>b</strong>" in h and "<code>c</code>" in h
+    assert h.count("<li>") == 2
+    assert "<th>h</th>" in h and "<td>v</td>" in h
+    assert "x &lt; y" in h  # escaping inside fences
