@@ -321,10 +321,12 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     # pool next to 8.5GB of weights). Uses the device's reported bytes_limit
     # when available, else the v5e 16GB spec sheet.
     page_size = 16
-    # BENCH_KV=fp8 halves page bytes (doubles pooled tokens) and now keeps
+    # BENCH_KV=fp8 halves page bytes (doubles pooled tokens) and keeps
     # the Pallas attention path (engine probe-gates the combination).
-    kv_dtype = (jnp.float8_e4m3fn if os.environ.get("BENCH_KV") == "fp8"
-                else dtype)
+    # BENCH_KV=int8 also halves values but adds per-token scales and
+    # serves via the XLA gather path (better accuracy, no fp8 compute).
+    kv_dtype = {"fp8": jnp.float8_e4m3fn,
+                "int8": jnp.int8}.get(os.environ.get("BENCH_KV", ""), dtype)
     # Draft-model weights load BEFORE the page fit so the HBM budget
     # subtracts them (and the fixed draft pool) — BENCH_DRAFT on a full
     # chip must shrink the target pool, not OOM.
@@ -341,8 +343,10 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     if on_accel:
         from runbookai_tpu.models.quant import weight_bytes
 
+        scale_bytes = 4 if jnp.dtype(kv_dtype) == jnp.int8 else 0
         page_bytes = (page_size * cfg.n_layers * 2 * cfg.n_kv_heads
-                      * cfg.head_dim * jnp.dtype(kv_dtype).itemsize)
+                      * (cfg.head_dim * jnp.dtype(kv_dtype).itemsize
+                         + scale_bytes))
         try:
             hbm = jax.devices()[0].memory_stats()["bytes_limit"]
         except Exception:  # noqa: BLE001 — plugin may not expose stats

@@ -631,7 +631,12 @@ def cmd_serve(args) -> int:
             batch=config.llm.max_batch_slots,
             tp=max(1, config.llm.mesh.model),
             weights="int8" if config.llm.dtype == "int8" else "bf16",
-            kv_dtype_bytes=1 if config.llm.kv_cache_dtype == "fp8" else 2,
+            # fp8/int8 pools store 1 byte per value; int8 adds one f32
+            # absmax scale per (token, kv head) on top.
+            kv_dtype_bytes=(1 if config.llm.kv_cache_dtype
+                            in ("fp8", "int8") else 2),
+            kv_scale_bytes=(4 if config.llm.kv_cache_dtype == "int8"
+                            else 0),
         )
         print(f"memory plan: {plan.explain()}", file=sys.stderr)
     embedder = None

@@ -384,6 +384,22 @@ class EngineCore:
         from runbookai_tpu.parallel.mesh import SEQ_AXIS as _SEQ
 
         _kv_split_mesh = mesh is not None and mesh.shape.get(_SEQ, 1) > 1
+        # int8 KV (values + per-token absmax scales, ops/attention.py):
+        # served by the XLA gather path only — the Pallas kernels read
+        # raw pools, and the page-split layout has no scale plumbing.
+        if jnp.dtype(self.ecfg.kv_dtype) == jnp.int8:
+            if _kv_split_mesh:
+                raise ValueError(
+                    "kv_dtype=int8 is not supported on a KV page-split "
+                    "mesh (seq axis > 1); use fp8 KV for split serving")
+            if self.ecfg.attn_impl == "pallas":
+                import dataclasses as _dc
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "kv_dtype=int8: serving via the XLA attention path "
+                    "(Pallas kernels read unscaled pools)")
+                self.ecfg = _dc.replace(self.ecfg, attn_impl="xla")
         # Probe whenever the dispatched kernels include constructs newer
         # than the proven baseline: sub-byte KV loads (fp8) and/or the
         # page-split PARTIAL kernel (clamped index maps, SMEM shard

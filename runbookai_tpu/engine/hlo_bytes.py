@@ -145,7 +145,9 @@ def param_nbytes(params: Any) -> int:
 
 
 def kv_pool_nbytes(core) -> int:
-    return core._kv_k.nbytes + core._kv_v.nbytes
+    # int8 pools are (values, scales) tuples — sum the pytree leaves.
+    return sum(leaf.nbytes
+               for leaf in jax.tree.leaves((core._kv_k, core._kv_v)))
 
 
 def decode_accounting(core, compiled=None) -> dict[str, float]:
@@ -182,7 +184,8 @@ def check_plan(core, plan, *, tol: float = 0.15) -> dict[str, float]:
     with no approximation, so it must match the allocated pool exactly.
     Raises AssertionError with the numbers on divergence."""
     actual_w = param_nbytes(core.params)
-    pool_tokens = core._kv_k.shape[1]
+    kv_vals = core._kv_k[0] if isinstance(core._kv_k, tuple) else core._kv_k
+    pool_tokens = kv_vals.shape[1]
     actual_kv_tok = kv_pool_nbytes(core) / pool_tokens
     got = {
         "plan_weight_bytes": plan.weight_bytes_per_chip,

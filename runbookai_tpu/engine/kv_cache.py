@@ -52,15 +52,39 @@ class PagePool:
         sharding=None,
     ) -> "PagePool":
         shape = (n_layers, num_pages * page_size, n_kv_heads, head_dim)
+        # int8 pools carry one f32 absmax scale per (token, kv head) —
+        # tuple leaves thread through jit/scan/donation as a pytree, so
+        # no engine signature changes (ops/attention.py quantize_kv).
+        quantized = jnp.dtype(dtype) == jnp.int8
+        scale_sharding = None
+        if sharding is not None and quantized:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            scale_sharding = NamedSharding(
+                sharding.mesh, PartitionSpec(*sharding.spec[:3]))
+
         if sharding is not None:
             # Create directly sharded (kv-heads over the model axis): a
-            # host-side zeros + device_put would materialize the full pool on
-            # one device first — an OOM at exactly the scale TP exists for.
+            # host-side zeros + device_put would materialize the full
+            # pool on one device first — an OOM at exactly the scale TP
+            # exists for. One jitted closure per shape, reused for K and
+            # V, so each zeros program compiles once.
             zeros = jax.jit(lambda: jnp.zeros(shape, dtype=dtype),
                             out_shardings=sharding)
-            kv_k, kv_v = zeros(), zeros()
+            zeros_s = (jax.jit(lambda: jnp.zeros(shape[:3], jnp.float32),
+                               out_shardings=scale_sharding)
+                       if quantized else None)
+
+            def alloc():
+                return (zeros(), zeros_s()) if quantized else zeros()
         else:
-            kv_k, kv_v = jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
+            def alloc():
+                vals = jnp.zeros(shape, dtype=dtype)
+                if quantized:
+                    return vals, jnp.zeros(shape[:3], jnp.float32)
+                return vals
+
+        kv_k, kv_v = alloc(), alloc()
         return PagePool(
             kv_k=kv_k,
             kv_v=kv_v,

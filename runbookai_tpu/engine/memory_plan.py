@@ -82,6 +82,7 @@ def plan_serving(
     tp: int = 1,
     weights: str = "int8",
     kv_dtype_bytes: int = 2,
+    kv_scale_bytes: int = 0,
     hbm_bytes: int = 16 * GiB,
     headroom_bytes: int = int(1.5 * GiB),
 ) -> ServingPlan:
@@ -89,7 +90,9 @@ def plan_serving(
 
     ``weights``: "int8" (1B/param + f32 scales, embeddings/head bf16) or
     "bf16". KV shards by the full tp via :func:`plan_kv_split` (heads as
-    far as they divide, pages for the rest).
+    far as they divide, pages for the rest). ``kv_scale_bytes``: extra
+    bytes per (token, kv head) — 4 for the int8 KV pool's f32 absmax
+    scales, 0 for raw-dtype pools.
     """
     from runbookai_tpu.parallel.kv_split import plan_kv_split
 
@@ -112,7 +115,7 @@ def plan_serving(
 
     kv_per_token = (cfg.n_layers * 2
                     * (cfg.n_kv_heads / max(plan.kv_shards, 1))
-                    * cfg.head_dim * kv_dtype_bytes
+                    * (cfg.head_dim * kv_dtype_bytes + kv_scale_bytes)
                     / max(plan.pg_shards, 1))
     budget = max(0, hbm_bytes - int(per_chip) - headroom_bytes)
     return ServingPlan(

@@ -132,8 +132,8 @@ class JaxTpuClient(BaseLLMClient):
             model_cfg_name, model_path, dtype=dtype, shardings=shardings,
             quantize_int8=quantize,
         )
-        kv_dtype = (jnp.float8_e4m3fn
-                    if llm_cfg.kv_cache_dtype == "fp8" else dtype)
+        kv_dtype = {"fp8": jnp.float8_e4m3fn,
+                    "int8": jnp.int8}.get(llm_cfg.kv_cache_dtype, dtype)
         ecfg = EngineConfig(
             page_size=llm_cfg.page_size,
             num_pages=llm_cfg.num_pages,

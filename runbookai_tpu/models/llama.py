@@ -390,6 +390,14 @@ def forward_impl(
         from runbookai_tpu.parallel.mesh import SEQ_AXIS
 
         kv_split_active = mesh.shape.get(SEQ_AXIS, 1) > 1
+    # int8 KV pools are (values, scales) tuples — XLA gather path only.
+    # Checked BEFORE any page write: the kv-split writer has no scale
+    # plumbing and would fail opaquely on a tuple mid-scan. (The engine
+    # refuses this combination at init; this covers direct callers.)
+    kv_quantized = isinstance(kv_k, tuple)
+    if kv_quantized and kv_split_active:
+        raise ValueError("int8 KV is not supported with the KV "
+                         "page-split mesh")
 
     # The Pallas qmm runs per-device code; under a TP mesh the layer
     # matmuls are partitioned by XLA SPMD (sharding annotations, not
@@ -436,7 +444,8 @@ def forward_impl(
             v_pages = write_kv_pages_batch(v_pages, v, positions,
                                            page_tables, page_size)
 
-        use_pallas = attn_impl == "pallas" and not kv_split_active
+        use_pallas = (attn_impl == "pallas" and not kv_split_active
+                      and not kv_quantized)
         shardable = False
         if use_pallas and mesh is not None:
             from runbookai_tpu.ops.paged_attention_pallas import tp_shardable

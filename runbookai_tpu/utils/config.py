@@ -92,7 +92,10 @@ class LLMConfig(BaseModel):
     # KV cache precision: "auto" follows the activation dtype (bf16);
     # "fp8" (float8_e4m3) halves pool bytes — double the pooled tokens
     # per chip — at ~1e-2 relative K/V error.
-    kv_cache_dtype: Literal["auto", "fp8"] = "auto"
+    # "int8": values + per-token absmax scales, XLA path (best accuracy
+    # at 1 byte/value on hardware without fast fp8); "fp8": raw e4m3
+    # pages, composes with the Pallas kernels and the page-split mesh.
+    kv_cache_dtype: Literal["auto", "fp8", "int8"] = "auto"
     # Paged KV cache (engine):
     page_size: int = 16  # tokens per KV page
     num_pages: int = 2048  # page pool size (static for XLA)
