@@ -274,6 +274,39 @@ def _probe_pallas_attn(model_cfg, ecfg, act_dtype, mesh=None) -> bool:
 
 
 @functools.lru_cache(maxsize=8)
+def _probe_pallas_attn_int8_cached(backend: str, n_kv: int, n_q: int,
+                                   head_dim: int, page_size: int,
+                                   act_dtype_name: str) -> bool:
+    """One compile of the int8-scaled decode kernel (tuple pool: int8
+    values + f32 per-token scales) proves the Mosaic lowering — the
+    extra rank-3 scale blocks and the widen-multiply — before serving
+    relies on it. Decode only: chunked prefill routes to XLA for int8."""
+    try:
+        from runbookai_tpu.ops.paged_attention_pallas import (
+            paged_decode_attention,
+        )
+
+        kv_vals = jnp.zeros((2 * page_size, n_kv, head_dim), jnp.int8)
+        kv_scales = jnp.zeros((2 * page_size, n_kv), jnp.float32)
+        tables = jnp.zeros((1, 2), jnp.int32)
+        q1 = jnp.zeros((1, n_q, head_dim), jnp.dtype(act_dtype_name))
+        out = paged_decode_attention(
+            q1, (kv_vals, kv_scales), (kv_vals, kv_scales), tables,
+            jnp.ones((1,), jnp.int32), page_size=page_size,
+            interpret=backend == "cpu")
+        jax.block_until_ready(out)
+        return True
+    except Exception:  # noqa: BLE001 — any Mosaic/lowering failure
+        return False
+
+
+def _probe_pallas_attn_int8(model_cfg, ecfg, act_dtype) -> bool:
+    return _probe_pallas_attn_int8_cached(
+        jax.default_backend(), model_cfg.n_kv_heads, model_cfg.n_heads,
+        model_cfg.head_dim, ecfg.page_size, jnp.dtype(act_dtype).name)
+
+
+@functools.lru_cache(maxsize=8)
 def _probe_qmm_pallas_cached(backend: str, m: int, k: int, n: int,
                              act_dtype_name: str, mesh=None) -> bool:
     """One compile of the int8 qmm kernel at the model's real (K, N)
@@ -385,26 +418,37 @@ class EngineCore:
 
         _kv_split_mesh = mesh is not None and mesh.shape.get(_SEQ, 1) > 1
         # int8 KV (values + per-token absmax scales, ops/attention.py):
-        # served by the XLA gather path only — the Pallas kernels read
-        # raw pools, and the page-split layout has no scale plumbing.
-        if jnp.dtype(self.ecfg.kv_dtype) == jnp.int8:
+        # the DECODE kernel reads int8 pages + scales directly (probe-
+        # gated like fp8); chunked prefill runs the XLA gather path, the
+        # per-head-shard shard_map path has no scale plumbing (mesh
+        # model>1 serves via XLA), and the page-split layout refuses.
+        _kv_int8 = jnp.dtype(self.ecfg.kv_dtype) == jnp.int8
+        if _kv_int8:
+            from runbookai_tpu.parallel.mesh import MODEL_AXIS as _MODEL
+
             if _kv_split_mesh:
                 raise ValueError(
                     "kv_dtype=int8 is not supported on a KV page-split "
                     "mesh (seq axis > 1); use fp8 KV for split serving")
-            if self.ecfg.attn_impl == "pallas":
+            _model_tp = mesh.shape.get(_MODEL, 1) if mesh is not None else 1
+            if (self.ecfg.attn_impl == "pallas"
+                    and (_model_tp > 1
+                         or not _probe_pallas_attn_int8(model_cfg,
+                                                        self.ecfg,
+                                                        act_dtype))):
                 import dataclasses as _dc
                 import logging
 
-                logging.getLogger(__name__).info(
-                    "kv_dtype=int8: serving via the XLA attention path "
-                    "(Pallas kernels read unscaled pools)")
+                logging.getLogger(__name__).warning(
+                    "kv_dtype=int8: serving attention via the XLA path "
+                    "(%s)", "TP mesh" if _model_tp > 1
+                    else "Mosaic rejected the int8 decode kernel probe")
                 self.ecfg = _dc.replace(self.ecfg, attn_impl="xla")
         # Probe whenever the dispatched kernels include constructs newer
         # than the proven baseline: sub-byte KV loads (fp8) and/or the
         # page-split PARTIAL kernel (clamped index maps, SMEM shard
         # scalar, multi-output finalize).
-        if (self.ecfg.attn_impl == "pallas"
+        if (self.ecfg.attn_impl == "pallas" and not _kv_int8
                 and (jnp.dtype(self.ecfg.kv_dtype).itemsize == 1
                      or _kv_split_mesh)
                 and not _probe_pallas_attn(model_cfg, self.ecfg, act_dtype,

@@ -444,10 +444,19 @@ def forward_impl(
             v_pages = write_kv_pages_batch(v_pages, v, positions,
                                            page_tables, page_size)
 
+        # int8 pools: the decode kernel reads int8 pages + scales
+        # directly (widened in VMEM); chunked prefill is compute-bound
+        # and stays on the XLA gather path; the per-head-shard shard_map
+        # path has no scale plumbing (mesh model>1 falls back below).
         use_pallas = (attn_impl == "pallas" and not kv_split_active
-                      and not kv_quantized)
+                      and (not kv_quantized or t == 1))
         shardable = False
-        if use_pallas and mesh is not None:
+        if use_pallas and kv_quantized and mesh is not None:
+            from runbookai_tpu.parallel.mesh import MODEL_AXIS
+
+            if mesh.shape.get(MODEL_AXIS, 1) > 1:
+                use_pallas = False
+        elif use_pallas and mesh is not None:
             from runbookai_tpu.ops.paged_attention_pallas import tp_shardable
             from runbookai_tpu.parallel.mesh import MODEL_AXIS
 

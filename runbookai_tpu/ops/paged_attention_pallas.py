@@ -39,12 +39,15 @@ NEG_INF = -1e30
 
 def _flash_page_accumulate(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
                            base, ctx, n_kv: int, group: int,
-                           page_size: int) -> None:
+                           page_size: int, ks_ref=None, vs_ref=None) -> None:
     """Shared online-softmax accumulation of one K/V page into the
-    (m, l, acc) scratch — the body of BOTH decode kernels (full-pool and
-    kv-split partial), kept in one place so masking/numerics fixes cannot
-    diverge. Masked positions are explicitly zeroed in p (exp underflow
-    handles them too, but the explicit mask keeps l exact by construction)."""
+    (m, l, acc) scratch — the body of ALL decode kernels (full-pool,
+    kv-split partial, int8-scaled), kept in one place so masking/numerics
+    fixes cannot diverge. Masked positions are explicitly zeroed in p
+    (exp underflow handles them too, but the explicit mask keeps l exact
+    by construction). With ``ks_ref``/``vs_ref`` the K/V page holds int8
+    values and these are their per-(token, head) f32 absmax scales,
+    applied on the in-VMEM widen (ops/attention.py quantize_kv)."""
     q = q_ref[0].astype(jnp.float32)  # [n_q, hd]
     hd = q.shape[-1]
     scale = 1.0 / (hd ** 0.5)
@@ -59,13 +62,18 @@ def _flash_page_accumulate(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
     v_heads = []
     for h in range(n_kv):
         k_h = k_ref[0, :, h, :].astype(jnp.float32)  # [ps, hd]
+        if ks_ref is not None:
+            k_h = k_h * ks_ref[0, :, h][:, None]
         q_h = q[h * group : (h + 1) * group]  # [group, hd]
         s_h = jax.lax.dot_general(
             q_h * scale, k_h, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [group, ps]
         s_rows.append(jnp.where(valid, s_h, NEG_INF))
-        v_heads.append(v_ref[0, :, h, :].astype(jnp.float32))  # [ps, hd]
+        v_h = v_ref[0, :, h, :].astype(jnp.float32)  # [ps, hd]
+        if vs_ref is not None:
+            v_h = v_h * vs_ref[0, :, h][:, None]
+        v_heads.append(v_h)
     s = jnp.concatenate(s_rows, axis=0)  # [n_q, ps] (kv-major head order)
 
     m_blk = jnp.max(s, axis=1, keepdims=True)
@@ -130,16 +138,67 @@ def _decode_kernel(
         o_ref[0] = (acc_ref[:] / l_final).astype(o_ref.dtype)
 
 
+def _decode_kernel_int8(
+    # scalar prefetch:
+    page_tables_ref,  # [B, P] int32 (SMEM)
+    ctx_lens_ref,  # [B] int32 (SMEM)
+    # blocks:
+    q_ref,  # [1, n_q, hd]
+    k_ref,  # [1, page_size, n_kv, hd] int8
+    v_ref,  # [1, page_size, n_kv, hd] int8
+    ks_ref,  # [1, page_size, n_kv] f32 absmax scales
+    vs_ref,  # [1, page_size, n_kv] f32
+    o_ref,  # [1, n_q, hd]
+    # scratch:
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    page_size: int,
+    n_kv: int,
+    group: int,
+    pages_per_seq: int,
+):
+    """int8-KV decode: identical flash accumulation, values widened and
+    scaled in VMEM on load — HBM still moves 1 byte/value."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ctx = ctx_lens_ref[b]
+    base = p * page_size
+
+    @pl.when(base < ctx)
+    def _accumulate():
+        _flash_page_accumulate(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+                               base, ctx, n_kv, group, page_size,
+                               ks_ref=ks_ref, vs_ref=vs_ref)
+
+    @pl.when(p == pages_per_seq - 1)
+    def _finalize():
+        l_final = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_final).astype(o_ref.dtype)
+
+
 def paged_decode_attention(
     q: jnp.ndarray,  # [B, n_q, hd]
-    k_flat: jnp.ndarray,  # [num_pages * page_size, n_kv, hd]
-    v_flat: jnp.ndarray,  # same
+    k_flat,  # [num_pages * page_size, n_kv, hd], or (int8 values, scales)
+    v_flat,  # same
     page_tables: jnp.ndarray,  # [B, P] int32 (physical page ids; 0 = null)
     ctx_lens: jnp.ndarray,  # [B] int32
     page_size: int,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Ragged paged attention for decode (one query token per sequence)."""
+    if isinstance(k_flat, tuple):
+        return _paged_decode_attention_int8(
+            q, k_flat, v_flat, page_tables, ctx_lens,
+            page_size=page_size, interpret=interpret)
     b, n_q, hd = q.shape
     n_kv = k_flat.shape[1]
     group = n_q // n_kv
@@ -176,6 +235,60 @@ def paged_decode_attention(
         out_shape=jax.ShapeDtypeStruct((b, n_q, hd), q.dtype),
         interpret=interpret,
     )(page_tables, ctx_lens, q, k_pages, v_pages)
+
+
+def _paged_decode_attention_int8(
+    q: jnp.ndarray,  # [B, n_q, hd]
+    k_flat: tuple,  # (int8 values [tokens, n_kv, hd], f32 scales [tokens, n_kv])
+    v_flat: tuple,
+    page_tables: jnp.ndarray,
+    ctx_lens: jnp.ndarray,
+    page_size: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Decode over the int8-scaled pool: same grid/prefetch as the raw
+    kernel with two extra per-page scale blocks."""
+    b, n_q, hd = q.shape
+    k_vals, k_scales = k_flat
+    v_vals, v_scales = v_flat
+    n_kv = k_vals.shape[1]
+    group = n_q // n_kv
+    pages_per_seq = page_tables.shape[1]
+    k_pages = k_vals.reshape(-1, page_size, n_kv, hd)
+    v_pages = v_vals.reshape(-1, page_size, n_kv, hd)
+    ks_pages = k_scales.reshape(-1, page_size, n_kv)
+    vs_pages = v_scales.reshape(-1, page_size, n_kv)
+
+    kv_map = lambda b_, p_, pt, cl: (pt[b_, p_], 0, 0, 0)  # noqa: E731
+    s_map = lambda b_, p_, pt, cl: (pt[b_, p_], 0, 0)  # noqa: E731
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, n_q, hd), lambda b_, p_, pt, cl: (b_, 0, 0)),
+            pl.BlockSpec((1, page_size, n_kv, hd), kv_map),
+            pl.BlockSpec((1, page_size, n_kv, hd), kv_map),
+            pl.BlockSpec((1, page_size, n_kv), s_map),
+            pl.BlockSpec((1, page_size, n_kv), s_map),
+        ],
+        out_specs=pl.BlockSpec((1, n_q, hd),
+                               lambda b_, p_, pt, cl: (b_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n_q, 128), jnp.float32),
+            pltpu.VMEM((n_q, 128), jnp.float32),
+            pltpu.VMEM((n_q, hd), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel_int8, page_size=page_size, n_kv=n_kv, group=group,
+        pages_per_seq=pages_per_seq,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_q, hd), q.dtype),
+        interpret=interpret,
+    )(page_tables, ctx_lens, q, k_pages, v_pages, ks_pages, vs_pages)
 
 
 def _chunk_kernel(

@@ -431,3 +431,62 @@ def test_xla_int8_decode_fusion_status_on_device():
     for line in bad[:8]:
         print("  ", line[:140])
     assert isinstance(bad, list)  # diagnostic: count printed for BENCHLOG
+
+
+def test_int8_kv_decode_kernel_on_device():
+    """int8-scaled decode kernel under real Mosaic: extra rank-3 scale
+    blocks + in-VMEM widen-multiply compile and match the XLA gather
+    path on the same quantized pool (interpret twin:
+    tests/test_int8_kv.py::test_int8_decode_kernel_interpret_parity)."""
+    from runbookai_tpu.ops.attention import quantize_kv
+    from runbookai_tpu.ops.attention import paged_attention as xla_paged
+
+    rng = np.random.default_rng(0)
+    n_kv, hd, n_q = 2, 128, 4
+    tokens = 8 * PS
+    raw = rng.normal(size=(tokens, n_kv, hd)).astype(np.float32)
+    vals, scales = quantize_kv(jnp.asarray(raw, jnp.bfloat16))
+    pool = (vals, scales)
+    ctx_lens = [PS * 3, PS * 2 + 5]
+    tables = _tables(ctx_lens, 4)
+    ctx = jnp.asarray(ctx_lens, jnp.int32)
+    q = jnp.asarray(rng.normal(size=(2, n_q, hd)), jnp.bfloat16)
+
+    got = paged_decode_attention(q, pool, pool, tables, ctx, page_size=PS)
+    want = xla_paged(q[:, None], pool, pool, tables, ctx,
+                     (ctx - 1)[:, None], page_size=PS)[:, 0]
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=5e-2, rtol=5e-2)
+
+
+def test_int8_kv_engine_pallas_on_device():
+    """Engine with kv_dtype=int8 + attn pallas on the chip: the probe
+    must keep the kernel (or this fails loudly), and greedy must match
+    the XLA path."""
+    from runbookai_tpu.engine.engine import EngineConfig, EngineCore
+    from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+    from runbookai_tpu.models.llama import CONFIGS, init_params
+    from runbookai_tpu.utils.tokens import ByteTokenizer
+
+    cfg = CONFIGS["llama3-test"]
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.bfloat16)
+    outs = {}
+    for impl in ("pallas", "xla"):
+        core = EngineCore(cfg, params, ByteTokenizer(), EngineConfig(
+            page_size=16, num_pages=64, max_batch_slots=2,
+            prefill_chunk=16, max_seq_len=128, kv_dtype=jnp.int8,
+            attn_impl=impl, speculative=False))
+        if impl == "pallas":
+            assert core.ecfg.attn_impl == "pallas", \
+                "Mosaic rejected the int8 decode kernel probe on device"
+        reqs = [EngineRequest(
+            prompt_ids=list(np.random.default_rng(5).integers(
+                3, 250, size=21)),
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=8,
+                                    stop_token_ids=()))]
+        for r in reqs:
+            core.submit(r)
+        core.run_until_idle()
+        outs[impl] = [r.out_ids for r in reqs]
+    assert outs["pallas"] == outs["xla"]

@@ -57,6 +57,7 @@ def test_soak_openai_server_mixed_traffic_with_injected_faults():
 
     ok = [0]
     disconnects = [0]
+    shed = [0]  # explicit 503 capacity aborts — load shedding, not loss
     crash_window_errors: list[str] = []
     errors: list[str] = []
     lock = threading.Lock()
@@ -120,6 +121,15 @@ def test_soak_openai_server_mixed_traffic_with_injected_faults():
                 with lock:
                     ok[0] += 1
             except Exception as e:  # noqa: BLE001 — classified below
+                # An explicit 503 under the deliberately undersized pool
+                # is the engine SHEDDING load (admission cannot fit even
+                # after preempting everything younger) — a definite,
+                # correct response. Losing a request means silence or an
+                # unclassified error, not this.
+                if getattr(e, "code", None) == 503:
+                    with lock:
+                        shed[0] += 1
+                    continue
                 msg = f"{kind}: {type(e).__name__}: {e}"
                 with lock:
                     (crash_window_errors if crash_window.is_set()
@@ -168,9 +178,11 @@ def test_soak_openai_server_mixed_traffic_with_injected_faults():
     m = dict(core.metrics)
     srv.shutdown()
 
-    # Zero lost requests outside the injected-fault window.
+    # Zero lost requests outside the injected-fault window: every normal
+    # request either completed or was explicitly shed with a 503.
     assert not errors, errors[:5]
     assert ok[0] >= DURATION / 2, (ok[0], DURATION)  # sustained progress
+    assert shed[0] <= max(4, ok[0] // 20), (shed[0], ok[0])  # shedding rare
     assert disconnects[0] > 0  # the disconnect path actually ran
     assert m["preemptions"] > 0, m  # pool pressure exercised scheduling
     # Crash window was real but bounded (in-flight requests only).
