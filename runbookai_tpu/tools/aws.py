@@ -408,3 +408,9 @@ def register(reg: ToolRegistry, config, safety=None) -> None:
         object_schema({"args": {"type": "array"}}, ["args"]),
         aws_cli, category="aws",
     )
+
+    # Deep drill-down helpers beyond the catalog rows (tools/aws_deep.py:
+    # EKS cluster/nodegroup health, Amplify deploy-job failures).
+    from runbookai_tpu.tools import aws_deep
+
+    aws_deep.register(reg, manager)

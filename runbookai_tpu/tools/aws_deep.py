@@ -1,0 +1,185 @@
+"""Deep EKS / Amplify helpers: drill-down beyond the catalog rows.
+
+Reference parity: ``src/tools/aws/eks.ts:71-360`` (clusters, node
+groups, fargate profiles, cluster health) and ``amplify.ts:55-300``
+(apps, branches, jobs, app health). The repo's generic catalog lists
+top-level resources (``tools/aws.py``); these helpers add the
+per-resource drill-down and the health roll-up an investigation
+actually needs: WHICH node group is degraded, WHICH deploy job failed.
+
+Built on the same :class:`~runbookai_tpu.tools.aws.AWSClientManager`
+(profile / role-assumption / region); every call is read-only boto3.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Optional
+
+from runbookai_tpu.tools.aws import AWSClientManager
+
+
+def _thread(fn):
+    return asyncio.to_thread(fn)
+
+
+# ------------------------------------------------------------------ EKS
+
+
+async def eks_overview(manager: AWSClientManager,
+                       region: Optional[str] = None,
+                       cluster: Optional[str] = None) -> dict[str, Any]:
+    """Clusters → node groups → fargate profiles with a health roll-up.
+
+    Mirrors the reference's ``getAllClustersWithStatus`` +
+    ``checkClusterHealth``: a cluster is unhealthy when its status is
+    not ACTIVE, any node group is not ACTIVE, or a node group reports
+    issues."""
+
+    def call() -> dict[str, Any]:
+        eks = manager.client("eks", region)
+        names = ([cluster] if cluster
+                 else eks.list_clusters().get("clusters", []))
+        out = []
+        for name in names[:20]:
+            c = eks.describe_cluster(name=name).get("cluster", {})
+            entry: dict[str, Any] = {
+                "name": name,
+                "status": c.get("status"),
+                "version": c.get("version"),
+                "endpoint_access": (c.get("resourcesVpcConfig") or {}).get(
+                    "endpointPublicAccess"),
+                "nodegroups": [],
+                "fargate_profiles": [],
+                "issues": [],
+            }
+            if c.get("status") != "ACTIVE":
+                entry["issues"].append(
+                    f"cluster status {c.get('status')}")
+            for ng in eks.list_nodegroups(clusterName=name).get(
+                    "nodegroups", [])[:20]:
+                g = eks.describe_nodegroup(
+                    clusterName=name, nodegroupName=ng).get("nodegroup", {})
+                scaling = g.get("scalingConfig") or {}
+                issues = [f"{i.get('code')}: {i.get('message', '')[:140]}"
+                          for i in (g.get("health") or {}).get("issues", [])]
+                entry["nodegroups"].append({
+                    "name": ng, "status": g.get("status"),
+                    "desired": scaling.get("desiredSize"),
+                    "min": scaling.get("minSize"),
+                    "max": scaling.get("maxSize"),
+                    "instance_types": g.get("instanceTypes"),
+                    "issues": issues,
+                })
+                if g.get("status") != "ACTIVE":
+                    entry["issues"].append(
+                        f"nodegroup {ng} status {g.get('status')}")
+                entry["issues"].extend(
+                    f"nodegroup {ng} {i}" for i in issues)
+            for fp in eks.list_fargate_profiles(clusterName=name).get(
+                    "fargateProfileNames", [])[:10]:
+                p = eks.describe_fargate_profile(
+                    clusterName=name, fargateProfileName=fp).get(
+                        "fargateProfile", {})
+                entry["fargate_profiles"].append(
+                    {"name": fp, "status": p.get("status")})
+            entry["healthy"] = not entry["issues"]
+            out.append(entry)
+        return {"clusters": out,
+                "unhealthy": [c["name"] for c in out if not c["healthy"]]}
+
+    return await _thread(call)
+
+
+# -------------------------------------------------------------- Amplify
+
+
+async def amplify_overview(manager: AWSClientManager,
+                           region: Optional[str] = None,
+                           app: Optional[str] = None,
+                           jobs_per_branch: int = 5) -> dict[str, Any]:
+    """Apps → branches → recent jobs with deploy-failure detection.
+
+    Mirrors ``getAllAppsWithStatus`` + ``checkAppHealth``: an app is
+    unhealthy when any branch's most recent job FAILED (the bad-deploy
+    signature the investigation is usually chasing)."""
+
+    def call() -> dict[str, Any]:
+        amp = manager.client("amplify", region)
+        apps = amp.list_apps().get("apps", [])
+        if app:
+            apps = [a for a in apps
+                    if a.get("appId") == app or a.get("name") == app]
+        out = []
+        for a in apps[:20]:
+            app_id = a.get("appId")
+            entry: dict[str, Any] = {
+                "app_id": app_id, "name": a.get("name"),
+                "platform": a.get("platform"),
+                "default_domain": a.get("defaultDomain"),
+                "branches": [], "issues": [],
+            }
+            for br in amp.list_branches(appId=app_id).get(
+                    "branches", [])[:10]:
+                bname = br.get("branchName")
+                jobs = amp.list_jobs(
+                    appId=app_id, branchName=bname,
+                    maxResults=jobs_per_branch).get("jobSummaries", [])
+                recent = [{
+                    "job_id": j.get("jobId"), "status": j.get("status"),
+                    "type": j.get("jobType"),
+                    "commit": (j.get("commitId") or "")[:10],
+                    "started": str(j.get("startTime", ""))[:19],
+                } for j in jobs]
+                entry["branches"].append({
+                    "name": bname, "stage": br.get("stage"),
+                    "auto_build": br.get("enableAutoBuild"),
+                    "recent_jobs": recent,
+                })
+                if recent and recent[0]["status"] == "FAILED":
+                    entry["issues"].append(
+                        f"branch {bname}: latest deploy job "
+                        f"{recent[0]['job_id']} FAILED "
+                        f"(commit {recent[0]['commit']})")
+            entry["healthy"] = not entry["issues"]
+            out.append(entry)
+        return {"apps": out,
+                "unhealthy": [x["name"] for x in out if not x["healthy"]]}
+
+    return await _thread(call)
+
+
+def register(reg, manager: AWSClientManager) -> None:
+    """Register eks_query / amplify_query next to the generic aws tools."""
+    from runbookai_tpu.tools.registry import object_schema
+
+    async def eks_query(args):
+        if not manager.available():
+            return {"error": "boto3 is not installed; EKS drill-down "
+                             "needs real AWS access"}
+        return await eks_overview(manager, region=args.get("region"),
+                                  cluster=args.get("cluster"))
+
+    async def amplify_query(args):
+        if not manager.available():
+            return {"error": "boto3 is not installed; Amplify drill-down "
+                             "needs real AWS access"}
+        return await amplify_overview(manager, region=args.get("region"),
+                                      app=args.get("app"))
+
+    reg.define(
+        "eks_query",
+        "EKS drill-down: clusters -> node groups (scaling, health "
+        "issues) -> fargate profiles, with an unhealthy-cluster roll-up.",
+        object_schema({"cluster": {"type": "string"},
+                       "region": {"type": "string"}}),
+        eks_query, category="aws",
+    )
+    reg.define(
+        "amplify_query",
+        "Amplify drill-down: apps -> branches -> recent deploy jobs, "
+        "flagging branches whose latest job FAILED.",
+        object_schema({"app": {"type": "string"},
+                       "region": {"type": "string"}}),
+        amplify_query, category="aws",
+    )

@@ -292,3 +292,90 @@ async def test_render_mermaid_tool_registered():
     out = await tool.execute({"code": "graph TD\n  A --> B"})
     assert out["type"] == "flowchart"
     assert "A" in out["diagram"]
+
+
+# ----------------------------------------------- deep EKS / Amplify
+
+
+class _FakeEks:
+    def list_clusters(self):
+        return {"clusters": ["prod", "staging"]}
+
+    def describe_cluster(self, name):
+        return {"cluster": {"status": "ACTIVE" if name == "prod"
+                            else "UPDATING", "version": "1.29",
+                            "resourcesVpcConfig": {
+                                "endpointPublicAccess": False}}}
+
+    def list_nodegroups(self, clusterName):
+        return {"nodegroups": ["ng-1"]}
+
+    def describe_nodegroup(self, clusterName, nodegroupName):
+        health = ({"issues": []} if clusterName == "prod" else
+                  {"issues": [{"code": "AsgInstanceLaunchFailures",
+                               "message": "insufficient capacity"}]})
+        return {"nodegroup": {"status": "ACTIVE" if clusterName == "prod"
+                              else "DEGRADED",
+                              "scalingConfig": {"desiredSize": 3,
+                                                "minSize": 1, "maxSize": 5},
+                              "instanceTypes": ["m5.large"],
+                              "health": health}}
+
+    def list_fargate_profiles(self, clusterName):
+        return {"fargateProfileNames": []}
+
+
+class _FakeAmplify:
+    def list_apps(self):
+        return {"apps": [{"appId": "a1", "name": "web",
+                          "platform": "WEB",
+                          "defaultDomain": "a1.amplifyapp.com"}]}
+
+    def list_branches(self, appId):
+        return {"branches": [{"branchName": "main", "stage": "PRODUCTION",
+                              "enableAutoBuild": True}]}
+
+    def list_jobs(self, appId, branchName, maxResults):
+        return {"jobSummaries": [
+            {"jobId": "9", "status": "FAILED", "jobType": "RELEASE",
+             "commitId": "deadbeefcafe", "startTime": "2026-07-30T10:00"},
+            {"jobId": "8", "status": "SUCCEED", "jobType": "RELEASE",
+             "commitId": "0123456789ab", "startTime": "2026-07-29T10:00"},
+        ]}
+
+
+class _FakeManager:
+    def __init__(self, clients):
+        self._clients = clients
+
+    def available(self):
+        return True
+
+    def client(self, name, region=None):
+        return self._clients[name]
+
+
+@pytest.mark.asyncio_inline
+async def test_eks_overview_health_rollup():
+    from runbookai_tpu.tools.aws_deep import eks_overview
+
+    out = await eks_overview(_FakeManager({"eks": _FakeEks()}))
+    by_name = {c["name"]: c for c in out["clusters"]}
+    assert by_name["prod"]["healthy"]
+    assert not by_name["staging"]["healthy"]
+    assert out["unhealthy"] == ["staging"]
+    issues = " ".join(by_name["staging"]["issues"])
+    assert "UPDATING" in issues and "insufficient capacity" in issues
+    assert by_name["prod"]["nodegroups"][0]["desired"] == 3
+
+
+@pytest.mark.asyncio_inline
+async def test_amplify_overview_flags_failed_deploy():
+    from runbookai_tpu.tools.aws_deep import amplify_overview
+
+    out = await amplify_overview(_FakeManager({"amplify": _FakeAmplify()}))
+    app = out["apps"][0]
+    assert not app["healthy"]
+    assert "FAILED" in app["issues"][0] and "deadbeefca" in app["issues"][0]
+    assert out["unhealthy"] == ["web"]
+    assert app["branches"][0]["recent_jobs"][0]["job_id"] == "9"
