@@ -1228,8 +1228,18 @@ class EngineCore:
 
     # ------------------------------------------------------------------ step
 
+    # ``finished`` high-water trim: a days-long server must not retain
+    # every EngineRequest (prompt/output ids, logprobs) for process
+    # lifetime — the 600s soak measured ~0.4 MB/s RSS growth from
+    # exactly this. Recent entries stay addressable for callers that
+    # inspect the tail.
+    _FINISHED_HIGH_WATER = 4096
+    _FINISHED_KEEP = 1024
+
     def step(self) -> list[EngineRequest]:
         """One scheduler iteration; returns requests finished during it."""
+        if len(self.finished) > self._FINISHED_HIGH_WATER:
+            del self.finished[: -self._FINISHED_KEEP]
         before = len(self.finished)
         self._admit()
         if self.prefilling:

@@ -592,3 +592,16 @@ def test_logprobs_align_with_visible_content(setup):
     assert req.out_ids[-1] == stop_tok
     assert len(out2.logprobs) == len(req.out_ids) - 1
     assert out2.text == tok.decode(req.out_ids[:-1])
+
+
+def test_finished_list_is_bounded(setup):
+    """A days-long server must not retain every finished EngineRequest
+    (the 600s soak measured ~0.4 MB/s RSS growth from this). step()
+    trims at the high-water mark, keeping the recent tail addressable."""
+    tok, params = setup
+    core = make_core(tok, params)
+    core.finished = [object()] * (core._FINISHED_HIGH_WATER + 10)
+    tail = core.finished[-core._FINISHED_KEEP:]
+    assert core.step() == []  # idle step still trims
+    assert len(core.finished) == core._FINISHED_KEEP
+    assert core.finished == tail
