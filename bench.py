@@ -333,7 +333,15 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     draft_name = os.environ.get("BENCH_DRAFT")
     dcfg = dparams = None
     DRAFT_POOL_PAGES = 256
-    if draft_name:
+    if draft_name == "self":
+        # Self-draft: the target drafts for itself. Acceptance is then
+        # meaningful EVEN with random weights (greedy draft == greedy
+        # target wherever numerics agree), so the artifact carries a
+        # real acceptance/amortization figure instead of noise — the
+        # measurable-now proof of the speculation pipeline (the real
+        # speedup needs a smaller draft + real weights).
+        dcfg, dparams = cfg, params
+    elif draft_name:
         dcfg = CONFIGS[draft_name]
         if on_accel:
             dparams = init_params_quantized(jax.random.PRNGKey(1), dcfg,
@@ -356,7 +364,8 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
             draft_page_bytes = (page_size * dcfg.n_layers * 2
                                 * dcfg.n_kv_heads * dcfg.head_dim
                                 * jnp.dtype(dtype).itemsize)
-            budget -= weight_bytes(dparams)
+            if draft_name != "self":  # self-draft shares the target tree
+                budget -= weight_bytes(dparams)
             budget -= DRAFT_POOL_PAGES * draft_page_bytes
         fit = max(256, int(budget // page_bytes))
         if fit < num_pages:
