@@ -191,7 +191,7 @@ class DraftWorker:
             ctx_lens[i] = len(hist)
             tables[i] = self._table_row(rid)
         greedy = np.zeros((b,), dtype=np.float32)
-        toks, self._kv_k, self._kv_v = _decode_multi(
+        toks, self._kv_k, self._kv_v, _ = _decode_multi(
             self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
             self._kv_k, self._kv_v, jnp.asarray(tables),
             jnp.asarray(ctx_lens), jnp.asarray(greedy),

@@ -97,10 +97,11 @@ class EngineConfig:
 
 @partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl",
                                    "mesh", "qmm_impl"),
-         donate_argnums=(4, 5))
+         donate_argnums=(4, 5, 14))
 def _decode_step(
     params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
-    temps, top_ps, top_ks, key, mask, adapter_ids, page_size: int,
+    temps, top_ps, top_ks, key, mask, adapter_ids, counts=None, pres=None,
+    freq=None, seeds=None, *, page_size: int,
     block_pages: int, attn_impl: str = "xla", mesh=None, qmm_impl: str = "xla",
 ):
     logits, kv_k, kv_v = forward_impl(
@@ -108,17 +109,22 @@ def _decode_step(
         page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
         mesh=mesh, adapter_ids=adapter_ids, qmm_impl=qmm_impl,
     )
-    tok = sample_tokens(logits[:, -1], key, temps, top_ps, mask, top_ks)
-    return tok, logits[:, -1], kv_k, kv_v
+    tok = sample_tokens(logits[:, -1], key, temps, top_ps, mask, top_ks,
+                        counts=counts, presence=pres, frequency=freq,
+                        seeds=seeds, positions=ctx_lens)
+    if counts is not None:
+        counts = counts.at[jnp.arange(tok.shape[0]), tok].add(1)
+    return tok, logits[:, -1], kv_k, kv_v, counts
 
 
 @partial(jax.jit,
          static_argnames=("cfg", "page_size", "block_pages", "k_steps", "attn_impl",
                           "mesh", "qmm_impl"),
-         donate_argnums=(4, 5))
+         donate_argnums=(4, 5, 13))
 def _decode_multi(
     params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
-    temps, top_ps, top_ks, key, adapter_ids, page_size: int, block_pages: int,
+    temps, top_ps, top_ks, key, adapter_ids, counts=None, pres=None,
+    freq=None, seeds=None, *, page_size: int, block_pages: int,
     k_steps: int, attn_impl: str = "xla", mesh=None, qmm_impl: str = "xla",
 ):
     """K autoregressive decode steps in ONE dispatch (on-device sampling).
@@ -128,25 +134,33 @@ def _decode_multi(
     ``k_steps`` tokens. Pages for ctx+K must be pre-allocated; per-sequence
     stop conditions are applied host-side after the fetch (tokens past a stop
     are discarded — their KV writes are position-addressed, so accepted tokens
-    simply overwrite them later).
+    simply overwrite them later). Penalty ``counts`` and per-request
+    ``seeds`` ride the scan carry, so penalized/seeded sampling keeps the
+    multi-token amortization.
     """
 
     def step(carry, _):
-        tokens, positions, kv_k, kv_v, ctx_lens, key = carry
+        tokens, positions, kv_k, kv_v, ctx_lens, key, counts = carry
         logits, kv_k, kv_v = forward_impl(
             params, cfg, tokens, positions, kv_k, kv_v, tables, ctx_lens,
             page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
             mesh=mesh, adapter_ids=adapter_ids, qmm_impl=qmm_impl,
         )
         key, sub = jax.random.split(key)
-        tok = sample_tokens(logits[:, -1], sub, temps, top_ps, None, top_ks)
-        carry = (tok[:, None], positions + 1, kv_k, kv_v, ctx_lens + 1, key)
+        tok = sample_tokens(logits[:, -1], sub, temps, top_ps, None, top_ks,
+                            counts=counts, presence=pres, frequency=freq,
+                            seeds=seeds, positions=ctx_lens)
+        if counts is not None:
+            counts = counts.at[jnp.arange(tok.shape[0]), tok].add(1)
+        carry = (tok[:, None], positions + 1, kv_k, kv_v, ctx_lens + 1, key,
+                 counts)
         return carry, tok
 
-    (_, _, kv_k, kv_v, _, _), toks = jax.lax.scan(
-        step, (tokens, positions, kv_k, kv_v, ctx_lens, key), None, length=k_steps
+    (_, _, kv_k, kv_v, _, _, counts), toks = jax.lax.scan(
+        step, (tokens, positions, kv_k, kv_v, ctx_lens, key, counts), None,
+        length=k_steps,
     )
-    return toks.T, kv_k, kv_v  # [B, K]
+    return toks.T, kv_k, kv_v, counts  # [B, K]
 
 
 @partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl",
@@ -353,6 +367,31 @@ def _probe_qmm_pallas(model_cfg, ecfg, act_dtype, mesh=None) -> bool:
                                     jnp.dtype(act_dtype).name, mesh=mesh)
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _seed_count_row(counts, row, ids, n):
+    """Reset one slot's penalty-count row to the histogram of ``ids[:n]``
+    (ids padded to a power of two host-side to bound compile count).
+    Used on RE-admission after preemption, where the generated-so-far
+    history must be restored; fresh assignments batch-zero instead."""
+    live = (jnp.arange(ids.shape[0]) < n).astype(jnp.int32)
+    hist = jnp.zeros((counts.shape[1],), jnp.int32).at[ids].add(live)
+    return counts.at[row].set(hist)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _reset_count_rows(counts, row_mask):
+    """Zero every row where ``row_mask`` — ONE dispatch for a whole
+    prefill batch of fresh penalized assignments."""
+    return jnp.where(row_mask[:, None], 0, counts)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _bump_counts_batch(counts, rows, toks, live):
+    """counts[rows[i], toks[i]] += live[i] — ONE dispatch for the whole
+    first-token batch (live masks out unpenalized/pad rows)."""
+    return counts.at[rows, toks].add(live.astype(jnp.int32))
+
+
 _TOPK_LOGPROBS = 20  # OpenAI's top_logprobs ceiling; one compiled shape
 
 
@@ -504,6 +543,14 @@ class EngineCore:
         self._kv_k = self.kv.pool.kv_k
         self._kv_v = self.kv.pool.kv_v
         self._key = jax.random.PRNGKey(seed)
+
+        # OpenAI repetition penalties: device-resident per-slot token
+        # counts, seeded at slot assignment from the (folded) prompt and
+        # updated inside the decode dispatches — zero per-step host
+        # traffic. Rows for unpenalized requests drift and are never
+        # read; each assignment re-seeds its row.
+        self._tok_counts = jnp.zeros(
+            (self.ecfg.max_batch_slots, model_cfg.vocab_size), jnp.int32)
 
         self.waiting: list[EngineRequest] = []
         self.prefilling: list[EngineRequest] = []
@@ -828,35 +875,16 @@ class EngineCore:
                 done_rows.append((i, req))
 
         if done_rows:
-            # Sample every completed row's first output token in ONE batched
-            # dispatch + sync (per-row sampling would re-serialize the TTFT
-            # win for short prompts finishing together).
-            temps = np.zeros((b,), dtype=np.float32)
-            top_ps = np.ones((b,), dtype=np.float32)
-            top_ks = np.zeros((b,), dtype=np.int32)
-            need_mask = False
-            mask = np.ones((b, self.cfg.vocab_size), dtype=bool)
-            for i, req in done_rows:
-                temps[i] = req.sampling.temperature
-                top_ps[i] = req.sampling.top_p
-                top_ks[i] = req.sampling.top_k
-                if self.mask_fn and req.sampling.guided:
-                    m = self.mask_fn(req)
-                    if m is not None:
-                        mask[i] = m
-                        need_mask = True
-            self._key, sub = jax.random.split(self._key)
-            toks = sample_tokens(
-                last_logits, sub, jnp.asarray(temps), jnp.asarray(top_ps),
-                jnp.asarray(mask) if need_mask else None,
-                jnp.asarray(top_ks),
-            )
-            toks_host = np.asarray(jax.device_get(toks))
-            lp_pairs = [(i, req) for i, req in done_rows
-                        if req.sampling.logprobs]
-            if lp_pairs:
-                self._append_logprob_entries(
-                    lp_pairs, toks_host, _token_logprobs(last_logits, toks))
+            # Slot assignment FIRST: penalized rows need their count row
+            # prepared before the first sampled token, and the gather
+            # below maps prefill rows to slots. Counts track GENERATED
+            # tokens only (OpenAI's c[j] counts previously *sampled*
+            # tokens — prompt content is never penalized): fresh
+            # assignments batch-zero their rows in one dispatch;
+            # re-admissions after preemption restore the generated-so-far
+            # histogram (rare path, per-request).
+            fresh_pen_rows = np.zeros((self.ecfg.max_batch_slots,),
+                                      dtype=bool)
             for i, req in done_rows:
                 # Publish the prompt's full pages so concurrent/following
                 # requests with the same prefix skip their prefill.
@@ -867,11 +895,97 @@ class EngineCore:
                 self._slots[slot] = req
                 req.slot = slot
                 req.state = RequestState.DECODE
+                self.decoding.append(req)
+                if req.sampling.penalized:
+                    if req.all_out_ids:
+                        self._seed_counts_for(req)
+                    else:
+                        fresh_pen_rows[slot] = True
+            if fresh_pen_rows.any():
+                self._tok_counts = _reset_count_rows(
+                    self._tok_counts, jnp.asarray(fresh_pen_rows))
+
+            # Sample every completed row's first output token in ONE batched
+            # dispatch + sync (per-row sampling would re-serialize the TTFT
+            # win for short prompts finishing together).
+            temps = np.zeros((b,), dtype=np.float32)
+            top_ps = np.ones((b,), dtype=np.float32)
+            top_ks = np.zeros((b,), dtype=np.int32)
+            need_mask = False
+            mask = np.ones((b, self.cfg.vocab_size), dtype=bool)
+            use_pen = any(req.sampling.penalized for _, req in done_rows)
+            use_seed = any(req.sampling.seed is not None
+                           for _, req in done_rows)
+            pres = np.zeros((b,), dtype=np.float32)
+            freq = np.zeros((b,), dtype=np.float32)
+            seeds = np.full((b,), -1, dtype=np.int32)
+            slot_map = np.zeros((b,), dtype=np.int32)
+            for i, req in done_rows:
+                temps[i] = req.sampling.temperature
+                top_ps[i] = req.sampling.top_p
+                top_ks[i] = req.sampling.top_k
+                pres[i] = req.sampling.presence_penalty
+                freq[i] = req.sampling.frequency_penalty
+                slot_map[i] = req.slot
+                if req.sampling.seed is not None:
+                    seeds[i] = req.sampling.seed & 0x7FFFFFFF
+                if self.mask_fn and req.sampling.guided:
+                    m = self.mask_fn(req)
+                    if m is not None:
+                        mask[i] = m
+                        need_mask = True
+            counts_rows = (jnp.take(self._tok_counts,
+                                    jnp.asarray(slot_map), axis=0)
+                           if use_pen else None)
+            self._key, sub = jax.random.split(self._key)
+            toks = sample_tokens(
+                last_logits, sub, jnp.asarray(temps), jnp.asarray(top_ps),
+                jnp.asarray(mask) if need_mask else None,
+                jnp.asarray(top_ks),
+                counts=counts_rows,
+                presence=jnp.asarray(pres) if use_pen else None,
+                frequency=jnp.asarray(freq) if use_pen else None,
+                seeds=jnp.asarray(seeds) if use_seed else None,
+                positions=jnp.asarray(ctx_lens) if use_seed else None,
+            )
+            toks_host = np.asarray(jax.device_get(toks))
+            lp_pairs = [(i, req) for i, req in done_rows
+                        if req.sampling.logprobs]
+            if lp_pairs:
+                self._append_logprob_entries(
+                    lp_pairs, toks_host, _token_logprobs(last_logits, toks))
+            if use_pen:
+                # ONE batched scatter for every penalized first token —
+                # per-request bumps would re-serialize the TTFT win the
+                # batched sampling above exists for.
+                live = np.zeros((b,), dtype=np.int32)
+                for i, req in done_rows:
+                    if req.sampling.penalized:
+                        live[i] = 1
+                self._tok_counts = _bump_counts_batch(
+                    self._tok_counts, jnp.asarray(slot_map),
+                    jnp.asarray(toks_host.astype(np.int32)),
+                    jnp.asarray(live))
+            for i, req in done_rows:
                 if req.first_token_time is None:  # true TTFT across preemption
                     req.first_token_time = time.perf_counter()
-                self.decoding.append(req)
                 self._emit_token(req, int(toks_host[i]))
         self.metrics["prefill_time_s"] += time.perf_counter() - t0
+
+    def _seed_counts_for(self, req: EngineRequest) -> None:
+        """Restore the request's slot row to its GENERATED-token histogram
+        (OpenAI penalties count sampled tokens, never the prompt); ids pad
+        to powers of two so compile count stays O(log len)."""
+        ids = req.all_out_ids
+        n = max(1, len(ids))
+        padded_len = 1
+        while padded_len < n:
+            padded_len *= 2
+        padded = np.zeros((padded_len,), dtype=np.int32)
+        padded[: len(ids)] = ids
+        self._tok_counts = _seed_count_row(
+            self._tok_counts, jnp.int32(req.slot), jnp.asarray(padded),
+            jnp.int32(len(ids)))
 
     # ---------------------------------------------------------------- decode
 
@@ -1138,6 +1252,10 @@ class EngineCore:
                 and all(r.sampling.temperature == 0.0
                         and not r.sampling.guided
                         and not r.sampling.logprobs
+                        # Penalized greedy shifts the argmax per position
+                        # as counts evolve; the verify forward has no
+                        # count plumbing — multi-step handles these.
+                        and not r.sampling.penalized
                         for r in self.decoding)):
             if self.draft is not None:
                 committed = [(r.request_id,
@@ -1171,6 +1289,11 @@ class EngineCore:
         top_ks = np.zeros((b,), dtype=np.int32)
         need_mask = False
         mask = np.ones((b, self.cfg.vocab_size), dtype=bool)
+        use_pen = any(r.sampling.penalized for r in self.decoding)
+        use_seed = any(r.sampling.seed is not None for r in self.decoding)
+        pres = np.zeros((b,), dtype=np.float32)
+        freq = np.zeros((b,), dtype=np.float32)
+        seeds = np.full((b,), -1, dtype=np.int32)
         for req in self.decoding:
             i = req.slot
             tokens[i, 0] = self._last_token[req.request_id]
@@ -1179,6 +1302,10 @@ class EngineCore:
             temps[i] = req.sampling.temperature
             top_ps[i] = req.sampling.top_p
             top_ks[i] = req.sampling.top_k
+            pres[i] = req.sampling.presence_penalty
+            freq[i] = req.sampling.frequency_penalty
+            if req.sampling.seed is not None:
+                seeds[i] = req.sampling.seed & 0x7FFFFFFF
             if self.mask_fn and req.sampling.guided:
                 m = self.mask_fn(req)
                 if m is not None:
@@ -1187,16 +1314,23 @@ class EngineCore:
         tables = self._tables_for(self._slots)
         adapter_ids = self._adapter_ids_for_slots()
         self._key, sub = jax.random.split(self._key)
+        pen_kw = dict(
+            counts=self._tok_counts if use_pen else None,
+            pres=jnp.asarray(pres) if use_pen else None,
+            freq=jnp.asarray(freq) if use_pen else None,
+            seeds=jnp.asarray(seeds) if use_seed else None,
+        )
 
         with self.tracer.span("engine.decode", k=k,
                               batch=len(self.decoding)), annotate("decode"):
             if k == 1:
-                toks, last_logits, self._kv_k, self._kv_v = _decode_step(
+                (toks, last_logits, self._kv_k, self._kv_v,
+                 counts_out) = _decode_step(
                     self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
                     self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
                     jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks), sub,
                     jnp.asarray(mask) if need_mask else None,
-                    jnp.asarray(adapter_ids),
+                    jnp.asarray(adapter_ids), **pen_kw,
                     page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
                     attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
                     qmm_impl=self.ecfg.qmm_impl,
@@ -1204,16 +1338,18 @@ class EngineCore:
                 toks_host = np.asarray(jax.device_get(toks))[:, None]  # [B, 1]
                 self._score_logprobs(last_logits, toks, toks_host[:, 0])
             else:
-                toks, self._kv_k, self._kv_v = _decode_multi(
+                toks, self._kv_k, self._kv_v, counts_out = _decode_multi(
                     self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
                     self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
                     jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks), sub,
-                    jnp.asarray(adapter_ids),
+                    jnp.asarray(adapter_ids), **pen_kw,
                     page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
                     k_steps=k, attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
                     qmm_impl=self.ecfg.qmm_impl,
                 )
                 toks_host = np.asarray(jax.device_get(toks))  # [B, K]
+            if counts_out is not None:
+                self._tok_counts = counts_out
 
         emitted = 0
         snapshot = list(self.decoding)

@@ -44,6 +44,25 @@ class SamplingParams:
     # disables speculation/grammar fast-forward for the request; values
     # come from the RAW model distribution (pre-grammar-mask).
     logprobs: int = 0
+    # OpenAI-style repetition penalties over the request's GENERATED
+    # tokens (OpenAI's c[j] counts previously sampled tokens — prompt
+    # content is never penalized): logits - presence*(count>0) -
+    # frequency*count, applied before masking and greedy selection.
+    # Token counts live in a device-resident [slots, vocab] array seeded
+    # at slot assignment and updated in-dispatch — no per-step host
+    # traffic. Penalized requests are excluded from speculation (the
+    # verify argmax would need evolving counts per position).
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    # Per-request sampling seed (OpenAI `seed`): each sampled position
+    # draws from fold_in(PRNGKey(seed), position) — reproducible for a
+    # given (seed, position) regardless of batch composition or engine
+    # history. None keeps the engine's dispatch key.
+    seed: Optional[int] = None
+
+    @property
+    def penalized(self) -> bool:
+        return bool(self.presence_penalty or self.frequency_penalty)
 
 
 @dataclass

@@ -23,9 +23,28 @@ def sample_tokens(
     top_p: jnp.ndarray,  # [B] float32 in (0, 1]
     mask: jnp.ndarray | None = None,  # [B, vocab] bool, True = allowed
     top_k: jnp.ndarray | None = None,  # [B] int32; 0 -> disabled
+    counts: jnp.ndarray | None = None,  # [B, vocab] int32 token counts
+    presence: jnp.ndarray | None = None,  # [B] float32 presence penalty
+    frequency: jnp.ndarray | None = None,  # [B] float32 frequency penalty
+    seeds: jnp.ndarray | None = None,  # [B] int32; -1 -> batch key
+    positions: jnp.ndarray | None = None,  # [B] int32 (seeded-key fold)
 ) -> jnp.ndarray:
     """Sample one token per row. Vectorized top-p via sorted-CDF threshold;
-    top-k composes with top-p (a token must survive both filters)."""
+    top-k composes with top-p (a token must survive both filters).
+
+    OpenAI-style penalties (opt-in): ``logits - presence*(count>0) -
+    frequency*count`` over the request's token history BEFORE masking and
+    greedy selection. Per-request ``seeds`` derive each row's key as
+    ``fold_in(PRNGKey(seed), position)`` — reproducible for a given
+    (seed, position) regardless of batch composition or engine history;
+    rows with seed < 0 keep the dispatch key."""
+    if counts is not None:
+        pen = jnp.zeros_like(logits)
+        if presence is not None:
+            pen = pen + presence[:, None] * (counts > 0)
+        if frequency is not None:
+            pen = pen + frequency[:, None] * counts.astype(logits.dtype)
+        logits = logits - pen
     if mask is not None:
         logits = jnp.where(mask, logits, NEG_INF)
 
@@ -58,5 +77,20 @@ def sample_tokens(
         cutoff_k = jnp.take_along_axis(sorted_logits, k_idx[:, None], axis=-1)
         filtered = jnp.where(scaled >= cutoff_k, filtered, NEG_INF)
 
-    sampled = jax.random.categorical(key, filtered, axis=-1)
+    if seeds is None:
+        sampled = jax.random.categorical(key, filtered, axis=-1)
+    else:
+        pos = (positions if positions is not None
+               else jnp.zeros_like(seeds))
+        rows = jnp.arange(filtered.shape[0], dtype=jnp.uint32)
+
+        def row_key(seed, p, row):
+            seeded = jax.random.fold_in(
+                jax.random.PRNGKey(jnp.maximum(seed, 0)), p)
+            batch = jax.random.fold_in(key, row)
+            return jax.lax.select(seed >= 0, seeded, batch)
+
+        keys = jax.vmap(row_key)(seeds, pos, rows)
+        sampled = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row))(keys, filtered)
     return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)

@@ -298,6 +298,16 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                         "top_logprobs requires logprobs: true")
                 if not 0 <= top_logprobs <= 20:
                     raise ValueError("top_logprobs must be 0..20")
+                # `or 0.0`: OpenAI marks these nullable (null == default).
+                presence = float(body.get("presence_penalty") or 0.0)
+                frequency = float(body.get("frequency_penalty") or 0.0)
+                if not -2.0 <= presence <= 2.0:
+                    raise ValueError("presence_penalty must be in [-2, 2]")
+                if not -2.0 <= frequency <= 2.0:
+                    raise ValueError("frequency_penalty must be in [-2, 2]")
+                seed = body.get("seed")
+                if seed is not None:
+                    seed = int(seed)
                 sampling = SamplingParams(
                     temperature=float(body.get("temperature",
                                                client.temperature)),
@@ -310,6 +320,9 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     stop_strings=tuple(stop),
                     guided=guided,
                     logprobs=((top_logprobs or 1) if want_logprobs else 0),
+                    presence_penalty=presence,
+                    frequency_penalty=frequency,
+                    seed=seed,
                 )
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 self._error(400, str(e))
@@ -335,6 +348,17 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     # n > 1 choices submit concurrently: the engine batches
                     # them in one decode dispatch and the shared prompt
                     # prefix rides the page cache.
+                    def _choice_sampling(i: int):
+                        # A fixed seed must still produce n DISTINCT
+                        # choices: choice i samples under seed+i (choice
+                        # 0 reproduces the n=1 output for that seed).
+                        if sampling.seed is None or i == 0:
+                            return sampling
+                        import dataclasses as _dc
+
+                        return _dc.replace(sampling,
+                                           seed=sampling.seed + i)
+
                     async def _gen_n():
                         # return_exceptions: every sibling runs to its own
                         # terminal state (each generate aborts itself on
@@ -342,9 +366,9 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                         # unobserved after an error response.
                         return await asyncio.gather(*[
                             client.engine.generate(
-                                ids, sampling, timeout_s=request_timeout,
-                                adapter=adapter)
-                            for _ in range(n)], return_exceptions=True)
+                                ids, _choice_sampling(i),
+                                timeout_s=request_timeout, adapter=adapter)
+                            for i in range(n)], return_exceptions=True)
 
                     outs = bridge.run(_gen_n(), timeout=request_timeout + 60)
                     if any(isinstance(o, BaseException) for o in outs):

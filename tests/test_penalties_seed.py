@@ -1,0 +1,243 @@
+"""OpenAI sampling parity: presence/frequency penalties + per-request seed.
+
+Penalty counts are device-resident per slot, track GENERATED tokens only
+(OpenAI's c[j]; prompt content is never penalized), zero at assignment,
+restored from the generated-so-far history across preemption, and update
+inside the decode dispatches — zero per-step host traffic. Seeded
+sampling derives each position's key from
+fold_in(PRNGKey(seed), position), so a seeded request reproduces
+byte-identically regardless of batch composition or engine history.
+Both are strictly opt-in: the default dispatch passes None and keeps the
+pre-existing compiled programs.
+"""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from runbookai_tpu.engine.engine import EngineConfig, EngineCore
+from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+from runbookai_tpu.models.llama import CONFIGS, init_params
+from runbookai_tpu.ops.sampling import sample_tokens
+from runbookai_tpu.utils.tokens import ByteTokenizer
+
+CFG = CONFIGS["llama3-test"]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    tok = ByteTokenizer()
+    params = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+    return tok, params
+
+
+def make_core(tok, params, **kw):
+    defaults = dict(page_size=4, num_pages=128, max_batch_slots=4,
+                    prefill_chunk=8, max_seq_len=256, block_pages=4,
+                    kv_dtype=jnp.float32)
+    defaults.update(kw)
+    return EngineCore(CFG, params, tok, EngineConfig(**defaults))
+
+
+def run(core, prompt, n, **sp):
+    req = EngineRequest(prompt_ids=list(prompt),
+                        sampling=SamplingParams(max_new_tokens=n,
+                                                stop_token_ids=(), **sp))
+    core.submit(req)
+    core.run_until_idle()
+    return req
+
+
+# ------------------------------------------------------------- op level
+
+
+def test_penalty_math_shifts_argmax():
+    logits = jnp.asarray([[0.0, 1.0, 0.9, -5.0]], jnp.float32)
+    counts = jnp.asarray([[0, 3, 0, 0]], jnp.int32)
+    key = jax.random.PRNGKey(0)
+    t = jnp.zeros((1,))
+    p = jnp.ones((1,))
+    # Unpenalized greedy picks token 1.
+    assert int(sample_tokens(logits, key, t, p)[0]) == 1
+    # Frequency penalty 0.1*3 > the 0.1 margin: token 2 wins.
+    tok = sample_tokens(logits, key, t, p, counts=counts,
+                        presence=jnp.zeros((1,)),
+                        frequency=jnp.full((1,), 0.2))
+    assert int(tok[0]) == 2
+    # Presence penalty is flat (count>0): same flip at 0.2.
+    tok = sample_tokens(logits, key, t, p, counts=counts,
+                        presence=jnp.full((1,), 0.2),
+                        frequency=jnp.zeros((1,)))
+    assert int(tok[0]) == 2
+
+
+def test_seeded_rows_ignore_batch_key():
+    logits = jnp.tile(jnp.asarray([[0.0, 0.5, 1.0, 0.2]], jnp.float32),
+                      (2, 1))
+    t = jnp.ones((2,))
+    p = jnp.ones((2,))
+    seeds = jnp.asarray([7, -1], jnp.int32)
+    pos = jnp.asarray([5, 5], jnp.int32)
+    a = sample_tokens(logits, jax.random.PRNGKey(1), t, p,
+                      seeds=seeds, positions=pos)
+    b = sample_tokens(logits, jax.random.PRNGKey(2), t, p,
+                      seeds=seeds, positions=pos)
+    assert int(a[0]) == int(b[0])  # seeded row: batch key irrelevant
+
+
+# --------------------------------------------------------- engine level
+
+
+def test_frequency_penalty_reduces_repetition(setup):
+    tok, params = setup
+    prompt = tok.encode("aaaa aaaa aaaa aaaa")
+    base = run(make_core(tok, params), prompt, 24, temperature=0.0)
+    pen = run(make_core(tok, params), prompt, 24, temperature=0.0,
+              frequency_penalty=1.5)
+    def max_run(ids):
+        best = cur = 1
+        for x, y in zip(ids, ids[1:]):
+            cur = cur + 1 if x == y else 1
+            best = max(best, cur)
+        return best
+    # Penalized output must repeat less (or at minimum differ) — random
+    # weights make absolute quality claims meaningless, but the penalty
+    # must bite.
+    assert pen.out_ids != base.out_ids
+    assert len(set(pen.out_ids)) >= len(set(base.out_ids))
+    # Deterministic across runs (greedy + penalties).
+    pen2 = run(make_core(tok, params), prompt, 24, temperature=0.0,
+               frequency_penalty=1.5)
+    assert pen2.out_ids == pen.out_ids
+
+
+def test_unpenalized_output_unchanged_by_feature(setup):
+    """Opt-out rows must be byte-identical to an engine where the
+    feature never engages — the default path is untouched."""
+    tok, params = setup
+    prompt = tok.encode("default path regression probe")
+    a = run(make_core(tok, params), prompt, 16)
+    b = run(make_core(tok, params), prompt, 16)
+    assert a.out_ids == b.out_ids
+
+
+def test_seed_reproducible_across_batch_composition(setup):
+    """The seed contract: same (seed, prompt) -> same output whether the
+    request runs alone or next to other traffic."""
+    tok, params = setup
+    prompt = tok.encode("seeded request")
+
+    solo = run(make_core(tok, params), prompt, 16, temperature=1.0, seed=42)
+
+    core = make_core(tok, params)
+    noise = EngineRequest(prompt_ids=tok.encode("other traffic padding"),
+                          sampling=SamplingParams(temperature=0.8,
+                                                  max_new_tokens=16,
+                                                  stop_token_ids=()))
+    seeded = EngineRequest(prompt_ids=list(prompt),
+                           sampling=SamplingParams(temperature=1.0,
+                                                   max_new_tokens=16,
+                                                   stop_token_ids=(),
+                                                   seed=42))
+    core.submit(noise)
+    core.submit(seeded)
+    core.run_until_idle()
+    assert seeded.out_ids == solo.out_ids
+
+    different = run(make_core(tok, params), prompt, 16, temperature=1.0,
+                    seed=43)
+    assert different.out_ids != solo.out_ids
+
+
+def test_penalty_survives_preemption(setup):
+    """Preemption folds output into the prompt; re-admission restores the
+    count row from the generated-so-far history (all_out_ids) — the
+    penalty keeps counting every sampled token."""
+    tok, params = setup
+    core = make_core(tok, params, num_pages=24, max_batch_slots=2,
+                     admit_headroom_tokens=0)
+    reqs = [EngineRequest(
+        prompt_ids=tok.encode(f"preempt me {i} " * 3),
+        sampling=SamplingParams(temperature=0.0, max_new_tokens=20,
+                                stop_token_ids=(),
+                                frequency_penalty=1.0))
+        for i in range(3)]
+    for r in reqs:
+        core.submit(r)
+    core.run_until_idle()
+    assert all(r.finish_reason is not None for r in reqs)
+    assert all(len(r.all_out_ids) == 20 for r in reqs)
+
+
+# ------------------------------------------------------------ API level
+
+
+@pytest.fixture(scope="module")
+def server():
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.server.openai_api import OpenAIServer
+
+    client = JaxTpuClient.for_testing(max_new_tokens=12)
+    srv = OpenAIServer(client, model_name="llama3-test", port=0)
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+def _post(srv, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/v1/chat/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def test_api_seed_round_trips(server):
+    body = {"messages": [{"role": "user", "content": "seeded"}],
+            "max_tokens": 8, "temperature": 1.0, "seed": 7}
+    a = _post(server, body)
+    b = _post(server, body)
+    assert a["choices"][0]["message"]["content"] == \
+        b["choices"][0]["message"]["content"]
+
+
+def test_api_penalties_accepted_and_validated(server):
+    body = {"messages": [{"role": "user", "content": "pp"}],
+            "max_tokens": 6, "presence_penalty": 0.5,
+            "frequency_penalty": 0.5}
+    out = _post(server, body)
+    assert out["choices"][0]["message"]["role"] == "assistant"
+    bad = {"messages": [{"role": "user", "content": "x"}],
+           "presence_penalty": 3.0}
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, bad)
+    assert e.value.code == 400
+
+
+def test_api_seeded_n_choices_are_distinct_and_reproducible(server):
+    body = {"messages": [{"role": "user", "content": "nn"}],
+            "max_tokens": 10, "temperature": 1.0, "seed": 11, "n": 2}
+    a = _post(server, body)
+    b = _post(server, body)
+    texts_a = [c["message"]["content"] for c in a["choices"]]
+    texts_b = [c["message"]["content"] for c in b["choices"]]
+    assert texts_a == texts_b  # reproducible
+    assert texts_a[0] != texts_a[1]  # but distinct across choices
+
+def test_prompt_tokens_are_never_penalized(setup):
+    """OpenAI's c[j] counts previously SAMPLED tokens: a prompt saturated
+    with one token must not shift the first generated token — counts are
+    zero until the model generates."""
+    tok, params = setup
+    prompt = tok.encode("zzzzzzzzzzzzzzzzzzzzzzzz")
+    base = run(make_core(tok, params), prompt, 1, temperature=0.0)
+    pen = run(make_core(tok, params), prompt, 1, temperature=0.0,
+              presence_penalty=2.0, frequency_penalty=2.0)
+    assert pen.out_ids == base.out_ids
