@@ -101,7 +101,7 @@ class EngineConfig:
 def _decode_step(
     params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
     temps, top_ps, top_ks, key, mask, adapter_ids, counts=None, pres=None,
-    freq=None, seeds=None, *, page_size: int,
+    freq=None, seeds=None, bias=None, *, page_size: int,
     block_pages: int, attn_impl: str = "xla", mesh=None, qmm_impl: str = "xla",
 ):
     logits, kv_k, kv_v = forward_impl(
@@ -111,7 +111,7 @@ def _decode_step(
     )
     tok = sample_tokens(logits[:, -1], key, temps, top_ps, mask, top_ks,
                         counts=counts, presence=pres, frequency=freq,
-                        seeds=seeds, positions=ctx_lens)
+                        seeds=seeds, positions=ctx_lens, bias=bias)
     if counts is not None:
         counts = counts.at[jnp.arange(tok.shape[0]), tok].add(1)
     return tok, logits[:, -1], kv_k, kv_v, counts
@@ -124,7 +124,7 @@ def _decode_step(
 def _decode_multi(
     params, cfg: LlamaConfig, tokens, positions, kv_k, kv_v, tables, ctx_lens,
     temps, top_ps, top_ks, key, adapter_ids, counts=None, pres=None,
-    freq=None, seeds=None, *, page_size: int, block_pages: int,
+    freq=None, seeds=None, bias=None, *, page_size: int, block_pages: int,
     k_steps: int, attn_impl: str = "xla", mesh=None, qmm_impl: str = "xla",
 ):
     """K autoregressive decode steps in ONE dispatch (on-device sampling).
@@ -149,7 +149,7 @@ def _decode_multi(
         key, sub = jax.random.split(key)
         tok = sample_tokens(logits[:, -1], sub, temps, top_ps, None, top_ks,
                             counts=counts, presence=pres, frequency=freq,
-                            seeds=seeds, positions=ctx_lens)
+                            seeds=seeds, positions=ctx_lens, bias=bias)
         if counts is not None:
             counts = counts.at[jnp.arange(tok.shape[0]), tok].add(1)
         carry = (tok[:, None], positions + 1, kv_k, kv_v, ctx_lens + 1, key,
@@ -916,9 +916,12 @@ class EngineCore:
             use_pen = any(req.sampling.penalized for _, req in done_rows)
             use_seed = any(req.sampling.seed is not None
                            for _, req in done_rows)
+            use_bias = any(req.sampling.logit_bias for _, req in done_rows)
             pres = np.zeros((b,), dtype=np.float32)
             freq = np.zeros((b,), dtype=np.float32)
             seeds = np.full((b,), -1, dtype=np.int32)
+            bias = (np.zeros((b, self.cfg.vocab_size), dtype=np.float32)
+                    if use_bias else None)
             slot_map = np.zeros((b,), dtype=np.int32)
             for i, req in done_rows:
                 temps[i] = req.sampling.temperature
@@ -929,6 +932,9 @@ class EngineCore:
                 slot_map[i] = req.slot
                 if req.sampling.seed is not None:
                     seeds[i] = req.sampling.seed & 0x7FFFFFFF
+                if bias is not None:
+                    for tok_id, b_val in req.sampling.logit_bias:
+                        bias[i, tok_id] = b_val
                 if self.mask_fn and req.sampling.guided:
                     m = self.mask_fn(req)
                     if m is not None:
@@ -947,6 +953,7 @@ class EngineCore:
                 frequency=jnp.asarray(freq) if use_pen else None,
                 seeds=jnp.asarray(seeds) if use_seed else None,
                 positions=jnp.asarray(ctx_lens) if use_seed else None,
+                bias=jnp.asarray(bias) if use_bias else None,
             )
             toks_host = np.asarray(jax.device_get(toks))
             lp_pairs = [(i, req) for i, req in done_rows
@@ -1255,7 +1262,9 @@ class EngineCore:
                         # Penalized greedy shifts the argmax per position
                         # as counts evolve; the verify forward has no
                         # count plumbing — multi-step handles these.
+                        # logit_bias likewise shifts the verify argmax.
                         and not r.sampling.penalized
+                        and not r.sampling.logit_bias
                         for r in self.decoding)):
             if self.draft is not None:
                 committed = [(r.request_id,
@@ -1291,9 +1300,12 @@ class EngineCore:
         mask = np.ones((b, self.cfg.vocab_size), dtype=bool)
         use_pen = any(r.sampling.penalized for r in self.decoding)
         use_seed = any(r.sampling.seed is not None for r in self.decoding)
+        use_bias = any(r.sampling.logit_bias for r in self.decoding)
         pres = np.zeros((b,), dtype=np.float32)
         freq = np.zeros((b,), dtype=np.float32)
         seeds = np.full((b,), -1, dtype=np.int32)
+        bias = (np.zeros((b, self.cfg.vocab_size), dtype=np.float32)
+                if use_bias else None)
         for req in self.decoding:
             i = req.slot
             tokens[i, 0] = self._last_token[req.request_id]
@@ -1306,6 +1318,9 @@ class EngineCore:
             freq[i] = req.sampling.frequency_penalty
             if req.sampling.seed is not None:
                 seeds[i] = req.sampling.seed & 0x7FFFFFFF
+            if bias is not None:
+                for tok_id, b_val in req.sampling.logit_bias:
+                    bias[i, tok_id] = b_val
             if self.mask_fn and req.sampling.guided:
                 m = self.mask_fn(req)
                 if m is not None:
@@ -1319,6 +1334,7 @@ class EngineCore:
             pres=jnp.asarray(pres) if use_pen else None,
             freq=jnp.asarray(freq) if use_pen else None,
             seeds=jnp.asarray(seeds) if use_seed else None,
+            bias=jnp.asarray(bias) if use_bias else None,
         )
 
         with self.tracer.span("engine.decode", k=k,

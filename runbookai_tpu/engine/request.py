@@ -59,6 +59,11 @@ class SamplingParams:
     # given (seed, position) regardless of batch composition or engine
     # history. None keeps the engine's dispatch key.
     seed: Optional[int] = None
+    # OpenAI logit_bias: ((token_id, bias), ...) added to the logits
+    # before penalties/masking/greedy. Densified host-side per dispatch
+    # (same shipping pattern as grammar masks); -100/+100 effectively
+    # ban/force tokens.
+    logit_bias: tuple[tuple[int, float], ...] = ()
 
     @property
     def penalized(self) -> bool:

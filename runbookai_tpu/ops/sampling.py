@@ -28,6 +28,7 @@ def sample_tokens(
     frequency: jnp.ndarray | None = None,  # [B] float32 frequency penalty
     seeds: jnp.ndarray | None = None,  # [B] int32; -1 -> batch key
     positions: jnp.ndarray | None = None,  # [B] int32 (seeded-key fold)
+    bias: jnp.ndarray | None = None,  # [B, vocab] float32 logit_bias
 ) -> jnp.ndarray:
     """Sample one token per row. Vectorized top-p via sorted-CDF threshold;
     top-k composes with top-p (a token must survive both filters).
@@ -37,7 +38,11 @@ def sample_tokens(
     greedy selection. Per-request ``seeds`` derive each row's key as
     ``fold_in(PRNGKey(seed), position)`` — reproducible for a given
     (seed, position) regardless of batch composition or engine history;
-    rows with seed < 0 keep the dispatch key."""
+    rows with seed < 0 keep the dispatch key. ``bias`` ([B, vocab],
+    OpenAI logit_bias densified host-side) adds BEFORE penalties, masks,
+    and greedy selection."""
+    if bias is not None:
+        logits = logits + bias
     if counts is not None:
         pen = jnp.zeros_like(logits)
         if presence is not None:

@@ -308,6 +308,21 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 seed = body.get("seed")
                 if seed is not None:
                     seed = int(seed)
+                lb = body.get("logit_bias") or {}
+                if not isinstance(lb, dict):
+                    raise ValueError("logit_bias must be an object of "
+                                     "token_id -> bias")
+                logit_bias = []
+                for tok_id, b_val in lb.items():
+                    b_val = float(b_val)
+                    if not -100.0 <= b_val <= 100.0:
+                        raise ValueError("logit_bias values must be in "
+                                         "[-100, 100]")
+                    tid = int(tok_id)
+                    if not 0 <= tid < client.tokenizer.vocab_size:
+                        raise ValueError(f"logit_bias token id {tid} out "
+                                         f"of vocab range")
+                    logit_bias.append((tid, b_val))
                 sampling = SamplingParams(
                     temperature=float(body.get("temperature",
                                                client.temperature)),
@@ -323,6 +338,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     presence_penalty=presence,
                     frequency_penalty=frequency,
                     seed=seed,
+                    logit_bias=tuple(logit_bias),
                 )
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 self._error(400, str(e))

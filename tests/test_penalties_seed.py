@@ -241,3 +241,36 @@ def test_prompt_tokens_are_never_penalized(setup):
     pen = run(make_core(tok, params), prompt, 1, temperature=0.0,
               presence_penalty=2.0, frequency_penalty=2.0)
     assert pen.out_ids == base.out_ids
+
+
+# ----------------------------------------------------------- logit_bias
+
+
+def test_logit_bias_forces_and_bans_tokens(setup):
+    """-100/+100 semantics: a +100 bias forces the token under greedy; a
+    -100 bias on the natural argmax bans it."""
+    tok, params = setup
+    prompt = tok.encode("bias probe")
+    base = run(make_core(tok, params), prompt, 4, temperature=0.0)
+    natural = base.out_ids[0]
+
+    forced = run(make_core(tok, params), prompt, 4, temperature=0.0,
+                 logit_bias=((123, 100.0),))
+    assert all(t == 123 for t in forced.out_ids)
+
+    banned = run(make_core(tok, params), prompt, 4, temperature=0.0,
+                 logit_bias=((natural, -100.0),))
+    assert banned.out_ids[0] != natural
+
+
+def test_api_logit_bias_round_trip(server):
+    out = _post(server, {
+        "messages": [{"role": "user", "content": "lb"}],
+        "max_tokens": 4, "logit_bias": {"97": 100.0}})
+    assert out["choices"][0]["message"]["content"]
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, {"messages": [{"role": "user", "content": "x"}],
+                       "logit_bias": {"97": 500.0}})
+    assert e.value.code == 400
