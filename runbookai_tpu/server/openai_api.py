@@ -148,6 +148,70 @@ def _logprob_entry(tokenizer, e: dict, top_n: int) -> dict:
     return out
 
 
+def parse_openai_sampling(body: dict, client) -> tuple[Any, int, int]:
+    """Shared OpenAI sampling-field parsing for the chat and legacy
+    completions endpoints: stop, n, logprobs, penalties, seed,
+    logit_bias, max_tokens (and its max_completion_tokens alias).
+    Returns (sampling, n, top_logprobs); raises ValueError on invalid
+    input (the handlers map that to HTTP 400)."""
+    from runbookai_tpu.engine.request import SamplingParams
+
+    stop = body.get("stop") or []
+    if isinstance(stop, str):
+        stop = [stop]
+    if not all(isinstance(s, str) for s in stop):
+        raise ValueError("stop must be a string or list of strings")
+    if len(stop) > 4:
+        raise ValueError("at most 4 stop sequences")
+    n = int(body.get("n", 1))
+    if not 1 <= n <= 8:
+        raise ValueError("n must be in [1, 8]")
+    want_logprobs = bool(body.get("logprobs"))
+    top_logprobs = int(body.get("top_logprobs") or 0)
+    if top_logprobs and not want_logprobs:
+        raise ValueError("top_logprobs requires logprobs: true")
+    if not 0 <= top_logprobs <= 20:
+        raise ValueError("top_logprobs must be 0..20")
+    # `or 0.0`: OpenAI marks these nullable (null == default).
+    presence = float(body.get("presence_penalty") or 0.0)
+    frequency = float(body.get("frequency_penalty") or 0.0)
+    if not -2.0 <= presence <= 2.0:
+        raise ValueError("presence_penalty must be in [-2, 2]")
+    if not -2.0 <= frequency <= 2.0:
+        raise ValueError("frequency_penalty must be in [-2, 2]")
+    seed = body.get("seed")
+    if seed is not None:
+        seed = int(seed)
+    lb = body.get("logit_bias") or {}
+    if not isinstance(lb, dict):
+        raise ValueError("logit_bias must be an object of token_id -> bias")
+    logit_bias = []
+    for tok_id, b_val in lb.items():
+        b_val = float(b_val)
+        if not -100.0 <= b_val <= 100.0:
+            raise ValueError("logit_bias values must be in [-100, 100]")
+        tid = int(tok_id)
+        if not 0 <= tid < client.tokenizer.vocab_size:
+            raise ValueError(f"logit_bias token id {tid} out of vocab range")
+        logit_bias.append((tid, b_val))
+    sampling = SamplingParams(
+        temperature=float(body.get("temperature", client.temperature)),
+        top_p=float(body.get("top_p", client.top_p)),
+        top_k=int(body.get("top_k", client.top_k)),
+        max_new_tokens=int(body.get("max_tokens")
+                           or body.get("max_completion_tokens")
+                           or client.max_new_tokens),
+        stop_token_ids=(client.tokenizer.eot_id, client.tokenizer.eos_id),
+        stop_strings=tuple(stop),
+        logprobs=((top_logprobs or 1) if want_logprobs else 0),
+        presence_penalty=presence,
+        frequency_penalty=frequency,
+        seed=seed,
+        logit_bias=tuple(logit_bias),
+    )
+    return sampling, n, top_logprobs
+
+
 def _completion_payload(model: str, content: str, usage: dict,
                         finish: str = "stop") -> dict:
     return {
@@ -184,8 +248,6 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                  request_timeout: float,
                  allow_runtime_adapters: bool = False,
                  embedder=None):
-    from runbookai_tpu.engine.request import SamplingParams
-
     client = bridge.client
     _embed_mutex = threading.Lock()
 
@@ -239,6 +301,9 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             if self.path == "/v1/embeddings":
                 self._embeddings()
                 return
+            if self.path == "/v1/completions":
+                self._legacy_completions()
+                return
             if self.path != "/v1/chat/completions":
                 self._error(404, f"no route {self.path}")
                 return
@@ -264,16 +329,8 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                                          f"served: {[model_name] + names}")
                         return
                 # Client-supplied values: coercion failures are 400s too.
-                stop = body.get("stop") or []
-                if isinstance(stop, str):
-                    stop = [stop]
-                if not all(isinstance(s, str) for s in stop):
-                    raise ValueError("stop must be a string or list of strings")
-                if len(stop) > 4:
-                    raise ValueError("at most 4 stop sequences")
-                n = int(body.get("n", 1))
-                if not 1 <= n <= 8:
-                    raise ValueError("n must be in [1, 8]")
+                sampling, n, top_logprobs = parse_openai_sampling(body,
+                                                                  client)
                 # response_format json_object -> grammar-constrained
                 # decoding (the engine's guided JSON automaton): output is
                 # a valid-JSON prefix by construction, and a COMPLETE
@@ -290,56 +347,8 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 if rf_type not in ("text", "json_object"):
                     raise ValueError(
                         "response_format.type must be text or json_object")
-                guided = "json" if rf_type == "json_object" else None
-                want_logprobs = bool(body.get("logprobs"))
-                top_logprobs = int(body.get("top_logprobs") or 0)
-                if top_logprobs and not want_logprobs:
-                    raise ValueError(
-                        "top_logprobs requires logprobs: true")
-                if not 0 <= top_logprobs <= 20:
-                    raise ValueError("top_logprobs must be 0..20")
-                # `or 0.0`: OpenAI marks these nullable (null == default).
-                presence = float(body.get("presence_penalty") or 0.0)
-                frequency = float(body.get("frequency_penalty") or 0.0)
-                if not -2.0 <= presence <= 2.0:
-                    raise ValueError("presence_penalty must be in [-2, 2]")
-                if not -2.0 <= frequency <= 2.0:
-                    raise ValueError("frequency_penalty must be in [-2, 2]")
-                seed = body.get("seed")
-                if seed is not None:
-                    seed = int(seed)
-                lb = body.get("logit_bias") or {}
-                if not isinstance(lb, dict):
-                    raise ValueError("logit_bias must be an object of "
-                                     "token_id -> bias")
-                logit_bias = []
-                for tok_id, b_val in lb.items():
-                    b_val = float(b_val)
-                    if not -100.0 <= b_val <= 100.0:
-                        raise ValueError("logit_bias values must be in "
-                                         "[-100, 100]")
-                    tid = int(tok_id)
-                    if not 0 <= tid < client.tokenizer.vocab_size:
-                        raise ValueError(f"logit_bias token id {tid} out "
-                                         f"of vocab range")
-                    logit_bias.append((tid, b_val))
-                sampling = SamplingParams(
-                    temperature=float(body.get("temperature",
-                                               client.temperature)),
-                    top_p=float(body.get("top_p", client.top_p)),
-                    top_k=int(body.get("top_k", client.top_k)),
-                    max_new_tokens=int(body.get("max_tokens")
-                                       or client.max_new_tokens),
-                    stop_token_ids=(client.tokenizer.eot_id,
-                                    client.tokenizer.eos_id),
-                    stop_strings=tuple(stop),
-                    guided=guided,
-                    logprobs=((top_logprobs or 1) if want_logprobs else 0),
-                    presence_penalty=presence,
-                    frequency_penalty=frequency,
-                    seed=seed,
-                    logit_bias=tuple(logit_bias),
-                )
+                sampling.guided = ("json" if rf_type == "json_object"
+                                   else None)
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 self._error(400, str(e))
                 return
@@ -439,6 +448,93 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 self._error(504, "generation timed out")
             except BrokenPipeError:
                 pass  # client went away; engine abort handled in stream path
+
+        def _legacy_completions(self) -> None:
+            """Legacy `/v1/completions`: raw-prompt text completion, no
+            chat template. ``prompt`` may be a string or list of strings
+            (OpenAI returns len(prompt) * n choices, prompt-major); all
+            shared sampling fields apply. Streaming is not offered on
+            the legacy surface — use `/v1/chat/completions`."""
+            try:
+                body = self._read_json()
+                if body.get("stream"):
+                    raise ValueError(
+                        "stream is not supported on /v1/completions; "
+                        "use /v1/chat/completions")
+                prompts = body.get("prompt")
+                if isinstance(prompts, str):
+                    prompts = [prompts]
+                if (not prompts or not isinstance(prompts, list)
+                        or not all(isinstance(p, str) for p in prompts)):
+                    raise ValueError(
+                        "prompt must be a string or list of strings")
+                if len(prompts) > 8:
+                    raise ValueError("at most 8 prompts per request")
+                sampling, n, top_logprobs = parse_openai_sampling(body,
+                                                                  client)
+                echo = bool(body.get("echo"))
+
+                async def _gen_all():
+                    import dataclasses as _dc
+
+                    jobs = []
+                    for p in prompts:
+                        ids = client.tokenizer.encode(p)
+                        for i in range(n):
+                            sp = sampling
+                            if sampling.seed is not None and i:
+                                sp = _dc.replace(sampling,
+                                                 seed=sampling.seed + i)
+                            jobs.append(client.engine.generate(
+                                ids, sp, timeout_s=request_timeout))
+                    return await asyncio.gather(*jobs,
+                                                return_exceptions=True)
+
+                outs = bridge.run(_gen_all(), timeout=request_timeout + 60)
+                if any(isinstance(o, BaseException) for o in outs):
+                    err = next(o for o in outs
+                               if isinstance(o, BaseException))
+                    if isinstance(err, (TimeoutError, _FutTimeout)):
+                        self._error(504, "generation timed out")
+                        return
+                    raise err
+                if any(o.finish_reason.value == "aborted" for o in outs):
+                    self._error(503, "request aborted by the engine "
+                                     "(insufficient KV capacity)")
+                    return
+                choices = []
+                prompt_tokens = 0
+                for pi, p in enumerate(prompts):
+                    prompt_tokens += len(client.tokenizer.encode(p))
+                    for i in range(n):
+                        o = outs[pi * n + i]
+                        choices.append({
+                            "index": pi * n + i,
+                            "text": (p + o.text) if echo else o.text,
+                            "logprobs": None,
+                            "finish_reason": ("length"
+                                              if o.finish_reason.value
+                                              == "max_tokens" else "stop"),
+                        })
+                completion_tokens = sum(o.decode_tokens for o in outs)
+                self._json(200, {
+                    "id": f"cmpl-{uuid.uuid4().hex[:12]}",
+                    "object": "text_completion",
+                    "created": int(time.time()),
+                    "model": body.get("model") or model_name,
+                    "choices": choices,
+                    "usage": {
+                        "prompt_tokens": prompt_tokens,
+                        "completion_tokens": completion_tokens,
+                        "total_tokens": prompt_tokens + completion_tokens,
+                    },
+                })
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._error(400, str(e))
+            except (TimeoutError, _FutTimeout):
+                self._error(504, "generation timed out")
+            except BrokenPipeError:
+                pass  # client went away
 
         def _embeddings(self) -> None:
             """OpenAI embeddings API over the on-device bge encoder (the

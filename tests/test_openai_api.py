@@ -356,3 +356,43 @@ def test_streaming_logprobs(server):
                       for c in chunks)
     rebuilt = b"".join(bytes(e["bytes"]) for e in entries)
     assert rebuilt.decode("utf-8", errors="replace") == content
+
+
+def test_legacy_completions_endpoint(server):
+    """/v1/completions: raw prompt (no chat template), text_completion
+    payload, prompt-major choices for list prompts, echo."""
+    with _post(server, "/v1/completions", {
+            "prompt": "legacy prompt", "max_tokens": 6}) as r:
+        body = json.loads(r.read())
+    assert body["object"] == "text_completion"
+    assert len(body["choices"]) == 1
+    assert body["choices"][0]["finish_reason"] in ("stop", "length")
+    assert body["usage"]["completion_tokens"] > 0
+
+    with _post(server, "/v1/completions", {
+            "prompt": ["alpha", "beta"], "n": 2, "max_tokens": 4}) as r:
+        multi = json.loads(r.read())
+    assert len(multi["choices"]) == 4  # len(prompt) * n, prompt-major
+    assert [c["index"] for c in multi["choices"]] == [0, 1, 2, 3]
+
+    with _post(server, "/v1/completions", {
+            "prompt": "echo me", "echo": True, "max_tokens": 4}) as r:
+        echoed = json.loads(r.read())
+    assert echoed["choices"][0]["text"].startswith("echo me")
+
+
+def test_legacy_completions_rejects_stream(server):
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/v1/completions",
+              {"prompt": "x", "stream": True}).read()
+    assert e.value.code == 400
+
+
+def test_max_completion_tokens_alias(server):
+    with _post(server, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "alias"}],
+            "max_completion_tokens": 3}) as r:
+        body = json.loads(r.read())
+    assert body["usage"]["completion_tokens"] <= 3
