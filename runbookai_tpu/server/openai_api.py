@@ -364,8 +364,11 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     if n != 1:
                         self._error(400, "stream with n > 1 is unsupported")
                         return
-                    self._stream_response(ids, sampling, adapter,
-                                          top_logprobs=top_logprobs)
+                    so = body.get("stream_options") or {}
+                    self._stream_response(
+                        ids, sampling, adapter,
+                        top_logprobs=top_logprobs,
+                        include_usage=bool(so.get("include_usage")))
                 else:
                     # The engine-side timeout ABORTS a stalled request
                     # (frees slot + KV pages) before raising; the bridge
@@ -637,7 +640,8 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                              "adapters": client.core.lora.names})
 
         def _stream_response(self, ids, sampling, adapter=None,
-                             top_logprobs: int = 0) -> None:
+                             top_logprobs: int = 0,
+                             include_usage: bool = False) -> None:
             from runbookai_tpu.model.jax_tpu import stream_text
 
             self.send_response(200)
@@ -708,6 +712,20 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 if lp_tail is not None:
                     final["choices"][0]["logprobs"] = lp_tail
                 send_chunk(final)
+                if include_usage:
+                    # stream_options.include_usage: one extra chunk after
+                    # the finish chunk with empty choices (OpenAI shape).
+                    n_out = state.get("n_tokens", 0)
+                    send_chunk({
+                        "id": chunk_id,
+                        "object": "chat.completion.chunk",
+                        "created": int(time.time()),
+                        "model": model_name,
+                        "choices": [],
+                        "usage": {"prompt_tokens": len(ids),
+                                  "completion_tokens": n_out,
+                                  "total_tokens": len(ids) + n_out},
+                    })
                 send_terminator()
             except (BrokenPipeError, ConnectionResetError):
                 # Client disconnected mid-stream: close the generator so

@@ -396,3 +396,23 @@ def test_max_completion_tokens_alias(server):
             "max_completion_tokens": 3}) as r:
         body = json.loads(r.read())
     assert body["usage"]["completion_tokens"] <= 3
+
+
+def test_stream_options_include_usage(server):
+    with _post(server, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "usage"}],
+            "max_tokens": 5, "stream": True,
+            "stream_options": {"include_usage": True}}) as r:
+        raw = r.read().decode()
+    events = [json.loads(line[len("data: "):])
+              for line in raw.splitlines()
+              if line.startswith("data: ") and line != "data: [DONE]"]
+    usage_chunks = [e for e in events if e.get("usage")]
+    assert len(usage_chunks) == 1
+    u = usage_chunks[-1]
+    assert u["choices"] == []  # OpenAI shape: usage chunk has no choices
+    assert u["usage"]["completion_tokens"] > 0
+    assert (u["usage"]["total_tokens"]
+            == u["usage"]["prompt_tokens"] + u["usage"]["completion_tokens"])
+    # The usage chunk comes after the finish chunk, before [DONE].
+    assert events[-1] is u
