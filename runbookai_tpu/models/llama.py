@@ -122,6 +122,18 @@ CONFIGS: dict[str, LlamaConfig] = {
         n_layers=32, n_heads=32, n_kv_heads=8, ffn_dim=14_336,
         max_seq_len=131_072, rope_scaling=(8.0, 1.0, 4.0, 8192),
     ),
+    "llama3.1-70b-instruct": LlamaConfig(
+        name="llama3.1-70b-instruct", vocab_size=128_256, dim=8192,
+        n_layers=80, n_heads=64, n_kv_heads=8, ffn_dim=28_672,
+        max_seq_len=131_072, rope_scaling=(8.0, 1.0, 4.0, 8192),
+    ),
+    # Llama-3.3-70B ships the 3.1-70B architecture exactly (dims, rope
+    # scaling, 128k window) — served under its own name for HF parity.
+    "llama3.3-70b-instruct": LlamaConfig(
+        name="llama3.3-70b-instruct", vocab_size=128_256, dim=8192,
+        n_layers=80, n_heads=64, n_kv_heads=8, ffn_dim=28_672,
+        max_seq_len=131_072, rope_scaling=(8.0, 1.0, 4.0, 8192),
+    ),
     "llama3.2-1b-instruct": LlamaConfig(
         name="llama3.2-1b-instruct", vocab_size=128_256, dim=2048,
         n_layers=16, n_heads=32, n_kv_heads=8, ffn_dim=8192,
@@ -154,6 +166,18 @@ CONFIGS: dict[str, LlamaConfig] = {
     "qwen2.5-7b-instruct": LlamaConfig(
         name="qwen2.5-7b-instruct", vocab_size=152_064, dim=3584,
         n_layers=28, n_heads=28, n_kv_heads=4, ffn_dim=18_944,
+        rope_theta=1_000_000.0, max_seq_len=32_768, qkv_bias=True,
+        family="qwen2",
+    ),
+    "qwen2.5-14b-instruct": LlamaConfig(
+        name="qwen2.5-14b-instruct", vocab_size=152_064, dim=5120,
+        n_layers=48, n_heads=40, n_kv_heads=8, ffn_dim=13_824,
+        rope_theta=1_000_000.0, max_seq_len=32_768, qkv_bias=True,
+        family="qwen2",
+    ),
+    "qwen2.5-32b-instruct": LlamaConfig(
+        name="qwen2.5-32b-instruct", vocab_size=152_064, dim=5120,
+        n_layers=64, n_heads=40, n_kv_heads=8, ffn_dim=27_648,
         rope_theta=1_000_000.0, max_seq_len=32_768, qkv_bias=True,
         family="qwen2",
     ),

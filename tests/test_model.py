@@ -177,3 +177,26 @@ def test_rope_scaling_changes_long_positions_only_low_freqs():
     assert np.allclose(scaled[0], base[0])          # highest freq untouched
     assert np.allclose(scaled[-1], base[-1] / 8.0)  # lowest divided
     assert np.all(scaled <= base + 1e-9)
+
+
+def test_new_config_entries_are_consistent():
+    """Llama-3.1/3.3-70B and Qwen2.5-14B/32B entries: param-count sanity
+    (the dims must multiply out to the family's advertised size) and
+    serving-plan compatibility with the kv-split factorization."""
+    from runbookai_tpu.engine.memory_plan import plan_serving
+    from runbookai_tpu.models.llama import CONFIGS
+
+    for name, lo, hi in (
+        ("llama3.1-70b-instruct", 68e9, 72e9),
+        ("llama3.3-70b-instruct", 68e9, 72e9),
+        ("qwen2.5-14b-instruct", 13e9, 16e9),
+        ("qwen2.5-32b-instruct", 31e9, 34e9),
+    ):
+        cfg = CONFIGS[name]
+        assert lo < cfg.total_params < hi, (name, cfg.total_params)
+        assert cfg.dim % cfg.n_heads == 0
+        assert cfg.n_heads % cfg.n_kv_heads == 0
+    # 3.3-70B serves under the same tp16 kv8xpg2 plan as 3.1/3-70B.
+    p = plan_serving(CONFIGS["llama3.3-70b-instruct"], max_seq_len=131_072,
+                     tp=16, weights="int8", kv_dtype_bytes=2)
+    assert (p.kv_shards, p.pg_shards) == (8, 2) and p.fits, p.explain()
