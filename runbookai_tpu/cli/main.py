@@ -582,6 +582,27 @@ def cmd_simulate(args) -> int:
             "fault_family": s.truth["fault_type"],
             "adversarial": s.truth.get("adversarial", "none"),
         } for s in scenarios}
+        # Deterministic triage baseline: what timeline+topology analysis
+        # alone scores (agent/signal_triage.py) — the floor any LLM-led
+        # investigation should beat on root-cause service identification.
+        from runbookai_tpu.agent.signal_triage import triage_signals
+
+        hits = 0
+        for s in scenarios:
+            fx = s.fixtures
+            rep = triage_signals(
+                alarms=fx["cloudwatch_alarms"], logs=fx["cloudwatch_logs"],
+                dd_events=fx["datadog"]["events"],
+                pods=fx["kubernetes"]["pods"],
+                prom_alerts=fx["prometheus"]["alerts"],
+                incident=fx["pagerduty"][0] if fx["pagerduty"] else {},
+                known_services=[e["service"] for e in fx["aws"]["ecs"]])
+            top = rep.candidates[0]["service"] if rep.candidates else None
+            hits += top == s.truth["root_cause_service"]
+        print(json.dumps({
+            "triage_baseline_top1_service_accuracy":
+                round(hits / max(1, len(scenarios)), 4),
+            "cases": len(scenarios)}), file=sys.stderr)
         return _live_eval_report(args, cases, name="simulated-incidents",
                                  case_labels=labels)
 
