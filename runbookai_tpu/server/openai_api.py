@@ -456,8 +456,10 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             """Legacy `/v1/completions`: raw-prompt text completion, no
             chat template. ``prompt`` may be a string or list of strings
             (OpenAI returns len(prompt) * n choices, prompt-major); all
-            shared sampling fields apply. Streaming is not offered on
-            the legacy surface — use `/v1/chat/completions`."""
+            shared sampling fields apply, ``logprobs`` is the classic
+            int (top-N per sampled token), and adapter-as-model routing
+            matches the chat endpoint. Streaming is not offered on the
+            legacy surface — use `/v1/chat/completions`."""
             try:
                 body = self._read_json()
                 if body.get("stream"):
@@ -473,23 +475,44 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                         "prompt must be a string or list of strings")
                 if len(prompts) > 8:
                     raise ValueError("at most 8 prompts per request")
-                sampling, n, top_logprobs = parse_openai_sampling(body,
-                                                                  client)
+                # Same routing policy as chat: adapters serve as model
+                # names, unknown names are 404s — never silent base-model
+                # serving.
+                requested = body.get("model")
+                adapter = None
+                if requested and requested != model_name:
+                    names = (client.core.lora.names
+                             if client.core.lora is not None else [])
+                    if requested in names:
+                        adapter = requested
+                    else:
+                        self._error(404, f"model {requested!r} not found; "
+                                         f"served: {[model_name] + names}")
+                        return
+                sampling, n, _ = parse_openai_sampling(body, client)
+                # Classic logprobs is an int: top-N alternatives per token.
+                lp_n = int(body.get("logprobs") or 0)
+                if not 0 <= lp_n <= 5:
+                    raise ValueError("logprobs must be 0..5")
+                sampling.logprobs = lp_n
                 echo = bool(body.get("echo"))
+                # Tokenize each prompt ONCE: the same ids feed the engine
+                # and the usage count, so they cannot disagree.
+                all_ids = [client.tokenizer.encode(p) for p in prompts]
 
                 async def _gen_all():
                     import dataclasses as _dc
 
                     jobs = []
-                    for p in prompts:
-                        ids = client.tokenizer.encode(p)
+                    for ids in all_ids:
                         for i in range(n):
                             sp = sampling
                             if sampling.seed is not None and i:
                                 sp = _dc.replace(sampling,
                                                  seed=sampling.seed + i)
                             jobs.append(client.engine.generate(
-                                ids, sp, timeout_s=request_timeout))
+                                ids, sp, timeout_s=request_timeout,
+                                adapter=adapter))
                     return await asyncio.gather(*jobs,
                                                 return_exceptions=True)
 
@@ -505,26 +528,46 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     self._error(503, "request aborted by the engine "
                                      "(insufficient KV capacity)")
                     return
+
+                def legacy_lp(o, text_start: int):
+                    if not lp_n or not o.logprobs:
+                        return None
+                    tokens, tlps, tops, offsets = [], [], [], []
+                    off = text_start
+                    for e in o.logprobs:
+                        raw = client.tokenizer.id_to_bytes(
+                            e["token_id"]).decode("utf-8", "replace")
+                        tokens.append(raw)
+                        tlps.append(e["logprob"])
+                        tops.append({
+                            client.tokenizer.id_to_bytes(t).decode(
+                                "utf-8", "replace"): lp
+                            for t, lp in e["top"][:lp_n]})
+                        offsets.append(off)
+                        off += len(raw)
+                    return {"tokens": tokens, "token_logprobs": tlps,
+                            "top_logprobs": tops, "text_offset": offsets}
+
                 choices = []
-                prompt_tokens = 0
                 for pi, p in enumerate(prompts):
-                    prompt_tokens += len(client.tokenizer.encode(p))
                     for i in range(n):
                         o = outs[pi * n + i]
                         choices.append({
                             "index": pi * n + i,
                             "text": (p + o.text) if echo else o.text,
-                            "logprobs": None,
+                            "logprobs": legacy_lp(
+                                o, len(p) if echo else 0),
                             "finish_reason": ("length"
                                               if o.finish_reason.value
                                               == "max_tokens" else "stop"),
                         })
+                prompt_tokens = sum(len(ids) for ids in all_ids)
                 completion_tokens = sum(o.decode_tokens for o in outs)
                 self._json(200, {
                     "id": f"cmpl-{uuid.uuid4().hex[:12]}",
                     "object": "text_completion",
                     "created": int(time.time()),
-                    "model": body.get("model") or model_name,
+                    "model": requested or model_name,
                     "choices": choices,
                     "usage": {
                         "prompt_tokens": prompt_tokens,

@@ -416,3 +416,32 @@ def test_stream_options_include_usage(server):
             == u["usage"]["prompt_tokens"] + u["usage"]["completion_tokens"])
     # The usage chunk comes after the finish chunk, before [DONE].
     assert events[-1] is u
+
+
+def test_legacy_completions_logprobs_and_model_routing(server):
+    """Classic int logprobs renders the legacy schema (tokens,
+    token_logprobs, top_logprobs dicts, text_offset); unknown model
+    names 404 like the chat endpoint."""
+    with _post(server, "/v1/completions", {
+            "prompt": "lp legacy", "max_tokens": 4, "logprobs": 2}) as r:
+        body = json.loads(r.read())
+    lp = body["choices"][0]["logprobs"]
+    assert lp is not None
+    assert len(lp["tokens"]) == len(lp["token_logprobs"]) \
+        == len(lp["top_logprobs"]) == len(lp["text_offset"])
+    assert lp["tokens"]
+    assert all(len(t) <= 2 for t in lp["top_logprobs"])
+    assert lp["text_offset"][0] == 0
+    # echo shifts offsets by the prompt length.
+    with _post(server, "/v1/completions", {
+            "prompt": "off", "max_tokens": 2, "logprobs": 1,
+            "echo": True}) as r:
+        echoed = json.loads(r.read())
+    assert echoed["choices"][0]["logprobs"]["text_offset"][0] == len("off")
+
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/v1/completions",
+              {"prompt": "x", "model": "no-such-model"}).read()
+    assert e.value.code == 404
