@@ -345,6 +345,12 @@ def cmd_knowledge(args) -> int:
 
     retriever = create_retriever(config)
     if args.knowledge_cmd == "sync":
+        if not config.knowledge.sources:
+            # Silent zero-document syncs are a config-location trap
+            # (config lives at .runbook/config.yaml, not ./runbook.yaml).
+            print("warning: no knowledge sources configured — add "
+                  "knowledge.sources entries to .runbook/config.yaml "
+                  "(see docs/CONFIG.md)", file=sys.stderr)
         counts = retriever.sync(force=args.force)
         for name, n in counts.items():
             print(f"{name}: {n} documents synced")
