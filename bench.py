@@ -423,6 +423,10 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     core.run_until_idle()
     core.metrics.update(decode_tokens=0, decode_steps=0, prefill_tokens=0,
                         decode_time_s=0.0, prefill_time_s=0.0)
+    # Latency histograms (utils/metrics.py) restart with the measured run
+    # so the p95s below exclude warmup-compile TTFTs.
+    core.hist_ttft.reset()
+    core.hist_tpot.reset()
 
     reqs = [make_req() for _ in range(n_requests)]
     t0 = time.perf_counter()
@@ -436,6 +440,12 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     total_tokens = m["decode_tokens"] + m["prefill_tokens"]
     ttfts = sorted(r.ttft_ms for r in reqs if r.ttft_ms is not None)
     p50_ttft = ttfts[len(ttfts) // 2] if ttfts else None
+    # Tail latency through the engine's serving histograms (the same
+    # runbook_ttft_seconds / runbook_tpot_seconds a production scrape sees):
+    # bucket-interpolated, so these track the tail trend rather than exact
+    # order statistics — BENCH_r*.json now regresses on p95, not just median.
+    p95_ttft = core.hist_ttft.percentile(95)
+    p95_tpot = core.hist_tpot.percentile(95)
 
     # MFU: decode FLOPs/token ≈ 2·N over the matmul params (attention reads
     # against short contexts here add <2% — noted as approximate).
@@ -465,6 +475,10 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         "num_pages": num_pages,
         "prefill_batch": ecfg.prefill_batch,
         "p50_ttft_ms": round(p50_ttft, 1) if p50_ttft is not None else None,
+        "p95_ttft_ms": (round(p95_ttft * 1e3, 1)
+                        if p95_ttft is not None else None),
+        "p95_tpot_ms": (round(p95_tpot * 1e3, 2)
+                        if p95_tpot is not None else None),
         "wall_s": round(wall, 2),
         "total_tokens": total_tokens,
         "total_throughput_tok_s": round(total_tokens / wall, 2),

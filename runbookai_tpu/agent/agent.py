@@ -44,7 +44,23 @@ from runbookai_tpu.agent.types import (
     ToolCall,
     ToolResult,
 )
+from runbookai_tpu.utils.metrics import get_registry
 from runbookai_tpu.utils.tokens import estimate_tokens
+
+# LLM/token accounting for the agent loop, in the same registry the serving
+# stack scrapes — an operator watching /metrics sees tool latency AND what
+# the loop spent on inference for the same investigation.
+_LLM_CALLS = get_registry().counter(
+    "runbook_agent_llm_calls_total", "LLM chat calls made by the agent loop")
+_LLM_PROMPT_TOKENS = get_registry().counter(
+    "runbook_agent_llm_prompt_tokens_total",
+    "Prompt tokens consumed by agent-loop LLM calls")
+_LLM_COMPLETION_TOKENS = get_registry().counter(
+    "runbook_agent_llm_completion_tokens_total",
+    "Completion tokens generated for agent-loop LLM calls")
+_TOOL_CACHE_HITS = get_registry().counter(
+    "runbook_agent_tool_cache_hits_total",
+    "Tool calls served from the LRU result cache", labels=("tool",))
 
 
 class NullKnowledge:
@@ -104,6 +120,7 @@ class Agent:
         LLMResponse (consumed by :meth:`run`, never surfaced)."""
         if not self.stream_tokens:
             resp = await self.llm.chat(system, prompt, tools)
+            self._count_llm_usage(resp)
             yield AgentEvent("_response", {"response": resp})
             return
         resp = None
@@ -127,7 +144,17 @@ class Agent:
                 "".join(parts))
             resp = LLMResponse(content=content, tool_calls=tool_calls,
                                thinking=thinking)
+        self._count_llm_usage(resp)
         yield AgentEvent("_response", {"response": resp})
+
+    @staticmethod
+    def _count_llm_usage(resp) -> None:
+        _LLM_CALLS.inc()
+        usage = getattr(resp, "usage", None) or {}
+        if usage.get("prompt_tokens"):
+            _LLM_PROMPT_TOKENS.inc(usage["prompt_tokens"])
+        if usage.get("completion_tokens"):
+            _LLM_COMPLETION_TOKENS.inc(usage["completion_tokens"])
 
     # ------------------------------------------------------------------ run
 
@@ -364,6 +391,7 @@ class Agent:
             if tool.risk == RiskLevel.READ:
                 cached = self.cache.get(call.name, call.args)
                 if cached is not None:
+                    _TOOL_CACHE_HITS.labels(tool=call.name).inc()
                     results[i] = ToolResult(call=call, result=cached, cached=True)
                     continue
             to_run.append((i, call))

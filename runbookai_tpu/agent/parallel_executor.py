@@ -14,6 +14,19 @@ import time
 from typing import Any, Awaitable, Callable, Optional
 
 from runbookai_tpu.agent.types import RiskLevel, Tool, ToolCall, ToolResult
+from runbookai_tpu.utils.metrics import TOOL_LATENCY_BUCKETS, get_registry
+
+# Per-tool serving metrics (same registry the engine/server report through;
+# tool names are a bounded set, so they are safe as a label).
+_TOOL_LATENCY = get_registry().histogram(
+    "runbook_agent_tool_latency_seconds", "Tool execution latency",
+    labels=("tool",), buckets=TOOL_LATENCY_BUCKETS)
+_TOOL_CALLS = get_registry().counter(
+    "runbook_agent_tool_calls_total", "Tool executions (cache misses)",
+    labels=("tool",))
+_TOOL_ERRORS = get_registry().counter(
+    "runbook_agent_tool_errors_total",
+    "Tool executions that errored or timed out", labels=("tool",))
 
 
 def analyze_tool_dependencies(
@@ -55,6 +68,7 @@ class ParallelToolExecutor:
     ) -> ToolResult:
         start = time.perf_counter()
         timeout = self.mutation_timeout if is_mutation else self.timeout
+        _TOOL_CALLS.labels(tool=call.name).inc()
         try:
             if timeout:
                 result = await asyncio.wait_for(execute(call), timeout=timeout)
@@ -63,11 +77,16 @@ class ParallelToolExecutor:
             return ToolResult(call=call, result=result,
                               duration_ms=(time.perf_counter() - start) * 1000)
         except asyncio.TimeoutError:
+            _TOOL_ERRORS.labels(tool=call.name).inc()
             return ToolResult(call=call, error=f"tool {call.name} timed out",
                               duration_ms=(time.perf_counter() - start) * 1000)
         except Exception as exc:  # noqa: BLE001 — tool errors surface as results
+            _TOOL_ERRORS.labels(tool=call.name).inc()
             return ToolResult(call=call, error=f"{type(exc).__name__}: {exc}",
                               duration_ms=(time.perf_counter() - start) * 1000)
+        finally:
+            _TOOL_LATENCY.labels(tool=call.name).observe(
+                time.perf_counter() - start)
 
     async def execute_all(
         self,

@@ -686,13 +686,52 @@ def cmd_serve(args) -> int:
     print(f"serving {config.llm.model} at http://{args.host}:{server.port}/v1 "
           f"(POST /v1/chat/completions"
           + (", /v1/embeddings" if embedder else "")
-          + ", GET /v1/models, /healthz)")
+          + ", GET /v1/models, /healthz, /metrics)")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
         server.shutdown()
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    """Observability snapshot: scrape a running server's ``/metrics``
+    (Prometheus text), or summarize a tracer JSONL into per-span latency
+    percentiles. The correlation workflow (docs/observability.md): take a
+    response's ``x-request-id``, grep the trace JSONL for it, then compare
+    that request against the population summarized here."""
+    if args.trace:
+        from runbookai_tpu.utils.trace import read_spans, summarize_spans
+
+        try:
+            spans = read_spans(args.trace)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"could not read trace {args.trace}: {e}", file=sys.stderr)
+            return 1
+        summary = summarize_spans(spans)
+        if args.span:
+            summary = {k: v for k, v in summary.items() if args.span in k}
+        print(json.dumps(summary, indent=2))
+        return 0
+
+    import urllib.error
+    import urllib.request
+
+    url = args.url.rstrip("/")
+    if not url.endswith("/metrics"):
+        url += "/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as r:
+            text = r.read().decode()
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        print(f"could not scrape {url}: {e}", file=sys.stderr)
+        return 1
+    if args.grep:
+        text = "\n".join(line for line in text.splitlines()
+                         if args.grep in line)
+    print(text)
     return 0
 
 
@@ -1010,6 +1049,20 @@ def build_parser() -> argparse.ArgumentParser:
 
     bench = sub.add_parser("bench", help="serving benchmark (one JSON line)")
     bench.set_defaults(fn=cmd_bench)
+
+    met = sub.add_parser(
+        "metrics", help="scrape a server's /metrics or summarize a trace")
+    met.add_argument("--url", default="http://127.0.0.1:8000",
+                     help="server base URL (GET <url>/metrics)")
+    met.add_argument("--trace", default=None, metavar="JSONL",
+                     help="summarize a tracer JSONL (per-span p50/p95/max) "
+                          "instead of scraping")
+    met.add_argument("--span", default=None,
+                     help="with --trace: only span names containing this")
+    met.add_argument("--grep", default=None,
+                     help="only /metrics lines containing this substring")
+    met.add_argument("--timeout", type=float, default=10.0)
+    met.set_defaults(fn=cmd_metrics)
 
     mcp = sub.add_parser("mcp", help="MCP server over stdio")
     mcp_sub = mcp.add_subparsers(dest="mcp_cmd", required=True)

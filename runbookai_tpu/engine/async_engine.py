@@ -123,17 +123,21 @@ class AsyncEngine:
         timeout_s: Optional[float] = None,
         priority: int = 0,
         adapter: Optional[str] = None,
+        request_id: Optional[str] = None,
     ) -> EngineOutput:
         """Submit one request and await its completion.
 
         With ``timeout_s``, a stalled generation is ABORTED in the engine
         (slot + KV pages freed) before ``TimeoutError`` propagates — a
         caller-side timeout alone would leave the request decoding to
-        max_new_tokens for nobody."""
+        max_new_tokens for nobody. ``request_id`` (the server's
+        x-request-id) rides into the engine's tracer records for
+        trace-to-request correlation."""
         await self.start()  # idempotent; restarts after a torn-down loop
         req = EngineRequest(prompt_ids=prompt_ids,
                             sampling=sampling or SamplingParams(),
-                            priority=priority, adapter=adapter)
+                            priority=priority, adapter=adapter,
+                            trace_id=request_id)
         req.done_event = asyncio.Event()
         loop = asyncio.get_running_loop()
         # done_event.set() happens on a worker thread; bridge it safely.
@@ -178,6 +182,7 @@ class AsyncEngine:
         priority: int = 0,
         adapter: Optional[str] = None,
         request_sink: Optional[list] = None,
+        request_id: Optional[str] = None,
     ):
         """Async iterator of token ids as the engine samples them.
 
@@ -190,7 +195,8 @@ class AsyncEngine:
         await self.start()  # idempotent; restarts after a torn-down loop
         req = EngineRequest(prompt_ids=prompt_ids,
                             sampling=sampling or SamplingParams(),
-                            priority=priority, adapter=adapter)
+                            priority=priority, adapter=adapter,
+                            trace_id=request_id)
         if request_sink is not None:
             # Streaming consumers that need per-token request state
             # (logprob entries accumulate on the engine worker thread;

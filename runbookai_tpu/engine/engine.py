@@ -48,6 +48,7 @@ from runbookai_tpu.engine.request import (
 )
 from runbookai_tpu.models.llama import LlamaConfig, forward_impl
 from runbookai_tpu.ops.sampling import sample_tokens
+from runbookai_tpu.utils import metrics as metrics_mod
 from runbookai_tpu.utils.trace import annotate, get_tracer
 
 
@@ -559,9 +560,93 @@ class EngineCore:
         self._slots: list[Optional[EngineRequest]] = [None] * self.ecfg.max_batch_slots
         self._last_token: dict[str, int] = {}
         # Serving metrics (BASELINE.md contract: TTFT + tokens/sec/chip).
+        # This dict stays the single source of truth for the step counters
+        # (/healthz contract, bench resets, tests); the registry re-exports
+        # it via scrape-time callbacks in _install_metrics.
         self.metrics = {"decode_tokens": 0, "decode_steps": 0, "prefill_tokens": 0,
                         "preemptions": 0, "decode_time_s": 0.0, "prefill_time_s": 0.0,
                         "cached_prefix_tokens": 0, "spec_drafted": 0, "spec_accepted": 0}
+        self.registry = metrics_mod.get_registry()
+        self._install_metrics()
+
+    def _install_metrics(self) -> None:
+        """Register the engine's Prometheus-facing metrics.
+
+        Per-request latency histograms are observed directly at the
+        scheduling points (admission, first token, finish); live-state
+        gauges and the legacy step counters are scrape-time callbacks, so
+        there is exactly one source of truth and zero per-step overhead.
+        Registration is get-or-create and ``set_function`` replaces the
+        previous callback, so rebuilding an engine in-process (tests,
+        bench children) re-binds the gauges to the newest core.
+        """
+        reg, m = self.registry, metrics_mod
+        self.hist_ttft = reg.histogram(
+            "runbook_ttft_seconds", "Time to first token per request",
+            buckets=m.TTFT_BUCKETS)
+        self.hist_tpot = reg.histogram(
+            "runbook_tpot_seconds",
+            "Per-token decode latency (e2e minus TTFT over generated-1)",
+            buckets=m.TPOT_BUCKETS)
+        self.hist_e2e = reg.histogram(
+            "runbook_e2e_seconds", "Request end-to-end latency",
+            buckets=m.E2E_BUCKETS)
+        self.hist_queue_wait = reg.histogram(
+            "runbook_queue_wait_seconds",
+            "Submission-to-admission wait (first admission only)",
+            buckets=m.QUEUE_WAIT_BUCKETS)
+        # Live scheduler/pool state: plain attribute reads, safe from the
+        # scrape thread without the step lock (at worst one step stale).
+        reg.gauge("runbook_running_requests",
+                  "Requests holding a decode slot"
+                  ).set_function(lambda: len(self.decoding))
+        reg.gauge("runbook_waiting_requests",
+                  "Requests queued or prefilling"
+                  ).set_function(lambda: len(self.waiting)
+                                 + len(self.prefilling))
+        reg.gauge("runbook_kv_pages_total", "KV pool size in pages"
+                  ).set_function(lambda: self.kv.allocator.num_pages)
+        reg.gauge("runbook_kv_pages_in_use",
+                  "KV pages referenced by live sequences"
+                  ).set_function(lambda: self.kv.pages_in_use)
+        reg.gauge("runbook_kv_pages_cached",
+                  "Retired-but-resident prefix-cache pages"
+                  ).set_function(lambda: self.kv.allocator.cached_pages)
+        reg.gauge("runbook_kv_pool_utilization",
+                  "Fraction of allocatable KV pages held by live sequences"
+                  ).set_function(self.kv.utilization)
+        reg.gauge("runbook_prefix_cache_hit_ratio",
+                  "Cached prompt tokens / (cached + prefilled) since start"
+                  ).set_function(self._prefix_hit_ratio)
+        for key, name, help_text in (
+            ("decode_tokens", "runbook_decode_tokens_total",
+             "Tokens sampled by decode dispatches"),
+            ("decode_steps", "runbook_decode_steps_total",
+             "Decode dispatches"),
+            ("prefill_tokens", "runbook_prefill_tokens_total",
+             "Prompt tokens prefilled"),
+            ("preemptions", "runbook_preemptions_total",
+             "Requests preempted by recompute under pool pressure"),
+            ("cached_prefix_tokens", "runbook_cached_prefix_tokens_total",
+             "Prompt tokens served from the prefix cache"),
+            ("spec_drafted", "runbook_spec_drafted_total",
+             "Speculative tokens drafted"),
+            ("spec_accepted", "runbook_spec_accepted_total",
+             "Speculative tokens accepted"),
+            ("grammar_forced_tokens", "runbook_grammar_forced_tokens_total",
+             "Tokens emitted by grammar fast-forward without a dispatch"),
+            ("decode_time_s", "runbook_decode_time_seconds_total",
+             "Wall-clock spent in decode dispatches"),
+            ("prefill_time_s", "runbook_prefill_time_seconds_total",
+             "Wall-clock spent in prefill dispatches"),
+        ):
+            reg.counter(name, help_text).set_function(
+                lambda k=key: float(self.metrics.get(k, 0)))
+
+    def _prefix_hit_ratio(self) -> float:
+        cached = self.metrics.get("cached_prefix_tokens", 0)
+        total = cached + self.metrics.get("prefill_tokens", 0)
+        return cached / total if total else 0.0
 
     # ------------------------------------------------------------------ API
 
@@ -661,6 +746,7 @@ class EngineCore:
                     self.waiting.pop(0)
                     req.state = RequestState.FAILED
                     req.finish_reason = FinishReason.ABORTED
+                    self._observe_finish(req)
                     self.finished.append(req)
                     if req.done_event is not None:
                         req.done_event.set()
@@ -681,6 +767,8 @@ class EngineCore:
                 # its OWN published pages is recompute avoidance, not a
                 # prompt-cache hit the client should be billed less for.
                 req.cached_tokens = cached
+                self.hist_queue_wait.observe(
+                    time.perf_counter() - req.arrival_time)
             self.metrics["cached_prefix_tokens"] += cached
             self.prefilling.append(req)
             in_flight += 1
@@ -733,9 +821,36 @@ class EngineCore:
             valid = valid + req.out_ids[:-1]
         return valid
 
+    def _observe_finish(self, req: EngineRequest) -> None:
+        """Latency histograms + trace correlation for a finishing request.
+
+        Idempotent via ``finish_time``: force_finish re-runs the cleanup of
+        a partially-finished request after an abort crash, and one request
+        must never observe twice."""
+        if req.finish_time is not None:
+            return
+        now = time.perf_counter()
+        req.finish_time = now
+        self.hist_e2e.observe(now - req.arrival_time)
+        if req.first_token_time is not None and req.num_generated > 1:
+            self.hist_tpot.observe((now - req.first_token_time)
+                                   / (req.num_generated - 1))
+        # One JSONL line per request ties the engine's view back to the
+        # server's x-request-id (req.trace_id) — the join key between a
+        # trace record and the request's metrics. No-op when tracing is off.
+        meta = {"request": req.request_id,
+                "reason": req.finish_reason.value if req.finish_reason else None,
+                "generated": req.num_generated}
+        if req.ttft_ms is not None:
+            meta["ttft_ms"] = round(req.ttft_ms, 3)
+        if req.trace_id is not None:
+            meta["trace_id"] = req.trace_id
+        self.tracer.event("engine.request", **meta)
+
     def _finish(self, req: EngineRequest, reason: FinishReason) -> None:
         req.state = RequestState.FINISHED
         req.finish_reason = reason
+        self._observe_finish(req)
         if req.slot is not None:
             self._slots[req.slot] = None
             req.slot = None
@@ -771,6 +886,10 @@ class EngineCore:
         self._last_token.pop(req.request_id, None)
         req.state = RequestState.FINISHED
         req.finish_reason = req.finish_reason or FinishReason.ABORTED
+        try:
+            self._observe_finish(req)
+        except Exception:  # noqa: BLE001 — metrics must not block recovery
+            pass
         if req not in self.finished:
             self.finished.append(req)
         if req.done_event is not None:
@@ -788,6 +907,7 @@ class EngineCore:
                         self.waiting.remove(req)
                         req.state = RequestState.FINISHED
                         req.finish_reason = FinishReason.ABORTED
+                        self._observe_finish(req)
                         self.finished.append(req)
                         if req.done_event is not None:
                             req.done_event.set()
@@ -976,6 +1096,8 @@ class EngineCore:
             for i, req in done_rows:
                 if req.first_token_time is None:  # true TTFT across preemption
                     req.first_token_time = time.perf_counter()
+                    self.hist_ttft.observe(req.first_token_time
+                                           - req.arrival_time)
                 self._emit_token(req, int(toks_host[i]))
         self.metrics["prefill_time_s"] += time.perf_counter() - t0
 

@@ -376,6 +376,22 @@ class KVCacheManager:
                     token_ids[b * self.page_size : (b + 1) * self.page_size])
         alloc.registered_blocks = max(alloc.registered_blocks, max_blocks)
 
+    # -------------------------------------------------------------- pressure
+
+    @property
+    def pages_in_use(self) -> int:
+        """Pages referenced by live sequences (excludes the reserved null
+        page, free pages, and retired-but-resident cache pages)."""
+        a = self.allocator
+        return a.num_pages - 1 - a.free_pages
+
+    def utilization(self) -> float:
+        """Live-reference pressure on the allocatable pool, 0..1 — the
+        KV-pressure gauge serving dashboards alert on (retired cache pages
+        still count as allocatable, exactly like admission does)."""
+        usable = self.allocator.num_pages - 1
+        return self.pages_in_use / usable if usable > 0 else 0.0
+
     # -------------------------------------------------------------- lifecycle
 
     def add_pages_needed(self, prompt_len: int, cached_len: int = 0,

@@ -85,6 +85,10 @@ class EngineRequest:
     adapter_idx: int = 0  # engine-resolved; 0 is the reserved zero adapter
     # Monotonic clock — compared against perf_counter() timestamps in the engine.
     arrival_time: float = field(default_factory=time.perf_counter)
+    # Caller-supplied correlation id (the server's x-request-id): carried
+    # into the engine's tracer records so a JSONL trace line joins back to
+    # the HTTP request that produced it. None for internal callers.
+    trace_id: Optional[str] = None
 
     # Mutable engine-owned state:
     state: RequestState = RequestState.WAITING
@@ -97,6 +101,7 @@ class EngineRequest:
     block_hashes: Optional[list[int]] = None
     slot: Optional[int] = None  # decode batch slot index
     first_token_time: Optional[float] = None  # TTFT measurement
+    finish_time: Optional[float] = None  # set by _finish; e2e/TPOT source
     finish_reason: Optional[FinishReason] = None
     guided_state: Any = None  # grammar automaton state
     # Completion signal for the async API (set by AsyncEngine).

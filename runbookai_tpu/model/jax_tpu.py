@@ -34,7 +34,8 @@ from runbookai_tpu.utils.tokens import load_tokenizer
 async def stream_text(engine, tokenizer, prompt_ids, sampling,
                       state: Optional[dict] = None, priority: int = 0,
                       adapter: Optional[str] = None,
-                      request_sink: Optional[list] = None):
+                      request_sink: Optional[list] = None,
+                      request_id: Optional[str] = None):
     """Token stream -> text-piece stream, shared by every streaming surface
     (client ``chat_stream``, OpenAI SSE endpoint): incremental UTF-8 decode
     over per-token bytes (multi-byte chars split across tokens never yield
@@ -48,7 +49,8 @@ async def stream_text(engine, tokenizer, prompt_ids, sampling,
     async for tok in engine.generate_stream(prompt_ids, sampling,
                                             priority=priority,
                                             adapter=adapter,
-                                            request_sink=request_sink):
+                                            request_sink=request_sink,
+                                            request_id=request_id):
         if state is not None:
             state["n_tokens"] = state.get("n_tokens", 0) + 1
         if tok in stop_ids:

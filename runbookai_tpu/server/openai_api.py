@@ -7,7 +7,16 @@ OpenAI-client tooling can point at a TPU slice with no code changes:
 - ``POST /v1/chat/completions`` — non-streaming and ``stream: true`` (SSE
   ``data:`` chunks, ``[DONE]`` terminator).
 - ``GET /v1/models`` — the single served model.
-- ``GET /healthz`` — liveness + engine metrics snapshot.
+- ``GET /healthz`` — liveness + engine metrics snapshot (taken under the
+  engine's step lock) + uptime + KV-pool pressure.
+- ``GET /metrics`` — Prometheus text exposition of the process registry
+  (``runbookai_tpu.utils.metrics``): request/latency per route, engine
+  TTFT/TPOT histograms, KV gauges, agent tool counters.
+
+Every response carries an ``x-request-id`` header (client-supplied value
+echoed, else generated); the id is attached to the handler thread's tracer
+context and carried through the async engine into its span records, so a
+trace JSONL line joins back to the request that produced it.
 
 Architecture: a ``ThreadingHTTPServer`` (stdlib; no web framework in the
 image) with a dedicated asyncio loop thread that owns the
@@ -27,6 +36,15 @@ import uuid
 from concurrent.futures import TimeoutError as _FutTimeout  # builtin alias 3.11+, distinct on 3.10
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
+
+from runbookai_tpu.utils.metrics import REQUEST_LATENCY_BUCKETS, get_registry
+from runbookai_tpu.utils.trace import get_tracer
+
+# Bounded route-label cardinality: anything else is scraped as "other".
+_KNOWN_ROUTES = frozenset((
+    "/v1/chat/completions", "/v1/completions", "/v1/embeddings",
+    "/v1/adapters", "/v1/models", "/healthz", "/metrics",
+))
 
 
 def messages_to_prompt_parts(messages: list[dict[str, Any]]):
@@ -250,12 +268,51 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                  embedder=None):
     client = bridge.client
     _embed_mutex = threading.Lock()
+    started_at = time.time()
+    registry = get_registry()
+    requests_total = registry.counter(
+        "runbook_requests_total", "HTTP requests served",
+        labels=("route", "method", "status"))
+    request_latency = registry.histogram(
+        "runbook_request_latency_seconds", "HTTP request handling latency",
+        labels=("route", "method"), buckets=REQUEST_LATENCY_BUCKETS)
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
-        def log_message(self, *args) -> None:  # quiet; metrics via /healthz
+        def log_message(self, *args) -> None:  # quiet; metrics via /metrics
             pass
+
+        def send_response(self, code: int, message=None) -> None:
+            # Every response (JSON, SSE, errors) echoes the correlation id;
+            # the hook also records the status for the route metrics.
+            super().send_response(code, message)
+            self._status = code
+            rid = getattr(self, "_request_id", None)
+            if rid:
+                self.send_header("x-request-id", rid)
+
+        def _dispatch(self, method: str, fn) -> None:
+            """Route wrapper: request-id propagation, tracer context, and
+            per-route request/latency instrumentation."""
+            self._request_id = (self.headers.get("x-request-id")
+                                or f"req-{uuid.uuid4().hex[:16]}")
+            self._status = 0
+            route = self.path if self.path in _KNOWN_ROUTES else "other"
+            tracer = get_tracer()
+            tracer.set_context(request_id=self._request_id)
+            t0 = time.perf_counter()
+            try:
+                with tracer.span("server.request", route=route,
+                                 method=method):
+                    fn()
+            finally:
+                tracer.clear_context()
+                requests_total.labels(
+                    route=route, method=method,
+                    status=str(self._status or 500)).inc()
+                request_latency.labels(route=route, method=method).observe(
+                    time.perf_counter() - t0)
 
         def _json(self, code: int, payload: dict) -> None:
             body = json.dumps(payload).encode()
@@ -277,6 +334,12 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             return body
 
         def do_GET(self) -> None:  # noqa: N802 — http.server API
+            self._dispatch("GET", self._route_get)
+
+        def do_POST(self) -> None:  # noqa: N802
+            self._dispatch("POST", self._route_post)
+
+        def _route_get(self) -> None:
             if self.path == "/v1/models":
                 models = [{"id": model_name, "object": "model",
                            "owned_by": "runbookai-tpu"}]
@@ -288,13 +351,43 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                                for n in client.core.lora.names]
                 self._json(200, {"object": "list", "data": models})
             elif self.path == "/healthz":
-                m = dict(client.core.metrics)
-                self._json(200, {"status": "ok", "model": model_name,
-                                 "metrics": m})
+                # Snapshot under the engine's step lock: the loop thread
+                # mutates several keys per step, so a lock-free shallow
+                # copy could pair a new decode_tokens with an old
+                # decode_time_s. Bounded wait only — a step that is busy
+                # compiling a new batch shape can hold the lock for tens
+                # of seconds, and a liveness probe that blocks that long
+                # gets the pod killed mid-compile. A torn-but-live
+                # snapshot beats a dead prober.
+                lock = getattr(client.engine, "_lock", None)
+                locked = lock is not None and lock.acquire(timeout=0.5)
+                try:
+                    m = dict(client.core.metrics)
+                finally:
+                    if locked:
+                        lock.release()
+                kv = client.core.kv
+                self._json(200, {
+                    "status": "ok", "model": model_name,
+                    "uptime_s": round(time.time() - started_at, 3),
+                    "kv": {"pages_total": kv.allocator.num_pages,
+                           "pages_in_use": kv.pages_in_use,
+                           "pages_cached": kv.allocator.cached_pages,
+                           "utilization": round(kv.utilization(), 4)},
+                    "metrics": m,
+                })
+            elif self.path == "/metrics":
+                body = registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._error(404, f"no route {self.path}")
 
-        def do_POST(self) -> None:  # noqa: N802
+        def _route_post(self) -> None:
             if self.path == "/v1/adapters":
                 self._load_adapter()
                 return
@@ -395,7 +488,8 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                         return await asyncio.gather(*[
                             client.engine.generate(
                                 ids, _choice_sampling(i),
-                                timeout_s=request_timeout, adapter=adapter)
+                                timeout_s=request_timeout, adapter=adapter,
+                                request_id=self._request_id)
                             for i in range(n)], return_exceptions=True)
 
                     outs = bridge.run(_gen_n(), timeout=request_timeout + 60)
@@ -512,7 +606,8 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                                                  seed=sampling.seed + i)
                             jobs.append(client.engine.generate(
                                 ids, sp, timeout_s=request_timeout,
-                                adapter=adapter))
+                                adapter=adapter,
+                                request_id=self._request_id))
                     return await asyncio.gather(*jobs,
                                                 return_exceptions=True)
 
@@ -718,7 +813,8 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             req_sink: list = []
             agen = stream_text(client.engine, client.tokenizer, ids,
                                sampling, state=state, adapter=adapter,
-                               request_sink=req_sink)
+                               request_sink=req_sink,
+                               request_id=getattr(self, "_request_id", None))
             lp_sent = 0
 
             def chunk_logprobs() -> Optional[dict]:

@@ -24,6 +24,7 @@ import json
 import os
 import threading
 import time
+import warnings
 from pathlib import Path
 from typing import Any, Iterator, Optional
 
@@ -42,6 +43,7 @@ class Tracer:
         self._local = threading.local()
         self._lock = threading.Lock()
         self._fh = None
+        self._warned = False
         if self.enabled:
             self.path.parent.mkdir(parents=True, exist_ok=True)
             self._fh = open(self.path, "a", buffering=1)  # line-buffered
@@ -54,12 +56,41 @@ class Tracer:
     def _depth(self, value: int) -> None:
         self._local.depth = value
 
+    # -------------------------------------------------------------- context
+
+    def set_context(self, **fields: Any) -> None:
+        """Attach per-thread fields to every span/event this thread writes
+        until :meth:`clear_context` — e.g. the server sets
+        ``request_id=<x-request-id>`` for the handler thread so a JSONL
+        trace line can be joined to its request's metrics."""
+        ctx = getattr(self._local, "ctx", None)
+        if ctx is None:
+            ctx = self._local.ctx = {}
+        ctx.update(fields)
+
+    def clear_context(self) -> None:
+        self._local.ctx = {}
+
+    def _ctx(self) -> Optional[dict[str, Any]]:
+        ctx = getattr(self._local, "ctx", None)
+        return dict(ctx) if ctx else None
+
     def _write(self, rec: dict[str, Any]) -> None:
         try:
             with self._lock:
+                if self._fh is None:
+                    return  # closed deliberately: silence, not a warning
                 self._fh.write(json.dumps(rec) + "\n")
-        except (OSError, ValueError, AttributeError):
-            self.enabled = False  # disk gone / closed: stop tracing, keep serving
+        except (OSError, ValueError) as e:
+            # Disk gone / fh poisoned: stop tracing, keep serving — but
+            # never silently (operators must learn their trail went dark).
+            self.enabled = False
+            if not self._warned:
+                self._warned = True
+                warnings.warn(
+                    f"tracing disabled: could not write {self.path} "
+                    f"({type(e).__name__}: {e})", RuntimeWarning,
+                    stacklevel=3)
 
     @contextlib.contextmanager
     def span(self, name: str, **meta: Any) -> Iterator[None]:
@@ -75,6 +106,9 @@ class Tracer:
             self._depth -= 1
             rec = {"ts": time.time(), "name": name, "depth": depth,
                    "ms": round((time.perf_counter() - t0) * 1e3, 3)}
+            ctx = self._ctx()
+            if ctx:
+                rec["ctx"] = ctx
             if meta:
                 rec["meta"] = meta
             self._write(rec)
@@ -84,13 +118,18 @@ class Tracer:
         if not self.enabled:
             return
         rec = {"ts": time.time(), "name": name, "depth": self._depth + 1, "ms": 0.0}
+        ctx = self._ctx()
+        if ctx:
+            rec["ctx"] = ctx
         if meta:
             rec["meta"] = meta
         self._write(rec)
 
     def close(self) -> None:
+        """Flush and release the line-buffered handle; tracing stays off."""
         with self._lock:
             if self._fh:
+                self._fh.flush()
                 self._fh.close()
                 self._fh = None
                 self.enabled = False
@@ -149,4 +188,38 @@ def read_spans(path: str | Path) -> list[dict[str, Any]]:
             line = line.strip()
             if line:
                 out.append(json.loads(line))
+    return out
+
+
+def _percentile(sorted_ms: list[float], q: float) -> float:
+    """Exact nearest-rank-with-interpolation percentile of a sorted list."""
+    if not sorted_ms:
+        return 0.0
+    pos = (len(sorted_ms) - 1) * q / 100.0
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_ms) - 1)
+    return sorted_ms[lo] + (sorted_ms[hi] - sorted_ms[lo]) * (pos - lo)
+
+
+def summarize_spans(spans: list[dict[str, Any]]) -> dict[str, dict[str, Any]]:
+    """Per-span-name latency summary: count, p50/p95/max/total ms.
+
+    The analysis half of ``runbook metrics --trace``: joins with the
+    Prometheus side through span names (engine.decode, server.request, ...)
+    and per-record ``ctx.request_id``.
+    """
+    by_name: dict[str, list[float]] = {}
+    for rec in spans:
+        by_name.setdefault(str(rec.get("name", "?")), []).append(
+            float(rec.get("ms", 0.0)))
+    out: dict[str, dict[str, Any]] = {}
+    for name in sorted(by_name):
+        ms = sorted(by_name[name])
+        out[name] = {
+            "count": len(ms),
+            "p50_ms": round(_percentile(ms, 50), 3),
+            "p95_ms": round(_percentile(ms, 95), 3),
+            "max_ms": round(ms[-1], 3),
+            "total_ms": round(sum(ms), 3),
+        }
     return out

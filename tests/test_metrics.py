@@ -1,0 +1,402 @@
+"""Serving metrics layer: registry semantics, Prometheus exposition, the
+/metrics endpoint over a live server, request-ID-correlated tracing, and the
+metric-name/bucket contract that pins dashboard-facing names at test time.
+"""
+
+import json
+import re
+import threading
+import urllib.request
+import warnings
+
+import pytest
+
+from runbookai_tpu.utils.metrics import (
+    METRIC_NAME_RE,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+
+# --------------------------------------------------------------------------- #
+# registry semantics                                                          #
+# --------------------------------------------------------------------------- #
+
+
+def test_counter_and_gauge_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("runbook_test_total", "test counter")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = reg.gauge("runbook_test_gauge", "test gauge")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value == 4
+
+    fn_g = reg.gauge("runbook_test_fn_gauge", "callback gauge")
+    fn_g.set_function(lambda: 42.0)
+    assert fn_g.value == 42.0
+    # A dying callback must not poison the scrape.
+    fn_g.set_function(lambda: 1 / 0)
+    assert "runbook_test_fn_gauge" in reg.render()
+
+
+def test_labels_and_get_or_create():
+    reg = MetricsRegistry()
+    c = reg.counter("runbook_req_total", "reqs", labels=("route", "status"))
+    c.labels(route="/a", status="200").inc()
+    c.labels("/a", "200").inc()
+    c.labels(route="/b", status="500").inc()
+    text = reg.render()
+    assert 'runbook_req_total{route="/a",status="200"} 2' in text
+    assert 'runbook_req_total{route="/b",status="500"} 1' in text
+    with pytest.raises(ValueError):
+        c.inc()  # labeled metric requires .labels()
+    with pytest.raises(ValueError):
+        c.labels(route="/a").inc()  # wrong arity
+
+    # get-or-create: same name returns the SAME object...
+    assert reg.counter("runbook_req_total", "reqs",
+                       labels=("route", "status")) is c
+    # ...but type or label mismatches are loud, never silent aliasing.
+    with pytest.raises(ValueError):
+        reg.gauge("runbook_req_total", "reqs")
+    with pytest.raises(ValueError):
+        reg.counter("runbook_req_total", "reqs", labels=("route",))
+    # Bucket mismatches too: re-registering a histogram with different
+    # bounds must not silently keep the old layout.
+    h = reg.histogram("runbook_gc_seconds", "x", buckets=(1.0, 2.0))
+    assert reg.histogram("runbook_gc_seconds", "x", buckets=(1, 2)) is h
+    with pytest.raises(ValueError):
+        reg.histogram("runbook_gc_seconds", "x", buckets=(1.0, 5.0))
+
+
+def test_name_and_bucket_validation():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.counter("bad_name_total", "no runbook_ prefix")
+    with pytest.raises(ValueError):
+        reg.counter("runbook_UPPER_total", "case")
+    with pytest.raises(ValueError):
+        reg.histogram("runbook_h_seconds", "x", buckets=())
+    with pytest.raises(ValueError):
+        reg.histogram("runbook_h_seconds", "x", buckets=(1.0, 0.5))
+    with pytest.raises(ValueError):
+        reg.histogram("runbook_h_seconds", "x", buckets=(1.0, float("inf")))
+    with pytest.raises(ValueError):
+        reg.counter("runbook_c_total", "x", labels=("le",))  # reserved
+
+
+def test_histogram_bucket_boundaries():
+    reg = MetricsRegistry()
+    h = reg.histogram("runbook_lat_seconds", "latency",
+                      buckets=(0.1, 1.0, 10.0))
+    h.observe(0.1)   # ON the boundary: le="0.1" is cumulative <=
+    h.observe(0.5)
+    h.observe(100.0)  # +Inf bucket only
+    text = reg.render()
+    assert 'runbook_lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'runbook_lat_seconds_bucket{le="1"} 2' in text
+    assert 'runbook_lat_seconds_bucket{le="10"} 2' in text
+    assert 'runbook_lat_seconds_bucket{le="+Inf"} 3' in text
+    assert "runbook_lat_seconds_count 3" in text
+    assert "runbook_lat_seconds_sum 100.6" in text
+    assert h.count == 3
+    h.reset()
+    assert h.count == 0
+
+
+def test_histogram_percentile_interpolation():
+    reg = MetricsRegistry()
+    h = reg.histogram("runbook_p_seconds", "p", buckets=(1.0, 2.0, 4.0))
+    assert h.percentile(95) is None  # empty
+    for v in (0.5, 1.5, 3.0, 3.5):
+        h.observe(v)
+    assert h.percentile(50) == pytest.approx(2.0)
+    assert 2.0 < h.percentile(95) <= 4.0
+    h.observe(1000.0)  # +Inf: clamps to last finite bound
+    assert h.percentile(99) == 4.0
+
+
+def test_prometheus_escaping():
+    reg = MetricsRegistry()
+    c = reg.counter("runbook_esc_total", 'help "quoted" \\ and\nnewline',
+                    labels=("tool",))
+    c.labels(tool='a"b\\c\nd').inc()
+    text = reg.render()
+    assert "# HELP runbook_esc_total" in text
+    assert "and\\nnewline" in text  # help newline escaped
+    assert '{tool="a\\"b\\\\c\\nd"} 1' in text  # label value escaped
+
+
+def test_concurrent_increments_from_threads():
+    reg = MetricsRegistry()
+    c = reg.counter("runbook_conc_total", "x")
+    h = reg.histogram("runbook_conc_seconds", "x", buckets=(0.5, 1.0))
+
+    def work():
+        for _ in range(500):
+            c.inc()
+            h.observe(0.25)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 4000
+    assert h.count == 4000
+    assert h.sum == pytest.approx(1000.0)
+
+
+def test_snapshot_is_json_friendly():
+    reg = MetricsRegistry()
+    reg.counter("runbook_s_total", "x").inc(3)
+    reg.gauge("runbook_s_gauge", "x").set(7)
+    h = reg.histogram("runbook_s_seconds", "x", buckets=(1.0, 2.0))
+    h.observe(0.5)
+    snap = json.loads(json.dumps(reg.snapshot()))
+    assert snap["runbook_s_total"] == 3
+    assert snap["runbook_s_gauge"] == 7
+    assert snap["runbook_s_seconds"]["count"] == 1
+
+
+# --------------------------------------------------------------------------- #
+# tracer: warn-once disable, close, per-thread context                        #
+# --------------------------------------------------------------------------- #
+
+
+def test_tracer_disable_warns_once(tmp_path):
+    from runbookai_tpu.utils.trace import Tracer
+
+    tr = Tracer(tmp_path / "t.jsonl")
+    with tr.span("ok"):
+        pass
+    tr._fh.close()  # simulate the disk/handle going away mid-flight
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with tr.span("lost"):
+            pass
+        with tr.span("lost2"):
+            pass
+    assert tr.enabled is False
+    warned = [w for w in caught if "tracing disabled" in str(w.message)]
+    assert len(warned) == 1  # once, not per span
+
+
+def test_tracer_close_is_silent_and_flushes(tmp_path):
+    from runbookai_tpu.utils.trace import Tracer, read_spans
+
+    tr = Tracer(tmp_path / "t.jsonl")
+    with tr.span("before"):
+        pass
+    tr.close()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        tr.event("after")  # deliberate close: no warning, no record
+    assert not [w for w in caught if "tracing disabled" in str(w.message)]
+    spans = read_spans(tmp_path / "t.jsonl")
+    assert [s["name"] for s in spans] == ["before"]
+
+
+def test_tracer_thread_context(tmp_path):
+    from runbookai_tpu.utils.trace import Tracer, read_spans
+
+    tr = Tracer(tmp_path / "t.jsonl")
+    tr.set_context(request_id="corr-1")
+    with tr.span("with-ctx"):
+        tr.event("inner")
+    tr.clear_context()
+    with tr.span("no-ctx"):
+        pass
+    tr.close()
+    spans = {s["name"]: s for s in read_spans(tmp_path / "t.jsonl")}
+    assert spans["with-ctx"]["ctx"] == {"request_id": "corr-1"}
+    assert spans["inner"]["ctx"] == {"request_id": "corr-1"}
+    assert "ctx" not in spans["no-ctx"]
+
+
+def test_trace_summary_and_cli(tmp_path, capsys):
+    from runbookai_tpu.utils.trace import summarize_spans
+
+    spans = [{"name": "engine.decode", "ms": float(i)} for i in range(1, 101)]
+    spans += [{"name": "engine.prefill", "ms": 5.0}]
+    summary = summarize_spans(spans)
+    assert summary["engine.decode"]["count"] == 100
+    assert summary["engine.decode"]["p50_ms"] == pytest.approx(50.5)
+    assert summary["engine.decode"]["p95_ms"] == pytest.approx(95.05)
+    assert summary["engine.decode"]["max_ms"] == 100.0
+    assert summary["engine.prefill"]["count"] == 1
+
+    # `runbook metrics --trace` summarizes the same JSONL from the CLI.
+    path = tmp_path / "trace.jsonl"
+    path.write_text("\n".join(json.dumps(s) for s in spans))
+    from runbookai_tpu.cli.main import main
+
+    rc = main(["metrics", "--trace", str(path), "--span", "decode"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert list(out) == ["engine.decode"]
+    assert out["engine.decode"]["p95_ms"] == pytest.approx(95.05)
+
+
+# --------------------------------------------------------------------------- #
+# serving integration: /metrics, /healthz, request-id propagation             #
+# --------------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def server():
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.server.openai_api import OpenAIServer
+
+    client = JaxTpuClient.for_testing(max_new_tokens=6)
+    srv = OpenAIServer(client, model_name="llama3-test", port=0)
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+def _post(srv, path, payload, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST")
+    return urllib.request.urlopen(req, timeout=120)
+
+
+def _get(srv, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}{path}", timeout=30)
+
+
+_SAMPLE_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+    r"(NaN|[+-]?Inf|[-+0-9.eE]+)$")
+
+
+def test_metrics_endpoint_scrapes_cleanly(server):
+    with _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 4,
+    }) as r:
+        json.loads(r.read())
+    with _get(server, "/metrics") as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    # Acceptance names: latency histogram, KV gauge, request counter.
+    assert "# TYPE runbook_ttft_seconds histogram" in text
+    assert "runbook_ttft_seconds_bucket" in text
+    assert "# TYPE runbook_kv_pages_in_use gauge" in text
+    assert "# TYPE runbook_requests_total counter" in text
+    assert 'route="/v1/chat/completions"' in text
+    # The engine actually observed the request we just made.
+    count_line = [ln for ln in text.splitlines()
+                  if ln.startswith("runbook_ttft_seconds_count")][0]
+    assert float(count_line.split()[-1]) >= 1
+    # Every sample line is well-formed Prometheus text format.
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _SAMPLE_LINE.match(line), line
+
+
+def test_healthz_keeps_contract_and_adds_pressure(server):
+    with _get(server, "/healthz") as r:
+        health = json.loads(r.read())
+    assert health["status"] == "ok"
+    # Backward-compatible engine snapshot keys (BASELINE.md contract).
+    for key in ("decode_tokens", "decode_steps", "prefill_tokens",
+                "preemptions", "decode_time_s", "prefill_time_s",
+                "cached_prefix_tokens", "spec_drafted", "spec_accepted"):
+        assert key in health["metrics"], key
+    assert health["uptime_s"] >= 0
+    assert health["kv"]["pages_total"] > 0
+    assert 0.0 <= health["kv"]["utilization"] <= 1.0
+
+
+def test_request_id_echoed_and_generated(server):
+    with _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "id"}], "max_tokens": 3,
+    }, headers={"x-request-id": "corr-echo-1"}) as r:
+        assert r.headers["x-request-id"] == "corr-echo-1"
+        json.loads(r.read())
+    # Absent header: the server generates one and echoes it.
+    with _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "id2"}], "max_tokens": 3,
+    }) as r:
+        assert r.headers["x-request-id"].startswith("req-")
+    # SSE responses carry it too (headers go out before the stream).
+    with _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "s"}], "max_tokens": 3,
+        "stream": True,
+    }, headers={"x-request-id": "corr-sse-2"}) as r:
+        assert r.headers["x-request-id"] == "corr-sse-2"
+        assert r.read().decode().rstrip().endswith("[DONE]")
+
+
+def test_request_id_propagates_to_trace_jsonl(tmp_path):
+    """End-to-end correlation: one HTTP request's x-request-id must appear
+    both in the server span's ctx and in the engine's finish event."""
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.server.openai_api import OpenAIServer
+    from runbookai_tpu.utils.trace import Tracer, read_spans, set_tracer
+
+    tracer = Tracer(tmp_path / "trace.jsonl")
+    set_tracer(tracer)
+    try:
+        client = JaxTpuClient.for_testing(max_new_tokens=4)
+        srv = OpenAIServer(client, model_name="llama3-test", port=0)
+        srv.start_background()
+        try:
+            with _post(srv, "/v1/chat/completions", {
+                "messages": [{"role": "user", "content": "trace me"}],
+                "max_tokens": 3,
+            }, headers={"x-request-id": "corr-trace-9"}) as r:
+                json.loads(r.read())
+        finally:
+            srv.shutdown()
+    finally:
+        set_tracer(None)
+        tracer.close()
+    spans = read_spans(tmp_path / "trace.jsonl")
+    server_spans = [s for s in spans if s["name"] == "server.request"
+                    and s.get("ctx", {}).get("request_id") == "corr-trace-9"]
+    assert server_spans, "server span missing the request id ctx"
+    assert server_spans[0]["meta"]["route"] == "/v1/chat/completions"
+    engine_events = [s for s in spans if s["name"] == "engine.request"
+                     and s.get("meta", {}).get("trace_id") == "corr-trace-9"]
+    assert engine_events, "engine finish event missing the trace id"
+    assert engine_events[0]["meta"]["generated"] >= 1
+
+
+# --------------------------------------------------------------------------- #
+# contract: names + explicit buckets (dashboard drift caught at test time)    #
+# --------------------------------------------------------------------------- #
+
+
+def test_metric_name_and_bucket_contract(server):
+    # Importing the instrumented layers registers their metrics; the live
+    # server fixture covers the engine- and server-registered ones.
+    import runbookai_tpu.agent.agent  # noqa: F401
+    import runbookai_tpu.agent.parallel_executor  # noqa: F401
+
+    metrics = list(get_registry())
+    names = [m.name for m in metrics]
+    # The layer is actually wired: engine, server, and agent all present.
+    assert "runbook_ttft_seconds" in names
+    assert "runbook_requests_total" in names
+    assert "runbook_agent_tool_latency_seconds" in names
+    assert "runbook_kv_pages_in_use" in names
+    for m in metrics:
+        assert METRIC_NAME_RE.match(m.name), m.name
+        assert m.type in ("counter", "gauge", "histogram"), m.name
+        if isinstance(m, Histogram):
+            assert m.buckets, f"{m.name} must declare explicit buckets"
+            assert list(m.buckets) == sorted(m.buckets), m.name
