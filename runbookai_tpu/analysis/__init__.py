@@ -1,0 +1,70 @@
+"""``runbook lint`` — AST static analysis for JAX/TPU serving hazards.
+
+The classes of bugs that sink a TPU serving stack — silent recompiles,
+host-device syncs in the decode loop, blocking calls under the engine step
+lock, drifting metric names — are all statically detectable but otherwise
+only surface at runtime on hardware CI never exercises. This package is the
+in-tree analyzer that enforces that discipline on every commit:
+
+- dependency-free (stdlib ``ast`` only — no jax import, so the gate runs in
+  milliseconds on any machine);
+- one visitor pass per file: every rule subscribes to node events on a
+  shared walker (``core._Walker``) instead of re-walking the tree;
+- findings carry ``file:line:col``, a stable rule id, a severity, and a
+  message; ``# runbook: noqa[RULE]`` on the statement suppresses a finding
+  in place (append a reason after the bracket — reviewers read it);
+- a checked-in baseline (``lint-baseline.json``) grandfathers pre-existing
+  findings so the gate only fails on NEW ones, and ``--update-baseline``
+  regenerates it deterministically.
+
+Rule set (see docs/lint.md for the catalog with bad/good examples):
+
+========  ==================================================================
+RBK001    data-dependent Python branching / ``bool()``/``int()``/``float()``
+          / ``.item()`` / ``.tolist()`` on traced values inside
+          ``@jax.jit``-reachable functions (recompile + host-sync hazards)
+RBK002    ``jax.block_until_ready`` / ``jax.device_get`` / implicit
+          device→host transfer in the engine step/decode loop outside
+          sanctioned sync points
+RBK003    blocking I/O (``time.sleep``, file/socket/subprocess) while
+          holding a lock (``with self._lock:`` scope analysis)
+RBK004    shared attributes mutated both inside and outside a lock scope
+          (lock-discipline heuristic)
+RBK005    metric registrations violating the observability contract
+          (``^runbook_[a-z0-9_]+$``; histograms need explicit buckets)
+RBK006    ``print`` / ``jax.debug.print`` left in engine/ops/model hot paths
+========  ==================================================================
+"""
+
+from runbookai_tpu.analysis.baseline import (
+    baseline_counts,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+from runbookai_tpu.analysis.core import (
+    Finding,
+    Rule,
+    Severity,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+    iter_python_files,
+)
+from runbookai_tpu.analysis.rules import default_rules, rule_by_id
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "Severity",
+    "analyze_file",
+    "analyze_paths",
+    "analyze_source",
+    "baseline_counts",
+    "default_rules",
+    "iter_python_files",
+    "load_baseline",
+    "new_findings",
+    "rule_by_id",
+    "write_baseline",
+]

@@ -1,0 +1,8 @@
+"""``python -m runbookai_tpu.analysis`` — same surface as ``runbook lint``."""
+
+import sys
+
+from runbookai_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

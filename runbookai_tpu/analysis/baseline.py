@@ -1,0 +1,91 @@
+"""Baseline semantics: grandfather pre-existing findings, fail on new ones.
+
+The baseline is a JSON object mapping ``"<path>:<RULE>"`` → count. Keying on
+(file, rule) with a count — instead of line numbers — makes the gate robust
+to unrelated edits that shift lines: moving a grandfathered finding around a
+file never trips CI, *adding one more* of the same rule in the same file
+does. Deterministic (sorted keys, trailing newline) so regeneration is a
+clean diff.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Iterable, Optional
+
+from runbookai_tpu.analysis.core import PARSE_RULE_ID, Finding
+
+
+def baseline_counts(findings: Iterable[Finding]) -> dict[str, int]:
+    # RBK000 (un-parseable file) is never grandfathered: a baselined parse
+    # error would mean a file that is silently never analyzed at all.
+    return dict(sorted(Counter(f.baseline_key for f in findings
+                               if f.rule != PARSE_RULE_ID).items()))
+
+
+def load_baseline(path: str | Path) -> dict[str, int]:
+    p = Path(path)
+    if not p.is_file():
+        return {}
+    data = json.loads(p.read_text(encoding="utf-8"))
+    if not isinstance(data, dict):
+        raise ValueError(f"{p}: baseline must be a JSON object")
+    out: dict[str, int] = {}
+    for key, value in data.items():
+        if not isinstance(value, int) or value < 0:
+            raise ValueError(f"{p}: baseline count for {key!r} must be a "
+                             f"non-negative integer")
+        out[str(key)] = value
+    return out
+
+
+def write_baseline(path: str | Path, findings: Iterable[Finding],
+                   analyzed_paths: Optional[set[str]] = None) -> dict[str, int]:
+    """Write the baseline; with ``analyzed_paths``, MERGE instead of replace.
+
+    A partial run (``lint some/file.py --update-baseline``) must only
+    refresh the keys of the files it actually analyzed — clobbering the
+    whole baseline from a narrow path set would un-grandfather every other
+    file's debt and fail the next full-tree gate. Keys whose file vanished
+    from disk are dropped on any update.
+    """
+    counts = baseline_counts(findings)
+    if analyzed_paths is not None:
+        # Key paths are relative to the baseline file's directory (the
+        # repo root in-tree), NOT the invoking cwd.
+        anchor = Path(path).resolve().parent
+        for key, count in load_baseline(path).items():
+            key_path = key.rsplit(":", 1)[0]
+            if key_path in analyzed_paths \
+                    or not (anchor / key_path).exists():
+                continue
+            counts.setdefault(key, count)
+    counts = dict(sorted(counts.items()))
+    Path(path).write_text(json.dumps(counts, indent=2, sort_keys=True) + "\n",
+                          encoding="utf-8")
+    return counts
+
+
+def new_findings(findings: Iterable[Finding],
+                 baseline: dict[str, int]) -> list[Finding]:
+    """Findings beyond each key's grandfathered count.
+
+    Within a key the EARLIEST findings (by line) consume the baseline
+    budget, so the excess reported is the one furthest into the file — in
+    practice the one the new edit introduced.
+    """
+    by_key: dict[str, list[Finding]] = {}
+    for f in findings:
+        by_key.setdefault(f.baseline_key, []).append(f)
+    out: list[Finding] = []
+    for key, group in by_key.items():
+        group.sort(key=lambda f: (f.line, f.col))
+        # Parse errors are always new — a hand-edited baseline must not be
+        # able to grandfather a file out of analysis entirely.
+        budget = 0 if key.endswith(f":{PARSE_RULE_ID}") \
+            else baseline.get(key, 0)
+        out.extend(group[budget:])
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out

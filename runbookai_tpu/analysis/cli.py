@@ -1,0 +1,115 @@
+"""Command surface for the analyzer — shared by ``runbook lint``,
+``python -m runbookai_tpu.analysis`` and ``scripts/lint.py``.
+
+Kept free of heavy imports (no jax, no engine): the lint gate is the
+fastest check in tier-1 and must stay that way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from runbookai_tpu.analysis.baseline import (
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+from runbookai_tpu.analysis.core import (
+    Severity,
+    _rel_path,
+    analyze_paths,
+    iter_python_files,
+)
+
+DEFAULT_BASELINE = "lint-baseline.json"
+
+
+def add_lint_arguments(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/directories to analyze "
+                             "(default: runbookai_tpu/)")
+    parser.add_argument("--format", choices=("text", "json"), default="text",
+                        dest="fmt", help="finding output format")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline JSON path (default: "
+                             f"{DEFAULT_BASELINE} when it exists)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, baselined or not")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from the current tree "
+                             "and exit 0")
+
+
+def run_lint(args: argparse.Namespace,
+             stdout=None) -> int:
+    out = stdout if stdout is not None else sys.stdout
+    paths = args.paths or ["runbookai_tpu"]
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        print(f"lint: no such path: {', '.join(missing)}", file=out)
+        return 2
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    # Finding paths (= baseline keys) anchor to the baseline file's
+    # directory — the repo root in-tree — so `runbook lint` matches the
+    # committed baseline no matter which cwd it is invoked from. Pure
+    # --no-baseline runs stay cwd-relative.
+    root = None
+    if not args.no_baseline:
+        root = Path(baseline_path).resolve().parent
+
+    findings = analyze_paths(paths, root=root)
+
+    if args.update_baseline:
+        # Merge-scoped to the analyzed files: a partial-path update must
+        # not drop other files' grandfathered keys (write_baseline doc).
+        # Normalized like Finding.path so set membership lines up.
+        analyzed = {_rel_path(f, root) for f in iter_python_files(paths)}
+        counts = write_baseline(baseline_path, findings,
+                                analyzed_paths=analyzed)
+        print(f"lint: baseline written to {baseline_path} "
+              f"({sum(counts.values())} findings across {len(counts)} keys)",
+              file=out)
+        return 0
+
+    baseline: dict[str, int] = {}
+    if not args.no_baseline and (args.baseline or Path(baseline_path).is_file()):
+        baseline = load_baseline(baseline_path)
+    new = new_findings(findings, baseline)
+
+    if args.fmt == "json":
+        json.dump({
+            "findings": [f.to_json() for f in new],
+            "total": len(findings),
+            "baselined": len(findings) - len(new),
+            "new": len(new),
+            "errors": sum(f.severity == Severity.ERROR for f in new),
+        }, out, indent=2)
+        out.write("\n")
+    else:
+        for f in new:
+            print(f.format(), file=out)
+        baselined = len(findings) - len(new)
+        suffix = f" ({baselined} baselined)" if baselined else ""
+        if new:
+            print(f"lint: {len(new)} new finding(s){suffix}", file=out)
+        else:
+            print(f"lint: clean{suffix}", file=out)
+    return 1 if new else 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="runbook-lint",
+        description="AST static analysis for JAX/TPU serving hazards "
+                    "(RBK001-RBK006; see docs/lint.md)")
+    add_lint_arguments(parser)
+    return run_lint(parser.parse_args(argv))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,587 @@
+"""Analyzer core: findings, the shared one-pass AST walker, noqa handling.
+
+Design constraints (package docstring has the why):
+
+- stdlib only — the gate must run without jax installed and in milliseconds;
+- ONE ``ast`` walk per file: rules are event subscribers on ``_Walker``,
+  which tracks the cross-cutting scope state every rule needs (enclosing
+  function + jit-reachability, traced parameter names, lock-scope depth,
+  enclosing class) so no rule re-derives it;
+- suppression is lexical: a ``# runbook: noqa[RULE]`` comment anywhere on
+  the lines a flagged statement spans silences that rule for the statement.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator, Optional, Sequence
+
+# Severities are informational ordering for humans; the gate fails on any
+# non-baselined finding regardless of severity.
+class Severity:
+    ERROR = "error"
+    WARNING = "warning"
+
+
+PARSE_RULE_ID = "RBK000"  # un-parseable file: always an error, never baselined away silently
+
+# Bare `noqa` (suppress-all) only counts when NOT followed by a bracket or
+# more word chars: a malformed `noqa[RBK002` (unclosed) or `noqa-ish` must
+# suppress NOTHING — silently widening a typo'd one-rule suppression to
+# all rules is how gates rot.
+_NOQA_RE = re.compile(
+    r"#\s*runbook:\s*noqa"
+    r"(?:\[(?P<rules>[A-Za-z0-9_,\s]*)\]|(?![\w\[-]))", re.IGNORECASE)
+
+# Attributes of a traced array that are static under jit (shape metadata is
+# known at trace time — branching on them does NOT retrace or sync).
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "itemsize"})
+
+# Calls whose result is static even when applied to a traced value.
+_STATIC_CALLS = frozenset({"len", "isinstance", "type", "hasattr", "getattr"})
+
+# Path components that mark a module as serving hot path for path-scoped
+# rules (RBK002 keys on "engine"; RBK006 on the full set).
+HOT_PATH_TAGS = frozenset({"engine", "ops", "model", "models", "parallel"})
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    severity: str
+    message: str
+
+    @property
+    def baseline_key(self) -> str:
+        # Line numbers churn on unrelated edits; baselines key on
+        # (file, rule) with a count so the gate survives refactors that
+        # move (but don't add) grandfathered findings.
+        return f"{self.path}:{self.rule}"
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} [{self.severity}] {self.message}")
+
+    def to_json(self) -> dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "rule": self.rule, "severity": self.severity,
+                "message": self.message}
+
+
+class Rule:
+    """Base class: subscribe to walker events by overriding hooks.
+
+    Hooks yield ``(node, message)`` pairs; the walker anchors the finding at
+    the node and applies noqa suppression over the node's line span.
+    """
+
+    rule_id: str = "RBK???"
+    severity: str = Severity.WARNING
+    description: str = ""
+
+    def on_call(self, ctx: "ModuleContext", scope: "Scope",
+                node: ast.Call) -> Iterator[tuple[ast.AST, str]]:
+        return iter(())
+
+    def on_branch(self, ctx: "ModuleContext", scope: "Scope",
+                  node: ast.stmt) -> Iterator[tuple[ast.AST, str]]:
+        """``if`` / ``while`` statements."""
+        return iter(())
+
+    def on_attr_write(self, ctx: "ModuleContext", scope: "Scope",
+                      node: ast.AST, attr: str) -> Iterator[tuple[ast.AST, str]]:
+        """Assignment / augmented assignment to ``self.<attr>``."""
+        return iter(())
+
+    def finish(self, ctx: "ModuleContext") -> Iterator[tuple[ast.AST, str]]:
+        """Called once after the walk — for rules that aggregate."""
+        return iter(())
+
+
+# --------------------------------------------------------------------------- #
+# helpers shared by rules                                                     #
+# --------------------------------------------------------------------------- #
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def mentions_traced(node: ast.AST, traced: frozenset[str]) -> bool:
+    """True when ``node`` references a traced name in a value position.
+
+    Shielded contexts do not count: ``x is None`` / ``x is not None``
+    (host-level structure checks), ``x.shape``-family attributes, and
+    ``len()/isinstance()``-family calls are all static under jit.
+    """
+    if not traced:
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return mentions_traced(node.value, traced)
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        if fn in _STATIC_CALLS:
+            return False
+        parts: list[ast.AST] = list(node.args)
+        parts.extend(kw.value for kw in node.keywords)
+        if isinstance(node.func, ast.Attribute):
+            parts.append(node.func)  # method receiver may be traced
+        return any(mentions_traced(c, traced) for c in parts)
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False  # identity checks never force a device sync
+        return any(mentions_traced(c, traced)
+                   for c in [node.left, *node.comparators])
+    return any(mentions_traced(c, traced) for c in ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------------------- #
+# per-module context: tags, noqa lines, jit reachability                      #
+# --------------------------------------------------------------------------- #
+
+
+def _path_tags(path: str) -> frozenset[str]:
+    parts = Path(path).parts
+    return frozenset(p.lower() for p in parts[:-1] if p not in (".", ".."))
+
+
+def _noqa_lines(source: str) -> dict[int, Optional[frozenset[str]]]:
+    """line → suppressed rule ids (None = all rules).
+
+    Scans real COMMENT tokens (via ``tokenize``), not raw lines — a string
+    literal *containing* the noqa syntax (an error message quoting it, a
+    test fixture) must never suppress findings on its own statement.
+    """
+    import io
+    import tokenize
+
+    out: dict[int, Optional[frozenset[str]]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out  # un-tokenizable files never reach the walker anyway
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT or "runbook" not in tok.string.lower():
+            continue
+        m = _NOQA_RE.search(tok.string)
+        if not m:
+            continue
+        rules = m.group("rules")
+        if rules is None or not rules.strip():
+            out[tok.start[0]] = None
+        else:
+            out[tok.start[0]] = frozenset(
+                r.strip().upper() for r in rules.split(",") if r.strip())
+    return out
+
+
+@dataclass
+class _FuncInfo:
+    node: ast.AST
+    jit_decorated: bool = False
+    static_params: frozenset[str] = frozenset()
+    jit_reachable: bool = False  # decorated OR in same-module closure
+    # Traced-by-propagation param names for closure-reached functions:
+    # a param only counts as traced if some jit-reachable call site passes
+    # it an expression that itself mentions a traced value (so shape/config
+    # helpers called from jit with static ints stay clean).
+    traced_params: set[str] = field(default_factory=set)
+
+
+def _jit_decorator_info(fn: ast.FunctionDef | ast.AsyncFunctionDef,
+                        ) -> Optional[frozenset[str]]:
+    """If ``fn`` is jit-decorated, return its static param names, else None.
+
+    Recognized forms: ``@jax.jit``, ``@jit``, ``@pjit``/``@jax.pjit``,
+    ``@partial(jax.jit, ...)`` / ``@functools.partial(jax.jit, ...)`` with
+    literal ``static_argnames`` / ``static_argnums``.
+    """
+    jit_names = {"jax.jit", "jit", "pjit", "jax.pjit"}
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target)
+        statics: set[str] = set()
+        is_jit = name in jit_names
+        if (isinstance(dec, ast.Call)
+                and name in {"partial", "functools.partial"}
+                and dec.args and dotted_name(dec.args[0]) in jit_names):
+            is_jit = True
+        if not is_jit:
+            continue
+        if isinstance(dec, ast.Call):
+            all_params = [a.arg for a in (*fn.args.posonlyargs, *fn.args.args)]
+            for kw in dec.keywords:
+                if kw.arg == "static_argnames":
+                    for el in ast.walk(kw.value):
+                        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                            statics.add(el.value)
+                elif kw.arg == "static_argnums":
+                    for el in ast.walk(kw.value):
+                        if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                            if 0 <= el.value < len(all_params):
+                                statics.add(all_params[el.value])
+        # kwonly args of a jit function are keyword-static by convention in
+        # this codebase (page_size=..., attn_impl=...): jax requires them to
+        # be static anyway (jit rejects traced kwonly defaults in our usage).
+        statics.update(a.arg for a in fn.args.kwonlyargs)
+        return frozenset(statics)
+    return None
+
+
+def _param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def _jit_table(tree: ast.Module) -> dict[ast.AST, _FuncInfo]:
+    """Every function def → jit info, with same-module closure propagation.
+
+    "jit-reachable" is approximated statically as: directly jit-decorated,
+    or called by name from a jit-reachable function *in the same module*
+    (cross-module reachability would need imports + a project call graph;
+    the in-module closure already covers the helper-split idiom that loses
+    the decorator from view).
+    """
+    infos: dict[ast.AST, _FuncInfo] = {}
+    by_name: dict[str, _FuncInfo] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            statics = _jit_decorator_info(node)
+            info = _FuncInfo(node=node, jit_decorated=statics is not None,
+                             static_params=statics or frozenset(),
+                             jit_reachable=statics is not None)
+            if info.jit_decorated:
+                info.traced_params = set(_param_names(node)) - set(statics)
+            infos[node] = info
+            # Last definition wins for duplicate names — matches runtime.
+            by_name[node.name] = info
+
+    def _callee_params(fn) -> list[str]:
+        return [a.arg for a in (*fn.args.posonlyargs, *fn.args.args)
+                if a.arg not in ("self", "cls")]
+
+    # Fixed-point closure over bare-name calls from jit-reachable bodies,
+    # propagating traced-ness PER PARAMETER from actual call-site args.
+    changed = True
+    while changed:
+        changed = False
+        for info in infos.values():
+            if not info.jit_reachable:
+                continue
+            caller_traced = frozenset(info.traced_params)
+            for call in ast.walk(info.node):
+                if not (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Name)):
+                    continue
+                callee = by_name.get(call.func.id)
+                if callee is None or callee is info:
+                    continue
+                if not callee.jit_reachable:
+                    callee.jit_reachable = True
+                    changed = True
+                params = _callee_params(callee.node)
+                hits: set[str] = set()
+                for idx, arg in enumerate(call.args):
+                    if idx < len(params) and mentions_traced(arg, caller_traced):
+                        hits.add(params[idx])
+                for kw in call.keywords:
+                    if kw.arg and mentions_traced(kw.value, caller_traced):
+                        hits.add(kw.arg)
+                hits -= callee.static_params
+                if not hits <= callee.traced_params:
+                    callee.traced_params |= hits
+                    changed = True
+    return infos
+
+
+@dataclass
+class ModuleContext:
+    path: str
+    source: str
+    tree: ast.Module
+    tags: frozenset[str]
+    noqa: dict[int, Optional[frozenset[str]]]
+    jit_info: dict[ast.AST, _FuncInfo]
+    lines: list[str] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if not self.lines:
+            self.lines = self.source.splitlines()
+
+    def _line_suppresses(self, line: int, rule_id: str) -> bool:
+        if line not in self.noqa:
+            return False
+        rules = self.noqa[line]
+        return rules is None or rule_id in rules
+
+    def suppressed(self, rule_id: str, node: ast.AST) -> bool:
+        start = getattr(node, "lineno", None)
+        if start is None:
+            return False
+        end = getattr(node, "end_lineno", start) or start
+        # 1) noqa anywhere on the lines the statement spans.
+        for line in range(start, end + 1):
+            if self._line_suppresses(line, rule_id):
+                return True
+        # 2) noqa in the contiguous comment block immediately above (long
+        #    dispatch lines can't fit a trailing comment + reason string).
+        lines = self.lines
+        line = start - 1
+        while 1 <= line <= len(lines) and lines[line - 1].lstrip().startswith("#"):
+            if self._line_suppresses(line, rule_id):
+                return True
+            line -= 1
+        return False
+
+
+@dataclass
+class Scope:
+    """Cross-cutting state rules read; maintained by the walker."""
+    in_jit: bool = False
+    traced_params: frozenset[str] = frozenset()
+    lock_depth: int = 0
+    class_name: Optional[str] = None
+    func_name: Optional[str] = None
+
+    @property
+    def in_lock(self) -> bool:
+        return self.lock_depth > 0
+
+
+# "lock" as a word segment: matches `_lock`, `lock`, `step_lock`, `rlock`,
+# `lock_a`; must NOT match `block`/`on_block`/`block_pages` (this codebase
+# is full of KV *block* state) — substring matching made those ERRORs.
+_LOCK_SEG_RE = re.compile(r"(?:^|_)(?:r|w|rw)?locks?(?:_|$|ed\b)")
+
+
+def _is_lock_ctx(item: ast.withitem) -> bool:
+    name = dotted_name(item.context_expr)
+    if name is None and isinstance(item.context_expr, ast.Call):
+        name = dotted_name(item.context_expr.func)
+    if name is None:
+        return False
+    return any(_LOCK_SEG_RE.search(seg) for seg in name.lower().split("."))
+
+
+class _Walker(ast.NodeVisitor):
+    """Single traversal that fans each node out to every subscribed rule."""
+
+    def __init__(self, ctx: ModuleContext, rules: Sequence[Rule]):
+        self.ctx = ctx
+        self.rules = rules
+        self.scope = Scope()
+        self.findings: list[Finding] = []
+        self._func_stack: list[_FuncInfo] = []
+
+    # ----------------------------------------------------------- plumbing
+
+    def _emit(self, rule: Rule, results: Iterable[tuple[ast.AST, str]]) -> None:
+        for node, message in results:
+            if self.ctx.suppressed(rule.rule_id, node):
+                continue
+            self.findings.append(Finding(
+                path=self.ctx.path,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                rule=rule.rule_id,
+                severity=rule.severity,
+                message=message,
+            ))
+
+    def run(self) -> list[Finding]:
+        self.visit(self.ctx.tree)
+        for rule in self.rules:
+            self._emit(rule, rule.finish(self.ctx))
+        self.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        return self.findings
+
+    # -------------------------------------------------------------- scope
+
+    def _visit_function(self, node) -> None:
+        info = self.ctx.jit_info.get(node)
+        prev = self.scope
+        if prev.in_jit:
+            # Nested def inside a jit body (scan/cond bodies): its params
+            # are carries/operands — traced by construction.
+            traced = prev.traced_params | frozenset(_param_names(node))
+            in_jit = True
+        elif info is not None and info.jit_reachable:
+            # Decorated roots: params minus statics. Closure-reached
+            # helpers: only params that some jit call site actually fed a
+            # traced expression (per-param propagation in _jit_table).
+            traced = frozenset(info.traced_params)
+            in_jit = True
+        else:
+            traced = frozenset()
+            in_jit = False
+        # lock_depth resets: a def nested inside a `with lock:` block is
+        # only *defined* there — its body runs later, lock not held.
+        self.scope = Scope(in_jit=in_jit, traced_params=traced,
+                           lock_depth=0,
+                           class_name=prev.class_name, func_name=node.name)
+        self._func_stack.append(info or _FuncInfo(node=node))
+        try:
+            self.generic_visit(node)
+        finally:
+            self._func_stack.pop()
+            self.scope = prev
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev = self.scope
+        self.scope = Scope(in_jit=False, traced_params=frozenset(),
+                           lock_depth=prev.lock_depth, class_name=node.name,
+                           func_name=prev.func_name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self.scope = prev
+
+    def _visit_with(self, node) -> None:
+        locked = any(_is_lock_ctx(i) for i in node.items)
+        if locked:
+            self.scope.lock_depth += 1
+        try:
+            self.generic_visit(node)
+        finally:
+            if locked:
+                self.scope.lock_depth -= 1
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with  # asyncio.Lock stalls coroutines the same
+
+    # ------------------------------------------------------------- events
+
+    def visit_Call(self, node: ast.Call) -> None:
+        for rule in self.rules:
+            self._emit(rule, rule.on_call(self.ctx, self.scope, node))
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        for rule in self.rules:
+            self._emit(rule, rule.on_branch(self.ctx, self.scope, node))
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        for rule in self.rules:
+            self._emit(rule, rule.on_branch(self.ctx, self.scope, node))
+        self.generic_visit(node)
+
+    def _attr_write(self, node: ast.AST, targets: Iterable[ast.AST]) -> None:
+        for target in targets:
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                for rule in self.rules:
+                    self._emit(rule, rule.on_attr_write(
+                        self.ctx, self.scope, node, target.attr))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._attr_write(node, node.targets)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._attr_write(node, [node.target])
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._attr_write(node, [node.target])
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------------- #
+# drivers                                                                     #
+# --------------------------------------------------------------------------- #
+
+_SKIP_DIRS = frozenset({"__pycache__", ".git", "node_modules", "docs-site",
+                        ".venv", "venv"})
+
+
+def iter_python_files(paths: Iterable[str | Path]) -> list[Path]:
+    out: list[Path] = []
+    seen: set[Path] = set()  # overlapping inputs must not double-count
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if any(part in _SKIP_DIRS for part in f.parts):
+                    continue
+                if f.resolve() not in seen:
+                    seen.add(f.resolve())
+                    out.append(f)
+        elif p.suffix == ".py" and p.resolve() not in seen:
+            seen.add(p.resolve())
+            out.append(p)
+    return out
+
+
+def _rel_path(path: Path, root: Optional[Path]) -> str:
+    root = root or Path.cwd()
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def analyze_source(source: str, path: str,
+                   rules: Optional[Sequence[Rule]] = None) -> list[Finding]:
+    """Analyze one module's source under a display path (noqa applied)."""
+    if rules is None:
+        # Fresh instances per call: RBK004 aggregates per-walk state, and a
+        # shared module-level set would cross-attribute findings if callers
+        # ever analyze concurrently.
+        from runbookai_tpu.analysis.rules import default_rules
+        rules = default_rules()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(path=path, line=e.lineno or 0, col=e.offset or 0,
+                        rule=PARSE_RULE_ID, severity=Severity.ERROR,
+                        message=f"un-parseable module: {e.msg}")]
+    ctx = ModuleContext(path=path, source=source, tree=tree,
+                        tags=_path_tags(path), noqa=_noqa_lines(source),
+                        jit_info=_jit_table(tree))
+    return _Walker(ctx, list(rules)).run()
+
+
+def analyze_file(path: str | Path, rules: Optional[Sequence[Rule]] = None,
+                 root: Optional[Path] = None) -> list[Finding]:
+    p = Path(path)
+    return analyze_source(p.read_text(encoding="utf-8"),
+                          _rel_path(p, root), rules=rules)
+
+
+def analyze_paths(paths: Iterable[str | Path],
+                  rules: Optional[Sequence[Rule]] = None,
+                  root: Optional[Path] = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(analyze_file(f, rules=rules, root=root))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

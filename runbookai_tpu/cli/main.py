@@ -769,6 +769,19 @@ def cmd_weights(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """``runbook lint`` — the static-analysis gate (docs/lint.md).
+
+    Exit 0 when the tree has no findings beyond the committed baseline,
+    non-zero otherwise; ``--update-baseline`` regenerates
+    lint-baseline.json. Dependency-free (never imports jax), so it runs
+    first and fastest in CI.
+    """
+    from runbookai_tpu.analysis.cli import run_lint
+
+    return run_lint(args)
+
+
 def cmd_mcp(args) -> int:
     from runbookai_tpu.server.mcp import MCPServer, run_stdio_server
 
@@ -1063,6 +1076,14 @@ def build_parser() -> argparse.ArgumentParser:
                      help="only /metrics lines containing this substring")
     met.add_argument("--timeout", type=float, default=10.0)
     met.set_defaults(fn=cmd_metrics)
+
+    lint = sub.add_parser(
+        "lint", help="AST static analysis for TPU serving hazards "
+                     "(RBK001-RBK006; docs/lint.md)")
+    from runbookai_tpu.analysis.cli import add_lint_arguments
+
+    add_lint_arguments(lint)
+    lint.set_defaults(fn=cmd_lint)
 
     mcp = sub.add_parser("mcp", help="MCP server over stdio")
     mcp_sub = mcp.add_subparsers(dest="mcp_cmd", required=True)

@@ -200,6 +200,8 @@ class DraftWorker:
             page_size=self.kv.page_size, block_pages=self.block_pages,
             k_steps=k, attn_impl=self.attn_impl,
         )
+        # runbook: noqa[RBK002] — sanctioned sync: one fetch per draft
+        # round; the k drafted tokens ride back in a single transfer.
         toks_host = np.asarray(jax.device_get(toks))  # [B, k]
         out: dict[str, list[int]] = {}
         for i, rid, hist in live:

@@ -242,6 +242,8 @@ def _probe_pallas_attn_cached(backend: str, n_kv: int, n_q: int,
         out = paged_decode_attention(q1, kv, kv, tables,
                                      jnp.ones((1,), jnp.int32),
                                      page_size=page_size, interpret=interp)
+        # runbook: noqa[RBK002] — probe barrier: the compile/execute must
+        # finish (or raise) before serving trusts the decode kernel.
         jax.block_until_ready(out)
 
         t = 4
@@ -250,6 +252,8 @@ def _probe_pallas_attn_cached(backend: str, n_kv: int, n_q: int,
         out = paged_chunk_attention(qt, kv, kv, tables,
                                     jnp.full((1,), t, jnp.int32), positions,
                                     page_size=page_size, interpret=interp)
+        # runbook: noqa[RBK002] — probe barrier: chunk-kernel lowering must
+        # prove out before prefill dispatches it.
         jax.block_until_ready(out)
         if kv_split:
             # The page-split mesh dispatches the PARTIAL kernel (extra
@@ -264,6 +268,8 @@ def _probe_pallas_attn_cached(backend: str, n_kv: int, n_q: int,
                 q1, kv, kv, tables, jnp.ones((1,), jnp.int32),
                 jnp.int32(0), page_size=page_size, pages_local=1,
                 interpret=interp)
+            # runbook: noqa[RBK002] — probe barrier: the PARTIAL kernel is
+            # the program a page-split mesh actually runs; prove it here.
             jax.block_until_ready(out)
         return True
     except Exception:  # noqa: BLE001 — any Mosaic/lowering failure
@@ -309,6 +315,8 @@ def _probe_pallas_attn_int8_cached(backend: str, n_kv: int, n_q: int,
             q1, (kv_vals, kv_scales), (kv_vals, kv_scales), tables,
             jnp.ones((1,), jnp.int32), page_size=page_size,
             interpret=backend == "cpu")
+        # runbook: noqa[RBK002] — probe barrier: int8 widen-multiply must
+        # lower (or raise) before serving reads int8 pages through it.
         jax.block_until_ready(out)
         return True
     except Exception:  # noqa: BLE001 — any Mosaic/lowering failure
@@ -346,6 +354,8 @@ def _probe_qmm_pallas_cached(backend: str, m: int, k: int, n: int,
 
             rep = replicated(mesh)
             x, q, s = (jax.device_put(a, rep) for a in (x, q, s))
+        # runbook: noqa[RBK002] — probe barrier: one qmm compile at the real
+        # (K, N) proves the Mosaic int8 dot before the first live dispatch.
         jax.block_until_ready(
             qmm_pallas(x, q, s, interpret=backend == "cpu"))
         return True
@@ -1075,6 +1085,8 @@ class EngineCore:
                 positions=jnp.asarray(ctx_lens) if use_seed else None,
                 bias=jnp.asarray(bias) if use_bias else None,
             )
+            # runbook: noqa[RBK002] — sanctioned sync: the one batched
+            # first-token fetch per prefill dispatch (TTFT emission point).
             toks_host = np.asarray(jax.device_get(toks))
             lp_pairs = [(i, req) for i, req in done_rows
                         if req.sampling.logprobs]
@@ -1122,6 +1134,8 @@ class EngineCore:
     def _append_logprob_entries(pairs, toks_h, scored) -> None:
         """Attach one {token_id, logprob, top} record per (row, request)
         pair from a scored batch (single host fetch for the triple)."""
+        # runbook: noqa[RBK002] — sanctioned sync: one [B, K+1] fetch per
+        # dispatch for logprob requests (full-vocab rows would dwarf it).
         chosen, top_ids, top_lp = jax.device_get(scored)
         chosen, top_ids, top_lp = (np.asarray(chosen), np.asarray(top_ids),
                                    np.asarray(top_lp))
@@ -1253,6 +1267,8 @@ class EngineCore:
                 attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
                 qmm_impl=self.ecfg.qmm_impl,
             )
+            # runbook: noqa[RBK002] — sanctioned sync: the one token fetch
+            # per speculative verify dispatch (k tokens amortize it).
             toks_host = np.asarray(jax.device_get(toks))  # [B, k]
 
         emitted = 0
@@ -1473,6 +1489,8 @@ class EngineCore:
                     attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
                     qmm_impl=self.ecfg.qmm_impl,
                 )
+                # runbook: noqa[RBK002] — sanctioned sync: the per-dispatch
+                # token fetch (k=1 path: guided/logprob requests).
                 toks_host = np.asarray(jax.device_get(toks))[:, None]  # [B, 1]
                 self._score_logprobs(last_logits, toks, toks_host[:, 0])
             else:
@@ -1485,6 +1503,8 @@ class EngineCore:
                     k_steps=k, attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
                     qmm_impl=self.ecfg.qmm_impl,
                 )
+                # runbook: noqa[RBK002] — sanctioned sync: ONE fetch per K
+                # decode steps — the amortization the engine exists for.
                 toks_host = np.asarray(jax.device_get(toks))  # [B, K]
             if counts_out is not None:
                 self._tok_counts = counts_out

@@ -84,7 +84,11 @@ class Tracer:
         except (OSError, ValueError) as e:
             # Disk gone / fh poisoned: stop tracing, keep serving — but
             # never silently (operators must learn their trail went dark).
-            self.enabled = False
+            # Disable under the same lock close() takes (the with-block
+            # above already released it on the exception path), so the
+            # enabled flag has one consistent writer discipline.
+            with self._lock:
+                self.enabled = False
             if not self._warned:
                 self._warned = True
                 warnings.warn(

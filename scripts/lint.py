@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""CI entrypoint for the runbook lint gate.
+
+Thin by design: resolves the repo root (so path keys in the baseline are
+stable no matter where CI invokes it), then delegates to
+``runbookai_tpu.analysis.cli``. Exits non-zero on any finding not covered
+by the committed ``lint-baseline.json`` — no network, no TPU, no jax.
+
+Usage:
+    python scripts/lint.py                 # gate: runbookai_tpu/ vs baseline
+    python scripts/lint.py --update-baseline
+    python scripts/lint.py path/to/file.py --no-baseline
+"""
+
+import os
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(ROOT))
+    os.chdir(ROOT)
+
+    from runbookai_tpu.analysis.cli import main
+
+    sys.exit(main())
