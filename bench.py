@@ -21,7 +21,9 @@ child (fresh process = the failed attempt's device buffers are gone).
 Env knobs: BENCH_MODEL, BENCH_CPU_MODEL, BENCH_REQUESTS, BENCH_PROMPT,
 BENCH_NEW, BENCH_SLOTS, BENCH_PAGES, BENCH_PROBE_TIMEOUT (patient probe,
 default min(1200, watchdog/2)), BENCH_PROBE_SHORT, BENCH_PROBE_COOLDOWN,
-BENCH_PROBE_ISO, BENCH_WATCHDOG, BENCH_ATTN, BENCH_PREFILL_BATCH.
+BENCH_PROBE_ISO, BENCH_WATCHDOG, BENCH_ATTN, BENCH_PREFILL_BATCH,
+BENCH_OVERLAP (=0 forces synchronous decode; `--no-overlap` sets it, so
+the overlapped-pipeline A/B is one flag on hardware).
 """
 
 from __future__ import annotations
@@ -288,6 +290,7 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     from runbookai_tpu.models.llama import CONFIGS, init_params, init_params_quantized
     from runbookai_tpu.utils.tokens import ByteTokenizer
 
+    overlap = os.environ.get("BENCH_OVERLAP", "1") != "0"
     n_requests = int(os.environ.get("BENCH_REQUESTS", 8))
     prompt_len = int(os.environ.get("BENCH_PROMPT", 128))
     new_tokens = int(os.environ.get("BENCH_NEW", 64))
@@ -382,6 +385,9 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         # Batch all concurrent prompts' prefill chunks into one dispatch so
         # TTFT stays ~flat under load (p50_ttft_ms in details tracks this).
         prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH", slots)),
+        # Overlapped decode pipeline (device-resident feedback + async
+        # egress); BENCH_OVERLAP=0 / --no-overlap is the sync A/B arm.
+        overlap_decode=overlap,
     )
     from runbookai_tpu.model.guided import JsonMaskProvider
 
@@ -422,7 +428,9 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         core.submit(make_req(max_new=new_tokens if slots > 1 else 4))
     core.run_until_idle()
     core.metrics.update(decode_tokens=0, decode_steps=0, prefill_tokens=0,
-                        decode_time_s=0.0, prefill_time_s=0.0)
+                        decode_time_s=0.0, prefill_time_s=0.0,
+                        decode_dispatch_time_s=0.0, decode_host_time_s=0.0,
+                        decode_host_overlap_s=0.0)
     # Latency histograms (utils/metrics.py) restart with the measured run
     # so the p95s below exclude warmup-compile TTFTs.
     core.hist_ttft.reset()
@@ -483,6 +491,15 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         "total_tokens": total_tokens,
         "total_throughput_tok_s": round(total_tokens / wall, 2),
         "decode_steps": m["decode_steps"],
+        # Overlapped-pipeline attribution: host work per decode dispatch
+        # and the fraction of it hidden behind device execution.
+        "overlap": overlap,
+        "host_ms_per_step": round(
+            m.get("decode_host_time_s", 0.0)
+            / max(m["decode_steps"], 1) * 1e3, 3),
+        "overlap_ratio": round(
+            m.get("decode_host_overlap_s", 0.0)
+            / max(m.get("decode_host_time_s", 0.0), 1e-9), 3),
         "preemptions": m["preemptions"],
         "spec_drafted": m.get("spec_drafted", 0),
         "spec_accepted": m.get("spec_accepted", 0),
@@ -609,6 +626,11 @@ def _spawn_inner(model_name: str, on_accel: bool, probe: dict,
 
 
 def main() -> None:
+    # One-flag A/B for the overlapped decode pipeline: strip the flag
+    # before --inner parsing; children inherit the env.
+    if "--no-overlap" in sys.argv:
+        sys.argv.remove("--no-overlap")
+        os.environ["BENCH_OVERLAP"] = "0"
     if len(sys.argv) > 1 and sys.argv[1] == "--inner":
         run_inner(sys.argv[2], sys.argv[3] == "1", json.loads(sys.argv[4]))
         return

@@ -85,13 +85,16 @@ class DataDependentHostOps(Rule):
 class EngineLoopHostSync(Rule):
     """RBK002 — host syncs in the engine step/decode loop.
 
-    The engine's throughput contract is ONE sanctioned host sync per
-    dispatch (the token fetch). Every extra ``block_until_ready`` /
-    ``device_get`` / implicit ``np.asarray(jnp...)`` in ``engine/`` modules
-    serializes the pipeline behind a device round-trip (~70ms each on
-    tunneled TPU). Sanctioned barriers carry
-    ``# runbook: noqa[RBK002] — <reason>`` so the next reader knows why the
-    sync is load-bearing.
+    The engine's throughput contract is ONE sanctioned token fetch in the
+    decode loop: the async-egress consumption point
+    (``EngineCore._fetch_tokens``) of the overlapped pipeline
+    (docs/decode_pipeline.md) — every decode path funnels through it.
+    Every extra ``block_until_ready`` / ``device_get`` / implicit
+    ``np.asarray(jnp...)`` in ``engine/`` modules serializes the pipeline
+    behind a device round-trip (~70ms each on tunneled TPU). Sanctioned
+    barriers carry ``# runbook: noqa[RBK002] — <reason>`` so the next
+    reader knows why the sync is load-bearing; tests/test_lint.py pins the
+    full per-function inventory.
     """
 
     rule_id = "RBK002"

@@ -57,15 +57,25 @@ class AsyncEngine:
         if self._wake:
             self._wake.set()
         task, self._task = self._task, None
-        if task is None:
-            return
+        if task is not None:
+            try:
+                await task
+            except asyncio.CancelledError:
+                if not task.cancelled():
+                    raise  # the cancellation targeted stop() itself, not the loop
+            except Exception:  # noqa: BLE001
+                pass  # step crash — already reported by _fail_live_requests
+        # Drain the overlapped decode pipeline: a window dispatched on the
+        # loop's final step would otherwise strand its tokens on device and
+        # leave streams/done_events waiting on a drain that never comes.
+        def _flush() -> None:
+            with self._lock:
+                self.core.flush()
+
         try:
-            await task
-        except asyncio.CancelledError:
-            if not task.cancelled():
-                raise  # the cancellation targeted stop() itself, not the loop
-        except Exception:  # noqa: BLE001
-            pass  # step crash — already reported by _fail_live_requests
+            await asyncio.to_thread(_flush)
+        except Exception:  # noqa: BLE001 — a poisoned core must not block stop
+            pass
 
     async def _loop(self) -> None:
         while not self._stopped:
@@ -100,6 +110,10 @@ class AsyncEngine:
                     # restarted loop doesn't re-step a zombie and the
                     # awaiter unblocks.
                     self.core.force_finish(req)
+            # Drop (don't drain) any in-flight decode window: fetching from
+            # a poisoned device would raise again on every restarted loop's
+            # first step, wedging has_work true forever.
+            self.core.discard_inflight()
 
     def _locked_step(self) -> None:
         with self._lock:

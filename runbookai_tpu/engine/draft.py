@@ -69,6 +69,10 @@ class DraftWorker:
         self._kv_v = self.kv.pool.kv_v
         # Committed tokens whose K/V the draft pool holds, per request.
         self.ctx: dict[str, int] = {}
+        # Per-request padded table rows, keyed on the pool's table version:
+        # rebuilding the O(max_pages) row every sync wave and draft round
+        # is redundant host work unless pages actually moved.
+        self._row_cache: dict[str, tuple[int, np.ndarray]] = {}
         # Requests the draft can no longer cover (pool pressure/length):
         # they fall back to prompt-lookup upstream.
         self.dead: set[str] = set()
@@ -80,6 +84,7 @@ class DraftWorker:
     def release(self, request_id: str) -> None:
         self.ctx.pop(request_id, None)
         self.dead.discard(request_id)
+        self._row_cache.pop(request_id, None)
         if request_id in self.kv.seqs:
             self.kv.release(request_id)
 
@@ -100,14 +105,19 @@ class DraftWorker:
         return self.kv.max_pages_per_seq * self.kv.page_size
 
     def _table_row(self, rid: str) -> np.ndarray:
+        hit = self._row_cache.get(rid)
+        if hit is not None and hit[0] == self.kv.version:
+            return hit[1]
         out = np.zeros((self.kv.max_pages_per_seq + 1,), dtype=np.int32)
         out[: self.kv.max_pages_per_seq] = self.kv.page_table_row(rid)
+        self._row_cache[rid] = (self.kv.version, out)
         return out
 
     def _kill(self, rid: str) -> None:
         """Stop covering a request (pool/length pressure): free its pages
         so they serve other drafts; upstream falls back to prompt-lookup."""
         self.dead.add(rid)
+        self._row_cache.pop(rid, None)
         if rid in self.kv.seqs:
             self.kv.release(rid)
         self.ctx.pop(rid, None)

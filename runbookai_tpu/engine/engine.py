@@ -94,6 +94,24 @@ class EngineConfig:
     # ~70ms per host sync regardless of T); a LOSS on CPU, where compute
     # scales with the padded chunk length — None = auto (on for tpu/axon).
     grammar_fast_forward: Optional[bool] = None
+    # Overlapped decode pipeline (one-step lag): the sampled token buffer
+    # stays device-resident and feeds the next dispatch directly, while a
+    # window's tokens are copied to host asynchronously and consumed when
+    # the NEXT window is already in flight — detokenization, stop scans and
+    # stream emission run behind the device step instead of serializing it.
+    # Stop conditions therefore fire one window late (emit-then-truncate:
+    # the overshoot window's tokens are discarded, its KV pages reclaimed
+    # on finish). Guided/logprob batches, spec verify, preemption and the
+    # context-limit boundary force a synchronous drain first, so token
+    # streams are byte-identical to ``False`` (forced-sync) mode.
+    overlap_decode: bool = True
+    # Max rounds to skip re-probing speculation after rounds that produced
+    # no usable drafts. Draft construction needs the host-current history,
+    # so each probe drains the overlapped window; backing off (1, 2, 4, …
+    # up to this cap per consecutive miss) keeps the lag pipeline hot on
+    # non-repetitive traffic while repetitive traffic re-enters
+    # speculation within a couple of rounds.
+    spec_backoff_rounds: int = 8
 
 
 @partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl",
@@ -418,6 +436,48 @@ def _token_logprobs(logits, toks):
     return chosen, top_ids, top_lp
 
 
+@dataclass
+class _PendingDecode:
+    """One in-flight decode window awaiting host consumption.
+
+    ``toks_dev`` is the [B, K] device token buffer of the issued dispatch
+    (its last column is already wired into the next dispatch's feed); the
+    host copy is started asynchronously at issue time and consumed by
+    :meth:`EngineCore._drain` one scheduler round later. ``reqs`` snapshots
+    (request, slot) at dispatch time so a slot reassigned before the drain
+    can never misroute tokens."""
+
+    toks_dev: jax.Array  # [B, K]
+    reqs: list[tuple[EngineRequest, int]]
+    req_ids: frozenset[str]
+    k: int
+
+
+@dataclass
+class _SlotInputs:
+    """Epoch-cached device inputs for a decode dispatch.
+
+    Everything here is a pure function of the slot→request mapping (the
+    scheduler epoch, bumped on admit/finish/preempt) and of the sequences'
+    page lists (the KV manager's table version, bumped on growth), so a
+    steady-state decode step reuses the uploaded arrays and does zero
+    O(B·pages) page-table or O(B·vocab) bias rebuild work."""
+
+    key: tuple[int, int]  # (scheduler epoch, kv table version)
+    tables: jax.Array  # [B, max_pages + 1] int32, device
+    adapters: jax.Array  # [B] int32, device
+    temps: jax.Array
+    top_ps: jax.Array
+    top_ks: jax.Array
+    pres: jax.Array
+    freq: jax.Array
+    seeds: jax.Array
+    bias: Optional[jax.Array]
+    use_pen: bool
+    use_seed: bool
+    use_bias: bool
+
+
 class EngineCore:
     """Synchronous stepping core. Drive with :meth:`step` until idle."""
 
@@ -569,13 +629,32 @@ class EngineCore:
         self.finished: list[EngineRequest] = []
         self._slots: list[Optional[EngineRequest]] = [None] * self.ecfg.max_batch_slots
         self._last_token: dict[str, int] = {}
+        # Overlapped decode pipeline state: the device-resident feed of each
+        # slot's last sampled token (input side — no host round-trip), the
+        # in-flight window awaiting async egress, the scheduler epoch that
+        # keys the cached dispatch inputs, and the speculation re-probe
+        # backoff (each probe costs a drain).
+        self._feed_toks = jnp.zeros((self.ecfg.max_batch_slots,), jnp.int32)
+        self._pending: Optional[_PendingDecode] = None
+        self._sched_epoch = 0
+        self._slot_cache: Optional[_SlotInputs] = None
+        self._spec_backoff = 0
+        self._spec_miss_streak = 0
+        # Wall-clock already booked by nested drains (lets _run_decode add
+        # only its own un-booked time to decode_time_s — no double count).
+        self._drain_time_acc = 0.0
         # Serving metrics (BASELINE.md contract: TTFT + tokens/sec/chip).
         # This dict stays the single source of truth for the step counters
         # (/healthz contract, bench resets, tests); the registry re-exports
         # it via scrape-time callbacks in _install_metrics.
+        # decode_time_s remains the total decode wall; the dispatch/host/
+        # overlap components split it so the pipeline's win is attributable
+        # (host emission used to be silently booked as decode time).
         self.metrics = {"decode_tokens": 0, "decode_steps": 0, "prefill_tokens": 0,
                         "preemptions": 0, "decode_time_s": 0.0, "prefill_time_s": 0.0,
-                        "cached_prefix_tokens": 0, "spec_drafted": 0, "spec_accepted": 0}
+                        "cached_prefix_tokens": 0, "spec_drafted": 0, "spec_accepted": 0,
+                        "decode_dispatch_time_s": 0.0, "decode_host_time_s": 0.0,
+                        "decode_host_overlap_s": 0.0}
         self.registry = metrics_mod.get_registry()
         self._install_metrics()
 
@@ -649,9 +728,27 @@ class EngineCore:
              "Wall-clock spent in decode dispatches"),
             ("prefill_time_s", "runbook_prefill_time_seconds_total",
              "Wall-clock spent in prefill dispatches"),
+            ("decode_dispatch_time_s", "runbook_decode_dispatch_seconds_total",
+             "Decode wall-clock blocked on device work (dispatch issue + "
+             "token egress wait)"),
+            ("decode_host_time_s", "runbook_decode_host_overhead_seconds",
+             "Decode wall-clock spent on host work (input prep, "
+             "detokenization, stop scans, stream emission)"),
+            ("decode_host_overlap_s",
+             "runbook_decode_host_overlapped_seconds_total",
+             "Host decode work that ran while a dispatch was in flight"),
         ):
             reg.counter(name, help_text).set_function(
                 lambda k=key: float(self.metrics.get(k, 0)))
+        reg.gauge("runbook_decode_overlap_ratio",
+                  "Fraction of host decode work hidden behind device "
+                  "execution by the lagged pipeline (0 in forced-sync mode)"
+                  ).set_function(self._overlap_ratio)
+
+    def _overlap_ratio(self) -> float:
+        host = self.metrics.get("decode_host_time_s", 0.0)
+        return (self.metrics.get("decode_host_overlap_s", 0.0) / host
+                if host > 0 else 0.0)
 
     def _prefix_hit_ratio(self) -> float:
         cached = self.metrics.get("cached_prefix_tokens", 0)
@@ -689,7 +786,23 @@ class EngineCore:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting or self.prefilling or self.decoding)
+        # An in-flight lagged window counts as work: its tokens still need
+        # host consumption even if every owning request already finished.
+        return bool(self.waiting or self.prefilling or self.decoding
+                    or self._pending is not None)
+
+    def flush(self) -> None:
+        """Drain the in-flight lagged decode window (if any), emitting its
+        tokens and settling metrics. Shutdown/idle hook — a no-op when the
+        pipeline is already drained."""
+        self._drain_pending()
+
+    def discard_inflight(self) -> None:
+        """Crash recovery only: drop the in-flight window WITHOUT fetching
+        (the device may be poisoned — a drain would raise again and wedge
+        ``has_work`` forever). Callers must have failed/aborted the owning
+        requests first; the window's tokens are lost with it."""
+        self._pending = None
 
     def _trash_pos(self) -> int:
         return self.kv.max_pages_per_seq * self.ecfg.page_size
@@ -709,6 +822,119 @@ class EngineCore:
             if r is not None and r.request_id in self.kv.seqs:
                 out[i, : self.kv.max_pages_per_seq] = self.kv.page_table_row(r.request_id)
         return out
+
+    # ------------------------------------------------- overlapped pipeline
+
+    def _bump_epoch(self) -> None:
+        """Invalidate the cached decode dispatch inputs. Called wherever
+        the slot→request mapping changes: slot assignment, finish,
+        preemption. Page-table growth invalidates separately through
+        ``kv.version`` (part of the same cache key)."""
+        self._sched_epoch += 1
+
+    def _lead(self, req: EngineRequest) -> int:
+        """Tokens scheduled for ``req`` in the in-flight window but not yet
+        consumed on host — the host's view of the sequence lags the device
+        by this much while the pipeline is primed."""
+        p = self._pending
+        if (p is not None and req.state == RequestState.DECODE
+                and req.request_id in p.req_ids):
+            return p.k
+        return 0
+
+    def _slot_inputs(self) -> _SlotInputs:
+        """Device inputs for a decode dispatch, rebuilt only when the
+        scheduler epoch or a page table moved (zero steady-state host
+        prep)."""
+        key = (self._sched_epoch, self.kv.version)
+        si = self._slot_cache
+        if si is not None and si.key == key:
+            return si
+        b = self.ecfg.max_batch_slots
+        temps = np.zeros((b,), dtype=np.float32)
+        top_ps = np.ones((b,), dtype=np.float32)
+        top_ks = np.zeros((b,), dtype=np.int32)
+        pres = np.zeros((b,), dtype=np.float32)
+        freq = np.zeros((b,), dtype=np.float32)
+        seeds = np.full((b,), -1, dtype=np.int32)
+        use_pen = any(r.sampling.penalized for r in self.decoding)
+        use_seed = any(r.sampling.seed is not None for r in self.decoding)
+        use_bias = any(r.sampling.logit_bias for r in self.decoding)
+        bias = (np.zeros((b, self.cfg.vocab_size), dtype=np.float32)
+                if use_bias else None)
+        for req in self.decoding:
+            i = req.slot
+            temps[i] = req.sampling.temperature
+            top_ps[i] = req.sampling.top_p
+            top_ks[i] = req.sampling.top_k
+            pres[i] = req.sampling.presence_penalty
+            freq[i] = req.sampling.frequency_penalty
+            if req.sampling.seed is not None:
+                seeds[i] = req.sampling.seed & 0x7FFFFFFF
+            if bias is not None:
+                for tok_id, b_val in req.sampling.logit_bias:
+                    bias[i, tok_id] = b_val
+        si = _SlotInputs(
+            key=key,
+            tables=jnp.asarray(self._tables_for(self._slots)),
+            adapters=jnp.asarray(self._adapter_ids_for_slots()),
+            temps=jnp.asarray(temps), top_ps=jnp.asarray(top_ps),
+            top_ks=jnp.asarray(top_ks), pres=jnp.asarray(pres),
+            freq=jnp.asarray(freq), seeds=jnp.asarray(seeds),
+            bias=jnp.asarray(bias) if bias is not None else None,
+            use_pen=use_pen, use_seed=use_seed, use_bias=use_bias,
+        )
+        self._slot_cache = si
+        return si
+
+    def _fetch_tokens(self, toks_dev: jax.Array) -> np.ndarray:
+        """THE decode-loop token egress. Every decode path (lagged drain,
+        forced-sync, guided k=1, speculative verify) consumes its sampled
+        tokens through this single point; the host copy was started
+        asynchronously at dispatch time, so in the lagged pipeline this
+        wait is bounded by whatever device time the host failed to hide."""
+        # runbook: noqa[RBK002] — sanctioned sync: the async-egress
+        # consumption point — the one token fetch in the decode loop
+        # (prefill TTFT and the logprob triple keep their own fetches).
+        return np.asarray(jax.device_get(toks_dev))
+
+    def _drain(self, pending: _PendingDecode, overlapped: bool) -> np.ndarray:
+        """Consume one decode window: fetch its tokens and emit them.
+
+        Stop conditions fire here — one window late in the lagged pipeline
+        (emit-then-truncate: a request finishing mid-window discards the
+        rest of its row, and a finished request's rows in any already-issued
+        overshoot window are discarded at that window's drain; the overshoot
+        KV writes land in pages reclaimed on finish and are never published).
+        ``overlapped`` marks emission work running while the next dispatch
+        executes on device — the time the pipeline hides."""
+        t0 = time.perf_counter()
+        toks_host = self._fetch_tokens(pending.toks_dev)
+        t_fetch = time.perf_counter()
+        emitted = 0
+        for step_idx in range(pending.k):
+            for req, slot in pending.reqs:
+                if req.state == RequestState.DECODE:
+                    self._emit_token(req, int(toks_host[slot, step_idx]))
+                    emitted += 1
+        t_emit = time.perf_counter()
+        self.metrics["decode_tokens"] += emitted
+        self.metrics["decode_steps"] += pending.k
+        self.metrics["decode_dispatch_time_s"] += t_fetch - t0
+        self.metrics["decode_host_time_s"] += t_emit - t_fetch
+        if overlapped:
+            self.metrics["decode_host_overlap_s"] += t_emit - t_fetch
+        self.metrics["decode_time_s"] += t_emit - t0
+        self._drain_time_acc += t_emit - t0
+        return toks_host
+
+    def _drain_pending(self) -> None:
+        """Synchronously settle the in-flight window (reconciliation point:
+        speculation drafting, guided masks, preemption folds, context-limit
+        finishes and shutdown all need the host view current first)."""
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            self._drain(pending, overlapped=False)
 
     # ------------------------------------------------------------ scheduling
 
@@ -802,6 +1028,13 @@ class EngineCore:
         request (recompute on re-admission)."""
         if not self.decoding:
             return False
+        # Folding generated tokens into the prompt needs the host view
+        # complete: settle the in-flight lagged window before choosing a
+        # victim (the drained tokens may even finish someone and free the
+        # pages this preemption was about to chase).
+        self._drain_pending()
+        if not self.decoding:
+            return False
         victim = max(self.decoding,
                      key=lambda r: (-r.priority, r.arrival_time))
         self.decoding.remove(victim)
@@ -817,6 +1050,7 @@ class EngineCore:
         victim.state = RequestState.WAITING
         self.waiting.insert(0, victim)
         self.metrics["preemptions"] += 1
+        self._bump_epoch()
         return True
 
     def _kv_valid_tokens(self, req: EngineRequest) -> list[int]:
@@ -861,6 +1095,7 @@ class EngineCore:
         req.state = RequestState.FINISHED
         req.finish_reason = reason
         self._observe_finish(req)
+        self._bump_epoch()
         if req.slot is not None:
             self._slots[req.slot] = None
             req.slot = None
@@ -884,6 +1119,7 @@ class EngineCore:
         for pool in (self.waiting, self.prefilling, self.decoding):
             if req in pool:
                 pool.remove(req)
+        self._bump_epoch()
         if req.slot is not None and req.slot < len(self._slots):
             self._slots[req.slot] = None
             req.slot = None
@@ -1031,6 +1267,7 @@ class EngineCore:
                         self._seed_counts_for(req)
                     else:
                         fresh_pen_rows[slot] = True
+            self._bump_epoch()  # slot→request mapping changed
             if fresh_pen_rows.any():
                 self._tok_counts = _reset_count_rows(
                     self._tok_counts, jnp.asarray(fresh_pen_rows))
@@ -1085,6 +1322,16 @@ class EngineCore:
                 positions=jnp.asarray(ctx_lens) if use_seed else None,
                 bias=jnp.asarray(bias) if use_bias else None,
             )
+            # Wire the first tokens into the device-resident decode feed
+            # before fetching them: row i scatters to its slot, pad rows
+            # scatter out of bounds and drop (fixed shape per prefill
+            # width, so no extra compile per batch composition).
+            feed_idx = np.full((b,), self.ecfg.max_batch_slots,
+                               dtype=np.int32)
+            for i, req in done_rows:
+                feed_idx[i] = req.slot
+            self._feed_toks = self._feed_toks.at[jnp.asarray(feed_idx)].set(
+                toks, mode="drop")
             # runbook: noqa[RBK002] — sanctioned sync: the one batched
             # first-token fetch per prefill dispatch (TTFT emission point).
             toks_host = np.asarray(jax.device_get(toks))
@@ -1148,10 +1395,12 @@ class EngineCore:
                         for t, p in zip(top_ids[i, :n], top_lp[i, :n])],
             })
 
-    def _score_logprobs(self, last_logits, toks, toks_h) -> None:
+    def _score_logprobs(self, last_logits, toks, toks_h, reqs) -> None:
         """Top-K logprobs for requests that asked (k==1 dispatches only —
-        _pick_k forces that). Raw model distribution, pre-mask."""
-        pairs = [(r.slot, r) for r in self.decoding if r.sampling.logprobs]
+        _pick_k forces that). Raw model distribution, pre-mask. ``reqs``
+        is the dispatch-time snapshot: a request finishing on this very
+        token must still get the token's entry."""
+        pairs = [(slot, r) for r, slot in reqs if r.sampling.logprobs]
         if not pairs:
             return
         self._append_logprob_entries(pairs, toks_h,
@@ -1188,7 +1437,10 @@ class EngineCore:
                for r in self.decoding):
             return 1
         k = max(1, self.ecfg.decode_steps_per_dispatch)
-        remaining = min(self.ecfg.max_seq_len - r.ctx_len for r in self.decoding)
+        # Scheduled (lead-adjusted) lengths: the in-flight window's tokens
+        # occupy context the host hasn't consumed yet.
+        remaining = min(self.ecfg.max_seq_len - (r.ctx_len + self._lead(r))
+                        for r in self.decoding)
         while k > 1 and (k > remaining):
             k //= 2
         # power-of-two clamp bounds distinct compiled programs
@@ -1216,20 +1468,30 @@ class EngineCore:
         return arr[start : start + max_draft].tolist()
 
     def _grow_pages_for_decode(self, k: int) -> None:
-        """Ensure every decoding sequence has pages for ctx + k tokens,
-        preempting the youngest (or aborting) under pool pressure."""
+        """Ensure every decoding sequence has pages for its scheduled
+        context (ctx + in-flight lead) + k tokens, preempting the youngest
+        (or aborting) under pool pressure. Preemption drains the lagged
+        window first (the fold needs the host view complete), so the
+        lead — and each target — may legitimately shrink mid-loop."""
         for req in list(self.decoding):
             while (
                 req.state == RequestState.DECODE
-                and not self.kv.can_extend(req.request_id, req.ctx_len + k)
+                and not self.kv.can_extend(
+                    req.request_id, req.ctx_len + self._lead(req) + k)
             ):
                 # _preempt_youngest may evict ``req`` itself — the state guard
-                # above then exits the loop.
+                # above then exits the loop. Its internal drain may even
+                # FINISH ``req`` (a stop was sitting in the lagged window),
+                # so re-check before declaring the pool unfixable.
                 if not self._preempt_youngest():
-                    self._finish(req, FinishReason.ABORTED)
+                    if req.state == RequestState.DECODE:
+                        self._finish(req, FinishReason.ABORTED)
                     break
             if req.state == RequestState.DECODE and req.request_id in self.kv.seqs:
-                self.kv.extend(req.request_id, req.ctx_len + k)
+                # Growth invalidates the cached dispatch tables by itself:
+                # kv.version is part of the _SlotInputs cache key.
+                self.kv.extend(req.request_id,
+                               req.ctx_len + self._lead(req) + k)
 
     def _run_decode_spec(self, drafts: dict[str, list[int]], k: int) -> None:
         """Speculative dispatch: feed [last, draft...] as one T=k chunk and
@@ -1254,22 +1516,21 @@ class EngineCore:
             positions[i] = np.arange(req.ctx_len - 1, req.ctx_len - 1 + k)
             ctx_lens[i] = req.ctx_len + k - 1  # keys written for all fed tokens
             self.metrics["spec_drafted"] += len(draft)
-        tables = self._tables_for(self._slots)
-        adapter_ids = self._adapter_ids_for_slots()
+        si = self._slot_inputs()
 
         with self.tracer.span("engine.decode_spec", k=k,
                               batch=len(self.decoding)), annotate("decode_spec"):
+            t_issue = time.perf_counter()
             toks, self._kv_k, self._kv_v = _decode_spec(
                 self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
-                self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
-                jnp.asarray(adapter_ids),
+                self._kv_k, self._kv_v, si.tables, jnp.asarray(ctx_lens),
+                si.adapters,
                 page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
                 attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
                 qmm_impl=self.ecfg.qmm_impl,
             )
-            # runbook: noqa[RBK002] — sanctioned sync: the one token fetch
-            # per speculative verify dispatch (k tokens amortize it).
-            toks_host = np.asarray(jax.device_get(toks))  # [B, k]
+            toks_host = self._fetch_tokens(toks)  # [B, k]
+            t_fetch = time.perf_counter()
 
         emitted = 0
         for req in list(self.decoding):
@@ -1285,9 +1546,23 @@ class EngineCore:
                 emitted += 1
                 self.metrics["spec_accepted"] += 1
                 j += 1
+        # Re-arm the device-resident feed with each survivor's last
+        # accepted token (the verify argmax buffer's last column is not the
+        # accepted tail); pad rows scatter out of bounds and drop.
+        feed_idx = np.full((b,), b, dtype=np.int32)
+        feed_val = np.zeros((b,), dtype=np.int32)
+        for req in self.decoding:
+            feed_idx[req.slot] = req.slot
+            feed_val[req.slot] = self._last_token[req.request_id]
+        self._feed_toks = self._feed_toks.at[jnp.asarray(feed_idx)].set(
+            jnp.asarray(feed_val), mode="drop")
+        t_end = time.perf_counter()
         self.metrics["decode_tokens"] += emitted
         self.metrics["decode_steps"] += 1
-        self.metrics["decode_time_s"] += time.perf_counter() - t0
+        self.metrics["decode_dispatch_time_s"] += t_fetch - t_issue
+        self.metrics["decode_host_time_s"] += (
+            (t_issue - t0) + (t_end - t_fetch))
+        self.metrics["decode_time_s"] += t_end - t0
 
     def _grammar_fast_forward(self, req: EngineRequest) -> None:
         """Emit a grammar-FORCED token run without per-token model dispatches.
@@ -1370,6 +1645,10 @@ class EngineCore:
         if req.slot is not None:
             self._slots[req.slot] = None
             req.slot = None
+        # Slot freed without a finish: invalidate the cached dispatch
+        # inputs or the next decode would read a stale table whose freed
+        # row still points at this request's live pages.
+        self._bump_epoch()
         if req.num_generated >= req.sampling.max_new_tokens:
             self._finish(req, FinishReason.MAX_TOKENS)
             return
@@ -1378,21 +1657,53 @@ class EngineCore:
 
     def _run_decode(self) -> None:
         if not self.decoding:
+            # Tail flush: every row of the in-flight window finished or
+            # aborted since its dispatch — consume (and discard) so device
+            # state and metrics settle even with nothing left to schedule.
+            self._drain_pending()
             return
         t0 = time.perf_counter()
-        # Sequences at the context limit finish before K is chosen.
-        for req in list(self.decoding):
-            if req.ctx_len + 1 > self.ecfg.max_seq_len:
-                self._finish(req, FinishReason.MAX_TOKENS)
-        # Grammar fast-forward may move guided requests back to prefill
-        # (their next tokens are forced — no sampling needed).
-        for req in list(self.decoding):
-            self._grammar_fast_forward(req)
-        if not self.decoding:
-            return
+        acc0 = self._drain_time_acc
+        # The token budget is host-known: when the in-flight window already
+        # covers every sequence's max_new_tokens, a new dispatch would be
+        # all-overshoot (every row discarded at drain). Drain instead —
+        # this is the common stream tail, e.g. a batch finishing together.
+        if self._pending is not None and all(
+                r.num_generated + self._lead(r) >= r.sampling.max_new_tokens
+                for r in self.decoding):
+            self._drain_pending()
+            if not self.decoding:
+                return
+        overlap = self.ecfg.overlap_decode
+        # Reconciliation: paths that must see the host view current before
+        # the next dispatch can even be BUILT — per-token grammar masks and
+        # logprob attachment (k=1 fetch), forced-sync mode, and sequences
+        # whose scheduled context hits the limit (finish precedes growth).
+        need_sync = (not overlap) or any(
+            r.sampling.guided or r.sampling.logprobs for r in self.decoding)
+        if not need_sync and any(
+                r.ctx_len + self._lead(r) + 1 > self.ecfg.max_seq_len
+                for r in self.decoding):
+            need_sync = True
+        if need_sync:
+            self._drain_pending()
+            # Sequences at the context limit finish before K is chosen.
+            for req in list(self.decoding):
+                if req.ctx_len + 1 > self.ecfg.max_seq_len:
+                    self._finish(req, FinishReason.MAX_TOKENS)
+            # Grammar fast-forward may move guided requests back to prefill
+            # (their next tokens are forced — no sampling needed).
+            for req in list(self.decoding):
+                self._grammar_fast_forward(req)
+            if not self.decoding:
+                return
         k = self._pick_k()
         # Prompt-lookup speculation for all-greedy batches: one T=k verify
         # forward replaces k sequential decode steps when any draft exists.
+        # Drafting needs the host-current history, so each probe drains the
+        # lagged window; a draftless probe backs off re-probing so
+        # non-repetitive traffic keeps the overlap instead of paying a
+        # drain every step.
         if (k > 1 and self.ecfg.speculative
                 and all(r.sampling.temperature == 0.0
                         and not r.sampling.guided
@@ -1404,121 +1715,146 @@ class EngineCore:
                         and not r.sampling.penalized
                         and not r.sampling.logit_bias
                         for r in self.decoding)):
-            if self.draft is not None:
-                committed = [(r.request_id,
-                              r.prompt_ids[: r.prefill_pos] + r.out_ids)
-                             for r in self.decoding]
-                drafts = self.draft.draft(committed, k - 1)
-                for r in self.decoding:  # prompt-lookup fallback
-                    if not drafts.get(r.request_id):
-                        drafts[r.request_id] = self._draft_for(r, k - 1)
-                self.metrics.update(self.draft.metrics)
+            if self._spec_backoff > 0:
+                self._spec_backoff -= 1
             else:
-                drafts = {r.request_id: self._draft_for(r, k - 1)
-                          for r in self.decoding}
-            # Worth it only when most of the batch drafts (nonempty decoding
-            # list makes this imply at least one draft): an undrafted request
-            # gets 1 token from a spec dispatch vs k from multi-step.
-            if 2 * sum(bool(d) for d in drafts.values()) >= len(self.decoding):
-                self._run_decode_spec(drafts, k)
-                return
-        # Grow pages to cover ctx + K for every sequence; preempt on pressure.
+                self._drain_pending()
+                if not self.decoding:
+                    return
+                if self.draft is not None:
+                    committed = [(r.request_id,
+                                  r.prompt_ids[: r.prefill_pos] + r.out_ids)
+                                 for r in self.decoding]
+                    drafts = self.draft.draft(committed, k - 1)
+                    for r in self.decoding:  # prompt-lookup fallback
+                        if not drafts.get(r.request_id):
+                            drafts[r.request_id] = self._draft_for(r, k - 1)
+                    self.metrics.update(self.draft.metrics)
+                else:
+                    drafts = {r.request_id: self._draft_for(r, k - 1)
+                              for r in self.decoding}
+                # Worth it only when most of the batch drafts (nonempty
+                # decoding list makes this imply at least one draft): an
+                # undrafted request gets 1 token from a spec dispatch vs k
+                # from multi-step.
+                if 2 * sum(bool(d) for d in drafts.values()) >= len(self.decoding):
+                    self._spec_miss_streak = 0
+                    self._run_decode_spec(drafts, k)
+                    return
+                self._spec_miss_streak += 1
+                self._spec_backoff = min(
+                    max(0, self.ecfg.spec_backoff_rounds),
+                    2 ** (self._spec_miss_streak - 1))
+        # Grow pages to cover scheduled ctx + K for every sequence; preempt
+        # on pressure (preemption drains the lagged window internally).
         self._grow_pages_for_decode(k)
         if not self.decoding:
+            self.metrics["decode_time_s"] += (
+                (time.perf_counter() - t0) - (self._drain_time_acc - acc0))
             return
 
         b = self.ecfg.max_batch_slots
-        tokens = np.zeros((b, 1), dtype=np.int32)
+        inflight = self._pending is not None
+        t_build = time.perf_counter()
+        si = self._slot_inputs()
         positions = np.zeros((b, 1), dtype=np.int32)
         ctx_lens = np.zeros((b,), dtype=np.int32)
-        temps = np.zeros((b,), dtype=np.float32)
-        top_ps = np.ones((b,), dtype=np.float32)
-        top_ks = np.zeros((b,), dtype=np.int32)
         need_mask = False
-        mask = np.ones((b, self.cfg.vocab_size), dtype=bool)
-        use_pen = any(r.sampling.penalized for r in self.decoding)
-        use_seed = any(r.sampling.seed is not None for r in self.decoding)
-        use_bias = any(r.sampling.logit_bias for r in self.decoding)
-        pres = np.zeros((b,), dtype=np.float32)
-        freq = np.zeros((b,), dtype=np.float32)
-        seeds = np.full((b,), -1, dtype=np.int32)
-        bias = (np.zeros((b, self.cfg.vocab_size), dtype=np.float32)
-                if use_bias else None)
+        mask = None
+        if self.mask_fn and any(r.sampling.guided for r in self.decoding):
+            mask = np.ones((b, self.cfg.vocab_size), dtype=bool)
         for req in self.decoding:
             i = req.slot
-            tokens[i, 0] = self._last_token[req.request_id]
-            positions[i, 0] = req.ctx_len - 1  # position of the token being fed
-            ctx_lens[i] = req.ctx_len
-            temps[i] = req.sampling.temperature
-            top_ps[i] = req.sampling.top_p
-            top_ks[i] = req.sampling.top_k
-            pres[i] = req.sampling.presence_penalty
-            freq[i] = req.sampling.frequency_penalty
-            if req.sampling.seed is not None:
-                seeds[i] = req.sampling.seed & 0x7FFFFFFF
-            if bias is not None:
-                for tok_id, b_val in req.sampling.logit_bias:
-                    bias[i, tok_id] = b_val
-            if self.mask_fn and req.sampling.guided:
+            ec = req.ctx_len + self._lead(req)  # scheduled context
+            positions[i, 0] = ec - 1  # position of the token being fed
+            ctx_lens[i] = ec
+            if mask is not None and req.sampling.guided:
                 m = self.mask_fn(req)
                 if m is not None:
                     mask[i] = m
                     need_mask = True
-        tables = self._tables_for(self._slots)
-        adapter_ids = self._adapter_ids_for_slots()
         self._key, sub = jax.random.split(self._key)
         pen_kw = dict(
-            counts=self._tok_counts if use_pen else None,
-            pres=jnp.asarray(pres) if use_pen else None,
-            freq=jnp.asarray(freq) if use_pen else None,
-            seeds=jnp.asarray(seeds) if use_seed else None,
-            bias=jnp.asarray(bias) if use_bias else None,
+            counts=self._tok_counts if si.use_pen else None,
+            pres=si.pres if si.use_pen else None,
+            freq=si.freq if si.use_pen else None,
+            seeds=si.seeds if si.use_seed else None,
+            bias=si.bias if si.use_bias else None,
         )
+        # Device-resident token feedback: each slot's last sampled token
+        # never visits the host on the input side.
+        tokens_dev = self._feed_toks[:, None]
 
         with self.tracer.span("engine.decode", k=k,
                               batch=len(self.decoding)), annotate("decode"):
+            t_issue = time.perf_counter()
+            last_logits = None
             if k == 1:
                 (toks, last_logits, self._kv_k, self._kv_v,
                  counts_out) = _decode_step(
-                    self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
-                    self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
-                    jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks), sub,
+                    self.params, self.cfg, tokens_dev, jnp.asarray(positions),
+                    self._kv_k, self._kv_v, si.tables, jnp.asarray(ctx_lens),
+                    si.temps, si.top_ps, si.top_ks, sub,
                     jnp.asarray(mask) if need_mask else None,
-                    jnp.asarray(adapter_ids), **pen_kw,
+                    si.adapters, **pen_kw,
                     page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
                     attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
                     qmm_impl=self.ecfg.qmm_impl,
                 )
-                # runbook: noqa[RBK002] — sanctioned sync: the per-dispatch
-                # token fetch (k=1 path: guided/logprob requests).
-                toks_host = np.asarray(jax.device_get(toks))[:, None]  # [B, 1]
-                self._score_logprobs(last_logits, toks, toks_host[:, 0])
+                self._feed_toks = toks
+                toks_win = toks[:, None]  # [B, 1]
             else:
-                toks, self._kv_k, self._kv_v, counts_out = _decode_multi(
-                    self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
-                    self._kv_k, self._kv_v, jnp.asarray(tables), jnp.asarray(ctx_lens),
-                    jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks), sub,
-                    jnp.asarray(adapter_ids), **pen_kw,
+                toks_win, self._kv_k, self._kv_v, counts_out = _decode_multi(
+                    self.params, self.cfg, tokens_dev, jnp.asarray(positions),
+                    self._kv_k, self._kv_v, si.tables, jnp.asarray(ctx_lens),
+                    si.temps, si.top_ps, si.top_ks, sub,
+                    si.adapters, **pen_kw,
                     page_size=self.ecfg.page_size, block_pages=self.ecfg.block_pages,
                     k_steps=k, attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
                     qmm_impl=self.ecfg.qmm_impl,
                 )
-                # runbook: noqa[RBK002] — sanctioned sync: ONE fetch per K
-                # decode steps — the amortization the engine exists for.
-                toks_host = np.asarray(jax.device_get(toks))  # [B, K]
+                self._feed_toks = toks_win[:, -1]
             if counts_out is not None:
                 self._tok_counts = counts_out
+            t_done = time.perf_counter()
 
-        emitted = 0
-        snapshot = list(self.decoding)
-        for step_idx in range(toks_host.shape[1]):
-            for req in snapshot:
-                if req.state == RequestState.DECODE:
-                    self._emit_token(req, int(toks_host[req.slot, step_idx]))
-                    emitted += 1
-        self.metrics["decode_tokens"] += emitted
-        self.metrics["decode_steps"] += toks_host.shape[1]
-        self.metrics["decode_time_s"] += time.perf_counter() - t0
+        pending = _PendingDecode(
+            toks_dev=toks_win,
+            reqs=[(r, r.slot) for r in self.decoding],
+            req_ids=frozenset(r.request_id for r in self.decoding),
+            k=k,
+        )
+        # Start the token egress behind the (async) dispatch: by the time
+        # the window is drained, the DMA has had a full device step to land.
+        if hasattr(toks_win, "copy_to_host_async"):
+            toks_win.copy_to_host_async()
+        self.metrics["decode_host_time_s"] += t_issue - t_build
+        if inflight:
+            # Input prep ran while the previous window executed on device.
+            self.metrics["decode_host_overlap_s"] += t_issue - t_build
+        self.metrics["decode_dispatch_time_s"] += t_done - t_issue
+
+        if need_sync:
+            # Forced-sync: consume this window before returning (guided
+            # masks / logprob attachment need the tokens before the next
+            # dispatch can be built anyway). Logprob entries attach BEFORE
+            # emission: _finish (inside the drain) wakes streaming
+            # consumers, and their tail flush must never observe the final
+            # token's entry still missing.
+            if k == 1 and any(r.sampling.logprobs for r, _ in pending.reqs):
+                toks_host = self._fetch_tokens(pending.toks_dev)
+                self._score_logprobs(last_logits, toks_win[:, 0],
+                                     toks_host[:, 0], pending.reqs)
+            self._drain(pending, overlapped=False)
+        else:
+            # One-step lag: park this window and consume the PREVIOUS one —
+            # its emission (detokenize, stop scans, stream callbacks) runs
+            # while this window executes on device.
+            prev, self._pending = self._pending, pending
+            if prev is not None:
+                self._drain(prev, overlapped=True)
+        self.metrics["decode_time_s"] += (
+            (time.perf_counter() - t0) - (self._drain_time_acc - acc0))
 
     # ------------------------------------------------------------------ step
 

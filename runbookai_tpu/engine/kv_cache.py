@@ -268,6 +268,12 @@ class KVCacheManager:
         self.page_size = page_size
         self.max_pages_per_seq = (max_seq_len + page_size - 1) // page_size
         self.seqs: dict[str, SequenceAllocation] = {}
+        # Monotonic page-table version: bumped whenever any sequence's page
+        # list changes (add/extend/release). Consumers that upload page
+        # tables to the device (engine decode dispatch, draft worker) key
+        # their caches on it, so a steady-state decode step rebuilds
+        # nothing and a stale table can never survive an allocation.
+        self.version = 0
         # Token ids actually stored in each published page — matches are
         # verified against these so a 64-bit hash collision can never serve
         # another request's KV (cross-request leakage). Bounded by num_pages.
@@ -352,6 +358,7 @@ class KVCacheManager:
             alloc.ctx_len = cached
             alloc.registered_blocks = len(alloc.pages)
         self.seqs[seq_id] = alloc
+        self.version += 1
         return cached
 
     def register_prefix(self, seq_id: str, token_ids: Sequence[int],
@@ -407,6 +414,7 @@ class KVCacheManager:
         need = alloc.pages_needed(new_ctx_len, self.page_size)
         if need:
             alloc.pages.extend(self.allocator.alloc(need))
+            self.version += 1
         alloc.ctx_len = new_ctx_len
 
     def can_extend(self, seq_id: str, new_ctx_len: int) -> bool:
@@ -431,6 +439,7 @@ class KVCacheManager:
             self.register_prefix(seq_id, token_ids)
         del self.seqs[seq_id]
         self.allocator.free(alloc.pages)
+        self.version += 1
 
     # ------------------------------------------------------------ page tables
 

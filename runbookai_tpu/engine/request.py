@@ -57,7 +57,10 @@ class SamplingParams:
     # Per-request sampling seed (OpenAI `seed`): each sampled position
     # draws from fold_in(PRNGKey(seed), position) — reproducible for a
     # given (seed, position) regardless of batch composition or engine
-    # history. None keeps the engine's dispatch key.
+    # history. None keeps the engine's dispatch key — reproducible only
+    # per run shape, since the overlapped decode pipeline's overshoot
+    # windows consume extra key splits at stream tails
+    # (docs/decode_pipeline.md). Seeded requests are pipeline-independent.
     seed: Optional[int] = None
     # OpenAI logit_bias: ((token_id, bias), ...) added to the logits
     # before penalties/masking/greedy. Densified host-side per dispatch
