@@ -23,7 +23,9 @@ BENCH_NEW, BENCH_SLOTS, BENCH_PAGES, BENCH_PROBE_TIMEOUT (patient probe,
 default min(1200, watchdog/2)), BENCH_PROBE_SHORT, BENCH_PROBE_COOLDOWN,
 BENCH_PROBE_ISO, BENCH_WATCHDOG, BENCH_ATTN, BENCH_PREFILL_BATCH,
 BENCH_OVERLAP (=0 forces synchronous decode; `--no-overlap` sets it, so
-the overlapped-pipeline A/B is one flag on hardware).
+the overlapped-pipeline A/B is one flag on hardware), BENCH_MIXED (=0 /
+`--no-mixed` forces the split prefill/decode dispatches, =1 forces the
+unified mixed dispatch; unset leaves the engine's auto policy).
 """
 
 from __future__ import annotations
@@ -291,6 +293,11 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     from runbookai_tpu.utils.tokens import ByteTokenizer
 
     overlap = os.environ.get("BENCH_OVERLAP", "1") != "0"
+    # Mixed-dispatch A/B: unset = the engine's auto policy (on for
+    # tpu/axon, off on CPU); BENCH_MIXED=0 / --no-mixed forces the split
+    # path, BENCH_MIXED=1 forces mixed (CPU smoke of the ragged program).
+    mixed_env = os.environ.get("BENCH_MIXED")
+    mixed = None if mixed_env is None else mixed_env != "0"
     n_requests = int(os.environ.get("BENCH_REQUESTS", 8))
     prompt_len = int(os.environ.get("BENCH_PROMPT", 128))
     new_tokens = int(os.environ.get("BENCH_NEW", 64))
@@ -388,6 +395,9 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         # Overlapped decode pipeline (device-resident feedback + async
         # egress); BENCH_OVERLAP=0 / --no-overlap is the sync A/B arm.
         overlap_decode=overlap,
+        # Unified mixed prefill+decode dispatch (one ragged forward per
+        # step with prompts in flight); --no-mixed is the split A/B arm.
+        mixed_dispatch=mixed,
     )
     from runbookai_tpu.model.guided import JsonMaskProvider
 
@@ -430,7 +440,9 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     core.metrics.update(decode_tokens=0, decode_steps=0, prefill_tokens=0,
                         decode_time_s=0.0, prefill_time_s=0.0,
                         decode_dispatch_time_s=0.0, decode_host_time_s=0.0,
-                        decode_host_overlap_s=0.0)
+                        decode_host_overlap_s=0.0, prefill_steps=0,
+                        decode_dispatches=0, mixed_steps=0, mixed_tokens=0,
+                        mixed_time_s=0.0)
     # Latency histograms (utils/metrics.py) restart with the measured run
     # so the p95s below exclude warmup-compile TTFTs.
     core.hist_ttft.reset()
@@ -494,6 +506,15 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         # Overlapped-pipeline attribution: host work per decode dispatch
         # and the fraction of it hidden behind device execution.
         "overlap": overlap,
+        # Mixed-dispatch attribution: the engine's RESOLVED mode (auto may
+        # differ from the request), dispatches that served both phases in
+        # one forward, and the real tokens each carried.
+        "mixed": core._mixed,
+        "mixed_dispatches": m.get("mixed_steps", 0),
+        "mixed_tokens_per_dispatch": round(
+            m.get("mixed_tokens", 0) / max(m.get("mixed_steps", 0), 1), 1),
+        "prefill_dispatches": m.get("prefill_steps", 0),
+        "decode_dispatches": m.get("decode_dispatches", 0),
         "host_ms_per_step": round(
             m.get("decode_host_time_s", 0.0)
             / max(m["decode_steps"], 1) * 1e3, 3),
@@ -626,11 +647,15 @@ def _spawn_inner(model_name: str, on_accel: bool, probe: dict,
 
 
 def main() -> None:
-    # One-flag A/B for the overlapped decode pipeline: strip the flag
-    # before --inner parsing; children inherit the env.
+    # One-flag A/Bs for the overlapped decode pipeline and the unified
+    # mixed dispatch: strip the flags before --inner parsing; children
+    # inherit the env.
     if "--no-overlap" in sys.argv:
         sys.argv.remove("--no-overlap")
         os.environ["BENCH_OVERLAP"] = "0"
+    if "--no-mixed" in sys.argv:
+        sys.argv.remove("--no-mixed")
+        os.environ["BENCH_MIXED"] = "0"
     if len(sys.argv) > 1 and sys.argv[1] == "--inner":
         run_inner(sys.argv[2], sys.argv[3] == "1", json.loads(sys.argv[4]))
         return

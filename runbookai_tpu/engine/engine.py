@@ -46,7 +46,11 @@ from runbookai_tpu.engine.request import (
     FinishReason,
     RequestState,
 )
-from runbookai_tpu.models.llama import LlamaConfig, forward_impl
+from runbookai_tpu.models.llama import (
+    LlamaConfig,
+    forward_impl,
+    forward_ragged_impl,
+)
 from runbookai_tpu.ops.sampling import sample_tokens
 from runbookai_tpu.utils import metrics as metrics_mod
 from runbookai_tpu.utils.trace import annotate, get_tracer
@@ -112,6 +116,20 @@ class EngineConfig:
     # non-repetitive traffic while repetitive traffic re-enters
     # speculation within a couple of rounds.
     spec_backoff_rounds: int = 8
+    # Unified mixed prefill+decode dispatch: whenever prompts and decodes
+    # coexist, ONE ragged forward serves every live decode slot (1 token
+    # each) plus the oldest prefill chunk(s), and a prefill row completing
+    # its prompt samples its first token in the same dispatch — the 2
+    # dispatches/step a prompt burst used to cost become 1 (the tunneled
+    # TPU pays ~70ms per host sync regardless of T). None = auto: on for
+    # tpu/axon where dispatch latency dominates, off on CPU where compute
+    # scales with the padded ragged buffer — the same policy and rationale
+    # as grammar_fast_forward. Guided/logprob requests and kv-page-split
+    # meshes keep the classic split path (forced-sync semantics).
+    mixed_dispatch: Optional[bool] = None
+    # Per-step token budget of a mixed dispatch: decode slots (1 token
+    # each) + prefill chunk tokens. None = prefill_chunk + max_batch_slots.
+    mixed_token_budget: Optional[int] = None
 
 
 @partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl",
@@ -228,6 +246,78 @@ def _prefill_step(
     )
     rows = jnp.arange(logits.shape[0])
     return logits[rows, last_idx], kv_k, kv_v
+
+
+# Row-run alignment of the mixed ragged token buffer: every row's token run
+# starts at a multiple of this, so each aligned block belongs to exactly one
+# row and the ragged forward collapses to a chunked one with per-block
+# gathered tables (ops/attention.ragged_paged_attention's layout contract).
+_RAGGED_BLOCK = 8
+
+
+@partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages",
+                                   "attn_impl", "mesh", "qmm_impl",
+                                   "ragged_block"),
+         donate_argnums=(7, 8, 23))
+def _mixed_step(
+    params, cfg: LlamaConfig, tokens, feed_toks, dec_idx, positions, row_ids,
+    kv_k, kv_v, tables, ctx_lens, adapter_rows, pf_last_idx, temps, top_ps,
+    top_ks, key, pf_temps, pf_top_ps, pf_top_ks, pf_slot_map, pf_live,
+    dec_live=None, counts=None, pres=None, freq=None, seeds=None, bias=None,
+    pf_pres=None, pf_freq=None, pf_seeds=None, pf_bias=None, *,
+    page_size: int, block_pages: int, attn_impl: str = "xla", mesh=None,
+    qmm_impl: str = "xla", ragged_block: int = _RAGGED_BLOCK,
+):
+    """ONE unified mixed prefill+decode dispatch (the ragged forward).
+
+    ``tokens`` is the flat ragged buffer with prefill chunks host-filled
+    and zeros at the decode positions; each slot's device-resident last
+    token (``feed_toks``) is scattered in at ``dec_idx`` so decode inputs
+    never visit the host. The forward returns last-token logits for every
+    decode slot AND every prefill row; decode rows sample exactly like
+    :func:`_decode_step` (feeding the overlap pipeline), and a prefill row
+    that completed its prompt samples its FIRST token in this same
+    dispatch (``pf_slot_map`` scatters it into the decode feed — TTFT
+    loses a whole dispatch). ``pf_slot_map`` rows for non-completing /
+    pad prefill rows point out of bounds and drop.
+
+    Penalty counts update in-dispatch for both groups; the rows are
+    disjoint (decode slots vs freshly assigned slots) so order is
+    irrelevant, matching the split path's semantics. The decode-side add
+    is masked by ``dec_live`` (1 = slot holds a live decoder): free
+    slots' rows sample garbage logits here, and — unlike the split path,
+    where a row is always re-seeded AFTER any such drift and before its
+    first read — a prompt completing in THIS dispatch had its row seeded
+    pre-dispatch, so an unmasked add would pollute it before the
+    first-token gather below reads it.
+    """
+    b = feed_toks.shape[0]
+    tokens = tokens.at[dec_idx].set(feed_toks)
+    sel_idx = jnp.concatenate([dec_idx, pf_last_idx])
+    logits, kv_k, kv_v = forward_ragged_impl(
+        params, cfg, tokens, positions, row_ids, kv_k, kv_v, tables,
+        ctx_lens, sel_idx, page_size=page_size, block_pages=block_pages,
+        attn_impl=attn_impl, mesh=mesh, adapter_ids=adapter_rows,
+        qmm_impl=qmm_impl, ragged_block=ragged_block,
+    )
+    dec_logits, pf_logits = logits[:b], logits[b:]
+    key_dec, key_pf = jax.random.split(key)
+    dec_tok = sample_tokens(dec_logits, key_dec, temps, top_ps, None, top_ks,
+                            counts=counts, presence=pres, frequency=freq,
+                            seeds=seeds, positions=ctx_lens[:b], bias=bias)
+    if counts is not None:
+        counts = counts.at[jnp.arange(b), dec_tok].add(dec_live)
+    pf_counts = (jnp.take(counts, jnp.clip(pf_slot_map, 0, b - 1), axis=0)
+                 if counts is not None else None)
+    pf_tok = sample_tokens(pf_logits, key_pf, pf_temps, pf_top_ps, None,
+                           pf_top_ks, counts=pf_counts, presence=pf_pres,
+                           frequency=pf_freq, seeds=pf_seeds,
+                           positions=ctx_lens[b:b + pf_temps.shape[0]],
+                           bias=pf_bias)
+    if counts is not None:
+        counts = counts.at[pf_slot_map, pf_tok].add(pf_live, mode="drop")
+    feed_new = dec_tok.at[pf_slot_map].set(pf_tok, mode="drop")
+    return dec_tok[:, None], pf_tok, feed_new, kv_k, kv_v, counts
 
 
 @functools.lru_cache(maxsize=8)
@@ -394,6 +484,48 @@ def _probe_qmm_pallas(model_cfg, ecfg, act_dtype, mesh=None) -> bool:
         mesh = None  # single-device mesh == no mesh for partitioning
     return _probe_qmm_pallas_cached(jax.default_backend(), m, k, n,
                                     jnp.dtype(act_dtype).name, mesh=mesh)
+
+
+@functools.lru_cache(maxsize=8)
+def _probe_pallas_ragged_cached(backend: str, n_kv: int, n_q: int,
+                                head_dim: int, page_size: int,
+                                kv_dtype_name: str,
+                                act_dtype_name: str) -> bool:
+    """One compile of the ragged mixed-dispatch kernel path
+    (``paged_ragged_attention`` — the chunk kernel at the blocked ragged
+    layout with per-block gathered tables) at a representative 2-row mix
+    (one decode-shaped row, one chunk-shaped row) proves the lowering
+    before the engine routes live mixed traffic through it."""
+    try:
+        from runbookai_tpu.ops.paged_attention_pallas import (
+            paged_ragged_attention,
+        )
+
+        rq = _RAGGED_BLOCK
+        kv = jnp.zeros((2 * page_size, n_kv, head_dim),
+                       jnp.dtype(kv_dtype_name))
+        tables = jnp.zeros((2, 2), jnp.int32)
+        q = jnp.zeros((2 * rq, n_q, head_dim), jnp.dtype(act_dtype_name))
+        row_ids = jnp.repeat(jnp.arange(2, dtype=jnp.int32), rq)
+        q_pos = jnp.concatenate(
+            [jnp.zeros((rq,), jnp.int32), jnp.arange(rq, dtype=jnp.int32)])
+        out = paged_ragged_attention(
+            q, kv, kv, tables, jnp.asarray([1, rq], jnp.int32), q_pos,
+            row_ids, page_size=page_size, ragged_block=rq,
+            interpret=backend == "cpu")
+        # runbook: noqa[RBK002] — probe barrier: the ragged mixed-dispatch
+        # kernel must lower (or raise) before mixed traffic relies on it.
+        jax.block_until_ready(out)
+        return True
+    except Exception:  # noqa: BLE001 — any Mosaic/lowering failure
+        return False
+
+
+def _probe_pallas_ragged(model_cfg, ecfg, act_dtype) -> bool:
+    return _probe_pallas_ragged_cached(
+        jax.default_backend(), model_cfg.n_kv_heads, model_cfg.n_heads,
+        model_cfg.head_dim, ecfg.page_size, jnp.dtype(ecfg.kv_dtype).name,
+        jnp.dtype(act_dtype).name)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -589,6 +721,41 @@ class EngineCore:
                     "on this backend; using the XLA matmul expression")
                 self.ecfg = _dc.replace(self.ecfg, qmm_impl="xla")
 
+        # Unified mixed prefill+decode dispatch: resolve the auto policy
+        # (on where dispatch latency dominates, off on CPU where compute
+        # scales with the padded ragged buffer) and probe the ragged
+        # kernel path like the other Pallas programs. The kv page-split
+        # mesh keeps the classic split path — the ragged layout has no
+        # page-shard plumbing. int8 KV needs no ragged probe: mixed steps
+        # are T>1 chunks, which int8 pools serve via the XLA gather path.
+        mixed = self.ecfg.mixed_dispatch
+        if mixed is None:
+            mixed = jax.default_backend() in ("tpu", "axon")
+        if mixed and _kv_split_mesh:
+            mixed = False
+        if (mixed and self.ecfg.attn_impl == "pallas" and not _kv_int8
+                and not _probe_pallas_ragged(model_cfg, self.ecfg,
+                                             act_dtype)):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "Mosaic rejected the ragged mixed-dispatch probe; serving "
+                "with split prefill/decode dispatches")
+            mixed = False
+        self._mixed = bool(mixed)
+        # Mixed-batch geometry (fixed shapes → one compiled mixed program
+        # in steady state): decode section = one aligned block per slot,
+        # prefill section = the chunk token budget rounded up to blocks,
+        # plus one reserved null row for padding blocks.
+        budget = (self.ecfg.mixed_token_budget
+                  or (self.ecfg.prefill_chunk + self.ecfg.max_batch_slots))
+        pf_budget = max(_RAGGED_BLOCK,
+                        budget - self.ecfg.max_batch_slots)
+        self._mix_pf_tokens = -(-pf_budget // _RAGGED_BLOCK) * _RAGGED_BLOCK
+        self._mix_pf_rows = max(1, self.ecfg.prefill_batch)
+        self._mix_rows = (self.ecfg.max_batch_slots + self._mix_pf_rows
+                          + 1)
+
         # Sharded serving: with a mesh, the KV pool shards its kv-head axis
         # over the TP (``model``) axis alongside the Megatron param shardings
         # (``params`` must already be device_put by the caller — see
@@ -650,11 +817,20 @@ class EngineCore:
         # decode_time_s remains the total decode wall; the dispatch/host/
         # overlap components split it so the pipeline's win is attributable
         # (host emission used to be silently booked as decode time).
+        # mixed_* split: a mixed step books its wall under mixed_time_s
+        # (NOT prefill_time_s/decode_time_s — those keep their pure-step
+        # semantics for the /healthz and PromQL contracts); the drained
+        # decode window's egress/emission stays booked as decode_* like
+        # any other window. prefill_steps / decode_dispatches /
+        # mixed_steps count DISPATCHES, making the 2-dispatches→1 win of
+        # mixed steps directly observable.
         self.metrics = {"decode_tokens": 0, "decode_steps": 0, "prefill_tokens": 0,
                         "preemptions": 0, "decode_time_s": 0.0, "prefill_time_s": 0.0,
                         "cached_prefix_tokens": 0, "spec_drafted": 0, "spec_accepted": 0,
                         "decode_dispatch_time_s": 0.0, "decode_host_time_s": 0.0,
-                        "decode_host_overlap_s": 0.0}
+                        "decode_host_overlap_s": 0.0, "prefill_steps": 0,
+                        "decode_dispatches": 0, "mixed_steps": 0,
+                        "mixed_tokens": 0, "mixed_time_s": 0.0}
         self.registry = metrics_mod.get_registry()
         self._install_metrics()
 
@@ -684,6 +860,10 @@ class EngineCore:
             "runbook_queue_wait_seconds",
             "Submission-to-admission wait (first admission only)",
             buckets=m.QUEUE_WAIT_BUCKETS)
+        self.hist_mixed_tokens = reg.histogram(
+            "runbook_mixed_tokens_per_dispatch",
+            "Real (unpadded) tokens per unified mixed prefill+decode "
+            "dispatch", buckets=m.MIXED_TOKENS_BUCKETS)
         # Live scheduler/pool state: plain attribute reads, safe from the
         # scrape thread without the step lock (at worst one step stale).
         reg.gauge("runbook_running_requests",
@@ -737,6 +917,17 @@ class EngineCore:
             ("decode_host_overlap_s",
              "runbook_decode_host_overlapped_seconds_total",
              "Host decode work that ran while a dispatch was in flight"),
+            ("prefill_steps", "runbook_prefill_dispatch_total",
+             "Pure prefill dispatches"),
+            ("decode_dispatches", "runbook_decode_dispatch_total",
+             "Pure decode dispatches (single, multi-step, and spec-verify)"),
+            ("mixed_steps", "runbook_mixed_dispatch_total",
+             "Unified mixed prefill+decode dispatches (one ragged forward "
+             "serving both phases)"),
+            ("mixed_tokens", "runbook_mixed_tokens_total",
+             "Real tokens processed by mixed dispatches"),
+            ("mixed_time_s", "runbook_mixed_time_seconds_total",
+             "Wall-clock spent building and issuing mixed dispatches"),
         ):
             reg.counter(name, help_text).set_function(
                 lambda k=key: float(self.metrics.get(k, 0)))
@@ -1234,6 +1425,7 @@ class EngineCore:
             )
 
         done_rows: list[tuple[int, EngineRequest]] = []
+        self.metrics["prefill_steps"] += 1
         for i, (req, chunk_len, new_ctx) in enumerate(rows):
             req.prefill_pos = new_ctx
             self.metrics["prefill_tokens"] += chunk_len
@@ -1360,10 +1552,13 @@ class EngineCore:
                 self._emit_token(req, int(toks_host[i]))
         self.metrics["prefill_time_s"] += time.perf_counter() - t0
 
-    def _seed_counts_for(self, req: EngineRequest) -> None:
+    def _seed_counts_for(self, req: EngineRequest,
+                         slot: Optional[int] = None) -> None:
         """Restore the request's slot row to its GENERATED-token histogram
         (OpenAI penalties count sampled tokens, never the prompt); ids pad
-        to powers of two so compile count stays O(log len)."""
+        to powers of two so compile count stays O(log len). ``slot``
+        overrides ``req.slot`` for the mixed dispatch, which prepares the
+        row BEFORE the in-dispatch first-token sampling assigns it."""
         ids = req.all_out_ids
         n = max(1, len(ids))
         padded_len = 1
@@ -1372,8 +1567,9 @@ class EngineCore:
         padded = np.zeros((padded_len,), dtype=np.int32)
         padded[: len(ids)] = ids
         self._tok_counts = _seed_count_row(
-            self._tok_counts, jnp.int32(req.slot), jnp.asarray(padded),
-            jnp.int32(len(ids)))
+            self._tok_counts,
+            jnp.int32(req.slot if slot is None else slot),
+            jnp.asarray(padded), jnp.int32(len(ids)))
 
     # ---------------------------------------------------------------- decode
 
@@ -1433,8 +1629,7 @@ class EngineCore:
         """Decode tokens per dispatch: 1 when any guided request needs
         per-token masks, else the largest power of two ≤ config that fits
         every sequence's remaining max_seq headroom."""
-        if any(r.sampling.guided or r.sampling.logprobs
-               for r in self.decoding):
+        if any(r.sampling.forced_sync for r in self.decoding):
             return 1
         k = max(1, self.ecfg.decode_steps_per_dispatch)
         # Scheduled (lead-adjusted) lengths: the in-flight window's tokens
@@ -1559,6 +1754,7 @@ class EngineCore:
         t_end = time.perf_counter()
         self.metrics["decode_tokens"] += emitted
         self.metrics["decode_steps"] += 1
+        self.metrics["decode_dispatches"] += 1
         self.metrics["decode_dispatch_time_s"] += t_fetch - t_issue
         self.metrics["decode_host_time_s"] += (
             (t_issue - t0) + (t_end - t_fetch))
@@ -1655,6 +1851,264 @@ class EngineCore:
         req.state = RequestState.PREFILL
         self.prefilling.append(req)
 
+    # ------------------------------------------------------- mixed dispatch
+
+    def _can_mix(self) -> bool:
+        """True when this step can run as ONE unified mixed dispatch.
+
+        Forced-sync consumers (guided masks, logprob attachment) and
+        sequences at the context limit keep the classic split path — their
+        reconciliation rules (docs/decode_pipeline.md) are defined against
+        it. The prefill HEAD is checked rather than skipped so FIFO
+        fairness survives: a guided prompt at the head falls the whole
+        step back to the classic path instead of starving behind mixers.
+        """
+        if not (self._mixed and self.prefilling and self.decoding):
+            return False
+        if any(r.sampling.forced_sync for r in self.decoding):
+            return False
+        if any(r.ctx_len + self._lead(r) + 1 > self.ecfg.max_seq_len
+               for r in self.decoding):
+            return False
+        return not self.prefilling[0].sampling.forced_sync
+
+    def _run_mixed(self) -> bool:
+        """One ragged dispatch: every live decode slot (1 token each) plus
+        the oldest prefill chunk(s), within the mixed token budget.
+
+        Decode rows behave exactly like a k=1 :meth:`_run_decode` window
+        (device-resident feed in, overlap pipeline out); prefill rows
+        advance their chunk, and rows completing their prompt sample the
+        FIRST output token inside the same dispatch (TTFT saves a whole
+        dispatch). Returns False when reconciliation (drains, preemption,
+        pool pressure) left nothing to mix — the caller then falls back to
+        the classic split path for this step; the dispatch has not been
+        issued and any prefill page extensions done here are idempotent
+        under the classic chunk sizes.
+        """
+        t0 = time.perf_counter()
+        acc0 = self._drain_time_acc
+        # Same all-budget-covered tail rule as _run_decode: a dispatch
+        # whose decode rows would all be overshoot is pure waste.
+        if self._pending is not None and all(
+                r.num_generated + self._lead(r) >= r.sampling.max_new_tokens
+                for r in self.decoding):
+            self._drain_pending()
+        if not self._can_mix():
+            return False
+        rq = _RAGGED_BLOCK
+        b = self.ecfg.max_batch_slots
+        # Prefill row selection: FIFO, chunked, budget- and row-capped.
+        # Stopping (not skipping) at the first ineligible/unfittable
+        # request preserves admission order; the classic path serves it.
+        pf_rows: list[tuple[EngineRequest, int, int]] = []
+        used = 0
+        for req in list(self.prefilling[: self._mix_pf_rows]):
+            if req.sampling.forced_sync:
+                break
+            room = self._mix_pf_tokens - used
+            if room < 1:
+                break
+            chunk = min(self.ecfg.prefill_chunk,
+                        len(req.prompt_ids) - req.prefill_pos, room)
+            new_ctx = req.prefill_pos + chunk
+            try:
+                self.kv.extend(req.request_id, new_ctx)
+            except MemoryError:
+                break  # run what fits; classic preempts when nothing does
+            pf_rows.append((req, chunk, new_ctx))
+            used += -(-chunk // rq) * rq
+        if not pf_rows:
+            return False
+        # Decode page growth AFTER the prefill extends, mirroring the
+        # classic step order (prefill dispatch precedes decode). The
+        # internal preemption/drain may finish or evict decoders — or the
+        # whole decode side — so re-check before committing to the mix.
+        self._grow_pages_for_decode(1)
+        if not self.decoding:
+            return False
+
+        t_build = time.perf_counter()
+        n = b * rq + self._mix_pf_tokens
+        n_pf = self._mix_pf_rows
+        pad_row = self._mix_rows - 1
+        trash = self._trash_pos()
+        tokens = np.zeros((n,), dtype=np.int32)
+        positions = np.full((n,), trash, dtype=np.int32)
+        row_ids = np.full((n,), pad_row, dtype=np.int32)
+        ctx_lens = np.zeros((self._mix_rows,), dtype=np.int32)
+        adapters = np.zeros((self._mix_rows,), dtype=np.int32)
+        dec_idx = np.arange(b, dtype=np.int32) * rq
+        dec_live = np.zeros((b,), dtype=np.int32)
+        for req in self.decoding:
+            s = req.slot
+            ec = req.ctx_len + self._lead(req)  # scheduled context
+            positions[s * rq] = ec - 1
+            row_ids[s * rq: (s + 1) * rq] = s
+            ctx_lens[s] = ec
+            adapters[s] = req.adapter_idx
+            dec_live[s] = 1
+        pf_last = np.zeros((n_pf,), dtype=np.int32)
+        off = b * rq
+        for j, (req, chunk, new_ctx) in enumerate(pf_rows):
+            r = b + j
+            tokens[off: off + chunk] = req.prompt_ids[req.prefill_pos:new_ctx]
+            positions[off: off + chunk] = np.arange(req.prefill_pos, new_ctx)
+            row_ids[off: off + (-(-chunk // rq) * rq)] = r
+            ctx_lens[r] = new_ctx
+            adapters[r] = req.adapter_idx
+            pf_last[j] = off + chunk - 1
+            off += -(-chunk // rq) * rq
+        tables = self._tables_for(
+            list(self._slots) + [r for r, _, _ in pf_rows]
+            + [None] * (n_pf - len(pf_rows)) + [None])
+
+        # Completions are host-known before the dispatch: precompute the
+        # slot each will take (same lowest-free-slot order the classic
+        # path uses) so penalty count rows can be prepared NOW — the
+        # in-dispatch first-token sampling reads them.
+        done: list[tuple[int, EngineRequest, int]] = []
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        for j, (req, chunk, new_ctx) in enumerate(pf_rows):
+            if new_ctx >= len(req.prompt_ids):
+                done.append((j, req, free.pop(0)))
+        fresh_pen = np.zeros((b,), dtype=bool)
+        for j, req, slot in done:
+            if req.sampling.penalized:
+                if req.all_out_ids:
+                    self._seed_counts_for(req, slot=slot)
+                else:
+                    fresh_pen[slot] = True
+        if fresh_pen.any():
+            self._tok_counts = _reset_count_rows(
+                self._tok_counts, jnp.asarray(fresh_pen))
+
+        si = self._slot_inputs()
+        pf_temps = np.zeros((n_pf,), dtype=np.float32)
+        pf_top_ps = np.ones((n_pf,), dtype=np.float32)
+        pf_top_ks = np.zeros((n_pf,), dtype=np.int32)
+        pf_pres = np.zeros((n_pf,), dtype=np.float32)
+        pf_freq = np.zeros((n_pf,), dtype=np.float32)
+        pf_seeds = np.full((n_pf,), -1, dtype=np.int32)
+        pf_slot_map = np.full((n_pf,), b, dtype=np.int32)  # b → dropped
+        pf_live = np.zeros((n_pf,), dtype=np.int32)
+        pf_use_pen = any(req.sampling.penalized for _, req, _ in done)
+        pf_use_seed = any(req.sampling.seed is not None
+                          for _, req, _ in done)
+        pf_use_bias = any(req.sampling.logit_bias for _, req, _ in done)
+        pf_bias = (np.zeros((n_pf, self.cfg.vocab_size), dtype=np.float32)
+                   if pf_use_bias else None)
+        for j, req, slot in done:
+            pf_temps[j] = req.sampling.temperature
+            pf_top_ps[j] = req.sampling.top_p
+            pf_top_ks[j] = req.sampling.top_k
+            pf_pres[j] = req.sampling.presence_penalty
+            pf_freq[j] = req.sampling.frequency_penalty
+            pf_slot_map[j] = slot
+            if req.sampling.penalized:
+                pf_live[j] = 1
+            if req.sampling.seed is not None:
+                pf_seeds[j] = req.sampling.seed & 0x7FFFFFFF
+            if pf_bias is not None:
+                for tok_id, b_val in req.sampling.logit_bias:
+                    pf_bias[j, tok_id] = b_val
+        use_pen = si.use_pen or pf_use_pen
+
+        real_tokens = len(self.decoding) + sum(c for _, c, _ in pf_rows)
+        dec_snapshot = list(self.decoding)
+        inflight = self._pending is not None
+        self._key, sub = jax.random.split(self._key)
+        with self.tracer.span("engine.mixed", batch=len(dec_snapshot),
+                              prefill_rows=len(pf_rows),
+                              tokens=int(real_tokens)), annotate("mixed"):
+            t_issue = time.perf_counter()
+            (toks_win, pf_toks, feed_new, self._kv_k, self._kv_v,
+             counts_out) = _mixed_step(
+                self.params, self.cfg, jnp.asarray(tokens), self._feed_toks,
+                jnp.asarray(dec_idx), jnp.asarray(positions),
+                jnp.asarray(row_ids), self._kv_k, self._kv_v,
+                jnp.asarray(tables), jnp.asarray(ctx_lens),
+                jnp.asarray(adapters), jnp.asarray(pf_last),
+                si.temps, si.top_ps, si.top_ks, sub,
+                jnp.asarray(pf_temps), jnp.asarray(pf_top_ps),
+                jnp.asarray(pf_top_ks), jnp.asarray(pf_slot_map),
+                jnp.asarray(pf_live),
+                dec_live=jnp.asarray(dec_live) if use_pen else None,
+                counts=self._tok_counts if use_pen else None,
+                pres=si.pres if si.use_pen else None,
+                freq=si.freq if si.use_pen else None,
+                seeds=si.seeds if si.use_seed else None,
+                bias=si.bias if si.use_bias else None,
+                pf_pres=jnp.asarray(pf_pres) if pf_use_pen else None,
+                pf_freq=jnp.asarray(pf_freq) if pf_use_pen else None,
+                pf_seeds=jnp.asarray(pf_seeds) if pf_use_seed else None,
+                pf_bias=jnp.asarray(pf_bias) if pf_use_bias else None,
+                page_size=self.ecfg.page_size,
+                block_pages=self.ecfg.block_pages,
+                attn_impl=self.ecfg.attn_impl, mesh=self.mesh,
+                qmm_impl=self.ecfg.qmm_impl, ragged_block=rq,
+            )
+        if counts_out is not None:
+            self._tok_counts = counts_out
+        self._feed_toks = feed_new
+
+        pending = _PendingDecode(
+            toks_dev=toks_win,
+            reqs=[(r, r.slot) for r in dec_snapshot],
+            req_ids=frozenset(r.request_id for r in dec_snapshot),
+            k=1,
+        )
+        if hasattr(toks_win, "copy_to_host_async"):
+            toks_win.copy_to_host_async()
+
+        # Prefill bookkeeping (chunk advance, completions join decode).
+        self.metrics["prefill_tokens"] += sum(c for _, c, _ in pf_rows)
+        for req, chunk, new_ctx in pf_rows:
+            req.prefill_pos = new_ctx
+        for j, req, slot in done:
+            self.kv.register_prefix(req.request_id, req.prompt_ids,
+                                    hashes=req.block_hashes)
+            self.prefilling.remove(req)
+            self._slots[slot] = req
+            req.slot = slot
+            req.state = RequestState.DECODE
+            self.decoding.append(req)
+        if done:
+            self._bump_epoch()  # slot→request mapping changed
+            # runbook: noqa[RBK002] — sanctioned sync: the one batched
+            # mixed-step first-token fetch (TTFT emission; decode rows
+            # stay device-resident in the overlap window).
+            pf_host = np.asarray(jax.device_get(pf_toks))
+            for j, req, slot in done:
+                if req.first_token_time is None:
+                    req.first_token_time = time.perf_counter()
+                    self.hist_ttft.observe(req.first_token_time
+                                           - req.arrival_time)
+                self._emit_token(req, int(pf_host[j]))
+
+        # Decode rows ride the overlap pipeline exactly like _run_decode.
+        if self.ecfg.overlap_decode:
+            prev, self._pending = self._pending, pending
+            if prev is not None:
+                self._drain(prev, overlapped=True)
+        else:
+            self._drain(pending, overlapped=False)
+
+        self.metrics["mixed_steps"] += 1
+        self.metrics["mixed_tokens"] += real_tokens
+        self.hist_mixed_tokens.observe(real_tokens)
+        # Host-prep attribution mirrors _run_decode: build work counts as
+        # (overlappable) host decode time; the drained window's fetch/emit
+        # was already booked as decode_* inside _drain. mixed_time_s books
+        # only this step's own un-drained wall, so pure-step counters keep
+        # their /healthz + PromQL semantics.
+        self.metrics["decode_host_time_s"] += t_issue - t_build
+        if inflight:
+            self.metrics["decode_host_overlap_s"] += t_issue - t_build
+        self.metrics["mixed_time_s"] += (
+            (time.perf_counter() - t0) - (self._drain_time_acc - acc0))
+        return True
+
     def _run_decode(self) -> None:
         if not self.decoding:
             # Tail flush: every row of the in-flight window finished or
@@ -1680,7 +2134,7 @@ class EngineCore:
         # logprob attachment (k=1 fetch), forced-sync mode, and sequences
         # whose scheduled context hits the limit (finish precedes growth).
         need_sync = (not overlap) or any(
-            r.sampling.guided or r.sampling.logprobs for r in self.decoding)
+            r.sampling.forced_sync for r in self.decoding)
         if not need_sync and any(
                 r.ctx_len + self._lead(r) + 1 > self.ecfg.max_seq_len
                 for r in self.decoding):
@@ -1833,6 +2287,7 @@ class EngineCore:
             # Input prep ran while the previous window executed on device.
             self.metrics["decode_host_overlap_s"] += t_issue - t_build
         self.metrics["decode_dispatch_time_s"] += t_done - t_issue
+        self.metrics["decode_dispatches"] += 1
 
         if need_sync:
             # Forced-sync: consume this window before returning (guided
@@ -1867,14 +2322,20 @@ class EngineCore:
     _FINISHED_KEEP = 1024
 
     def step(self) -> list[EngineRequest]:
-        """One scheduler iteration; returns requests finished during it."""
+        """One scheduler iteration; returns requests finished during it.
+
+        With prompts and decodes both live (and mixed dispatch enabled),
+        the step runs as ONE unified ragged dispatch; otherwise — or when
+        mixing bails during reconciliation — the classic split
+        prefill-then-decode pair runs, at most one dispatch each."""
         if len(self.finished) > self._FINISHED_HIGH_WATER:
             del self.finished[: -self._FINISHED_KEEP]
         before = len(self.finished)
         self._admit()
-        if self.prefilling:
-            self._run_prefill()
-        self._run_decode()
+        if not (self._can_mix() and self._run_mixed()):
+            if self.prefilling:
+                self._run_prefill()
+            self._run_decode()
         return self.finished[before:]
 
     def run_until_idle(self, max_steps: int = 100_000) -> list[EngineRequest]:

@@ -72,6 +72,16 @@ class SamplingParams:
     def penalized(self) -> bool:
         return bool(self.presence_penalty or self.frequency_penalty)
 
+    @property
+    def forced_sync(self) -> bool:
+        """True when the request pins the engine to synchronous k=1 decode
+        dispatches: per-token grammar masks and logprob attachment both
+        need the previous token on host before the next dispatch can be
+        built. Such requests also keep the classic split prefill/decode
+        dispatches — the unified mixed dispatch excludes them so its
+        single-forward fast path never has to reconcile mid-step."""
+        return bool(self.guided or self.logprobs)
+
 
 @dataclass
 class EngineRequest:

@@ -13,6 +13,7 @@ with the LLM on one slice (SURVEY.md §7 hard part 5).
 from __future__ import annotations
 
 import hashlib
+from collections import OrderedDict
 from typing import Optional
 
 import numpy as np
@@ -29,6 +30,7 @@ class Embedder:
         max_length: int = 512,
         batch_size: int = 64,
         query_instruction: str = "Represent this sentence for searching relevant passages: ",
+        cache_max_entries: int = 4096,
     ):
         import jax.numpy as jnp  # deferred
 
@@ -41,9 +43,15 @@ class Embedder:
         self.batch_size = batch_size
         self.query_instruction = query_instruction
         self.dim = self.cfg.dim
-        self._cache: dict[str, np.ndarray] = {}
+        # LRU-bounded md5→embedding cache: a days-long process indexing
+        # rolling docs must not grow this dict forever (same leak class
+        # the r5 soak caught in the engine's finished-request registry).
+        # ~dim*4 bytes/entry → the default cap holds ~12 MB for bge-base.
+        self._cache: OrderedDict[str, np.ndarray] = OrderedDict()
+        self._cache_max = max(0, cache_max_entries)
         self._jnp = jnp
-        self.stats = {"texts": 0, "tokens": 0, "cache_hits": 0, "batches": 0}
+        self.stats = {"texts": 0, "tokens": 0, "cache_hits": 0, "batches": 0,
+                      "cache_evictions": 0}
 
     @classmethod
     def from_config(cls, emb_cfg) -> "Embedder":
@@ -51,7 +59,9 @@ class Embedder:
         endpoint — one place maps EmbedderConfig fields to kwargs."""
         return cls(model_name=emb_cfg.model, model_path=emb_cfg.model_path,
                    max_length=emb_cfg.max_length,
-                   batch_size=emb_cfg.batch_size)
+                   batch_size=emb_cfg.batch_size,
+                   cache_max_entries=getattr(emb_cfg, "cache_max_entries",
+                                             4096))
 
     @staticmethod
     def _key(text: str) -> str:
@@ -81,6 +91,7 @@ class Embedder:
             key = self._key(("q:" if is_query else "d:") + rendered)
             cached = self._cache.get(key)
             if cached is not None:
+                self._cache.move_to_end(key)  # LRU recency
                 out[i] = cached
                 self.stats["cache_hits"] += 1
             else:
@@ -105,11 +116,22 @@ class Embedder:
                 out[i] = embs[row]
             self.stats["batches"] += 1
 
-        # Fill cache after computing.
+        # Fill cache after computing, evicting least-recently-used entries
+        # past the cap (a duplicate within `texts` refreshes recency only).
         for i, text in enumerate(texts):
             rendered = (self.query_instruction + text) if is_query else text
             key = self._key(("q:" if is_query else "d:") + rendered)
-            self._cache.setdefault(key, out[i])
+            if key in self._cache:
+                self._cache.move_to_end(key)
+            elif self._cache_max:
+                # Copy, don't view: out[i] aliases the whole [N, dim]
+                # batch array — a cached view would pin the full batch in
+                # memory (defeating the cap) and share mutable memory
+                # with the caller's returned rows.
+                self._cache[key] = out[i].copy()
+                while len(self._cache) > self._cache_max:
+                    self._cache.popitem(last=False)
+                    self.stats["cache_evictions"] += 1
         self.stats["texts"] += len(texts)
         return out
 

@@ -369,7 +369,7 @@ def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
     return (norm * weight).astype(x.dtype)
 
 
-def forward_impl(
+def _forward_hidden(
     params: Params,
     cfg: LlamaConfig,
     tokens: jnp.ndarray,  # [B, T] int32 token ids for the current chunk
@@ -385,15 +385,12 @@ def forward_impl(
     adapter_ids: Optional[jnp.ndarray] = None,  # [B] int32 LoRA rows
     qmm_impl: str = "xla",
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One forward chunk. Returns (logits [B, T, vocab] f32, kv_k', kv_v').
+    """Transformer stack over one paged chunk, WITHOUT the LM head.
 
-    Raw (un-jitted) implementation so callers can inline it inside their own
-    compiled step functions — nested jit inside lax.scan hangs some remote
-    compile backends. ``attn_impl="pallas"`` selects the Pallas ragged paged
-    decode kernel when T == 1; with a TP ``mesh`` the kernel runs per
-    model-axis shard via shard_map (falling back to the XLA gather path only
-    when GQA heads don't divide the axis — the pool replicates there too).
-    Donate ``kv_k``/``kv_v`` at the jit call site for in-place page updates.
+    Returns (hidden [B, T, D], kv_k', kv_v'). Shared by
+    :func:`forward_impl` (full [B, T, vocab] logits) and
+    :func:`forward_ragged_impl` (mixed prefill+decode batches, which gather
+    the few rows they need before paying for the vocab projection).
     """
     b, t = tokens.shape
     hd, n_kv = cfg.head_dim, cfg.n_kv_heads
@@ -559,10 +556,100 @@ def forward_impl(
     h, (kv_k_new, kv_v_new) = jax.lax.scan(
         layer_step, h, (params["layers"], lora, kv_k, kv_v)
     )
-    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (h @ head).astype(jnp.float32)
-    return logits, kv_k_new, kv_v_new
+    return h, kv_k_new, kv_v_new
+
+
+def forward_impl(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [B, T] int32 token ids for the current chunk
+    positions: jnp.ndarray,  # [B, T] absolute positions (pad with pos of last real)
+    kv_k: jnp.ndarray,  # [n_layers, num_pages * page_size, n_kv, head_dim]
+    kv_v: jnp.ndarray,  # same
+    page_tables: jnp.ndarray,  # [B, max_pages]
+    ctx_lens: jnp.ndarray,  # [B] cache length AFTER this chunk
+    page_size: int,
+    block_pages: int = 32,
+    attn_impl: str = "xla",
+    mesh=None,
+    adapter_ids: Optional[jnp.ndarray] = None,  # [B] int32 LoRA rows
+    qmm_impl: str = "xla",
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One forward chunk. Returns (logits [B, T, vocab] f32, kv_k', kv_v').
+
+    Raw (un-jitted) implementation so callers can inline it inside their own
+    compiled step functions — nested jit inside lax.scan hangs some remote
+    compile backends. ``attn_impl="pallas"`` selects the Pallas ragged paged
+    decode kernel when T == 1; with a TP ``mesh`` the kernel runs per
+    model-axis shard via shard_map (falling back to the XLA gather path only
+    when GQA heads don't divide the axis — the pool replicates there too).
+    Donate ``kv_k``/``kv_v`` at the jit call site for in-place page updates.
+    """
+    h, kv_k_new, kv_v_new = _forward_hidden(
+        params, cfg, tokens, positions, kv_k, kv_v, page_tables, ctx_lens,
+        page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
+        mesh=mesh, adapter_ids=adapter_ids, qmm_impl=qmm_impl,
+    )
+    return lm_head_logits(params, cfg, h), kv_k_new, kv_v_new
+
+
+def forward_ragged_impl(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [N] int32 flat ragged token batch
+    positions: jnp.ndarray,  # [N] absolute positions (pads: trash position)
+    row_ids: jnp.ndarray,  # [N] int32 row (sequence) owning each token
+    kv_k: jnp.ndarray,
+    kv_v: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [R, max_pages(+1)] per-ROW page tables
+    ctx_lens: jnp.ndarray,  # [R] cache length AFTER this step, per row
+    sel_idx: jnp.ndarray,  # [S] flat token indices whose logits are wanted
+    page_size: int,
+    block_pages: int = 32,
+    attn_impl: str = "xla",
+    mesh=None,
+    adapter_ids: Optional[jnp.ndarray] = None,  # [R] int32 LoRA rows, per row
+    qmm_impl: str = "xla",
+    ragged_block: int = 8,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Mixed prefill+decode forward over ONE flat ragged token batch.
+
+    The serving entry for the unified mixed dispatch (PAPERS.md "Ragged
+    Paged Attention"): decode rows contribute one token each and prefill
+    rows a whole chunk, flattened into a single [N] buffer with per-token
+    ``row_ids`` selecting each token's page-table row / context length /
+    adapter.
+
+    Layout contract (the engine's builder upholds it): every row's token
+    run is contiguous ascending and starts at a multiple of
+    ``ragged_block``; pad tokens carry the trash position (their K/V land
+    in the reserved null page) and either their run's row id or a
+    dedicated null row with ``ctx_len = 0``. Under that alignment each
+    ``ragged_block``-sized block belongs to exactly one row, so the whole
+    stack runs as a [N/ragged_block, ragged_block] chunked forward with
+    per-BLOCK gathered tables — the same transform
+    :func:`runbookai_tpu.ops.attention.ragged_paged_attention` and the
+    Pallas ``paged_ragged_attention`` apply per attention call, hoisted
+    here above the layer scan so KV writes and page loads share it.
+
+    Returns (logits [S, vocab] f32 for the ``sel_idx`` tokens only — the
+    vocab projection is paid for S rows, not N — kv_k', kv_v').
+    """
+    n = tokens.shape[0]
+    rq = ragged_block
+    nb = n // rq
+    block_rows = row_ids.reshape(nb, rq)[:, 0]
+    h, kv_k_new, kv_v_new = _forward_hidden(
+        params, cfg, tokens.reshape(nb, rq), positions.reshape(nb, rq),
+        kv_k, kv_v, page_tables[block_rows], ctx_lens[block_rows],
+        page_size=page_size, block_pages=block_pages, attn_impl=attn_impl,
+        mesh=mesh,
+        adapter_ids=(adapter_ids[block_rows]
+                     if adapter_ids is not None else None),
+        qmm_impl=qmm_impl,
+    )
+    h_sel = h.reshape(n, h.shape[-1])[sel_idx]
+    return lm_head_logits(params, cfg, h_sel), kv_k_new, kv_v_new
 
 
 forward = partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages",

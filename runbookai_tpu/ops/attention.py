@@ -161,3 +161,52 @@ def paged_attention(
 
     out = acc / jnp.maximum(l[..., None], 1e-30)
     return out.reshape(b, t, n_q, d).astype(q.dtype)
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,  # [N, n_q, head_dim] — flat ragged token batch
+    k_flat: jnp.ndarray,  # [num_pages * page_size, n_kv, head_dim]
+    v_flat: jnp.ndarray,  # same
+    page_tables: jnp.ndarray,  # [R, max_pages] per-ROW page tables
+    ctx_lens: jnp.ndarray,  # [R] cached tokens per row (incl. this step's)
+    q_positions: jnp.ndarray,  # [N] absolute position of each query token
+    row_ids: jnp.ndarray,  # [N] row (sequence) owning each token
+    page_size: int,
+    block_pages: int = 32,
+    ragged_block: int = 8,
+) -> jnp.ndarray:
+    """Portable XLA ragged paged attention over a FLAT mixed token batch.
+
+    The segment-masked layout for the unified mixed prefill+decode dispatch
+    (PAPERS.md "Ragged Paged Attention"): decode rows contribute 1 token,
+    prefill rows a whole chunk, all flattened into one [N] buffer whose
+    per-token ``row_ids`` select the page table / context length to attend
+    through. Layout contract — each row's token run is contiguous and
+    starts at a multiple of ``ragged_block`` (the engine's mixed-batch
+    builder pads rows up to it) — so every ``ragged_block``-sized block
+    belongs to exactly one row and the flat batch collapses to a
+    [N/ragged_block, ragged_block] chunked call of :func:`paged_attention`
+    with per-block gathered tables: page blocks are fetched once per
+    ``ragged_block`` queries instead of once per token, and the existing
+    causal+ragged mask (position < ctx, position ≤ q_position) does the
+    segment masking. Pad tokens (trash positions / null rows with
+    ``ctx_len = 0``) produce finite garbage that callers discard.
+
+    This is the STANDALONE op (and the layout-contract reference, pinned
+    against per-sequence attention by tests/test_mixed_dispatch.py): the
+    serving forward does not call it per layer — ``forward_ragged_impl``
+    hoists this exact flat→blocked transform above its layer scan so the
+    KV-write gathers share it. Change the layout here and there together.
+
+    Returns [N, n_q, head_dim].
+    """
+    n, n_q, d = q.shape
+    rq = ragged_block
+    nb = n // rq
+    rows = row_ids.reshape(nb, rq)[:, 0]
+    out = paged_attention(
+        q.reshape(nb, rq, n_q, d), k_flat, v_flat,
+        page_tables[rows], ctx_lens[rows], q_positions.reshape(nb, rq),
+        page_size, block_pages=block_pages,
+    )
+    return out.reshape(n, n_q, d)

@@ -465,6 +465,51 @@ def paged_chunk_attention(
     return out[:, :t]
 
 
+def paged_ragged_attention(
+    q: jnp.ndarray,  # [N, n_q, hd] — flat ragged token batch
+    k_flat: jnp.ndarray,  # [num_pages * page_size, n_kv, hd]
+    v_flat: jnp.ndarray,  # same
+    page_tables: jnp.ndarray,  # [R, P] int32 per-ROW page tables
+    ctx_lens: jnp.ndarray,  # [R] int32 cache length incl. this step's tokens
+    q_positions: jnp.ndarray,  # [N] int32 absolute query positions
+    row_ids: jnp.ndarray,  # [N] int32 row owning each token
+    page_size: int,
+    ragged_block: int = 8,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Ragged paged attention over a FLAT mixed prefill+decode batch.
+
+    The per-row-ragged extension of :func:`paged_chunk_attention` for the
+    unified mixed dispatch (PAPERS.md "Ragged Paged Attention"): decode
+    rows feed 1 token, prefill rows a chunk, flattened into one [N]
+    buffer. Layout contract (the engine's mixed builder upholds it): each
+    row's token run is contiguous ascending and starts at a multiple of
+    ``ragged_block``, so every ``ragged_block``-sized q block belongs to
+    exactly one row — the flat batch maps onto the chunk kernel's
+    (sequence, q_block, page) grid with the q-block axis re-labelled by a
+    per-block row gather. Each grid step still scalar-prefetches the
+    owning row's page table and flash-accumulates in VMEM, and K/V pages
+    are fetched once per ``ragged_block`` queries rather than once per
+    token (the reason this beats running the decode kernel at B = N).
+    Per-row raggedness is carried by the per-block ``ctx_lens`` /
+    ``q_start`` scalars: a pad block (null row, ``ctx_len = 0``) skips
+    every accumulation and finalizes to zeros; pad tokens inside a real
+    row's last block act as later queries whose outputs the caller
+    discards (their K/V writes go to the null page via trash positions).
+
+    Returns [N, n_q, hd].
+    """
+    n, n_q, hd = q.shape
+    rq = ragged_block
+    nb = n // rq
+    rows = row_ids.reshape(nb, rq)[:, 0]
+    return paged_chunk_attention(
+        q.reshape(nb, rq, n_q, hd), k_flat, v_flat,
+        page_tables[rows], ctx_lens[rows], q_positions.reshape(nb, rq),
+        page_size=page_size, interpret=interpret, q_block=rq,
+    ).reshape(n, n_q, hd)
+
+
 def _decode_kernel_partial(
     # scalar prefetch:
     page_tables_ref,  # [B, P] int32 GLOBAL page ids (SMEM)

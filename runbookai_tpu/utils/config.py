@@ -229,6 +229,9 @@ class EmbedderConfig(BaseModel):
     dim: int = 768
     batch_size: int = 64
     max_length: int = 512
+    # LRU cap on the in-memory md5→embedding cache (entries). Bounds a
+    # days-long indexer process; ~dim·4 bytes per entry.
+    cache_max_entries: int = 4096
 
 
 class KnowledgeConfig(BaseModel):

@@ -48,6 +48,10 @@ QUEUE_WAIT_BUCKETS = TTFT_BUCKETS
 REQUEST_LATENCY_BUCKETS = E2E_BUCKETS
 TOOL_LATENCY_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                         10.0, 30.0, 60.0, 120.0)
+# Token counts per mixed prefill+decode dispatch (powers of two up to the
+# largest plausible mixed_token_budget) — a count histogram, not seconds.
+MIXED_TOKENS_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                        512.0, 1024.0, 2048.0, 4096.0)
 
 
 def _escape_label_value(value: str) -> str:

@@ -112,6 +112,37 @@ def test_embedder_batching_cache_and_determinism():
     assert cosine_similarity(q, vecs[0]) < 0.9999
 
 
+def test_embedder_cache_is_lru_bounded():
+    """The md5 cache must not grow without bound in a days-long indexer
+    process: LRU eviction past the cap, recency refresh on hit, and an
+    eviction stat so a soak can watch it."""
+    emb = Embedder(model_name="bge-test", batch_size=4, max_length=64,
+                   cache_max_entries=3)
+    out = emb.embed_texts(["a", "b", "c"])
+    assert len(emb._cache) == 3 and emb.stats["cache_evictions"] == 0
+    # Entries are owned copies: a view of the batch array would pin the
+    # whole [N, dim] base (defeating the cap) and alias caller memory.
+    assert all(v.base is None for v in emb._cache.values())
+    out[0][:] = 0.0  # caller mutates its returned row
+    np.testing.assert_allclose(
+        np.linalg.norm(emb.embed_texts(["a"])[0]), 1.0, rtol=1e-4)
+    emb.embed_texts(["a"])  # refreshes "a" to most-recent
+    emb.embed_texts(["d"])  # evicts the LRU entry — "b", not "a"
+    assert len(emb._cache) == 3
+    assert emb.stats["cache_evictions"] == 1
+    hits0 = emb.stats["cache_hits"]
+    emb.embed_texts(["a", "d"])  # both still resident
+    assert emb.stats["cache_hits"] == hits0 + 2
+    emb.embed_texts(["b"])  # "b" was evicted: recompute, evict again
+    assert emb.stats["cache_evictions"] == 2
+    assert len(emb._cache) == 3
+    # cache_max_entries=0 disables caching entirely (and never grows).
+    off = Embedder(model_name="bge-test", max_length=64,
+                   cache_max_entries=0)
+    off.embed_texts(["x", "y"])
+    assert len(off._cache) == 0
+
+
 def test_vector_store_topk(store):
     vs = VectorStore(store.db)
     rng = np.random.default_rng(0)

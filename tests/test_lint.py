@@ -709,8 +709,14 @@ class TestTreeIsClean:
             "_probe_pallas_attn_cached": 3,
             "_probe_pallas_attn_int8_cached": 1,
             "_probe_qmm_pallas_cached": 1,
+            "_probe_pallas_ragged_cached": 1,
             # Per-prefill-dispatch first-token fetch (TTFT emission):
             "_run_prefill": 1,
+            # Per-mixed-dispatch first-token fetch: same TTFT emission
+            # point as _run_prefill's, for prefill rows that complete
+            # inside a unified mixed dispatch (decode rows stay in the
+            # async-egress window and never add a sync):
+            "_run_mixed": 1,
             # Logprob triple fetch ([B, K+1], logprob requests only):
             "_append_logprob_entries": 1,
             # THE decode-loop token fetch (async egress consumption):
