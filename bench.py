@@ -25,7 +25,14 @@ BENCH_PROBE_ISO, BENCH_WATCHDOG, BENCH_ATTN, BENCH_PREFILL_BATCH,
 BENCH_OVERLAP (=0 forces synchronous decode; `--no-overlap` sets it, so
 the overlapped-pipeline A/B is one flag on hardware), BENCH_MIXED (=0 /
 `--no-mixed` forces the split prefill/decode dispatches, =1 forces the
-unified mixed dispatch; unset leaves the engine's auto policy).
+unified mixed dispatch; unset leaves the engine's auto policy),
+BENCH_DP (`--dp N`: serve the SAME request set through a data-parallel
+engine fleet — N replicas splitting the slot/page budget, fronted by the
+prefix-affinity router; details carry per-replica throughput, affinity
+hit ratio and imbalance, and `outputs_digest` proves per-request streams
+byte-identical across the dp=1/dp=N arms), BENCH_SHARED_PREFIX (first S
+prompt tokens shared across requests, exercising the router's
+prefix-affinity path; default 0 keeps the historical prompt series).
 """
 
 from __future__ import annotations
@@ -82,6 +89,24 @@ def emit(value: float, unit: str, details: dict) -> None:
 
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def reset_warmup_metrics(core) -> None:
+    """Zero the step counters + latency histograms after warmup, so every
+    arm's measured window excludes compile-time traffic. ONE helper for
+    the dp=1 and fleet arms — two hand-maintained key lists would drift
+    the A/B the first time a new counter lands (cached_prefix_tokens and
+    preemptions reset too: both are reported per measured window)."""
+    core.metrics.update(
+        decode_tokens=0, decode_steps=0, prefill_tokens=0,
+        cached_prefix_tokens=0, preemptions=0,
+        decode_time_s=0.0, prefill_time_s=0.0,
+        decode_dispatch_time_s=0.0, decode_host_time_s=0.0,
+        decode_host_overlap_s=0.0, prefill_steps=0,
+        decode_dispatches=0, mixed_steps=0, mixed_tokens=0,
+        mixed_time_s=0.0)
+    core.hist_ttft.reset()
+    core.hist_tpot.reset()
 
 
 def _parses(text: str) -> bool:
@@ -415,16 +440,50 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
             num_pages=DRAFT_POOL_PAGES, attn_impl=ecfg.attn_impl)
 
     masker = JsonMaskProvider(tok)
+
+    rng = np.random.default_rng(0)
+    # Optional shared prompt head (BENCH_SHARED_PREFIX tokens): the same
+    # leading pages across requests, so the fleet router's prefix-affinity
+    # path is exercised. Drawn FIRST so the per-request tails line up
+    # between the dp=1 and dp=N arms regardless of the setting.
+    shared_len = min(int(os.environ.get("BENCH_SHARED_PREFIX", 0)),
+                     max(prompt_len - 1, 0))
+    shared_prefix = (rng.integers(0, 256, size=shared_len).tolist()
+                     if shared_len else [])
+
+    def make_prompt() -> list:
+        tail = rng.integers(0, 256, size=prompt_len - shared_len).tolist()
+        return shared_prefix + tail
+
+    def outputs_digest(token_lists) -> str:
+        """Digest of every request's output token stream, in submission
+        order — equal digests across the dp=1 and dp=N arms prove the
+        fleet served byte-identical per-request streams."""
+        import hashlib
+
+        return hashlib.md5(json.dumps(
+            [list(map(int, ids)) for ids in token_lists]).encode()
+        ).hexdigest()
+
+    dp = max(1, int(os.environ.get("BENCH_DP", "1") or 1))
+    if dp > 1:
+        run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe,
+                        n_requests=n_requests, prompt_len=prompt_len,
+                        new_tokens=new_tokens, make_prompt=make_prompt,
+                        outputs_digest=outputs_digest, on_accel=on_accel,
+                        quantized=quantized, weights_path=weights_path,
+                        draft_cfg=dcfg, draft_params=dparams,
+                        draft_name=draft_name,
+                        draft_pool_pages=DRAFT_POOL_PAGES)
+        return
+
     core = EngineCore(cfg, params, tok, ecfg,
                       mask_fn=masker.mask, advance_fn=masker.advance,
                       draft_worker=draft_worker)
 
-    rng = np.random.default_rng(0)
-
     def make_req(max_new=new_tokens, guided=None):
-        prompt = rng.integers(0, 256, size=prompt_len).tolist()
         return EngineRequest(
-            prompt_ids=prompt,
+            prompt_ids=make_prompt(),
             sampling=SamplingParams(temperature=0.0, max_new_tokens=max_new,
                                     stop_token_ids=(), guided=guided),
         )
@@ -437,16 +496,9 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     for _ in range(min(slots, n_requests)):
         core.submit(make_req(max_new=new_tokens if slots > 1 else 4))
     core.run_until_idle()
-    core.metrics.update(decode_tokens=0, decode_steps=0, prefill_tokens=0,
-                        decode_time_s=0.0, prefill_time_s=0.0,
-                        decode_dispatch_time_s=0.0, decode_host_time_s=0.0,
-                        decode_host_overlap_s=0.0, prefill_steps=0,
-                        decode_dispatches=0, mixed_steps=0, mixed_tokens=0,
-                        mixed_time_s=0.0)
-    # Latency histograms (utils/metrics.py) restart with the measured run
-    # so the p95s below exclude warmup-compile TTFTs.
-    core.hist_ttft.reset()
-    core.hist_tpot.reset()
+    # Counters + latency histograms restart with the measured run so the
+    # p95s below exclude warmup-compile TTFTs.
+    reset_warmup_metrics(core)
 
     reqs = [make_req() for _ in range(n_requests)]
     t0 = time.perf_counter()
@@ -522,6 +574,7 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
             m.get("decode_host_overlap_s", 0.0)
             / max(m.get("decode_host_time_s", 0.0), 1e-9), 3),
         "preemptions": m["preemptions"],
+        "outputs_digest": outputs_digest([r.all_out_ids for r in reqs]),
         "spec_drafted": m.get("spec_drafted", 0),
         "spec_accepted": m.get("spec_accepted", 0),
         "draft_model": draft_name,
@@ -570,6 +623,142 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     emit(round(decode_tps, 2), "tok/s", details)
 
 
+def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
+                    n_requests, prompt_len, new_tokens, make_prompt,
+                    outputs_digest, on_accel, quantized, weights_path,
+                    draft_cfg=None, draft_params=None, draft_name=None,
+                    draft_pool_pages=256) -> None:
+    """The ``--dp N`` arm: the SAME request set through a data-parallel
+    engine fleet. The slot/page budget splits across replicas (fixed total
+    resources, like a pod slicing its chips along the dp axis — the split
+    is exact, never rounded UP past the dp=1 arm's budget), each replica's
+    AsyncEngine loop steps on its own worker thread, and the
+    prefix-affinity router places every request. BENCH_DRAFT builds one
+    draft worker per replica so a speculative A/B stays symmetric. The
+    headline is the aggregate decode rate over the concurrent window
+    (total decode tokens / the busiest replica's decode wall);
+    ``outputs_digest`` must equal the dp=1 arm's — routing chooses a
+    replica, never changes a stream."""
+    import asyncio
+    import dataclasses as _dc
+    import time as _time
+
+    import jax.numpy as jnp
+
+    from runbookai_tpu.engine.fleet import AsyncFleet, build_engine_fleet
+    from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+    from runbookai_tpu.utils.weights import quality_marker
+
+    slots_total = ecfg.max_batch_slots
+    slots_per = max(1, slots_total // dp)
+    ecfg = _dc.replace(
+        ecfg, dp_replicas=dp,
+        max_batch_slots=slots_per,
+        # Exact split (allocator minimum 2): a floor that rounds the
+        # per-replica pool UP would hand the fleet arm more total pages
+        # than dp=1 and fake a win via fewer preemptions.
+        num_pages=max(2, ecfg.num_pages // dp),
+        prefill_batch=max(1, min(ecfg.prefill_batch, slots_per)),
+    )
+    draft_factory = None
+    if draft_params is not None:
+        from runbookai_tpu.engine.draft import DraftWorker
+
+        def draft_factory(_idx: int) -> "DraftWorker":
+            return DraftWorker(
+                draft_cfg, draft_params, max_batch_slots=slots_per,
+                max_seq_len=ecfg.max_seq_len, page_size=ecfg.page_size,
+                num_pages=max(2, draft_pool_pages // dp),
+                attn_impl=ecfg.attn_impl)
+    cores = build_engine_fleet(cfg, params, tok, ecfg,
+                               mask_fn=masker.mask,
+                               advance_fn=masker.advance,
+                               draft_worker_factory=draft_factory)
+
+    # Warmup compiles every program shape per replica (each replica's
+    # device slice is its own executable), consuming exactly the same rng
+    # draws as the dp=1 arm so the measured prompts line up across arms.
+    warm = min(slots_total, n_requests)
+    for w in range(warm):
+        cores[w % dp].submit(EngineRequest(
+            prompt_ids=make_prompt(),
+            sampling=SamplingParams(temperature=0.0,
+                                    max_new_tokens=new_tokens,
+                                    stop_token_ids=())))
+    for core in cores:
+        core.run_until_idle()
+        reset_warmup_metrics(core)
+
+    fleet = AsyncFleet(cores)
+    prompts = [make_prompt() for _ in range(n_requests)]
+    sampling = SamplingParams(temperature=0.0, max_new_tokens=new_tokens,
+                              stop_token_ids=())
+
+    async def _run():
+        outs = await asyncio.gather(*[
+            fleet.generate(p, sampling) for p in prompts])
+        await fleet.stop()
+        return outs
+
+    t0 = _time.perf_counter()
+    outs = asyncio.run(_run())
+    wall = _time.perf_counter() - t0
+
+    # Lost = aborted/shed (a stop-token finish is a legitimate completion;
+    # byte-identity across arms is what outputs_digest pins).
+    lost = sum(1 for o in outs if o.finish_reason.value == "aborted")
+    total_decode = sum(c.metrics["decode_tokens"] for c in cores)
+    max_decode_t = max(c.metrics["decode_time_s"] for c in cores)
+    routed = fleet.routed_counts()
+    per_replica = [{
+        "replica": i,
+        "requests_routed": routed[i],
+        "decode_tokens": c.metrics["decode_tokens"],
+        "decode_time_s": round(c.metrics["decode_time_s"], 3),
+        "tok_s": round(c.metrics["decode_tokens"]
+                       / max(c.metrics["decode_time_s"], 1e-9), 2),
+        "prefill_tokens": c.metrics["prefill_tokens"],
+        "cached_prefix_tokens": c.metrics["cached_prefix_tokens"],
+        "spec_drafted": c.metrics.get("spec_drafted", 0),
+        "spec_accepted": c.metrics.get("spec_accepted", 0),
+    } for i, c in enumerate(cores)]
+    ttfts = sorted(o.ttft_ms for o in outs if o.ttft_ms is not None)
+    details = {
+        "model": cfg.name,
+        "weights": "int8" if quantized else "float32",
+        "quality": quality_marker(weights_path),
+        "platform": probe.get("platform"),
+        "device_kind": probe.get("kind"),
+        "dp": dp,
+        "attn_impl": cores[0].ecfg.attn_impl,
+        "kv_dtype": str(jnp.dtype(ecfg.kv_dtype).name),
+        "requests": n_requests,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "batch_slots_per_replica": ecfg.max_batch_slots,
+        "num_pages_per_replica": ecfg.num_pages,
+        "num_pages_total": ecfg.num_pages * dp,
+        "draft_model": draft_name,
+        "shared_prefix": int(os.environ.get("BENCH_SHARED_PREFIX", 0)),
+        "wall_s": round(wall, 2),
+        "total_tokens": total_decode + sum(c.metrics["prefill_tokens"]
+                                           for c in cores),
+        "total_throughput_tok_s": round(
+            (total_decode + sum(c.metrics["prefill_tokens"]
+                                for c in cores)) / wall, 2),
+        "decode_tps_sum_per_replica": round(
+            sum(r["tok_s"] for r in per_replica), 2),
+        "p50_ttft_ms": (round(ttfts[len(ttfts) // 2], 1) if ttfts else None),
+        "lost_requests": lost,
+        "outputs_digest": outputs_digest([o.token_ids for o in outs]),
+        "per_replica": per_replica,
+        "affinity_hit_ratio": round(fleet.affinity_hit_ratio(), 4),
+        "imbalance_ratio": round(fleet._imbalance(), 4),
+        "router_retries": int(fleet._m_retries.value),
+    }
+    emit(round(total_decode / max(max_decode_t, 1e-9), 2), "tok/s", details)
+
+
 def bench_bge_encode() -> dict:
     """Secondary metric: bge-base embedding throughput (BASELINE.md config 3
     — knowledge-index encode). Random-init weights, identical compute."""
@@ -603,7 +792,9 @@ def run_inner(model_name: str, on_accel: bool, probe: dict) -> None:
     if not on_accel:
         from runbookai_tpu.utils.cpu_mesh import force_cpu_platform
 
-        force_cpu_platform(1)
+        # A CPU fleet needs one virtual device per replica so each
+        # replica's compiled steps run on its own device slice.
+        force_cpu_platform(max(1, int(os.environ.get("BENCH_DP", "1") or 1)))
     try:
         run_bench(model_name, on_accel, probe)
     except Exception as e:  # noqa: BLE001 — always emit a parseable line
@@ -656,6 +847,15 @@ def main() -> None:
     if "--no-mixed" in sys.argv:
         sys.argv.remove("--no-mixed")
         os.environ["BENCH_MIXED"] = "0"
+    if "--dp" in sys.argv:
+        # Data-parallel fleet A/B: `--dp N` serves the same request set
+        # through N engine replicas behind the prefix-affinity router.
+        i = sys.argv.index("--dp")
+        sys.argv.pop(i)
+        if i >= len(sys.argv) or not sys.argv[i].isdigit():
+            print("usage: bench.py --dp N (replica count)", file=sys.stderr)
+            sys.exit(2)
+        os.environ["BENCH_DP"] = sys.argv.pop(i)
     if len(sys.argv) > 1 and sys.argv[1] == "--inner":
         run_inner(sys.argv[2], sys.argv[3] == "1", json.loads(sys.argv[4]))
         return
@@ -675,9 +875,16 @@ def main() -> None:
     # hardware (VERDICT r2 next-round #10). Cheap (~1 min) on the tiny model.
     cpu_probe = {"ok": True, "platform": "cpu", "kind": "cpu", "n": 1}
     sanity_budget = min(480.0, max(60.0, watchdog_s - (time.monotonic() - t0) - 600.0))
-    cpu_sanity = _spawn_inner(
-        os.environ.get("BENCH_CPU_MODEL", "llama3-test"), False, cpu_probe,
-        sanity_budget)
+    # The sanity line is the round-over-round single-engine series; a --dp
+    # run must not switch it to fleet mode (env restored right after).
+    dp_env = os.environ.pop("BENCH_DP", None)
+    try:
+        cpu_sanity = _spawn_inner(
+            os.environ.get("BENCH_CPU_MODEL", "llama3-test"), False,
+            cpu_probe, sanity_budget)
+    finally:
+        if dp_env is not None:
+            os.environ["BENCH_DP"] = dp_env
     sanity_line = None
     if cpu_sanity is not None:
         d = cpu_sanity.get("details", {})
@@ -704,8 +911,11 @@ def main() -> None:
         print(json.dumps(result), flush=True)
 
     if not on_accel and cpu_sanity is not None and \
+            os.environ.get("BENCH_DP", "1") in ("", "1") and \
             os.environ.get("BENCH_CPU_MODEL", "llama3-test") == model_name:
-        # The fallback headline IS the cpu-sanity config — don't run it twice.
+        # The fallback headline IS the cpu-sanity config — don't run it
+        # twice. (A --dp run's headline is the fleet arm, which the dp=1
+        # sanity line deliberately is not.)
         result = cpu_sanity
         result.setdefault("details", {})["tpu_error"] = probe.get("error")
         finish(result)

@@ -473,8 +473,14 @@ def cmd_eval(args) -> int:
     )
 
     if args.run_all:
-        from runbookai_tpu.evalsuite.run_all import run_all_benchmarks
+        from runbookai_tpu.evalsuite.run_all import parse_shard, run_all_benchmarks
 
+        try:
+            shard = (parse_shard(args.shard)
+                     if getattr(args, "shard", None) else None)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 1
         runner = None
         if not args.offline:
             from runbookai_tpu.cli.runtime import build_runtime
@@ -485,7 +491,7 @@ def cmd_eval(args) -> int:
         aggregate = run_all_benchmarks(
             datasets_root=args.datasets_root, out_dir=args.out,
             runner=runner, min_pass_rate=args.min_pass_rate,
-            setup=args.setup_datasets)
+            setup=args.setup_datasets, shard=shard)
         print(json.dumps(aggregate, indent=2, default=str))
         return 0 if aggregate["failed"] == 0 else 1
 
@@ -1050,6 +1056,10 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--datasets-root", default="examples/evals/datasets")
     ev.add_argument("--setup-datasets", action="store_true",
                     help="git-clone missing dataset repos first")
+    ev.add_argument("--shard", default=None, metavar="I/N",
+                    help="with --all: statically take cases i::n on this "
+                         "host ('auto' = this process's multihost rank); "
+                         "the engine fleet balances within the shard")
     ev.set_defaults(fn=cmd_eval)
 
     serve = sub.add_parser(

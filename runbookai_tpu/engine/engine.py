@@ -130,6 +130,16 @@ class EngineConfig:
     # Per-step token budget of a mixed dispatch: decode slots (1 token
     # each) + prefill chunk tokens. None = prefill_chunk + max_batch_slots.
     mixed_token_budget: Optional[int] = None
+    # Data-parallel engine fleet (engine/fleet.py): construct this many
+    # EngineCore replicas, each pinned to a disjoint device slice of the
+    # dp axis, behind a prefix-affinity router with a least-loaded
+    # tiebreak. 1 = the classic single engine; >1 makes JaxTpuClient (and
+    # every surface behind it — OpenAI server, MCP, agent runtime, eval
+    # suite) serve through an AsyncFleet. Slots/pages in this config are
+    # PER REPLICA. On CPU tier-1 the replicas land on the virtual mesh's
+    # devices; on a pod each host builds replicas over its local slice
+    # (parallel/multihost.local_replica_range).
+    dp_replicas: int = 1
 
 
 @partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl",
@@ -553,6 +563,54 @@ def _bump_counts_batch(counts, rows, toks, live):
     return counts.at[rows, toks].add(live.astype(jnp.int32))
 
 
+# The legacy step-counter dict keys re-exported as Prometheus counters via
+# scrape-time callbacks: (metrics-dict key, metric name, help). Module-level
+# so the fleet can re-bind the same names to cross-replica sums — one table,
+# no drift between single-engine and fleet exports.
+LEGACY_COUNTER_EXPORTS: tuple[tuple[str, str, str], ...] = (
+    ("decode_tokens", "runbook_decode_tokens_total",
+     "Tokens sampled by decode dispatches"),
+    ("decode_steps", "runbook_decode_steps_total",
+     "Decode dispatches"),
+    ("prefill_tokens", "runbook_prefill_tokens_total",
+     "Prompt tokens prefilled"),
+    ("preemptions", "runbook_preemptions_total",
+     "Requests preempted by recompute under pool pressure"),
+    ("cached_prefix_tokens", "runbook_cached_prefix_tokens_total",
+     "Prompt tokens served from the prefix cache"),
+    ("spec_drafted", "runbook_spec_drafted_total",
+     "Speculative tokens drafted"),
+    ("spec_accepted", "runbook_spec_accepted_total",
+     "Speculative tokens accepted"),
+    ("grammar_forced_tokens", "runbook_grammar_forced_tokens_total",
+     "Tokens emitted by grammar fast-forward without a dispatch"),
+    ("decode_time_s", "runbook_decode_time_seconds_total",
+     "Wall-clock spent in decode dispatches"),
+    ("prefill_time_s", "runbook_prefill_time_seconds_total",
+     "Wall-clock spent in prefill dispatches"),
+    ("decode_dispatch_time_s", "runbook_decode_dispatch_seconds_total",
+     "Decode wall-clock blocked on device work (dispatch issue + "
+     "token egress wait)"),
+    ("decode_host_time_s", "runbook_decode_host_overhead_seconds",
+     "Decode wall-clock spent on host work (input prep, "
+     "detokenization, stop scans, stream emission)"),
+    ("decode_host_overlap_s",
+     "runbook_decode_host_overlapped_seconds_total",
+     "Host decode work that ran while a dispatch was in flight"),
+    ("prefill_steps", "runbook_prefill_dispatch_total",
+     "Pure prefill dispatches"),
+    ("decode_dispatches", "runbook_decode_dispatch_total",
+     "Pure decode dispatches (single, multi-step, and spec-verify)"),
+    ("mixed_steps", "runbook_mixed_dispatch_total",
+     "Unified mixed prefill+decode dispatches (one ragged forward "
+     "serving both phases)"),
+    ("mixed_tokens", "runbook_mixed_tokens_total",
+     "Real tokens processed by mixed dispatches"),
+    ("mixed_time_s", "runbook_mixed_time_seconds_total",
+     "Wall-clock spent building and issuing mixed dispatches"),
+)
+
+
 _TOPK_LOGPROBS = 20  # OpenAI's top_logprobs ceiling; one compiled shape
 
 
@@ -626,9 +684,16 @@ class EngineCore:
         mesh=None,
         lora_registry=None,
         draft_worker=None,
+        replica_idx: Optional[int] = None,
     ):
         self.cfg = model_cfg
         self.ecfg = engine_cfg or EngineConfig()
+        # Fleet membership (engine/fleet.py): replica ``i`` namespaces every
+        # admitted request id with ``r{i}-`` so two replicas admitting the
+        # same caller id can never collide in the shared Tracer/registry,
+        # and stamps its index on trace records. None = standalone engine.
+        self.replica_idx = replica_idx
+        self._rid_prefix = f"r{replica_idx}-" if replica_idx is not None else ""
         self.params = params
         # Multi-LoRA: the stacked adapter pytree rides inside params so the
         # compiled steps see one tree; per-dispatch adapter_ids rows select
@@ -843,9 +908,23 @@ class EngineCore:
         there is exactly one source of truth and zero per-step overhead.
         Registration is get-or-create and ``set_function`` replaces the
         previous callback, so rebuilding an engine in-process (tests,
-        bench children) re-binds the gauges to the newest core.
+        bench children) re-binds the gauges to the newest core. A
+        standalone engine also clears any per-replica labeled callbacks a
+        previous FLEET left behind (fleet.py's ``_install_metrics``
+        re-binds them when a fleet is current): without this, falling
+        back from dp>1 to a single engine would keep scraping the dead
+        replicas' cores — and pinning their params — forever.
         """
         reg, m = self.registry, metrics_mod
+        if self.replica_idx is None:
+            for name in ("runbook_replica_running_requests",
+                         "runbook_replica_waiting_requests",
+                         "runbook_replica_kv_pool_utilization",
+                         "runbook_replica_decode_tokens_total",
+                         "runbook_router_imbalance_ratio"):
+                stale = reg.get(name)
+                if stale is not None:
+                    stale.clear_functions()
         self.hist_ttft = reg.histogram(
             "runbook_ttft_seconds", "Time to first token per request",
             buckets=m.TTFT_BUCKETS)
@@ -887,48 +966,7 @@ class EngineCore:
         reg.gauge("runbook_prefix_cache_hit_ratio",
                   "Cached prompt tokens / (cached + prefilled) since start"
                   ).set_function(self._prefix_hit_ratio)
-        for key, name, help_text in (
-            ("decode_tokens", "runbook_decode_tokens_total",
-             "Tokens sampled by decode dispatches"),
-            ("decode_steps", "runbook_decode_steps_total",
-             "Decode dispatches"),
-            ("prefill_tokens", "runbook_prefill_tokens_total",
-             "Prompt tokens prefilled"),
-            ("preemptions", "runbook_preemptions_total",
-             "Requests preempted by recompute under pool pressure"),
-            ("cached_prefix_tokens", "runbook_cached_prefix_tokens_total",
-             "Prompt tokens served from the prefix cache"),
-            ("spec_drafted", "runbook_spec_drafted_total",
-             "Speculative tokens drafted"),
-            ("spec_accepted", "runbook_spec_accepted_total",
-             "Speculative tokens accepted"),
-            ("grammar_forced_tokens", "runbook_grammar_forced_tokens_total",
-             "Tokens emitted by grammar fast-forward without a dispatch"),
-            ("decode_time_s", "runbook_decode_time_seconds_total",
-             "Wall-clock spent in decode dispatches"),
-            ("prefill_time_s", "runbook_prefill_time_seconds_total",
-             "Wall-clock spent in prefill dispatches"),
-            ("decode_dispatch_time_s", "runbook_decode_dispatch_seconds_total",
-             "Decode wall-clock blocked on device work (dispatch issue + "
-             "token egress wait)"),
-            ("decode_host_time_s", "runbook_decode_host_overhead_seconds",
-             "Decode wall-clock spent on host work (input prep, "
-             "detokenization, stop scans, stream emission)"),
-            ("decode_host_overlap_s",
-             "runbook_decode_host_overlapped_seconds_total",
-             "Host decode work that ran while a dispatch was in flight"),
-            ("prefill_steps", "runbook_prefill_dispatch_total",
-             "Pure prefill dispatches"),
-            ("decode_dispatches", "runbook_decode_dispatch_total",
-             "Pure decode dispatches (single, multi-step, and spec-verify)"),
-            ("mixed_steps", "runbook_mixed_dispatch_total",
-             "Unified mixed prefill+decode dispatches (one ragged forward "
-             "serving both phases)"),
-            ("mixed_tokens", "runbook_mixed_tokens_total",
-             "Real tokens processed by mixed dispatches"),
-            ("mixed_time_s", "runbook_mixed_time_seconds_total",
-             "Wall-clock spent building and issuing mixed dispatches"),
-        ):
+        for key, name, help_text in LEGACY_COUNTER_EXPORTS:
             reg.counter(name, help_text).set_function(
                 lambda k=key: float(self.metrics.get(k, 0)))
         reg.gauge("runbook_decode_overlap_ratio",
@@ -955,6 +993,12 @@ class EngineCore:
             self.params["lora"] = self.lora.stacked()
 
     def submit(self, req: EngineRequest) -> None:
+        if self._rid_prefix and not req.request_id.startswith(self._rid_prefix):
+            # Replica namespace: the engine-internal id gains the r{idx}-
+            # prefix (tracer JSONL, KV seq ids, abort lookups); the
+            # caller's x-request-id travels separately as trace_id and is
+            # echoed unchanged.
+            req.request_id = self._rid_prefix + req.request_id
         if not req.prompt_ids:
             req.prompt_ids = [self.tokenizer.bos_id]
         if req.adapter is not None:
@@ -1276,6 +1320,8 @@ class EngineCore:
         meta = {"request": req.request_id,
                 "reason": req.finish_reason.value if req.finish_reason else None,
                 "generated": req.num_generated}
+        if self.replica_idx is not None:
+            meta["replica"] = self.replica_idx
         if req.ttft_ms is not None:
             meta["ttft_ms"] = round(req.ttft_ms, 3)
         if req.trace_id is not None:

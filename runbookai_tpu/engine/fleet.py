@@ -1,0 +1,567 @@
+"""Data-parallel engine fleet: N ``EngineCore`` replicas behind a
+prefix-affinity router.
+
+The serving comparison literature is unambiguous that above the engine, the
+two highest-leverage pod-scale moves are (1) data-parallel replica scaling —
+most of Gemma-on-TPU's pod throughput comes from replicas, not deeper model
+sharding — and (2) prefix-cache-aware request routing across those replicas
+(AIBrix, arXiv:2504.03648). This module is both:
+
+- :func:`build_engine_fleet` constructs ``EngineConfig.dp_replicas``
+  independent :class:`~runbookai_tpu.engine.engine.EngineCore` replicas,
+  each pinned to a disjoint device slice of the dp axis
+  (``parallel/mesh.replica_device_slices``). Replicas never communicate
+  inside compiled programs — weights are replicated, KV pools are private —
+  so the fleet scales the *data* axis of ``parallel/mesh.py`` without
+  touching the TP/seq story within a replica. On CPU tier-1 the replicas
+  land on the virtual mesh's devices (or share the default device when the
+  platform exposes only one).
+
+- :class:`AsyncFleet` fronts the replicas with the exact
+  ``generate``/``generate_stream``/``start``/``stop``/``refresh_lora``
+  surface of :class:`~runbookai_tpu.engine.async_engine.AsyncEngine`, so
+  ``server/openai_api.py``, ``server/mcp.py``, the agent runtime and the
+  eval suite all switch to a fleet behind the one-line config change
+  ``EngineConfig.dp_replicas`` (``llm.dp_replicas`` in config files).
+
+Routing policy (:meth:`AsyncFleet._route`): hash the prompt's full pages
+once (``kv_cache.hash_blocks``) and probe every replica's
+``KVCacheManager.match_prefix`` — requests sharing a system prompt land on
+the replica already holding those pages, so agent iterations ride the
+prefix cache instead of re-prefilling on a cold replica. Affinity is
+load-guarded: a matching replica wins only while its live load stays
+within ``affinity_load_slack`` of the least-loaded replica (a hot prefix
+must not pile the whole pod onto one engine). With no usable match,
+placement is least-loaded with a round-robin tiebreak. Overflow sheds
+(``shed_queue_depth``) and a replica that aborts on pool pressure gets the
+request retried on its siblings (``max_retries``).
+
+Per-request streams are byte-identical to the single-engine path: the
+router only *chooses* a replica; the chosen ``AsyncEngine`` serves the
+request exactly as a standalone engine would.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from contextvars import ContextVar
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence
+
+from runbookai_tpu.engine.async_engine import AsyncEngine
+from runbookai_tpu.engine.engine import (
+    LEGACY_COUNTER_EXPORTS,
+    EngineConfig,
+    EngineCore,
+)
+from runbookai_tpu.engine.kv_cache import hash_blocks
+from runbookai_tpu.engine.request import (
+    EngineOutput,
+    FinishReason,
+    FleetSaturated,
+    SamplingParams,
+)
+from runbookai_tpu.utils import metrics as metrics_mod
+
+# Per-asyncio-task eval-case attribution: the eval runner sets this around
+# each case (AsyncFleet.begin_case/end_case) and contextvars flow through
+# awaits, so every engine call a case makes — however deep in the agent
+# stack — is attributed to it without plumbing ids through the orchestrator.
+CURRENT_CASE: ContextVar[Optional[str]] = ContextVar(
+    "runbook_fleet_case", default=None)
+
+# Bound on the routed-case attribution map: entries are popped by
+# case_routes(); a caller that never collects them must not leak memory.
+_CASE_ROUTES_MAX = 4096
+
+
+@dataclass
+class FleetConfig:
+    """Router policy knobs (docs/SERVING.md)."""
+
+    # Prefix-affinity placement on/off (off = pure least-loaded).
+    affinity: bool = True
+    # A prefix-matching replica may exceed the least-loaded replica's live
+    # load by at most this many requests and still win placement. None =
+    # one batch's worth (the replica's max_batch_slots): affinity is worth
+    # at most one slot-generation of queueing, never a pile-up.
+    affinity_load_slack: Optional[int] = None
+    # Shed (synthetic abort / FleetSaturated, no submission) when EVERY
+    # replica's waiting queue is at least this deep. None = never shed.
+    shed_queue_depth: Optional[int] = None
+    # Cross-replica retries when a replica aborts a request on pool
+    # pressure. None = up to every other replica once.
+    max_retries: Optional[int] = None
+
+
+def build_engine_fleet(
+    model_cfg,
+    params,
+    tokenizer,
+    engine_cfg: Optional[EngineConfig] = None,
+    *,
+    mask_fn=None,
+    advance_fn=None,
+    seed: int = 0,
+    tracer=None,
+    lora_registry=None,
+    draft_worker_factory: Optional[Callable[[int], Any]] = None,
+    devices: Optional[Sequence[Any]] = None,
+    replica_indices: Optional[Sequence[int]] = None,
+) -> list[EngineCore]:
+    """Construct the fleet's ``EngineCore`` replicas.
+
+    Each replica ``i`` gets ``replica_idx=i`` (request-id namespace
+    ``r{i}-``) and — when the host exposes enough devices — its own
+    single-slice mesh with the params replicated onto it, so its compiled
+    steps and KV pool live entirely on its slice of the dp axis. With too
+    few devices (single-device CPU), replicas share the default device:
+    N independent engines whose dispatch loops interleave on it.
+
+    ``replica_indices`` restricts construction to a subset of the global
+    fleet — each pod host passes ``multihost.local_replica_range(dp)`` with
+    ``devices=jax.local_devices()`` so replicas never span hosts.
+    ``draft_worker_factory(i)`` builds a per-replica draft worker (one
+    worker cannot serve two cores — its slot state is per-engine).
+    """
+    import jax
+
+    from runbookai_tpu.parallel.mesh import (
+        build_mesh,
+        replica_device_slices,
+        replicated,
+    )
+
+    ecfg = engine_cfg or EngineConfig()
+    dp = max(1, ecfg.dp_replicas)
+    indices = list(replica_indices if replica_indices is not None
+                   else range(dp))
+    # Slices are computed over the replicas built HERE (this host's
+    # share), positioned within the caller's device list — a pod host
+    # building replicas [4, 8) of a dp=8 fleet owns slices 0..3 of its
+    # jax.local_devices(), not (nonexistent) global offsets 4..7.
+    slices = replica_device_slices(len(indices), devices=devices)
+    if (len(indices) > 1 and slices[0] is None
+            and jax.default_backend() in ("tpu", "axon")):
+        # Single-device timesharing is the legitimate CPU tier-1 fleet;
+        # on an accelerator it means dp was oversized for the slice —
+        # "dp=8" results measured on one chip with 7 idle. Loud, not
+        # fatal: a deliberately oversubscribed smoke run stays possible.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "engine fleet: %d replicas but only %d local device(s) — "
+            "all replicas will timeshare the default device",
+            len(indices),
+            len(devices) if devices is not None else len(jax.devices()))
+    cores: list[EngineCore] = []
+    for pos, i in enumerate(indices):
+        mesh_i = None
+        params_i = params
+        if dp > 1 and slices[pos] is not None:
+            mesh_i = build_mesh(devices=slices[pos])
+            # DP means replicated weights: each replica's slice holds its
+            # own copy, placed once here so per-dispatch transfers never
+            # pay for it.
+            params_i = jax.device_put(params, replicated(mesh_i))
+        cores.append(EngineCore(
+            model_cfg, params_i, tokenizer, ecfg,
+            mask_fn=mask_fn, advance_fn=advance_fn, seed=seed,
+            tracer=tracer, mesh=mesh_i, lora_registry=lora_registry,
+            draft_worker=(draft_worker_factory(i)
+                          if draft_worker_factory else None),
+            replica_idx=i,
+        ))
+    return cores
+
+
+class AsyncFleet:
+    """AsyncEngine-compatible facade over N replicas + the router."""
+
+    def __init__(self, cores: Sequence[EngineCore],
+                 fleet_cfg: Optional[FleetConfig] = None):
+        if not cores:
+            raise ValueError("a fleet needs at least one EngineCore")
+        self.cores = list(cores)
+        self.replicas = [AsyncEngine(core) for core in self.cores]
+        self.dp = len(self.cores)
+        # GLOBAL replica ids for everything operator-facing (metric
+        # labels, health rows, eval attribution): on a pod host building
+        # replicas [4, 8) these must match the r{idx}- request prefixes
+        # and trace records, not local list positions 0..3.
+        self.replica_ids = [c.replica_idx if c.replica_idx is not None
+                            else i for i, c in enumerate(self.cores)]
+        self.cfg = fleet_cfg or FleetConfig()
+        self._page_size = self.cores[0].ecfg.page_size
+        slack = self.cfg.affinity_load_slack
+        self._slack = (slack if slack is not None
+                       else self.cores[0].ecfg.max_batch_slots)
+        # Router state below is mutated ONLY under this lock (routing runs
+        # on event-loop threads and, for bench/eval drivers, possibly
+        # several of them).
+        self._lock = threading.Lock()
+        self._routed = [0] * self.dp
+        self._rr = 0
+        self._affinity_hits = 0
+        self._case_routes: dict[str, dict[int, int]] = {}
+        self._install_metrics()
+
+    # ------------------------------------------------------------- routing
+
+    def _live_load(self, core: EngineCore) -> int:
+        """Live slots + queue depth (racy read of the engine's pools —
+        at worst one step stale, same contract as the scrape gauges)."""
+        return (len(core.waiting) + len(core.prefilling)
+                + len(core.decoding))
+
+    def _hash_seed(self, adapter: Optional[str]) -> int:
+        """Prefix-cache namespace of the request (LoRA adapter row)."""
+        if adapter is None:
+            return 0
+        lora = self.cores[0].lora
+        if lora is None:
+            return 0
+        try:
+            return lora.index_of(adapter)
+        except Exception:  # noqa: BLE001 — unknown adapter errors at submit
+            return 0
+
+    def _route(self, prompt_ids: list[int], hash_seed: int = 0,
+               exclude: frozenset[int] = frozenset()) -> Optional[int]:
+        """Pick a replica: prefix affinity under a load guard, else
+        least-loaded with round-robin tiebreak. None = shed."""
+        hashes = None
+        if self.cfg.affinity and len(prompt_ids) >= self._page_size:
+            hashes = hash_blocks(
+                prompt_ids, self._page_size,
+                max_blocks=(len(prompt_ids) - 1) // self._page_size,
+                seed=hash_seed)
+        candidates: list[tuple[int, int, int]] = []  # (idx, matched, load)
+        for i, core in enumerate(self.cores):
+            if i in exclude:
+                continue
+            matched = (core.kv.match_prefix(prompt_ids, hashes=hashes,
+                                            hash_seed=hash_seed)
+                       if hashes else 0)
+            candidates.append((i, matched, self._live_load(core)))
+        if not candidates:
+            return None
+        min_load = min(load for _, _, load in candidates)
+        if (self.cfg.shed_queue_depth is not None
+                and all(len(self.cores[i].waiting) >= self.cfg.shed_queue_depth
+                        for i, _, _ in candidates)):
+            self._m_shed.inc()
+            return None
+        affine = [c for c in candidates
+                  if c[1] >= self._page_size
+                  and c[2] <= min_load + self._slack]
+        with self._lock:
+            if affine:
+                pick, _matched, _load = max(
+                    affine, key=lambda c: (c[1], -c[2]))
+                self._affinity_hits += 1
+                self._m_affinity.inc()
+            else:
+                tied = [c[0] for c in candidates if c[2] == min_load]
+                # Round-robin among equally loaded replicas so a cold
+                # fleet spreads a burst instead of flooding replica 0.
+                pick = min(tied, key=lambda i: (i - self._rr) % self.dp)
+                self._rr = (pick + 1) % self.dp
+            self._routed[pick] += 1
+            case = CURRENT_CASE.get()
+            if case is not None and (case in self._case_routes
+                                     or len(self._case_routes)
+                                     < _CASE_ROUTES_MAX):
+                # The cap bounds NEW entries only: a case already being
+                # tracked keeps counting, or its attribution would silently
+                # undercount mid-flight.
+                per = self._case_routes.setdefault(case, {})
+                gid = self.replica_ids[pick]
+                per[gid] = per.get(gid, 0) + 1
+        self._m_requests.labels(replica=str(self.replica_ids[pick])).inc()
+        return pick
+
+    # ----------------------------------------------------- AsyncEngine API
+
+    async def start(self) -> None:
+        for replica in self.replicas:
+            await replica.start()
+
+    async def stop(self) -> None:
+        await asyncio.gather(*(r.stop() for r in self.replicas))
+
+    async def refresh_lora(self) -> None:
+        await asyncio.gather(*(r.refresh_lora() for r in self.replicas))
+
+    def _shed_output(self, request_id: Optional[str]) -> EngineOutput:
+        return EngineOutput(
+            request_id=request_id or "shed", token_ids=[], text="",
+            finish_reason=FinishReason.ABORTED, ttft_ms=None,
+            decode_tokens=0, elapsed_s=0.0)
+
+    async def generate(
+        self,
+        prompt_ids: list[int],
+        sampling: Optional[SamplingParams] = None,
+        timeout_s: Optional[float] = None,
+        priority: int = 0,
+        adapter: Optional[str] = None,
+        request_id: Optional[str] = None,
+    ) -> EngineOutput:
+        """Route, then delegate to the chosen replica's ``generate``.
+
+        A replica aborting the request (admission fail-fast / pool
+        pressure) triggers a retry on its siblings — one replica's full
+        pool must not 503 a pod with idle capacity elsewhere. Timeouts
+        propagate without retry: the caller's budget is already spent.
+        """
+        retries = (self.cfg.max_retries if self.cfg.max_retries is not None
+                   else self.dp - 1)
+        hash_seed = self._hash_seed(adapter)
+        tried: set[int] = set()
+        out: Optional[EngineOutput] = None
+        for attempt in range(retries + 1):
+            idx = self._route(prompt_ids, hash_seed,
+                              exclude=frozenset(tried))
+            if idx is None:
+                break
+            if attempt:
+                self._m_retries.inc()
+            out = await self.replicas[idx].generate(
+                prompt_ids, sampling, timeout_s=timeout_s,
+                priority=priority, adapter=adapter, request_id=request_id)
+            if out.finish_reason is not FinishReason.ABORTED:
+                return out
+            tried.add(idx)
+        return out if out is not None else self._shed_output(request_id)
+
+    async def generate_stream(
+        self,
+        prompt_ids: list[int],
+        sampling: Optional[SamplingParams] = None,
+        priority: int = 0,
+        adapter: Optional[str] = None,
+        request_sink: Optional[list] = None,
+        request_id: Optional[str] = None,
+    ):
+        """Route once, then yield the replica's token stream unchanged
+        (no cross-replica retry mid-stream: tokens already yielded cannot
+        be unsaid). Shedding raises :class:`FleetSaturated`."""
+        idx = self._route(prompt_ids, self._hash_seed(adapter))
+        if idx is None:
+            raise FleetSaturated(
+                f"all {self.dp} replicas over shed_queue_depth="
+                f"{self.cfg.shed_queue_depth}")
+        agen = self.replicas[idx].generate_stream(
+            prompt_ids, sampling, priority=priority, adapter=adapter,
+            request_sink=request_sink, request_id=request_id)
+        try:
+            async for tok in agen:
+                yield tok
+        finally:
+            # `async for` abandons (never closes) its iterator on early
+            # exit; close explicitly so the replica's early-exit abort
+            # (slot + KV pages freed) runs NOW, not at GC time.
+            await agen.aclose()
+
+    # -------------------------------------------------- eval attribution
+
+    def begin_case(self, case_id: str):
+        """Attribute subsequent routing in this asyncio task (and its
+        awaited children) to ``case_id``; returns the reset token."""
+        return CURRENT_CASE.set(case_id)
+
+    def end_case(self, token) -> None:
+        CURRENT_CASE.reset(token)
+
+    def case_routes(self, case_id: str) -> dict[int, int]:
+        """Pop {replica: request_count} attributed to a finished case."""
+        with self._lock:
+            return self._case_routes.pop(case_id, {})
+
+    # --------------------------------------------------------- observability
+
+    def routed_counts(self) -> list[int]:
+        with self._lock:
+            return list(self._routed)
+
+    def _imbalance(self) -> float:
+        with self._lock:
+            routed = list(self._routed)
+        total = sum(routed)
+        if total == 0:
+            return 0.0
+        return max(routed) / (total / len(routed))
+
+    def affinity_hit_ratio(self) -> float:
+        with self._lock:
+            hits, total = self._affinity_hits, sum(self._routed)
+        return hits / total if total else 0.0
+
+    def _install_metrics(self) -> None:
+        """Router metrics + per-replica labeled gauges, and the unlabeled
+        engine names re-bound to cross-replica aggregates so an existing
+        dashboard keeps reading fleet-wide truth. Labeled callbacks are
+        cleared first: a larger previous fleet's stale replica labelsets
+        must not keep scraping dead engines."""
+        reg = metrics_mod.get_registry()
+        self._m_requests = reg.counter(
+            "runbook_router_requests_total",
+            "Requests placed by the fleet router", labels=("replica",))
+        self._m_affinity = reg.counter(
+            "runbook_router_affinity_hits_total",
+            "Placements onto a replica already holding the request's "
+            "prefix pages (>= one full page matched)")
+        self._m_retries = reg.counter(
+            "runbook_router_retries_total",
+            "Cross-replica retries after a replica aborted on pool pressure")
+        self._m_shed = reg.counter(
+            "runbook_router_shed_total",
+            "Requests shed with every replica over shed_queue_depth")
+        reg.gauge(
+            "runbook_router_imbalance_ratio",
+            "Max over mean of per-replica routed request counts "
+            "(1.0 = perfectly balanced, dp = everything on one replica)"
+        ).set_function(self._imbalance)
+        per_replica = (
+            (reg.gauge("runbook_replica_running_requests",
+                       "Requests holding a decode slot, per fleet replica",
+                       labels=("replica",)),
+             lambda c: float(len(c.decoding))),
+            (reg.gauge("runbook_replica_waiting_requests",
+                       "Requests queued or prefilling, per fleet replica",
+                       labels=("replica",)),
+             lambda c: float(len(c.waiting) + len(c.prefilling))),
+            (reg.gauge("runbook_replica_kv_pool_utilization",
+                       "Fraction of allocatable KV pages held by live "
+                       "sequences, per fleet replica", labels=("replica",)),
+             lambda c: c.kv.utilization()),
+            (reg.counter("runbook_replica_decode_tokens_total",
+                         "Tokens sampled by decode dispatches, per fleet "
+                         "replica", labels=("replica",)),
+             lambda c: float(c.metrics.get("decode_tokens", 0))),
+        )
+        for metric, fn in per_replica:
+            metric.clear_functions()
+            for gid, core in zip(self.replica_ids, self.cores):
+                metric.labels(replica=str(gid)).set_function(
+                    lambda c=core, f=fn: f(c))
+        # Unlabeled engine names → fleet aggregates (each core's
+        # _install_metrics bound them to itself during construction; the
+        # last rebind wins, and the fleet is constructed last).
+        reg.gauge("runbook_running_requests",
+                  "Requests holding a decode slot").set_function(
+            lambda: sum(len(c.decoding) for c in self.cores))
+        reg.gauge("runbook_waiting_requests",
+                  "Requests queued or prefilling").set_function(
+            lambda: sum(len(c.waiting) + len(c.prefilling)
+                        for c in self.cores))
+        reg.gauge("runbook_kv_pages_total", "KV pool size in pages"
+                  ).set_function(
+            lambda: sum(c.kv.allocator.num_pages for c in self.cores))
+        reg.gauge("runbook_kv_pages_in_use",
+                  "KV pages referenced by live sequences").set_function(
+            lambda: sum(c.kv.pages_in_use for c in self.cores))
+        reg.gauge("runbook_kv_pages_cached",
+                  "Retired-but-resident prefix-cache pages").set_function(
+            lambda: sum(c.kv.allocator.cached_pages for c in self.cores))
+        reg.gauge("runbook_kv_pool_utilization",
+                  "Fraction of allocatable KV pages held by live sequences"
+                  ).set_function(self._agg_utilization)
+        reg.gauge("runbook_prefix_cache_hit_ratio",
+                  "Cached prompt tokens / (cached + prefilled) since start"
+                  ).set_function(self._agg_prefix_hit_ratio)
+        reg.gauge("runbook_decode_overlap_ratio",
+                  "Fraction of host decode work hidden behind device "
+                  "execution by the lagged pipeline (0 in forced-sync mode)"
+                  ).set_function(self._agg_overlap_ratio)
+        for key, name, help_text in LEGACY_COUNTER_EXPORTS:
+            reg.counter(name, help_text).set_function(
+                lambda k=key: float(sum(c.metrics.get(k, 0)
+                                        for c in self.cores)))
+
+    def _agg_utilization(self) -> float:
+        usable = sum(c.kv.allocator.num_pages - 1 for c in self.cores)
+        used = sum(c.kv.pages_in_use for c in self.cores)
+        return used / usable if usable > 0 else 0.0
+
+    def _agg_prefix_hit_ratio(self) -> float:
+        cached = sum(c.metrics.get("cached_prefix_tokens", 0)
+                     for c in self.cores)
+        total = cached + sum(c.metrics.get("prefill_tokens", 0)
+                             for c in self.cores)
+        return cached / total if total else 0.0
+
+    def _agg_overlap_ratio(self) -> float:
+        host = sum(c.metrics.get("decode_host_time_s", 0.0)
+                   for c in self.cores)
+        overlap = sum(c.metrics.get("decode_host_overlap_s", 0.0)
+                      for c in self.cores)
+        return overlap / host if host > 0 else 0.0
+
+    def is_saturated(self) -> bool:
+        """True when a placement would shed right now (every replica's
+        waiting queue at/over ``shed_queue_depth``). The HTTP layer
+        pre-checks this before committing SSE headers so a saturated
+        stream gets a real 503; the inevitable check-then-route race
+        falls back to the in-stream error event."""
+        depth = self.cfg.shed_queue_depth
+        return depth is not None and all(
+            len(core.waiting) >= depth for core in self.cores)
+
+    def health_snapshot(self, lock_timeout: float = 0.5) -> dict:
+        """Aggregated ``/healthz`` body: summed legacy metrics dict (the
+        contract keys keep their meaning — fleet-wide totals), pooled KV
+        stats, per-replica breakdown, and router state. Each replica's
+        metrics snapshot under its own step lock, with ``lock_timeout``
+        as ONE shared budget across the whole loop — a probe over a dp=8
+        fleet must stay as bounded as the single engine's (a liveness
+        probe that blocks seconds gets the pod killed mid-compile); a
+        torn-but-live snapshot beats a dead prober."""
+        import time
+
+        agg: dict = {}
+        replicas = []
+        kv_total = kv_used = kv_cached = 0
+        deadline = time.monotonic() + lock_timeout
+        for i, (engine, core) in enumerate(zip(self.replicas, self.cores)):
+            budget = max(0.0, deadline - time.monotonic())
+            locked = engine._lock.acquire(timeout=budget) if budget \
+                else engine._lock.acquire(blocking=False)
+            try:
+                m = dict(core.metrics)
+            finally:
+                if locked:
+                    engine._lock.release()
+            for k, v in m.items():
+                agg[k] = agg.get(k, 0) + v
+            kv = core.kv
+            kv_total += kv.allocator.num_pages
+            kv_used += kv.pages_in_use
+            kv_cached += kv.allocator.cached_pages
+            replicas.append({
+                "replica": self.replica_ids[i],
+                "running": len(core.decoding),
+                "waiting": len(core.waiting) + len(core.prefilling),
+                "kv": {"pages_total": kv.allocator.num_pages,
+                       "pages_in_use": kv.pages_in_use,
+                       "pages_cached": kv.allocator.cached_pages,
+                       "utilization": round(kv.utilization(), 4)},
+                "decode_tokens": m.get("decode_tokens", 0),
+            })
+        usable = sum(c.kv.allocator.num_pages - 1 for c in self.cores)
+        return {
+            "dp_replicas": self.dp,
+            "kv": {"pages_total": kv_total, "pages_in_use": kv_used,
+                   "pages_cached": kv_cached,
+                   "utilization": round(kv_used / usable, 4)
+                   if usable else 0.0},
+            "metrics": agg,
+            "replicas": replicas,
+            "router": {
+                "routed": self.routed_counts(),
+                "affinity_hit_ratio": round(self.affinity_hit_ratio(), 4),
+                "imbalance_ratio": round(self._imbalance(), 4),
+            },
+        }

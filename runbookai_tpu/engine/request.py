@@ -10,6 +10,14 @@ from enum import Enum
 from typing import Any, Optional
 
 
+class FleetSaturated(RuntimeError):
+    """Every fleet replica is over the shed queue depth — the request was
+    shed without being submitted (engine/fleet.py raises it on streaming
+    placements; the HTTP layer maps it to 503 before headers, or to an
+    SSE error event once they are out). Lives here, not in fleet.py, so
+    the server can catch it without importing the jax-heavy fleet module."""
+
+
 class RequestState(str, Enum):
     WAITING = "waiting"  # queued, no pages yet
     PREFILL = "prefill"  # prompt being processed in chunks

@@ -46,6 +46,25 @@ INPUT_CANDIDATES = {
 }
 
 
+def parse_shard(spec: str) -> tuple[int, int]:
+    """``"i/n"`` → (index, count), 0-based index; ``"auto"`` takes this
+    process's rank in the multihost runtime (``multihost.shard_for_host``)
+    so each pod host statically owns ``cases[i::n]`` before its local
+    fleet balances dynamically."""
+    if spec == "auto":
+        from runbookai_tpu.parallel.multihost import shard_for_host
+
+        return shard_for_host()
+    try:
+        idx_s, _, n_s = spec.partition("/")
+        idx, n = int(idx_s), int(n_s)
+    except ValueError:
+        raise ValueError(f"shard must look like 'i/n' or 'auto', got {spec!r}")
+    if n < 1 or not 0 <= idx < n:
+        raise ValueError(f"shard index must satisfy 0 <= i < n, got {spec!r}")
+    return idx, n
+
+
 @dataclass
 class BenchmarkRun:
     benchmark: str
@@ -113,8 +132,13 @@ def run_single_benchmark(
     runner: Optional[Callable[[list], BenchmarkReport]] = None,
     input_path: Optional[str | Path] = None,
     min_pass_rate: float = 0.0,
+    shard: Optional[tuple[int, int]] = None,
 ) -> BenchmarkRun:
-    """Locate input → convert → run → report (run-all-benchmarks.ts:133)."""
+    """Locate input → convert → run → report (run-all-benchmarks.ts:133).
+
+    ``shard=(i, n)`` keeps only ``cases[i::n]`` — the static per-host split
+    of a pod-wide run; each host's engine fleet balances its own share
+    dynamically after this cut."""
     source = Path(input_path) if input_path else _find_input(Path(datasets_root), name)
     if source is None:
         return BenchmarkRun(name, "skipped",
@@ -129,6 +153,12 @@ def run_single_benchmark(
         cases = load_fixtures_file(fixtures_path)
     except Exception as exc:  # noqa: BLE001 — converter failure is a status
         return BenchmarkRun(name, "failed", reason=f"convert: {exc}")
+    if shard is not None:
+        idx, n = shard
+        cases = cases[idx::n]
+        if not cases:
+            return BenchmarkRun(name, "skipped",
+                                reason=f"no cases in shard {idx}/{n}")
     if not cases:
         return BenchmarkRun(name, "skipped", reason="no cases after conversion")
     try:
@@ -150,13 +180,15 @@ def run_all_benchmarks(
     runner: Optional[Callable[[list], BenchmarkReport]] = None,
     min_pass_rate: float = 0.0,
     setup: bool = False,
+    shard: Optional[tuple[int, int]] = None,
 ) -> dict[str, Any]:
     """All benchmarks → per-report JSONs + aggregate summary (ts:344-435)."""
     names = benchmarks or list(CONVERTERS)
     if setup:
         setup_datasets(datasets_root, names)
     runs = [run_single_benchmark(n, datasets_root, out_dir, runner=runner,
-                                 min_pass_rate=min_pass_rate) for n in names]
+                                 min_pass_rate=min_pass_rate, shard=shard)
+            for n in names]
     reports = [r.report for r in runs if r.report is not None]
     out = Path(out_dir)
     summary_path = write_reports(reports, out) if reports else None
@@ -168,6 +200,7 @@ def run_all_benchmarks(
         # the harness; pass@1 means investigation quality only once real
         # weights are in play — every artifact says which it was.
         "quality": quality_marker(discover_weights()),
+        **({"shard": f"{shard[0]}/{shard[1]}"} if shard is not None else {}),
         "results": [r.to_dict() for r in runs],
         "passed": sum(1 for r in runs if r.status == "passed"),
         "failed": sum(1 for r in runs if r.status == "failed"),

@@ -6,9 +6,16 @@ real runtime per case :121-187) and ``run-all-benchmarks.ts`` (:133-344 —
 per-benchmark reports + ``summary.json``, skipped/failed statuses).
 
 The TPU upgrade (SURVEY.md §3.5): cases are independent, so live mode runs N
-investigations **concurrently** against the shared continuous-batching engine
-(asyncio gather = data parallelism over the engine's batch slots; on a pod,
-engines per data-replica extend this across chips over ICI).
+investigations **concurrently** against the continuous-batching engine
+(asyncio gather = data parallelism over the engine's batch slots). When the
+client serves through a data-parallel engine fleet
+(``EngineConfig.dp_replicas`` > 1, ``engine/fleet.py``), the fan-out widens
+automatically — the concurrency budget multiplies by the replica count, the
+prefix-affinity router spreads cases across replicas (each case's repeated
+system prompt pins to the replica holding its KV pages), and every case's
+report row records which replicas served its requests
+(``replica_requests``). Across a pod, ``run_all.py --shard i/n`` first
+splits cases statically per host; the fleet balances dynamically within one.
 """
 
 from __future__ import annotations
@@ -99,19 +106,34 @@ async def run_live(
     concurrency: int = 4,
     knowledge=None,
     max_iterations: int = 20,
+    scale_concurrency_with_fleet: bool = True,
 ) -> BenchmarkReport:
     """Run full investigations concurrently against a shared engine.
 
     ``llm_factory`` returns the (shared) client exposing ``complete``; the
     continuous-batching engine interleaves all cases' decodes (DP batching).
+    With an engine fleet behind the client, ``concurrency`` is the
+    PER-REPLICA budget: the semaphore widens by the replica count (the
+    router keeps per-replica load at roughly the configured level), and
+    each case row gains ``replica_requests`` — how many engine calls each
+    replica served for it.
     """
     report = BenchmarkReport(name=name)
     llm = llm_factory()
-    sem = asyncio.Semaphore(concurrency)
+    engine = getattr(llm, "engine", None)
+    dp = getattr(engine, "dp", 1)
+    eff_concurrency = (concurrency * dp if scale_concurrency_with_fleet
+                       else concurrency)
+    # Fleet attribution (duck-typed so mock LLM clients need nothing):
+    # begin_case tags the asyncio task; every routed request inside it is
+    # credited to the case, however deep in the agent stack it happens.
+    begin_case = getattr(engine, "begin_case", None)
+    sem = asyncio.Semaphore(eff_concurrency)
     t0 = time.perf_counter()
 
     async def run_case(case: EvalCase) -> dict[str, Any]:
         async with sem:
+            token = begin_case(case.case_id) if begin_case else None
             try:
                 orch = InvestigationOrchestrator(
                     llm, _executor_for_case(case),
@@ -128,7 +150,7 @@ async def run_live(
                     "summary": result.conclusion_summary,
                 }
                 score = score_investigation_result(case, payload)
-                return {
+                out = {
                     "case_id": case.case_id, "status": "completed",
                     "passed": score.passed, "score": score.total,
                     "dimensions": score.dimensions,
@@ -137,8 +159,18 @@ async def run_live(
                     "iterations": result.summary["iterations"],
                 }
             except Exception as exc:  # noqa: BLE001 — a case failure is a result
-                return {"case_id": case.case_id, "status": "failed",
-                        "passed": False, "error": f"{type(exc).__name__}: {exc}"}
+                out = {"case_id": case.case_id, "status": "failed",
+                       "passed": False,
+                       "error": f"{type(exc).__name__}: {exc}"}
+            finally:
+                if token is not None:
+                    engine.end_case(token)
+            if begin_case:
+                out["replica_requests"] = {
+                    f"r{i}": n
+                    for i, n in sorted(
+                        engine.case_routes(case.case_id).items())}
+            return out
 
     report.cases = list(await asyncio.gather(*(run_case(c) for c in cases)))
     report.elapsed_s = time.perf_counter() - t0
@@ -171,6 +203,15 @@ def write_reports(reports: list[BenchmarkReport], out_dir: str | Path) -> Path:
         "overall_pass_rate": round(
             sum(r.passed for r in reports) / max(1, sum(len(r.cases) for r in reports)), 4),
     }
+    # Fleet runs: total engine requests each replica served, summed from
+    # the per-case attribution run_live recorded.
+    replica_totals: dict[str, int] = {}
+    for report in reports:
+        for c in report.cases:
+            for rep, n in (c.get("replica_requests") or {}).items():
+                replica_totals[rep] = replica_totals.get(rep, 0) + n
+    if replica_totals:
+        summary["replica_attribution"] = dict(sorted(replica_totals.items()))
     path = out / "summary.json"
     path.write_text(json.dumps(summary, indent=2))
     return path

@@ -68,7 +68,7 @@ async def stream_text(engine, tokenizer, prompt_ids, sampling,
 class JaxTpuClient(BaseLLMClient):
     def __init__(
         self,
-        core: EngineCore,
+        core: "EngineCore | list[EngineCore]",
         tokenizer,
         temperature: float = 0.0,
         top_p: float = 1.0,
@@ -76,9 +76,25 @@ class JaxTpuClient(BaseLLMClient):
         max_new_tokens: int = 1024,
         guided_json: bool = True,
         chat_format: str = "llama3",
+        fleet_cfg=None,
     ):
-        self.core = core
-        self.engine = AsyncEngine(core)
+        # ``core`` may be a data-parallel fleet (list of replicas, built by
+        # engine/fleet.build_engine_fleet when EngineConfig.dp_replicas > 1):
+        # the client then serves through an AsyncFleet with the same
+        # generate/generate_stream surface, and ``self.core`` stays replica
+        # 0 for surfaces that need the shared pieces (LoRA registry names,
+        # tokenizer-adjacent config) — fleet-wide state goes through
+        # ``self.engine.health_snapshot()``. ``fleet_cfg`` (a
+        # fleet.FleetConfig) carries the router policy knobs.
+        cores = list(core) if isinstance(core, (list, tuple)) else [core]
+        self.cores = cores
+        self.core = cores[0]
+        if len(cores) > 1:
+            from runbookai_tpu.engine.fleet import AsyncFleet
+
+            self.engine = AsyncFleet(cores, fleet_cfg)
+        else:
+            self.engine = AsyncEngine(self.core)
         self.tokenizer = tokenizer
         self.temperature = temperature
         self.top_p = top_p
@@ -107,6 +123,15 @@ class JaxTpuClient(BaseLLMClient):
         # int8 = weight-only quantization; activations and KV stay bf16.
         quantize = llm_cfg.dtype == "int8"
         dtype = jnp.float32 if llm_cfg.dtype == "float32" else jnp.bfloat16
+        dp_replicas = max(1, getattr(llm_cfg, "dp_replicas", 1))
+        if dp_replicas > 1 and llm_cfg.mesh.device_count > 1:
+            # Replicas are single-slice engines; sharding a model WITHIN a
+            # replica on top of dp is a later composition — refuse loudly
+            # rather than silently building N full-mesh engines that all
+            # claim the same devices.
+            raise ValueError(
+                "llm.dp_replicas > 1 requires llm.mesh.data/model = 1 "
+                "(each fleet replica owns its own device slice)")
         if llm_cfg.mesh.device_count > 1:
             from runbookai_tpu.models.llama import CONFIGS
             from runbookai_tpu.parallel.kv_split import plan_kv_split
@@ -162,6 +187,7 @@ class JaxTpuClient(BaseLLMClient):
                             if quantize and jax.default_backend()
                             in ("tpu", "axon")
                             else "xla")),
+            dp_replicas=dp_replicas,
         )
         lora_registry = None
         if getattr(llm_cfg, "lora_adapters", None):
@@ -172,28 +198,70 @@ class JaxTpuClient(BaseLLMClient):
                 targets=tuple(llm_cfg.lora_targets), dtype=dtype)
             for name, path in llm_cfg.lora_adapters.items():
                 lora_registry.load_peft_dir(name, path)
-        draft_worker = None
+        draft_factory = None
         if llm_cfg.draft_model:
             from runbookai_tpu.engine.draft import DraftWorker
 
             dcfg, dparams = load_or_init(
                 llm_cfg.draft_model, llm_cfg.draft_model_path, dtype=dtype)
-            draft_worker = DraftWorker(
-                dcfg, dparams, max_batch_slots=ecfg.max_batch_slots,
-                max_seq_len=ecfg.max_seq_len, page_size=ecfg.page_size,
-                attn_impl=ecfg.attn_impl)
+
+            def draft_factory(_idx: int) -> "DraftWorker":
+                # One worker per replica: its slot/page state is
+                # per-engine and cannot be shared across cores.
+                return DraftWorker(
+                    dcfg, dparams, max_batch_slots=ecfg.max_batch_slots,
+                    max_seq_len=ecfg.max_seq_len, page_size=ecfg.page_size,
+                    attn_impl=ecfg.attn_impl)
         masker = JsonMaskProvider(tokenizer, schemas=orchestrator_schemas())
-        core = EngineCore(
-            cfg, params, tokenizer, ecfg,
-            mask_fn=masker.mask, advance_fn=masker.advance, mesh=mesh,
-            lora_registry=lora_registry, draft_worker=draft_worker,
-        )
+        fleet_cfg = None
+        if dp_replicas > 1:
+            from runbookai_tpu.engine.fleet import (
+                FleetConfig,
+                build_engine_fleet,
+            )
+
+            router = getattr(llm_cfg, "fleet", None)
+            if router is not None:
+                fleet_cfg = FleetConfig(
+                    affinity=router.affinity,
+                    affinity_load_slack=router.affinity_load_slack,
+                    shed_queue_depth=router.shed_queue_depth,
+                    max_retries=router.max_retries)
+            # Pod scale-out: each process builds only ITS replicas over
+            # its local chips — replicas never span hosts (their device
+            # slices must stay in one ICI domain). Single process owns
+            # the whole fleet over the (== local) global device list.
+            replica_indices = None
+            fleet_devices = None
+            if jax.process_count() > 1:
+                from runbookai_tpu.parallel.multihost import (
+                    local_replica_range,
+                )
+
+                replica_indices = list(local_replica_range(dp_replicas))
+                fleet_devices = jax.local_devices()
+            core = build_engine_fleet(
+                cfg, params, tokenizer, ecfg,
+                mask_fn=masker.mask, advance_fn=masker.advance,
+                lora_registry=lora_registry,
+                draft_worker_factory=draft_factory,
+                devices=fleet_devices,
+                replica_indices=replica_indices,
+            )
+        else:
+            core = EngineCore(
+                cfg, params, tokenizer, ecfg,
+                mask_fn=masker.mask, advance_fn=masker.advance, mesh=mesh,
+                lora_registry=lora_registry,
+                draft_worker=draft_factory(0) if draft_factory else None,
+            )
         return cls(
             core, tokenizer,
             temperature=llm_cfg.temperature, top_p=llm_cfg.top_p,
             top_k=llm_cfg.top_k,
             max_new_tokens=llm_cfg.max_new_tokens, guided_json=llm_cfg.guided_json,
             chat_format=format_for_model(model_cfg_name, cfg.family),
+            fleet_cfg=fleet_cfg,
         )
 
     @classmethod
@@ -211,9 +279,17 @@ class JaxTpuClient(BaseLLMClient):
         ecfg = EngineConfig(**ecfg_kw)
         masker = JsonMaskProvider(tokenizer, schemas=orchestrator_schemas(),
                                   limits=schema_limits)
-        core = EngineCore(cfg, params, tokenizer, ecfg,
-                          mask_fn=masker.mask, advance_fn=masker.advance,
-                          lora_registry=lora_registry)
+        if ecfg.dp_replicas > 1:
+            from runbookai_tpu.engine.fleet import build_engine_fleet
+
+            core = build_engine_fleet(
+                cfg, params, tokenizer, ecfg,
+                mask_fn=masker.mask, advance_fn=masker.advance,
+                lora_registry=lora_registry)
+        else:
+            core = EngineCore(cfg, params, tokenizer, ecfg,
+                              mask_fn=masker.mask, advance_fn=masker.advance,
+                              lora_registry=lora_registry)
         return cls(core, tokenizer, temperature=temperature,
                    max_new_tokens=max_new_tokens,
                    chat_format=format_for_model(model_name, cfg.family))

@@ -71,6 +71,30 @@ def single_device_mesh() -> Mesh:
     return build_mesh(1, 1)
 
 
+def replica_device_slices(
+    dp: int,
+    per_replica: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> list[Optional[list[jax.Device]]]:
+    """Disjoint device slices along the dp axis for an engine fleet.
+
+    Each of the ``dp`` replicas owns ``per_replica`` consecutive devices
+    (the replica-internal axes — model/seq — stay within a slice, so their
+    high-frequency collectives ride ICI while replicas never communicate
+    inside compiled programs at all). When the host has fewer devices than
+    the fleet needs, every entry is ``None``: replicas share the default
+    device — the CPU tier-1 virtual-fleet case when the platform exposes a
+    single device.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if dp < 1 or per_replica < 1:
+        raise ValueError("dp and per_replica must be >= 1")
+    if len(devices) < dp * per_replica:
+        return [None] * dp
+    return [devices[i * per_replica:(i + 1) * per_replica]
+            for i in range(dp)]
+
+
 def named(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 

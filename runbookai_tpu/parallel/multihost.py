@@ -89,6 +89,31 @@ def summary() -> dict:
     }
 
 
+def local_replica_range(dp_replicas: int) -> range:
+    """Engine-fleet replica indices THIS host owns.
+
+    A pod-wide fleet of ``dp_replicas`` engines is partitioned statically:
+    process ``p`` of ``P`` builds replicas ``[p*dp/P, (p+1)*dp/P)`` over its
+    ``jax.local_devices()`` — replicas never span hosts (their device slices
+    must stay within one ICI domain), so an indivisible count is a config
+    error, same policy as :func:`assert_batch_divisible`.
+    """
+    pc = jax.process_count()
+    if dp_replicas % pc:
+        raise ValueError(
+            f"dp_replicas {dp_replicas} not divisible by process count {pc}")
+    per = dp_replicas // pc
+    start = jax.process_index() * per
+    return range(start, start + per)
+
+
+def shard_for_host() -> tuple[int, int]:
+    """Static ``(index, count)`` benchmark shard for this process — the
+    ``--shard auto`` source for ``evalsuite/run_all.py``: each host takes
+    cases ``index::count`` before its local fleet balances dynamically."""
+    return jax.process_index(), jax.process_count()
+
+
 def assert_batch_divisible(global_batch: int, data_axis_size: int) -> int:
     """Per-process batch share for the host-sharded input pipeline: each
     process feeds only its local slice of the ``data`` axis (global arrays

@@ -37,6 +37,7 @@ from concurrent.futures import TimeoutError as _FutTimeout  # builtin alias 3.11
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
+from runbookai_tpu.engine.request import FleetSaturated
 from runbookai_tpu.utils.metrics import REQUEST_LATENCY_BUCKETS, get_registry
 from runbookai_tpu.utils.trace import get_tracer
 
@@ -359,23 +360,30 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 # of seconds, and a liveness probe that blocks that long
                 # gets the pod killed mid-compile. A torn-but-live
                 # snapshot beats a dead prober.
-                lock = getattr(client.engine, "_lock", None)
-                locked = lock is not None and lock.acquire(timeout=0.5)
-                try:
-                    m = dict(client.core.metrics)
-                finally:
-                    if locked:
-                        lock.release()
-                kv = client.core.kv
-                self._json(200, {
-                    "status": "ok", "model": model_name,
-                    "uptime_s": round(time.time() - started_at, 3),
-                    "kv": {"pages_total": kv.allocator.num_pages,
-                           "pages_in_use": kv.pages_in_use,
-                           "pages_cached": kv.allocator.cached_pages,
-                           "utilization": round(kv.utilization(), 4)},
-                    "metrics": m,
-                })
+                body = {"status": "ok", "model": model_name,
+                        "uptime_s": round(time.time() - started_at, 3)}
+                snapshot = getattr(client.engine, "health_snapshot", None)
+                if snapshot is not None:
+                    # Engine fleet: summed metrics dict (the contract keys
+                    # become fleet-wide totals), pooled KV stats, plus the
+                    # per-replica breakdown and router state.
+                    body.update(snapshot())
+                else:
+                    lock = getattr(client.engine, "_lock", None)
+                    locked = lock is not None and lock.acquire(timeout=0.5)
+                    try:
+                        m = dict(client.core.metrics)
+                    finally:
+                        if locked:
+                            lock.release()
+                    kv = client.core.kv
+                    body["kv"] = {
+                        "pages_total": kv.allocator.num_pages,
+                        "pages_in_use": kv.pages_in_use,
+                        "pages_cached": kv.allocator.cached_pages,
+                        "utilization": round(kv.utilization(), 4)}
+                    body["metrics"] = m
+                self._json(200, body)
             elif self.path == "/metrics":
                 body = registry.render().encode()
                 self.send_response(200)
@@ -456,6 +464,15 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 if body.get("stream"):
                     if n != 1:
                         self._error(400, "stream with n > 1 is unsupported")
+                        return
+                    # Fleet shedding: refuse BEFORE committing SSE headers
+                    # so a saturated pod answers a real 503 (the check-
+                    # then-route race falls back to an in-stream error
+                    # event inside _stream_response).
+                    saturated = getattr(client.engine, "is_saturated", None)
+                    if saturated is not None and saturated():
+                        self._error(503, "all fleet replicas are "
+                                         "saturated (request shed)")
                         return
                     so = body.get("stream_options") or {}
                     self._stream_response(
@@ -880,6 +897,16 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 try:
                     send_terminator(b'data: {"error": {"message": '
                                     b'"generation timed out"}}\n\n')
+                except OSError:
+                    pass
+            except FleetSaturated:
+                # Lost the pre-header saturation race: the fleet shed this
+                # placement after the 200/SSE headers went out. Same
+                # well-formed-body policy as the timeout path.
+                try:
+                    send_terminator(b'data: {"error": {"message": '
+                                    b'"all fleet replicas are saturated '
+                                    b'(request shed)"}}\n\n')
                 except OSError:
                     pass
 

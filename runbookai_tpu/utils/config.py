@@ -57,6 +57,23 @@ class MeshConfig(BaseModel):
         return self.data * self.model
 
 
+class FleetRouterConfig(BaseModel):
+    """Engine-fleet router policy (engine/fleet.FleetConfig; only read
+    when ``dp_replicas > 1``). See docs/SERVING.md."""
+
+    # Prefix-affinity placement on/off (off = pure least-loaded).
+    affinity: bool = True
+    # Max live-load excess (requests) a prefix-matching replica may carry
+    # over the least-loaded one and still win. None = one batch's worth.
+    affinity_load_slack: Optional[int] = None
+    # Shed (503) when EVERY replica's waiting queue is at least this deep.
+    # None = never shed.
+    shed_queue_depth: Optional[int] = None
+    # Cross-replica retries after a pool-pressure abort. None = each
+    # other replica once.
+    max_retries: Optional[int] = None
+
+
 class LLMConfig(BaseModel):
     provider: Literal["jax-tpu", "mock"] = "mock"
     model: str = "llama3-8b-instruct"
@@ -103,6 +120,12 @@ class LLMConfig(BaseModel):
     prefill_chunk: int = 512  # prefill processed in chunks of this many tokens
     decode_steps: int = 8  # decode tokens per device dispatch (host-sync amortization)
     mesh: MeshConfig = Field(default_factory=MeshConfig)
+    # Data-parallel engine fleet (engine/fleet.py): build this many engine
+    # replicas, each on its own device slice, behind the prefix-affinity
+    # router. Slots/pages above are PER REPLICA. Requires mesh.data/model
+    # = 1 (a replica is a single-slice engine).
+    dp_replicas: int = 1
+    fleet: FleetRouterConfig = Field(default_factory=FleetRouterConfig)
     guided_json: bool = True  # token-level JSON grammar masks for complete()
 
 
@@ -384,6 +407,12 @@ def validate_config(config: Config) -> list[str]:
     mesh = config.llm.mesh
     if mesh.data < 1 or mesh.model < 1:
         problems.append("llm.mesh axes must be >= 1")
+    if config.llm.dp_replicas < 1:
+        problems.append("llm.dp_replicas must be >= 1")
+    if config.llm.dp_replicas > 1 and mesh.device_count > 1:
+        problems.append(
+            "llm.dp_replicas > 1 requires llm.mesh.data/model = 1 "
+            "(each fleet replica owns its own device slice)")
     slack = config.incident.slack
     if (slack.enabled and slack.app_token
             and "mode" not in slack.model_fields_set):

@@ -94,6 +94,14 @@ class _Child:
     def observe(self, value: float) -> None:
         self._metric._observe(self._key, value)
 
+    def set_function(self, fn: Callable[[], float]) -> "_Child":
+        """Sample ``fn()`` at scrape time for THIS labelset (the labeled
+        twin of ``_Metric.set_function`` — per-replica engine gauges bind
+        one callback per replica label). Re-binding a labelset replaces
+        its previous callback."""
+        self._metric._set_key_function(self._key, fn)
+        return self
+
 
 class _Metric:
     type = "untyped"
@@ -112,6 +120,9 @@ class _Metric:
         self._lock = threading.Lock()
         self._values: dict[tuple[str, ...], float] = {}
         self._fn: Optional[Callable[[], float]] = None
+        # Per-labelset scrape-time callbacks (labeled set_function): each
+        # key's callback shadows any stored value for that key.
+        self._key_fns: dict[tuple[str, ...], Callable[[], float]] = {}
 
     # ------------------------------------------------------------- labelling
 
@@ -145,6 +156,22 @@ class _Metric:
         self._fn = fn
         return self
 
+    def _set_key_function(self, key: tuple[str, ...],
+                          fn: Callable[[], float]) -> None:
+        if len(key) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} expects {len(self.labelnames)} label values")
+        with self._lock:
+            self._key_fns[key] = fn
+
+    def clear_functions(self) -> None:
+        """Drop every scrape-time callback (labeled and unlabeled). A
+        rebuilt fleet calls this before re-binding so replica labelsets
+        from a larger previous fleet don't keep scraping dead engines."""
+        self._fn = None
+        with self._lock:
+            self._key_fns.clear()
+
     # ---------------------------------------------------------------- values
 
     def _inc(self, key: tuple[str, ...], amount: float) -> None:
@@ -176,7 +203,23 @@ class _Metric:
             out.append(("", (), cb))
         with self._lock:
             items = sorted(self._values.items())
+            key_fns = sorted(self._key_fns.items())
+        # Callbacks run OUTSIDE the metric lock: they read live engine
+        # state and must never deadlock a scrape against an engine step.
+        seen: set[tuple[str, ...]] = set()
+        for key, fn in key_fns:
+            # A bound callback owns its labelset even when it raises: the
+            # series is dropped, never replaced by a stale stored value
+            # masquerading as live data.
+            seen.add(key)
+            try:
+                value = float(fn())
+            except Exception:  # noqa: BLE001 — dead engine must not 500 /metrics
+                continue
+            out.append(("", tuple(zip(self.labelnames, key)), value))
         for key, value in items:
+            if key in seen:
+                continue  # the callback shadows any stored value
             out.append(("", tuple(zip(self.labelnames, key)), value))
         if not out and not self.labelnames:
             out.append(("", (), 0.0))
@@ -279,6 +322,9 @@ class Histogram(_Metric):
         raise ValueError(f"{self.name} is a histogram; use observe()")
 
     def _set(self, key, value) -> None:
+        raise ValueError(f"{self.name} is a histogram; use observe()")
+
+    def _set_key_function(self, key, fn) -> None:
         raise ValueError(f"{self.name} is a histogram; use observe()")
 
     def _state(self, key: tuple[str, ...] = ()) -> tuple[list[float], float, float]:

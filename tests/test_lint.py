@@ -725,3 +725,13 @@ class TestTreeIsClean:
         draft = self._rbk002_sites(
             ROOT / "runbookai_tpu" / "engine" / "draft.py")
         assert draft == {"draft": 1}, draft
+        # The fleet router is HOST-ONLY code: routing reads the replicas'
+        # prefix-cache indexes and pool counters, never device state. A
+        # noqa[RBK002] appearing here would mean the router started
+        # syncing the device on the placement path — a per-request stall
+        # the fleet exists to avoid. RBK004 lock discipline covers the
+        # module through the standard engine/ tag (fleet.py's shared
+        # router state mutates only under AsyncFleet._lock).
+        fleet = self._rbk002_sites(
+            ROOT / "runbookai_tpu" / "engine" / "fleet.py")
+        assert fleet == {}, fleet

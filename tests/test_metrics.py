@@ -46,6 +46,44 @@ def test_counter_and_gauge_semantics():
     assert "runbook_test_fn_gauge" in reg.render()
 
 
+def test_labeled_scrape_time_callbacks():
+    """Per-labelset set_function (the fleet's per-replica gauges): each
+    labelset samples its own callback at scrape time, callbacks shadow any
+    stored value for their key, a dying callback drops only its own
+    series, and clear_functions() unbinds a rebuilt fleet's stale keys."""
+    reg = MetricsRegistry()
+    g = reg.gauge("runbook_test_replica_gauge", "per-replica",
+                  labels=("replica",))
+    state = {"0": 7.0, "1": 11.0}
+    g.labels(replica="0").set_function(lambda: state["0"])
+    g.labels(replica="1").set_function(lambda: state["1"])
+    text = reg.render()
+    assert 'runbook_test_replica_gauge{replica="0"} 7' in text
+    assert 'runbook_test_replica_gauge{replica="1"} 11' in text
+    state["0"] = 8.0
+    assert 'replica="0"} 8' in reg.render()
+    # The callback shadows a stored value for the same key...
+    g.labels(replica="0").set(99)
+    assert 'replica="0"} 8' in reg.render()
+    # ...and a dying callback drops only its own series — including any
+    # stale stored value for its key (never resurfaced as live data).
+    g.labels(replica="1").set(55)
+    g.labels(replica="1").set_function(lambda: 1 / 0)
+    text = reg.render()
+    assert 'replica="0"} 8' in text and 'replica="1"' not in text
+    g.clear_functions()
+    # Stored values resurface once callbacks are gone.
+    assert 'replica="0"} 99' in reg.render()
+    # Histograms have no per-key callbacks — observe() is the only input.
+    h = reg.histogram("runbook_test_cb_hist", "h", buckets=(1.0,),
+                      labels=("replica",))
+    with pytest.raises(ValueError):
+        h.labels(replica="0").set_function(lambda: 1.0)
+    # Wrong arity is rejected like labels() itself rejects it.
+    with pytest.raises(ValueError):
+        g._set_key_function(("a", "b"), lambda: 0.0)
+
+
 def test_labels_and_get_or_create():
     reg = MetricsRegistry()
     c = reg.counter("runbook_req_total", "reqs", labels=("route", "status"))
