@@ -32,7 +32,13 @@ prefix-affinity router; details carry per-replica throughput, affinity
 hit ratio and imbalance, and `outputs_digest` proves per-request streams
 byte-identical across the dp=1/dp=N arms), BENCH_SHARED_PREFIX (first S
 prompt tokens shared across requests, exercising the router's
-prefix-affinity path; default 0 keeps the historical prompt series).
+prefix-affinity path; default 0 keeps the historical prompt series),
+BENCH_PLAN (`--plan PATH`: pin the engine config to a serving-plan
+artifact from `runbook tune` — plan values become the defaults, explicit
+BENCH_* env still wins, and the plan id/hash lands in `details` so every
+banked figure is auditable against the exact plan that produced it).
+Every artifact's `details.engine_config` records the core's fully
+resolved EngineConfig (post probe-gating), flags or no flags.
 """
 
 from __future__ import annotations
@@ -312,22 +318,65 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     import jax
     import jax.numpy as jnp
 
-    from runbookai_tpu.engine.engine import EngineConfig, EngineCore
+    from runbookai_tpu.engine.engine import (
+        EngineConfig,
+        EngineCore,
+        resolve_kv_dtype,
+    )
     from runbookai_tpu.engine.request import EngineRequest, SamplingParams
     from runbookai_tpu.models.llama import CONFIGS, init_params, init_params_quantized
     from runbookai_tpu.utils.tokens import ByteTokenizer
 
-    overlap = os.environ.get("BENCH_OVERLAP", "1") != "0"
+    n_requests = int(os.environ.get("BENCH_REQUESTS", 8))
+    prompt_len = int(os.environ.get("BENCH_PROMPT", 128))
+    new_tokens = int(os.environ.get("BENCH_NEW", 64))
+
+    # Serving-plan pinning (--plan PATH / BENCH_PLAN): the artifact's
+    # engine block supplies the defaults below; explicit BENCH_* env
+    # still wins — the same explicit-beats-plan precedence as `llm.plan`
+    # in config files (runbookai_tpu/autotune/plan.py). A plan tuned for
+    # a different model is refused like from_config refuses it: a banked
+    # figure must never cite an artifact that didn't pin it.
+    plan = None
+    plan_path = os.environ.get("BENCH_PLAN")
+    if plan_path:
+        from runbookai_tpu.autotune.plan import load_plan
+
+        plan = load_plan(plan_path)
+        if plan.model != model_name:
+            raise ValueError(
+                f"plan {plan.plan_id} was tuned for model "
+                f"{plan.model!r}, not {model_name!r} (set BENCH_MODEL or "
+                f"re-run `runbook tune`)")
+
+    def pick(key: str, default, env_var: str | None = None):
+        """The one spelling of bench's precedence: explicit BENCH_* env
+        beats the plan's engine block beats the hand-picked default.
+        Integer knobs coerce (env strings, plan JSON numbers); other
+        types pass through raw."""
+        coerce = isinstance(default, int) and not isinstance(default, bool)
+        if env_var is not None and env_var in os.environ:
+            value = os.environ[env_var]
+            return int(value) if coerce else value
+        if plan is not None and plan.engine.get(key) is not None:
+            value = plan.engine[key]
+            return int(value) if coerce else value
+        return default
+
+    def resolve_impl(value: str, default: str) -> str:
+        return default if value == "auto" else value
+
+    overlap = (os.environ["BENCH_OVERLAP"] != "0"
+               if "BENCH_OVERLAP" in os.environ
+               else bool(pick("overlap_decode", True)))
     # Mixed-dispatch A/B: unset = the engine's auto policy (on for
     # tpu/axon, off on CPU); BENCH_MIXED=0 / --no-mixed forces the split
     # path, BENCH_MIXED=1 forces mixed (CPU smoke of the ragged program).
     mixed_env = os.environ.get("BENCH_MIXED")
-    mixed = None if mixed_env is None else mixed_env != "0"
-    n_requests = int(os.environ.get("BENCH_REQUESTS", 8))
-    prompt_len = int(os.environ.get("BENCH_PROMPT", 128))
-    new_tokens = int(os.environ.get("BENCH_NEW", 64))
-    slots = int(os.environ.get("BENCH_SLOTS", 8))
-    num_pages = int(os.environ.get("BENCH_PAGES", 1024))
+    mixed = (pick("mixed_dispatch", None) if mixed_env is None
+             else mixed_env != "0")
+    slots = pick("max_batch_slots", 8, env_var="BENCH_SLOTS")
+    num_pages = pick("num_pages", 1024, env_var="BENCH_PAGES")
 
     cfg = CONFIGS[model_name]
     dtype = jnp.bfloat16 if on_accel else jnp.float32
@@ -355,13 +404,15 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     # fit the chip (the slots=16 experiment OOM'd by preallocating an 8GB
     # pool next to 8.5GB of weights). Uses the device's reported bytes_limit
     # when available, else the v5e 16GB spec sheet.
-    page_size = 16
+    page_size = pick("page_size", 16)
     # BENCH_KV=fp8 halves page bytes (doubles pooled tokens) and keeps
     # the Pallas attention path (engine probe-gates the combination).
     # BENCH_KV=int8 also halves values but adds per-token scales and
     # serves via the XLA gather path (better accuracy, no fp8 compute).
-    kv_dtype = {"fp8": jnp.float8_e4m3fn,
-                "int8": jnp.int8}.get(os.environ.get("BENCH_KV", ""), dtype)
+    kv_name = os.environ.get("BENCH_KV", "")
+    if not kv_name and plan is not None:
+        kv_name = plan.engine.get("kv_dtype") or ""
+    kv_dtype = resolve_kv_dtype(kv_name, dtype)
     # Draft-model weights load BEFORE the page fit so the HBM budget
     # subtracts them (and the fixed draft pool) — BENCH_DRAFT on a full
     # chip must shrink the target pool, not OOM.
@@ -407,16 +458,28 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
             num_pages = fit
     ecfg = EngineConfig(
         page_size=page_size, num_pages=num_pages, max_batch_slots=slots,
-        prefill_chunk=128, max_seq_len=2048, kv_dtype=kv_dtype, block_pages=16,
-        attn_impl=os.environ.get("BENCH_ATTN", "pallas" if on_accel else "xla"),
+        prefill_chunk=pick("prefill_chunk", 128),
+        max_seq_len=pick("max_seq_len", 2048), kv_dtype=kv_dtype,
+        block_pages=pick("block_pages", 16),
+        decode_steps_per_dispatch=pick("decode_steps_per_dispatch", 8),
+        speculative=bool(pick("speculative", True)),
+        mixed_token_budget=pick("mixed_token_budget", None),
+        # "auto" (from a plan or env) resolves HERE to the backend
+        # default — EngineConfig compares impls literally, so an
+        # unresolved "auto" would silently serve the XLA path on TPU.
+        attn_impl=resolve_impl(
+            os.environ.get("BENCH_ATTN", pick("attn_impl", "auto")),
+            "pallas" if on_accel else "xla"),
         # Streamed-int8 matmul kernel (ops/qmm_pallas.py): the decode
         # bound is weight bytes/step; this makes the halved byte count
         # structural instead of an XLA fusion gamble.
-        qmm_impl=os.environ.get(
-            "BENCH_QMM", "pallas" if (on_accel and quantized) else "xla"),
+        qmm_impl=resolve_impl(
+            os.environ.get("BENCH_QMM", pick("qmm_impl", "auto")),
+            "pallas" if (on_accel and quantized) else "xla"),
         # Batch all concurrent prompts' prefill chunks into one dispatch so
         # TTFT stays ~flat under load (p50_ttft_ms in details tracks this).
-        prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH", slots)),
+        prefill_batch=pick("prefill_batch", slots,
+                           env_var="BENCH_PREFILL_BATCH"),
         # Overlapped decode pipeline (device-resident feedback + async
         # egress); BENCH_OVERLAP=0 / --no-overlap is the sync A/B arm.
         overlap_decode=overlap,
@@ -465,7 +528,15 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
             [list(map(int, ids)) for ids in token_lists]).encode()
         ).hexdigest()
 
-    dp = max(1, int(os.environ.get("BENCH_DP", "1") or 1))
+    dp_env = os.environ.get("BENCH_DP")
+    dp = int(dp_env) if dp_env else pick("dp_replicas", 1)
+    dp = max(1, dp)
+    # A plan's slots/pages are PER REPLICA (the llm.*/EngineConfig
+    # contract) — a plan-sized fleet must not re-split them. The --dp
+    # flag keeps its historical fixed-total-budget A/B semantics.
+    per_replica = dp > 1 and not dp_env and plan is not None
+    plan_detail = ({"id": plan.plan_id, "hash": plan.content_hash,
+                    "path": plan_path} if plan is not None else None)
     if dp > 1:
         run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe,
                         n_requests=n_requests, prompt_len=prompt_len,
@@ -474,7 +545,9 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
                         quantized=quantized, weights_path=weights_path,
                         draft_cfg=dcfg, draft_params=dparams,
                         draft_name=draft_name,
-                        draft_pool_pages=DRAFT_POOL_PAGES)
+                        draft_pool_pages=DRAFT_POOL_PAGES,
+                        plan_detail=plan_detail,
+                        per_replica=per_replica)
         return
 
     core = EngineCore(cfg, params, tok, ecfg,
@@ -524,7 +597,14 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     peak = peak_flops_per_chip(probe.get("kind", "")) if on_accel else None
     mfu = (2.0 * cfg.matmul_params * decode_tps / peak) if peak else None
 
+    # Reproducibility contract: the CORE's fully resolved EngineConfig
+    # (post probe-gating) rides in every artifact, so a banked figure can
+    # be replayed — and audited against its plan when one pinned the run.
+    from runbookai_tpu.autotune.plan import engine_config_dict
+
     details = {
+        "engine_config": engine_config_dict(core.ecfg),
+        "plan": plan_detail,
         "model": model_name,
         "weights": "int8" if quantized else str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
         # Quality axis honesty: random-init weights give real THROUGHPUT
@@ -627,7 +707,8 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
                     n_requests, prompt_len, new_tokens, make_prompt,
                     outputs_digest, on_accel, quantized, weights_path,
                     draft_cfg=None, draft_params=None, draft_name=None,
-                    draft_pool_pages=256) -> None:
+                    draft_pool_pages=256, plan_detail=None,
+                    per_replica=False) -> None:
     """The ``--dp N`` arm: the SAME request set through a data-parallel
     engine fleet. The slot/page budget splits across replicas (fixed total
     resources, like a pod slicing its chips along the dp axis — the split
@@ -640,26 +721,32 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
     ``outputs_digest`` must equal the dp=1 arm's — routing chooses a
     replica, never changes a stream."""
     import asyncio
-    import dataclasses as _dc
     import time as _time
 
     import jax.numpy as jnp
 
-    from runbookai_tpu.engine.fleet import AsyncFleet, build_engine_fleet
+    from runbookai_tpu.engine.fleet import (
+        AsyncFleet,
+        build_engine_fleet,
+        split_engine_budget,
+    )
     from runbookai_tpu.engine.request import EngineRequest, SamplingParams
     from runbookai_tpu.utils.weights import quality_marker
 
-    slots_total = ecfg.max_batch_slots
-    slots_per = max(1, slots_total // dp)
-    ecfg = _dc.replace(
-        ecfg, dp_replicas=dp,
-        max_batch_slots=slots_per,
-        # Exact split (allocator minimum 2): a floor that rounds the
-        # per-replica pool UP would hand the fleet arm more total pages
-        # than dp=1 and fake a win via fewer preemptions.
-        num_pages=max(2, ecfg.num_pages // dp),
-        prefill_batch=max(1, min(ecfg.prefill_batch, slots_per)),
-    )
+    if per_replica:
+        # Plan-sized fleet: slots/pages already PER REPLICA (the
+        # llm.*/EngineConfig contract) — just stamp the replica count.
+        import dataclasses as _dc
+
+        ecfg = _dc.replace(ecfg, dp_replicas=dp)
+        slots_total = ecfg.max_batch_slots * dp
+    else:
+        # --dp A/B: exact per-replica split of the fleet-TOTAL budget
+        # (never rounded UP past the dp=1 arm's resources) —
+        # fleet.split_engine_budget.
+        slots_total = ecfg.max_batch_slots
+        ecfg = split_engine_budget(ecfg, dp)
+    slots_per = ecfg.max_batch_slots
     draft_factory = None
     if draft_params is not None:
         from runbookai_tpu.engine.draft import DraftWorker
@@ -710,7 +797,7 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
     total_decode = sum(c.metrics["decode_tokens"] for c in cores)
     max_decode_t = max(c.metrics["decode_time_s"] for c in cores)
     routed = fleet.routed_counts()
-    per_replica = [{
+    replica_stats = [{
         "replica": i,
         "requests_routed": routed[i],
         "decode_tokens": c.metrics["decode_tokens"],
@@ -723,7 +810,13 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
         "spec_accepted": c.metrics.get("spec_accepted", 0),
     } for i, c in enumerate(cores)]
     ttfts = sorted(o.ttft_ms for o in outs if o.ttft_ms is not None)
+    from runbookai_tpu.autotune.plan import engine_config_dict
+
     details = {
+        # Per-REPLICA resolved config (the fleet split applied), plus the
+        # plan that pinned this arm when --plan was used.
+        "engine_config": engine_config_dict(cores[0].ecfg),
+        "plan": plan_detail,
         "model": cfg.name,
         "weights": "int8" if quantized else "float32",
         "quality": quality_marker(weights_path),
@@ -747,11 +840,11 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
             (total_decode + sum(c.metrics["prefill_tokens"]
                                 for c in cores)) / wall, 2),
         "decode_tps_sum_per_replica": round(
-            sum(r["tok_s"] for r in per_replica), 2),
+            sum(r["tok_s"] for r in replica_stats), 2),
         "p50_ttft_ms": (round(ttfts[len(ttfts) // 2], 1) if ttfts else None),
         "lost_requests": lost,
         "outputs_digest": outputs_digest([o.token_ids for o in outs]),
-        "per_replica": per_replica,
+        "per_replica": replica_stats,
         "affinity_hit_ratio": round(fleet.affinity_hit_ratio(), 4),
         "imbalance_ratio": round(fleet._imbalance(), 4),
         "router_retries": int(fleet._m_retries.value),
@@ -793,8 +886,25 @@ def run_inner(model_name: str, on_accel: bool, probe: dict) -> None:
         from runbookai_tpu.utils.cpu_mesh import force_cpu_platform
 
         # A CPU fleet needs one virtual device per replica so each
-        # replica's compiled steps run on its own device slice.
-        force_cpu_platform(max(1, int(os.environ.get("BENCH_DP", "1") or 1)))
+        # replica's compiled steps run on its own device slice. A plan
+        # may size the fleet when BENCH_DP doesn't (autotune.plan is
+        # stdlib-only, so loading it here cannot initialize jax before
+        # force_cpu_platform runs).
+        dp_env = os.environ.get("BENCH_DP")
+        dp = int(dp_env) if dp_env else 1
+        plan_path = os.environ.get("BENCH_PLAN")
+        # Only an UNSET BENCH_DP defers to the plan — an explicit
+        # BENCH_DP=1 pins a single-device run (env beats plan).
+        if not dp_env and plan_path:
+            from runbookai_tpu.autotune.plan import load_plan
+
+            try:
+                dp = int(load_plan(plan_path).engine.get("dp_replicas")
+                         or 1)
+            except ValueError:
+                dp = 1  # invalid plans fail in run_bench with
+                # load_plan's real error, not here
+        force_cpu_platform(max(1, dp))
     try:
         run_bench(model_name, on_accel, probe)
     except Exception as e:  # noqa: BLE001 — always emit a parseable line
@@ -856,6 +966,16 @@ def main() -> None:
             print("usage: bench.py --dp N (replica count)", file=sys.stderr)
             sys.exit(2)
         os.environ["BENCH_DP"] = sys.argv.pop(i)
+    if "--plan" in sys.argv:
+        # Pin the engine config to a `runbook tune` serving-plan artifact
+        # (explicit BENCH_* env still overrides individual plan keys).
+        i = sys.argv.index("--plan")
+        sys.argv.pop(i)
+        if i >= len(sys.argv):
+            print("usage: bench.py --plan PATH (serving-plan artifact)",
+                  file=sys.stderr)
+            sys.exit(2)
+        os.environ["BENCH_PLAN"] = sys.argv.pop(i)
     if len(sys.argv) > 1 and sys.argv[1] == "--inner":
         run_inner(sys.argv[2], sys.argv[3] == "1", json.loads(sys.argv[4]))
         return
@@ -876,8 +996,9 @@ def main() -> None:
     cpu_probe = {"ok": True, "platform": "cpu", "kind": "cpu", "n": 1}
     sanity_budget = min(480.0, max(60.0, watchdog_s - (time.monotonic() - t0) - 600.0))
     # The sanity line is the round-over-round single-engine series; a --dp
-    # run must not switch it to fleet mode (env restored right after).
+    # or --plan run must not perturb it (env restored right after).
     dp_env = os.environ.pop("BENCH_DP", None)
+    plan_env = os.environ.pop("BENCH_PLAN", None)
     try:
         cpu_sanity = _spawn_inner(
             os.environ.get("BENCH_CPU_MODEL", "llama3-test"), False,
@@ -885,6 +1006,8 @@ def main() -> None:
     finally:
         if dp_env is not None:
             os.environ["BENCH_DP"] = dp_env
+        if plan_env is not None:
+            os.environ["BENCH_PLAN"] = plan_env
     sanity_line = None
     if cpu_sanity is not None:
         d = cpu_sanity.get("details", {})
@@ -912,10 +1035,12 @@ def main() -> None:
 
     if not on_accel and cpu_sanity is not None and \
             os.environ.get("BENCH_DP", "1") in ("", "1") and \
+            "BENCH_PLAN" not in os.environ and \
             os.environ.get("BENCH_CPU_MODEL", "llama3-test") == model_name:
         # The fallback headline IS the cpu-sanity config — don't run it
-        # twice. (A --dp run's headline is the fleet arm, which the dp=1
-        # sanity line deliberately is not.)
+        # twice. (A --dp run's headline is the fleet arm, and a --plan
+        # run's headline applies the plan, which the default sanity line
+        # deliberately does not.)
         result = cpu_sanity
         result.setdefault("details", {})["tpu_error"] = probe.get("error")
         finish(result)

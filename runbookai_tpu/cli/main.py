@@ -709,7 +709,11 @@ def cmd_metrics(args) -> int:
     response's ``x-request-id``, grep the trace JSONL for it, then compare
     that request against the population summarized here."""
     if args.trace:
-        from runbookai_tpu.utils.trace import read_spans, summarize_spans
+        from runbookai_tpu.utils.trace import (
+            dispatch_counters,
+            read_spans,
+            summarize_spans,
+        )
 
         try:
             spans = read_spans(args.trace)
@@ -719,6 +723,12 @@ def cmd_metrics(args) -> int:
         summary = summarize_spans(spans)
         if args.span:
             summary = {k: v for k, v in summary.items() if args.span in k}
+        else:
+            # Dispatch-kind counters (PR 4 attribution) recovered from the
+            # trace alone — a tune run's measured refinement (or any bench
+            # arm) is sanity-checkable without its Prometheus scrape: zero
+            # engine.mixed spans under a mixed-dispatch plan is a lie.
+            summary["dispatch_counters"] = dispatch_counters(spans)
         print(json.dumps(summary, indent=2))
         return 0
 
@@ -739,6 +749,121 @@ def cmd_metrics(args) -> int:
                          if args.grep in line)
     print(text)
     return 0
+
+
+def cmd_tune(args) -> int:
+    """``runbook tune`` — serving-plan autotuner sweep (docs/autotune.md):
+    analytic cost-model prune over the engine knob space, measured
+    refinement of the survivors (baseline always competes, so the emitted
+    plan can never regress the hand-picked defaults), versioned plan
+    artifact out."""
+    import os
+
+    if args.smoke and not os.environ.get("JAX_PLATFORMS"):
+        # The smoke path is a CPU contract — don't let a half-up
+        # accelerator plugin hang a bounded-time sweep.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from runbookai_tpu.autotune.cost_model import (
+        HARDWARE,
+        Candidate,
+        SearchSpace,
+        Workload,
+        smoke_space,
+    )
+    from runbookai_tpu.autotune.search import tune
+
+    # ONE config read serves both defaults (model, out) — or none at all
+    # when the flags pin everything.
+    config = _load(args) if args.out is None or (
+        args.model is None and not args.smoke) else None
+    if args.smoke:
+        model = args.model or "llama3-test"
+        space = smoke_space()
+        workload = Workload(prompt_len=min(args.prompt_len, 48),
+                            output_len=min(args.output_len, 16),
+                            concurrency=min(args.concurrency, 4))
+        baseline = Candidate(page_size=4, num_pages=256,
+                             max_batch_slots=4, prefill_chunk=32,
+                             kv_dtype="auto", max_seq_len=256)
+        hw, weights = HARDWARE["cpu"], "bf16"
+    else:
+        model = args.model or config.llm.model
+        workload = Workload(
+            prompt_len=args.prompt_len, output_len=args.output_len,
+            concurrency=args.concurrency, guided_share=args.guided_share,
+            spec_hit_rate=args.spec_hit_rate)
+        axes = {}
+        if args.dp:
+            axes["dp_replicas"] = tuple(
+                int(v) for v in args.dp.split(","))
+        if args.tp:
+            axes["tp"] = tuple(int(v) for v in args.tp.split(","))
+        space = SearchSpace(**axes)
+        baseline = None
+        hw_name = args.hw
+        if hw_name == "auto":
+            import jax
+
+            if jax.default_backend() == "cpu":
+                hw_name = "cpu"
+            else:
+                kind = jax.devices()[0].device_kind.lower()
+                hw_name = "v6e" if "v6" in kind else "v5e"
+        hw, weights = HARDWARE[hw_name], args.weights
+    out = args.out or str(
+        Path(config.runbook_dir) / "plans" / f"{model}.{hw.name}.json")
+    try:
+        result = tune(
+            model, workload, hw, space, weights=weights, top_k=args.top_k,
+            measure=not args.no_measure, baseline=baseline,
+            n_requests=args.requests, new_tokens=args.new_tokens,
+            budget_s=args.budget_s, out=out, log=print)
+    except ValueError as e:
+        # e.g. an all-infeasible sweep — no plan artifact is written.
+        print(str(e), file=sys.stderr)
+        return 1
+    plan = result.plan
+    print(json.dumps({
+        "plan_id": plan.plan_id, "out": str(out),
+        "engine": plan.engine,
+        "cost_model": plan.provenance.get("cost_model"),
+        "measured": plan.provenance.get("measured"),
+    }, indent=2))
+    return 0
+
+
+def cmd_plan(args) -> int:
+    """``runbook plan show|validate`` — inspect / gate plan artifacts."""
+    from runbookai_tpu.autotune.plan import load_plan, validate_plan
+
+    if args.plan_cmd == "show":
+        try:
+            plan = load_plan(args.path)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        print(json.dumps(plan.to_dict(), indent=2))
+        return 0
+    if args.plan_cmd == "validate":
+        failures = 0
+        for path in args.paths:
+            try:
+                data = json.loads(Path(path).read_text())
+            except (OSError, json.JSONDecodeError) as e:
+                print(f"{path}: unreadable ({e})")
+                failures += 1
+                continue
+            problems = validate_plan(data)
+            if problems:
+                failures += 1
+                print(f"{path}: INVALID")
+                for p in problems:
+                    print(f"  - {p}")
+            else:
+                print(f"{path}: ok ({data['plan_id']})")
+        return 0 if failures == 0 else 1
+    return 1
 
 
 def cmd_bench(args) -> int:
@@ -1072,6 +1197,51 @@ def build_parser() -> argparse.ArgumentParser:
 
     bench = sub.add_parser("bench", help="serving benchmark (one JSON line)")
     bench.set_defaults(fn=cmd_bench)
+
+    tune = sub.add_parser(
+        "tune", help="serving-plan autotuner: cost-model sweep + measured "
+                     "refinement -> plan artifact (docs/autotune.md)")
+    tune.add_argument("--model", default=None,
+                      help="model config name (default: llm.model; "
+                           "--smoke: llama3-test)")
+    tune.add_argument("--smoke", action="store_true",
+                      help="bounded CPU smoke sweep (tiny model + space)")
+    tune.add_argument("--hw", default="auto",
+                      choices=["auto", "v5e", "v6e", "v5e-tunnel", "cpu"],
+                      help="hardware envelope for the cost model")
+    tune.add_argument("--weights", default="int8", choices=["int8", "bf16"])
+    tune.add_argument("--prompt-len", type=int, default=512)
+    tune.add_argument("--output-len", type=int, default=128)
+    tune.add_argument("--concurrency", type=int, default=16)
+    tune.add_argument("--guided-share", type=float, default=0.0)
+    tune.add_argument("--spec-hit-rate", type=float, default=0.0)
+    tune.add_argument("--dp", default=None, metavar="1,2,4",
+                      help="dp_replicas axis values (comma-separated)")
+    tune.add_argument("--tp", default=None, metavar="1,8,16",
+                      help="tp axis values (comma-separated)")
+    tune.add_argument("--top-k", type=int, default=3,
+                      help="survivors refined with measured runs")
+    tune.add_argument("--no-measure", action="store_true",
+                      help="analytic only (no engine runs)")
+    tune.add_argument("--requests", type=int, default=4,
+                      help="measured-run request count")
+    tune.add_argument("--new-tokens", type=int, default=16,
+                      help="measured-run decode tokens per request")
+    tune.add_argument("--budget-s", type=float, default=300.0,
+                      help="measured-phase time budget")
+    tune.add_argument("--out", default=None,
+                      help="plan path (default: "
+                           ".runbook/plans/<model>.<hw>.json)")
+    tune.set_defaults(fn=cmd_tune)
+
+    plan = sub.add_parser("plan", help="serving-plan artifacts")
+    plan_sub = plan.add_subparsers(dest="plan_cmd", required=True)
+    plan_show = plan_sub.add_parser("show", help="print a validated plan")
+    plan_show.add_argument("path")
+    plan_val = plan_sub.add_parser(
+        "validate", help="schema + content-hash check (CI gate)")
+    plan_val.add_argument("paths", nargs="+")
+    plan.set_defaults(fn=cmd_plan)
 
     met = sub.add_parser(
         "metrics", help="scrape a server's /metrics or summarize a trace")

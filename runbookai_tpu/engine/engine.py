@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import functools
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, fields
 from functools import partial
 from typing import Any, Callable, Optional
 
@@ -140,6 +140,60 @@ class EngineConfig:
     # devices; on a pod each host builds replicas over its local slice
     # (parallel/multihost.local_replica_range).
     dp_replicas: int = 1
+
+    @classmethod
+    def from_plan(cls, engine_block: dict, *, default_kv_dtype: Any = None,
+                  **overrides) -> "EngineConfig":
+        """Construct from a serving-plan artifact's ``engine`` block
+        (:mod:`runbookai_tpu.autotune.plan`) — the autotuner's output is
+        a first-class config input, not YAML to be re-typed.
+
+        ``engine_block`` keys map 1:1 onto fields; ``kv_dtype`` travels
+        as a plan string ("auto"/"bf16"/"fp8"/"int8" — "auto" resolves to
+        ``default_kv_dtype``, the activation dtype, exactly the
+        ``llm.kv_cache_dtype`` contract). ``overrides`` win over the plan
+        (explicit config beats artifact). Unknown keys raise: a plan from
+        a newer schema must fail loudly, never half-apply.
+        """
+        names = {f.name for f in fields(cls)}
+        unknown = sorted(set(engine_block) - names - {"kv_dtype"})
+        if unknown:
+            raise ValueError(
+                f"plan engine block has unknown keys: {', '.join(unknown)}")
+        kw = {k: v for k, v in engine_block.items() if k != "kv_dtype"}
+        name = engine_block.get("kv_dtype")
+        if name is not None:
+            kw["kv_dtype"] = resolve_kv_dtype(
+                name, default_kv_dtype if default_kv_dtype is not None
+                else jnp.bfloat16)
+        for key in ("attn_impl", "qmm_impl"):
+            # EngineConfig serves literal impls only — "auto" is a
+            # deployment-time decision (backend, weight width) the caller
+            # must make; passing it through would compare false against
+            # "pallas" everywhere and silently serve the XLA path.
+            if kw.get(key) == "auto" and key not in overrides:
+                raise ValueError(
+                    f"plan {key} 'auto' must be resolved by the caller "
+                    f"(pass {key}=... for the deployment backend)")
+        kw.update(overrides)
+        return cls(**kw)
+
+
+def resolve_kv_dtype(name: Optional[str], default: Any) -> Any:
+    """The ONE resolver for every kv-dtype spelling a plan or config can
+    carry: ``bench --plan``, :meth:`EngineConfig.from_plan` and
+    ``from_config`` must allocate the same pool for the same string.
+    "auto"/empty/None follow ``default`` (the activation dtype); "bf16"
+    pins a bfloat16 pool even on float32 activations; unknown names
+    raise instead of silently serving the activation width."""
+    if name in (None, "", "auto"):
+        return default
+    resolved = {"bf16": jnp.bfloat16, "fp8": jnp.float8_e4m3fn,
+                "int8": jnp.int8}.get(name)
+    if resolved is None:
+        raise ValueError(
+            f"kv_dtype {name!r} not one of auto/bf16/fp8/int8")
+    return resolved
 
 
 @partial(jax.jit, static_argnames=("cfg", "page_size", "block_pages", "attn_impl",

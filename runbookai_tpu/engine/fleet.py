@@ -95,6 +95,28 @@ class FleetConfig:
     max_retries: Optional[int] = None
 
 
+def split_engine_budget(engine_cfg: EngineConfig, dp: int) -> EngineConfig:
+    """Per-replica EngineConfig from a fleet-TOTAL slot/page budget.
+
+    The split is exact, never rounded UP past the total (a floor that
+    rounded the per-replica pool up would hand a dp arm more aggregate
+    pages than dp=1 and fake a win via fewer preemptions — the bench
+    --dp arm's fixed-total-budget contract, and this helper's ONLY
+    caller). Plan artifacts and the autotuner's measured arms carry
+    PER-REPLICA slot/page budgets already (the llm.*/EngineConfig
+    contract) and must never pass through this split.
+    Allocator minimums: 1 slot, 2 pages per replica.
+    """
+    import dataclasses
+
+    dp = max(1, dp)
+    slots_per = max(1, engine_cfg.max_batch_slots // dp)
+    return dataclasses.replace(
+        engine_cfg, dp_replicas=dp, max_batch_slots=slots_per,
+        num_pages=max(2, engine_cfg.num_pages // dp),
+        prefill_batch=max(1, min(engine_cfg.prefill_batch, slots_per)))
+
+
 def build_engine_fleet(
     model_cfg,
     params,

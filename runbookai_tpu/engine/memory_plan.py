@@ -2,10 +2,14 @@
 
 SURVEY §5.7 / r3 VERDICT weak #7: long-context serving must be *planned*,
 not defaulted — KV bytes scale linearly with context and dominate HBM long
-before compute becomes a problem. This module is the arithmetic the engine,
-bench, and docs all quote, with the KV-split factorization
-(:mod:`runbookai_tpu.parallel.kv_split`) folded in so plans stay correct
-past the GQA head count.
+before compute becomes a problem. This module is the RESIDENCY arithmetic
+(with the KV-split factorization of :mod:`runbookai_tpu.parallel.kv_split`
+folded in so plans stay correct past the GQA head count); it is no longer
+the only planning layer: the serving-plan autotuner
+(:mod:`runbookai_tpu.autotune`) composes these numbers with an HLO-bytes
+roofline to search the full knob space, and its cost model delegates every
+residency figure here (pinned equal by tests/test_autotune.py) — engine,
+bench, docs, and tuner all quote ONE arithmetic.
 
 The headline numbers it encodes (v5e, 16 GB/chip):
 

@@ -16,7 +16,11 @@ import jax.numpy as jnp
 
 from runbookai_tpu.agent.types import LLMResponse
 from runbookai_tpu.engine.async_engine import AsyncEngine
-from runbookai_tpu.engine.engine import EngineConfig, EngineCore
+from runbookai_tpu.engine.engine import (
+    EngineConfig,
+    EngineCore,
+    resolve_kv_dtype,
+)
 from runbookai_tpu.engine.request import SamplingParams
 from runbookai_tpu.model.chat_template import (
     build_chat_prompt,
@@ -112,8 +116,32 @@ class JaxTpuClient(BaseLLMClient):
         A real checkpoint is discovered automatically: configured
         ``model_path`` first, else ``$RUNBOOK_WEIGHTS`` (utils/weights.py)
         — so live eval banks pass@1 the moment weights exist (VERDICT r4
-        #3) with no config change."""
+        #3) with no config change.
+
+        ``llm.plan`` makes a ``runbook tune`` serving-plan artifact a
+        first-class config input: the plan's engine block supplies every
+        knob the sweep decided, while keys the operator set EXPLICITLY in
+        YAML keep winning (``autotune.plan.apply_plan_to_llm`` reads
+        pydantic's ``model_fields_set`` for exactly that precedence), and
+        plan keys with no YAML spelling (speculative, mixed_token_budget,
+        …) land directly on the built EngineConfig."""
         from runbookai_tpu.utils.weights import discover_weights
+
+        serving_plan = None
+        if getattr(llm_cfg, "plan", None):
+            from runbookai_tpu.autotune.plan import (
+                apply_plan_to_llm,
+                load_plan,
+            )
+
+            serving_plan = load_plan(llm_cfg.plan)
+            if serving_plan.model != llm_cfg.model:
+                raise ValueError(
+                    f"llm.plan {serving_plan.plan_id!r} was tuned for "
+                    f"model {serving_plan.model!r}, not {llm_cfg.model!r} "
+                    f"— plans are per model×topology; re-run "
+                    f"`runbook tune`")
+            llm_cfg = apply_plan_to_llm(llm_cfg, serving_plan)
 
         model_path = discover_weights(llm_cfg.model, llm_cfg.model_path)
         tokenizer = load_tokenizer(llm_cfg.tokenizer_path or model_path)
@@ -159,8 +187,7 @@ class JaxTpuClient(BaseLLMClient):
             model_cfg_name, model_path, dtype=dtype, shardings=shardings,
             quantize_int8=quantize,
         )
-        kv_dtype = {"fp8": jnp.float8_e4m3fn,
-                    "int8": jnp.int8}.get(llm_cfg.kv_cache_dtype, dtype)
+        kv_dtype = resolve_kv_dtype(llm_cfg.kv_cache_dtype, dtype)
         ecfg = EngineConfig(
             page_size=llm_cfg.page_size,
             num_pages=llm_cfg.num_pages,
@@ -189,6 +216,18 @@ class JaxTpuClient(BaseLLMClient):
                             else "xla")),
             dp_replicas=dp_replicas,
         )
+        if serving_plan is not None:
+            from runbookai_tpu.autotune.plan import engine_only_overrides
+
+            # Plan keys with no llm.* spelling (speculative,
+            # mixed_token_budget, prefill_batch, block_pages, …) apply
+            # straight onto the engine config. (Named serving_plan: the
+            # TP branch above rebinds `plan` to a KVSplitPlan.)
+            overrides = engine_only_overrides(serving_plan)
+            if overrides:
+                import dataclasses as _dc
+
+                ecfg = _dc.replace(ecfg, **overrides)
         lora_registry = None
         if getattr(llm_cfg, "lora_adapters", None):
             from runbookai_tpu.models.lora import LoraRegistry

@@ -112,7 +112,15 @@ class LLMConfig(BaseModel):
     # "int8": values + per-token absmax scales, XLA path (best accuracy
     # at 1 byte/value on hardware without fast fp8); "fp8": raw e4m3
     # pages, composes with the Pallas kernels and the page-split mesh.
-    kv_cache_dtype: Literal["auto", "fp8", "int8"] = "auto"
+    # "bf16" pins a bfloat16 pool even on float32 activations (the plan
+    # artifact spelling — identical to "auto" on bf16 deployments).
+    kv_cache_dtype: Literal["auto", "bf16", "fp8", "int8"] = "auto"
+    # Serving-plan artifact (runbook tune; runbookai_tpu/autotune/plan.py):
+    # path to a schema-versioned plan JSON whose engine block supplies the
+    # serving knobs below. Precedence: any key you set EXPLICITLY in this
+    # file still wins over the plan; unset keys take the plan's values
+    # instead of the defaults (docs/autotune.md, docs/CONFIG.md).
+    plan: Optional[str] = None
     # Paged KV cache (engine):
     page_size: int = 16  # tokens per KV page
     num_pages: int = 2048  # page pool size (static for XLA)
@@ -399,6 +407,21 @@ def validate_config(config: Config) -> list[str]:
     if config.llm.provider == "jax-tpu" and config.llm.model_path:
         if not Path(config.llm.model_path).exists():
             problems.append(f"llm.model_path does not exist: {config.llm.model_path}")
+    if config.llm.plan:
+        if not Path(config.llm.plan).is_file():
+            problems.append(f"llm.plan does not exist: {config.llm.plan}")
+        else:
+            from runbookai_tpu.autotune.plan import load_plan
+
+            try:
+                plan = load_plan(config.llm.plan)
+            except ValueError as e:
+                problems.append(f"llm.plan: {e}")
+            else:
+                if plan.model != config.llm.model:
+                    problems.append(
+                        f"llm.plan was tuned for model {plan.model!r} but "
+                        f"llm.model is {config.llm.model!r}")
     for src in config.knowledge.sources:
         if src.type == "filesystem" and src.path and not Path(src.path).exists():
             problems.append(f"knowledge source path does not exist: {src.path}")

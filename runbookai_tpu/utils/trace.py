@@ -205,6 +205,32 @@ def _percentile(sorted_ms: list[float], q: float) -> float:
     return sorted_ms[lo] + (sorted_ms[hi] - sorted_ms[lo]) * (pos - lo)
 
 
+# Span name -> dispatch-kind counter. One engine span = one device
+# dispatch of that kind, so a trace JSONL alone reconstructs the PR-4
+# counters (`runbook_prefill_dispatch_total` / `runbook_decode_dispatch_
+# total` / `runbook_mixed_dispatch_total`) — engine.decode_spec is a
+# decode dispatch that happened to verify a speculative draft.
+_DISPATCH_SPANS = {
+    "engine.prefill": "prefill_steps",
+    "engine.decode": "decode_dispatches",
+    "engine.decode_spec": "decode_dispatches",
+    "engine.mixed": "mixed_steps",
+}
+
+
+def dispatch_counters(spans: list[dict[str, Any]]) -> dict[str, int]:
+    """Dispatch-kind counts recovered from a span JSONL — lets a tune
+    run's measured refinement (or any banked bench arm) be sanity-checked
+    from its trace alone: a config that claims mixed dispatch but traces
+    zero ``engine.mixed`` spans did not serve the config it claims."""
+    out = {"prefill_steps": 0, "decode_dispatches": 0, "mixed_steps": 0}
+    for rec in spans:
+        key = _DISPATCH_SPANS.get(str(rec.get("name", "")))
+        if key is not None:
+            out[key] += 1
+    return out
+
+
 def summarize_spans(spans: list[dict[str, Any]]) -> dict[str, dict[str, Any]]:
     """Per-span-name latency summary: count, p50/p95/max/total ms.
 
