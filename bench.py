@@ -36,9 +36,18 @@ prefix-affinity path; default 0 keeps the historical prompt series),
 BENCH_PLAN (`--plan PATH`: pin the engine config to a serving-plan
 artifact from `runbook tune` — plan values become the defaults, explicit
 BENCH_* env still wins, and the plan id/hash lands in `details` so every
-banked figure is auditable against the exact plan that produced it).
+banked figure is auditable against the exact plan that produced it),
+BENCH_PROFILE (`--profile [DIR]`: wrap the measured window in an XProf
+capture — details.profile records the TensorBoard-readable trace dir, or
+a clean skip when jax.profiler capture is unavailable), BENCH_SLO (JSON
+dict of llm.slo-style targets, e.g. '{"tpot_p95_ms": 40}' — evaluated
+against the measured window's histograms into details.slo with the
+per-objective burn ratio).
 Every artifact's `details.engine_config` records the core's fully
-resolved EngineConfig (post probe-gating), flags or no flags.
+resolved EngineConfig (post probe-gating), flags or no flags; every
+measured window also carries `details.flight_summary` (step-level
+dispatch-kind counts, occupancy p50/p95, KV-pressure peak from the
+engine flight recorder).
 """
 
 from __future__ import annotations
@@ -113,6 +122,54 @@ def reset_warmup_metrics(core) -> None:
         mixed_time_s=0.0)
     core.hist_ttft.reset()
     core.hist_tpot.reset()
+    # The flight_summary block must describe the MEASURED window, not the
+    # warmup compiles.
+    core.flight.reset()
+
+
+def profile_context():
+    """BENCH_PROFILE support (`--profile [DIR]`): an XProf capture around
+    the measured window, recorded in ``details["profile"]`` as captured
+    (with the trace dir) or cleanly skipped — the CPU tier-1 smoke
+    asserts exactly that produced-or-skipped contract."""
+    import contextlib
+
+    target = os.environ.get("BENCH_PROFILE")
+    if not target:
+        return contextlib.nullcontext(None), None
+    from runbookai_tpu.utils.trace import try_device_trace
+
+    profile_dir = (target if target != "1"
+                   else os.path.join(".runbook", "profile", "bench"))
+    return try_device_trace(profile_dir), profile_dir
+
+
+def profile_detail(profile_dir: str | None, captured) -> dict | None:
+    if profile_dir is None:
+        return None
+    return {"dir": profile_dir, "captured": bool(captured),
+            **({} if captured else
+               {"skipped": "jax.profiler capture unavailable"})}
+
+
+def slo_detail(registry_targets_env: str | None) -> dict | None:
+    """BENCH_SLO='{"tpot_p95_ms": 40}' evaluates the configured targets
+    against the measured window's histograms (utils/slo.py) and reports
+    the burn — the one-flag proof that a breached objective scrapes
+    ``runbook_slo_burn_ratio > 1`` while an unconfigured run carries no
+    SLO block at all."""
+    if not registry_targets_env:
+        return None
+    from runbookai_tpu.utils.slo import SLOMonitor
+
+    try:
+        targets = json.loads(registry_targets_env)
+        if not isinstance(targets, dict):
+            raise TypeError(f"expected a JSON object, got {type(targets).__name__}")
+        monitor = SLOMonitor(targets)
+    except (ValueError, TypeError) as e:
+        return {"error": f"bad BENCH_SLO: {e}"}
+    return monitor.evaluate()
 
 
 def _parses(text: str) -> bool:
@@ -574,10 +631,12 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     reset_warmup_metrics(core)
 
     reqs = [make_req() for _ in range(n_requests)]
+    prof_ctx, prof_dir = profile_context()
     t0 = time.perf_counter()
-    for r in reqs:
-        core.submit(r)
-    core.run_until_idle()
+    with prof_ctx as prof_captured:
+        for r in reqs:
+            core.submit(r)
+        core.run_until_idle()
     wall = time.perf_counter() - t0
 
     m = core.metrics
@@ -654,6 +713,10 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
             m.get("decode_host_overlap_s", 0.0)
             / max(m.get("decode_host_time_s", 0.0), 1e-9), 3),
         "preemptions": m["preemptions"],
+        # Step-level provenance of the measured window (engine flight
+        # recorder): what kinds of dispatches ran, how full the batch
+        # sat, and the KV-pressure peak the run actually hit.
+        "flight_summary": core.flight.summary(),
         "outputs_digest": outputs_digest([r.all_out_ids for r in reqs]),
         "spec_drafted": m.get("spec_drafted", 0),
         "spec_accepted": m.get("spec_accepted", 0),
@@ -663,6 +726,12 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         "mfu": round(mfu, 4) if mfu is not None else None,
         "peak_flops_per_chip": peak,
     }
+    prof = profile_detail(prof_dir, prof_captured)
+    if prof is not None:
+        details["profile"] = prof
+    slo = slo_detail(os.environ.get("BENCH_SLO"))
+    if slo is not None:
+        details["slo"] = slo
     if on_accel and os.environ.get("BENCH_GUIDED", "1") != "0":
         # Secondary metric: guided JSON decoding through the SAME engine —
         # proves the grammar masks + fast-forward on hardware and gives a
@@ -730,6 +799,7 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
         build_engine_fleet,
         split_engine_budget,
     )
+    from runbookai_tpu.engine.flight_recorder import FlightRecorder
     from runbookai_tpu.engine.request import EngineRequest, SamplingParams
     from runbookai_tpu.utils.weights import quality_marker
 
@@ -787,8 +857,10 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
         await fleet.stop()
         return outs
 
+    prof_ctx, prof_dir = profile_context()
     t0 = _time.perf_counter()
-    outs = asyncio.run(_run())
+    with prof_ctx as prof_captured:
+        outs = asyncio.run(_run())
     wall = _time.perf_counter() - t0
 
     # Lost = aborted/shed (a stop-token finish is a legitimate completion;
@@ -848,7 +920,17 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
         "affinity_hit_ratio": round(fleet.affinity_hit_ratio(), 4),
         "imbalance_ratio": round(fleet._imbalance(), 4),
         "router_retries": int(fleet._m_retries.value),
+        # Fleet-wide flight provenance: kinds/tokens summed, pressure
+        # peaks = the worst replica (engine/flight_recorder.py).
+        "flight_summary": FlightRecorder.merge_summaries(
+            [c.flight.summary() for c in cores]),
     }
+    prof = profile_detail(prof_dir, prof_captured)
+    if prof is not None:
+        details["profile"] = prof
+    slo = slo_detail(os.environ.get("BENCH_SLO"))
+    if slo is not None:
+        details["slo"] = slo
     emit(round(total_decode / max(max_decode_t, 1e-9), 2), "tok/s", details)
 
 
@@ -957,6 +1039,17 @@ def main() -> None:
     if "--no-mixed" in sys.argv:
         sys.argv.remove("--no-mixed")
         os.environ["BENCH_MIXED"] = "0"
+    if "--profile" in sys.argv:
+        # On-demand XProf capture around the measured window
+        # (BENCH_PROFILE=DIR|1): TensorBoard-readable trace dir, or a
+        # clean skip recorded in details.profile when capture is
+        # unavailable. An optional following arg names the directory.
+        i = sys.argv.index("--profile")
+        sys.argv.pop(i)
+        if i < len(sys.argv) and not sys.argv[i].startswith("-"):
+            os.environ["BENCH_PROFILE"] = sys.argv.pop(i)
+        else:
+            os.environ["BENCH_PROFILE"] = "1"
     if "--dp" in sys.argv:
         # Data-parallel fleet A/B: `--dp N` serves the same request set
         # through N engine replicas behind the prefix-affinity router.

@@ -692,7 +692,7 @@ def cmd_serve(args) -> int:
     print(f"serving {config.llm.model} at http://{args.host}:{server.port}/v1 "
           f"(POST /v1/chat/completions"
           + (", /v1/embeddings" if embedder else "")
-          + ", GET /v1/models, /healthz, /metrics)")
+          + ", GET /v1/models, /healthz, /metrics, /debug/steps)")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -729,6 +729,12 @@ def cmd_metrics(args) -> int:
             # arm) is sanity-checkable without its Prometheus scrape: zero
             # engine.mixed spans under a mixed-dispatch plan is a lie.
             summary["dispatch_counters"] = dispatch_counters(spans)
+            # Queue-wait and router-placement live in EVENT meta (ms=0),
+            # so the per-span duration table above drops them; surface
+            # them as a lifecycle block alongside the dispatch counters.
+            from runbookai_tpu.utils.timeline import lifecycle_summary
+
+            summary["request_lifecycle"] = lifecycle_summary(spans)
         print(json.dumps(summary, indent=2))
         return 0
 
@@ -748,6 +754,85 @@ def cmd_metrics(args) -> int:
         text = "\n".join(line for line in text.splitlines()
                          if args.grep in line)
     print(text)
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    """``runbook timeline <request-id> --trace <file>`` — stitch one
+    request's trace JSONL records (enqueue → router placement → admit →
+    prefill chunks → decode windows → finish/abort) into a span tree.
+    The id may be the caller's ``x-request-id`` or an engine-internal
+    ``r{i}-…`` id; a fleet request shows every replica it touched."""
+    from runbookai_tpu.utils.timeline import build_timeline, render_timeline
+    from runbookai_tpu.utils.trace import read_spans
+
+    try:
+        spans = read_spans(args.trace)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"could not read trace {args.trace}: {e}", file=sys.stderr)
+        return 1
+    tl = build_timeline(spans, args.request_id)
+    if tl is None:
+        print(f"no records for request {args.request_id!r} in {args.trace}",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(tl, indent=2))
+    else:
+        print(render_timeline(tl, max_events=args.max_events))
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """``runbook profile`` — on-demand XLA/XProf capture around N engine
+    steps of synthetic load on the CONFIGURED engine, written as a
+    TensorBoard-readable trace directory (``tensorboard --logdir DIR``,
+    or upload to xprof). Probe-gated: an environment without a working
+    ``jax.profiler`` capture path reports the skip and exits cleanly."""
+    import numpy as _np
+
+    from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.utils.trace import try_device_trace
+
+    config = _load(args)
+    if config.llm.provider != "jax-tpu":
+        print("profile requires llm.provider: jax-tpu (a real engine to "
+              "profile)", file=sys.stderr)
+        return 1
+    client = JaxTpuClient.from_config(config.llm)
+    core = client.core  # replica 0 when fleeted: one engine's device view
+    rng = _np.random.default_rng(0)
+
+    def _submit(n: int, max_new: int) -> None:
+        for _ in range(n):
+            core.submit(EngineRequest(
+                prompt_ids=rng.integers(
+                    1, min(256, core.cfg.vocab_size - 1),
+                    size=args.prompt_len).tolist(),
+                sampling=SamplingParams(temperature=0.0,
+                                        max_new_tokens=max_new,
+                                        stop_token_ids=())))
+
+    # Warmup outside the capture: compile time would drown the N measured
+    # steps and the trace would profile Mosaic/XLA, not serving.
+    _submit(min(2, max(1, args.concurrency)), 4)
+    core.run_until_idle()
+
+    _submit(args.concurrency, args.new_tokens)
+    steps = 0
+    with try_device_trace(args.out) as captured:
+        while core.has_work and steps < args.steps:
+            core.step()
+            steps += 1
+    while core.has_work:  # settle outside the capture
+        core.step()
+    if captured:
+        print(f"captured {steps} engine steps -> {args.out} "
+              f"(view: tensorboard --logdir {args.out})")
+        return 0
+    print(f"profile skipped: jax.profiler capture unavailable on this "
+          f"backend (ran {steps} steps uncaptured)", file=sys.stderr)
     return 0
 
 
@@ -1242,6 +1327,34 @@ def build_parser() -> argparse.ArgumentParser:
         "validate", help="schema + content-hash check (CI gate)")
     plan_val.add_argument("paths", nargs="+")
     plan.set_defaults(fn=cmd_plan)
+
+    tl = sub.add_parser(
+        "timeline", help="render one request's span tree from a trace "
+                         "JSONL (enqueue -> route -> admit -> prefill -> "
+                         "decode -> finish)")
+    tl.add_argument("request_id",
+                    help="x-request-id (or engine-internal r{i}-… id)")
+    tl.add_argument("--trace", required=True, metavar="JSONL",
+                    help="tracer JSONL file (RUNBOOK_TRACE output)")
+    tl.add_argument("--json", action="store_true",
+                    help="structured timeline instead of the ASCII tree")
+    tl.add_argument("--max-events", type=int, default=60,
+                    help="tree rows before the middle dispatch windows "
+                         "collapse into one summary line")
+    tl.set_defaults(fn=cmd_timeline)
+
+    prof = sub.add_parser(
+        "profile", help="on-demand XLA/XProf capture around N engine "
+                        "steps -> TensorBoard-readable trace dir")
+    prof.add_argument("--steps", type=int, default=32,
+                      help="engine steps to capture (after warmup)")
+    prof.add_argument("--out", default=".runbook/profile",
+                      help="trace output directory")
+    prof.add_argument("--concurrency", type=int, default=4,
+                      help="synthetic requests in flight during capture")
+    prof.add_argument("--prompt-len", type=int, default=128)
+    prof.add_argument("--new-tokens", type=int, default=32)
+    prof.set_defaults(fn=cmd_profile)
 
     met = sub.add_parser(
         "metrics", help="scrape a server's /metrics or summarize a trace")

@@ -119,6 +119,26 @@ class AsyncEngine:
         with self._lock:
             self.core.step()
 
+    def debug_steps(self, last_n: Optional[int] = None,
+                    lock_timeout: float = 0.5) -> dict:
+        """Flight-recorder snapshot for ``GET /debug/steps``.
+
+        Taken under the step lock (bounded wait, same contract as the
+        ``/healthz`` snapshot: a step busy compiling can hold the lock
+        for tens of seconds and a debug probe must not hang that long —
+        a torn-by-one-record snapshot beats a wedged prober)."""
+        locked = self._lock.acquire(timeout=lock_timeout)
+        try:
+            flight = self.core.flight
+            return {
+                "capacity": flight.capacity,
+                "steps_total": flight.total_steps,
+                "steps": flight.snapshot(last_n),
+            }
+        finally:
+            if locked:
+                self._lock.release()
+
     async def refresh_lora(self) -> None:
         """Swap in the registry's latest stacked adapters between steps.
         The lock wait happens in a worker thread so the event loop (and

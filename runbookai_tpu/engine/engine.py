@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from runbookai_tpu.engine.flight_recorder import FlightRecorder
 from runbookai_tpu.engine.kv_cache import KVCacheManager, hash_blocks
 from runbookai_tpu.engine.request import (
     EngineOutput,
@@ -140,6 +141,12 @@ class EngineConfig:
     # devices; on a pod each host builds replicas over its local slice
     # (parallel/multihost.local_replica_range).
     dp_replicas: int = 1
+    # Flight recorder (engine/flight_recorder.py): retain the last N
+    # per-step records (dispatch kind, tokens, occupancy, queue depth,
+    # KV pressure, wall split) in a preallocated ring — O(1) append off
+    # the hot path, surfaced via GET /debug/steps and bench's
+    # flight_summary. 0 disables recording entirely.
+    flight_recorder_steps: int = 512
 
     @classmethod
     def from_plan(cls, engine_block: dict, *, default_kv_dtype: Any = None,
@@ -951,6 +958,10 @@ class EngineCore:
                         "decode_dispatches": 0, "mixed_steps": 0,
                         "mixed_tokens": 0, "mixed_time_s": 0.0}
         self.registry = metrics_mod.get_registry()
+        # Flight recorder: one bounded record per step (what was the
+        # engine DOING on the slow steps?). The step thread is the only
+        # writer; /debug/steps snapshots under the AsyncEngine lock.
+        self.flight = FlightRecorder(self.ecfg.flight_recorder_steps)
         self._install_metrics()
 
     def _install_metrics(self) -> None:
@@ -1072,6 +1083,17 @@ class EngineCore:
             pass  # guided_state initialized lazily by the mask provider
         req.state = RequestState.WAITING
         self.waiting.append(req)
+        if self.tracer.enabled:
+            # Timeline anchor: the enqueue event opens the request's span
+            # tree (`runbook timeline`); engine.admit and engine.request
+            # close the queue-wait and lifetime edges against it.
+            meta = {"request": req.request_id,
+                    "prompt_tokens": len(req.prompt_ids)}
+            if self.replica_idx is not None:
+                meta["replica"] = self.replica_idx
+            if req.trace_id is not None:
+                meta["trace_id"] = req.trace_id
+            self.tracer.event("engine.enqueue", **meta)
 
     @property
     def has_work(self) -> bool:
@@ -1297,6 +1319,15 @@ class EngineCore:
             self.metrics["cached_prefix_tokens"] += cached
             self.prefilling.append(req)
             in_flight += 1
+            if self.tracer.enabled:
+                meta = {"request": req.request_id, "cached_tokens": cached,
+                        "queue_ms": round((time.perf_counter()
+                                           - req.arrival_time) * 1e3, 3)}
+                if self.replica_idx is not None:
+                    meta["replica"] = self.replica_idx
+                if req.trace_id is not None:
+                    meta["trace_id"] = req.trace_id
+                self.tracer.event("engine.admit", **meta)
 
     @staticmethod
     def _fold_into_prompt(req: EngineRequest, prefill_pos: int) -> None:
@@ -1511,8 +1542,13 @@ class EngineCore:
             last_idx[i] = chunk_len - 1
             adapter_ids[i] = req.adapter_idx
 
-        with self.tracer.span("engine.prefill", batch=len(rows),
-                              tokens=int(sum(c for _, c, _ in rows))), \
+        pf_meta: dict[str, Any] = {"batch": len(rows),
+                                   "tokens": int(sum(c for _, c, _ in rows))}
+        if self.tracer.enabled:
+            # Request attribution for `runbook timeline`: which sequences'
+            # chunks rode this dispatch (built only when tracing is on).
+            pf_meta["requests"] = [r.request_id for r, _, _ in rows]
+        with self.tracer.span("engine.prefill", **pf_meta), \
                 annotate("prefill"):
             last_logits, self._kv_k, self._kv_v = _prefill_step(
                 self.params, self.cfg, jnp.asarray(tokens), self._kv_k, self._kv_v,
@@ -1813,8 +1849,11 @@ class EngineCore:
             self.metrics["spec_drafted"] += len(draft)
         si = self._slot_inputs()
 
-        with self.tracer.span("engine.decode_spec", k=k,
-                              batch=len(self.decoding)), annotate("decode_spec"):
+        spec_meta: dict[str, Any] = {"k": k, "batch": len(self.decoding)}
+        if self.tracer.enabled:
+            spec_meta["requests"] = [r.request_id for r in self.decoding]
+        with self.tracer.span("engine.decode_spec", **spec_meta), \
+                annotate("decode_spec"):
             t_issue = time.perf_counter()
             toks, self._kv_k, self._kv_v = _decode_spec(
                 self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
@@ -2118,9 +2157,14 @@ class EngineCore:
         dec_snapshot = list(self.decoding)
         inflight = self._pending is not None
         self._key, sub = jax.random.split(self._key)
-        with self.tracer.span("engine.mixed", batch=len(dec_snapshot),
-                              prefill_rows=len(pf_rows),
-                              tokens=int(real_tokens)), annotate("mixed"):
+        mix_meta: dict[str, Any] = {"batch": len(dec_snapshot),
+                                    "prefill_rows": len(pf_rows),
+                                    "tokens": int(real_tokens)}
+        if self.tracer.enabled:
+            mix_meta["requests"] = (
+                [r.request_id for r in dec_snapshot]
+                + [r.request_id for r, _, _ in pf_rows])
+        with self.tracer.span("engine.mixed", **mix_meta), annotate("mixed"):
             t_issue = time.perf_counter()
             (toks_win, pf_toks, feed_new, self._kv_k, self._kv_v,
              counts_out) = _mixed_step(
@@ -2339,8 +2383,10 @@ class EngineCore:
         # never visits the host on the input side.
         tokens_dev = self._feed_toks[:, None]
 
-        with self.tracer.span("engine.decode", k=k,
-                              batch=len(self.decoding)), annotate("decode"):
+        dec_meta: dict[str, Any] = {"k": k, "batch": len(self.decoding)}
+        if self.tracer.enabled:
+            dec_meta["requests"] = [r.request_id for r in self.decoding]
+        with self.tracer.span("engine.decode", **dec_meta), annotate("decode"):
             t_issue = time.perf_counter()
             last_logits = None
             if k == 1:
@@ -2431,12 +2477,66 @@ class EngineCore:
         if len(self.finished) > self._FINISHED_HIGH_WATER:
             del self.finished[: -self._FINISHED_KEEP]
         before = len(self.finished)
+        recording = self.flight.enabled
+        if recording:
+            m = self.metrics
+            t0 = time.perf_counter()
+            pre = (m["prefill_steps"], m["decode_dispatches"],
+                   m["mixed_steps"], m["prefill_tokens"],
+                   m["decode_tokens"], m["decode_dispatch_time_s"],
+                   m["decode_host_time_s"], m["decode_host_overlap_s"],
+                   m["preemptions"])
         self._admit()
         if not (self._can_mix() and self._run_mixed()):
             if self.prefilling:
                 self._run_prefill()
             self._run_decode()
+        if recording:
+            self._record_step(t0, pre)
         return self.finished[before:]
+
+    def _record_step(self, t0: float, pre: tuple) -> None:
+        """Append this step's flight record (O(1): one dict + ring slot).
+
+        Dispatch kind derives from the PR 4 counters' deltas — ``mixed``
+        for the unified ragged step, ``prefill+decode`` when the classic
+        split path ran both dispatches, ``idle`` for a drain/admit-only
+        step. Token counts follow the metrics dict's semantics: decode
+        tokens book at window DRAIN, one window late under overlap."""
+        m = self.metrics
+        d_prefill = m["prefill_steps"] - pre[0]
+        d_decode = m["decode_dispatches"] - pre[1]
+        d_mixed = m["mixed_steps"] - pre[2]
+        if d_mixed:
+            kind = "mixed"
+        elif d_prefill and d_decode:
+            kind = "prefill+decode"
+        elif d_prefill:
+            kind = "prefill"
+        elif d_decode:
+            kind = "decode"
+        else:
+            kind = "idle"
+        batch = len(self.decoding)
+        rec = {
+            "ts": round(time.time(), 6),
+            "kind": kind,
+            "tokens": (m["prefill_tokens"] - pre[3]
+                       + m["decode_tokens"] - pre[4]),
+            "batch": batch,
+            "occupancy": round(batch / self.ecfg.max_batch_slots, 4),
+            "queue_depth": len(self.waiting) + len(self.prefilling),
+            "kv_free_pages": self.kv.allocator.free_pages,
+            "kv_utilization": round(self.kv.utilization(), 4),
+            "dispatch_s": round(m["decode_dispatch_time_s"] - pre[5], 6),
+            "host_s": round(m["decode_host_time_s"] - pre[6], 6),
+            "overlap_s": round(m["decode_host_overlap_s"] - pre[7], 6),
+            "wall_s": round(time.perf_counter() - t0, 6),
+            "preemptions": m["preemptions"] - pre[8],
+        }
+        if self.replica_idx is not None:
+            rec["replica"] = self.replica_idx
+        self.flight.append(rec)
 
     def run_until_idle(self, max_steps: int = 100_000) -> list[EngineRequest]:
         done: list[EngineRequest] = []

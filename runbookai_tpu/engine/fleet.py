@@ -63,6 +63,7 @@ from runbookai_tpu.engine.request import (
     SamplingParams,
 )
 from runbookai_tpu.utils import metrics as metrics_mod
+from runbookai_tpu.utils.trace import get_tracer
 
 # Per-asyncio-task eval-case attribution: the eval runner sets this around
 # each case (AsyncFleet.begin_case/end_case) and contextvars flow through
@@ -250,9 +251,16 @@ class AsyncFleet:
             return 0
 
     def _route(self, prompt_ids: list[int], hash_seed: int = 0,
-               exclude: frozenset[int] = frozenset()) -> Optional[int]:
+               exclude: frozenset[int] = frozenset(),
+               trace_id: Optional[str] = None) -> Optional[int]:
         """Pick a replica: prefix affinity under a load guard, else
-        least-loaded with round-robin tiebreak. None = shed."""
+        least-loaded with round-robin tiebreak. None = shed.
+
+        ``trace_id`` (the caller's x-request-id) rides into the
+        ``router.place`` trace event so a request timeline can show
+        WHERE the router put it and WHY (affinity vs least-loaded) —
+        routing runs on the event-loop thread, where the server
+        handler's per-thread tracer context is not visible."""
         hashes = None
         if self.cfg.affinity and len(prompt_ids) >= self._page_size:
             hashes = hash_blocks(
@@ -274,6 +282,10 @@ class AsyncFleet:
                 and all(len(self.cores[i].waiting) >= self.cfg.shed_queue_depth
                         for i, _, _ in candidates)):
             self._m_shed.inc()
+            shed_meta = {"dp": self.dp}
+            if trace_id is not None:
+                shed_meta["trace_id"] = trace_id
+            get_tracer().event("router.shed", **shed_meta)
             return None
         affine = [c for c in candidates
                   if c[1] >= self._page_size
@@ -302,6 +314,13 @@ class AsyncFleet:
                 gid = self.replica_ids[pick]
                 per[gid] = per.get(gid, 0) + 1
         self._m_requests.labels(replica=str(self.replica_ids[pick])).inc()
+        tracer = get_tracer()
+        if tracer.enabled:
+            meta = {"replica": self.replica_ids[pick],
+                    "affinity": bool(affine)}
+            if trace_id is not None:
+                meta["trace_id"] = trace_id
+            tracer.event("router.place", **meta)
         return pick
 
     # ----------------------------------------------------- AsyncEngine API
@@ -345,7 +364,8 @@ class AsyncFleet:
         out: Optional[EngineOutput] = None
         for attempt in range(retries + 1):
             idx = self._route(prompt_ids, hash_seed,
-                              exclude=frozenset(tried))
+                              exclude=frozenset(tried),
+                              trace_id=request_id)
             if idx is None:
                 break
             if attempt:
@@ -370,7 +390,8 @@ class AsyncFleet:
         """Route once, then yield the replica's token stream unchanged
         (no cross-replica retry mid-stream: tokens already yielded cannot
         be unsaid). Shedding raises :class:`FleetSaturated`."""
-        idx = self._route(prompt_ids, self._hash_seed(adapter))
+        idx = self._route(prompt_ids, self._hash_seed(adapter),
+                          trace_id=request_id)
         if idx is None:
             raise FleetSaturated(
                 f"all {self.dp} replicas over shed_queue_depth="
@@ -531,6 +552,33 @@ class AsyncFleet:
         depth = self.cfg.shed_queue_depth
         return depth is not None and all(
             len(core.waiting) >= depth for core in self.cores)
+
+    def debug_steps(self, last_n: Optional[int] = None,
+                    lock_timeout: float = 0.5) -> dict:
+        """Fleet-wide ``GET /debug/steps``: each replica's flight records
+        (already stamped with their ``replica`` index by the recorder)
+        merged into one timeline ordered by wall-clock ``ts``. ONE shared
+        lock budget across the loop, like :meth:`health_snapshot` — a
+        debug probe over a dp=8 fleet must stay as bounded as the single
+        engine's."""
+        import time
+
+        merged: list[dict] = []
+        capacity = 0
+        steps_total = 0
+        deadline = time.monotonic() + lock_timeout
+        for engine in self.replicas:
+            budget = max(0.0, deadline - time.monotonic())
+            snap = engine.debug_steps(last_n, lock_timeout=budget)
+            capacity += snap["capacity"]
+            steps_total += snap["steps_total"]
+            merged.extend(snap["steps"])
+        merged.sort(key=lambda r: r.get("ts", 0.0))
+        if last_n is not None:
+            n = max(0, int(last_n))
+            merged = merged[-n:] if n else []
+        return {"capacity": capacity, "steps_total": steps_total,
+                "dp_replicas": self.dp, "steps": merged}
 
     def health_snapshot(self, lock_timeout: float = 0.5) -> dict:
         """Aggregated ``/healthz`` body: summed legacy metrics dict (the

@@ -1,0 +1,158 @@
+"""Engine flight recorder: a bounded in-memory ring of per-step records.
+
+PR 1's histograms answer "how slow is the tail"; this module answers the
+question that follows — "what was the engine *doing* on the slow steps?"
+(FlashInfer-Bench's thesis: a serving stack improves only when every
+measured run leaves a machine-readable record of what actually executed.)
+
+One :class:`StepRecord`-shaped dict is appended per
+:meth:`EngineCore.step`: step index, dispatch kind (the PR 4 counters:
+prefill / decode / mixed — plus ``prefill+decode`` for a split step that
+ran both, and ``idle`` for a drain-only step), real tokens this dispatch,
+batch occupancy, queue depth, KV-pool free pages, the dispatch/host/
+overlap wall split, preemptions, and the replica index when fleeted.
+
+Design constraints (pinned by ``tests/test_observability.py``):
+
+- **O(1) append, no lock**: the buffer is preallocated and the writer is
+  the engine step thread (already serialized by the AsyncEngine lock);
+  a slot assignment + cursor bump is the entire hot-path cost. Readers
+  (``/debug/steps`` scrapes) snapshot under that same engine lock — or
+  tolerate a one-record tear when they cannot afford to wait, exactly
+  like the scrape gauges.
+- **Bounded**: ``capacity`` records, oldest overwritten. A 1800s soak at
+  ~50 steps/s stays a few MB regardless of run length.
+- **Dumpable**: :meth:`snapshot` (newest-last dicts) for ``/debug/steps``
+  and the AsyncFleet aggregation, :meth:`dump_jsonl` for offline diffing,
+  :meth:`summary` for bench's ``flight_summary`` provenance block.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+from runbookai_tpu.utils.trace import _percentile
+
+# The per-step record keys, in emission order (documentation + the
+# /debug/steps shape test import this so the wire contract is pinned).
+STEP_RECORD_FIELDS = (
+    "step", "ts", "kind", "tokens", "batch", "occupancy", "queue_depth",
+    "kv_free_pages", "kv_utilization", "dispatch_s", "host_s", "overlap_s",
+    "wall_s", "preemptions", "replica",
+)
+
+
+class FlightRecorder:
+    """Preallocated ring of the last ``capacity`` step records."""
+
+    __slots__ = ("capacity", "_buf", "_next")
+
+    def __init__(self, capacity: int):
+        self.capacity = max(0, int(capacity))
+        self._buf: list[Optional[dict[str, Any]]] = [None] * self.capacity
+        self._next = 0  # monotonically increasing step cursor
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    @property
+    def total_steps(self) -> int:
+        """Steps recorded since construction (including overwritten ones)."""
+        return self._next
+
+    def __len__(self) -> int:
+        return min(self._next, self.capacity)
+
+    def append(self, rec: dict[str, Any]) -> None:
+        """O(1), allocation-free beyond the caller's dict; no lock (the
+        engine step thread is the only writer)."""
+        if not self.capacity:
+            return
+        rec["step"] = self._next
+        self._buf[self._next % self.capacity] = rec
+        self._next += 1
+
+    def reset(self) -> None:
+        """Drop every record and restart the step cursor (bench warmup:
+        the measured window's provenance must exclude compile traffic)."""
+        self._buf = [None] * self.capacity
+        self._next = 0
+
+    def snapshot(self, last_n: Optional[int] = None) -> list[dict[str, Any]]:
+        """Oldest→newest copies of the retained records (at most
+        ``last_n``). Each record is shallow-copied so callers can JSON-
+        serialize outside the engine lock without racing the writer."""
+        n = len(self)
+        if last_n is not None:
+            n = min(n, max(0, int(last_n)))
+        start = self._next - n
+        return [dict(self._buf[i % self.capacity])
+                for i in range(start, self._next)
+                if self._buf[i % self.capacity] is not None]
+
+    def dump_jsonl(self, path: str | Path) -> int:
+        """Write the retained records as JSONL; returns the record count."""
+        records = self.snapshot()
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        with open(p, "w") as fh:
+            for rec in records:
+                fh.write(json.dumps(rec) + "\n")
+        return len(records)
+
+    @staticmethod
+    def merge_summaries(summaries: list[dict[str, Any]]) -> dict[str, Any]:
+        """Fleet-wide roll-up of per-replica :meth:`summary` blocks:
+        dispatch kinds and tokens sum, pressure peaks take the max, and
+        occupancy percentiles report the worst replica (the one whose
+        batch ran fullest — the capacity-planning signal)."""
+        kinds: dict[str, int] = {}
+        merged: dict[str, Any] = {
+            "steps_recorded": 0, "steps_total": 0, "capacity": 0,
+            "tokens": 0, "occupancy_p50": 0.0, "occupancy_p95": 0.0,
+            "kv_utilization_peak": 0.0, "queue_depth_peak": 0,
+        }
+        for s in summaries:
+            for kind, count in s.get("dispatch_kinds", {}).items():
+                kinds[kind] = kinds.get(kind, 0) + count
+            for key in ("steps_recorded", "steps_total", "capacity",
+                        "tokens"):
+                merged[key] += s.get(key, 0)
+            for key in ("occupancy_p50", "occupancy_p95",
+                        "kv_utilization_peak", "queue_depth_peak"):
+                merged[key] = max(merged[key], s.get(key, 0))
+        merged["dispatch_kinds"] = dict(sorted(kinds.items()))
+        return merged
+
+    def summary(self) -> dict[str, Any]:
+        """Step-level provenance for a measured run (bench
+        ``flight_summary``): per-dispatch-kind step counts, occupancy
+        p50/p95, and the KV-pressure peak over the retained window."""
+        records = self.snapshot()
+        kinds: dict[str, int] = {}
+        occ: list[float] = []
+        kv_peak = 0.0
+        queue_peak = 0
+        tokens = 0
+        for rec in records:
+            kinds[str(rec.get("kind", "?"))] = (
+                kinds.get(str(rec.get("kind", "?")), 0) + 1)
+            occ.append(float(rec.get("occupancy", 0.0)))
+            kv_peak = max(kv_peak, float(rec.get("kv_utilization", 0.0)))
+            queue_peak = max(queue_peak, int(rec.get("queue_depth", 0)))
+            tokens += int(rec.get("tokens", 0))
+        occ.sort()
+        return {
+            "steps_recorded": len(records),
+            "steps_total": self.total_steps,
+            "capacity": self.capacity,
+            "dispatch_kinds": dict(sorted(kinds.items())),
+            "tokens": tokens,
+            "occupancy_p50": round(_percentile(occ, 50), 4),
+            "occupancy_p95": round(_percentile(occ, 95), 4),
+            "kv_utilization_peak": round(kv_peak, 4),
+            "queue_depth_peak": queue_peak,
+        }

@@ -81,6 +81,7 @@ class JaxTpuClient(BaseLLMClient):
         guided_json: bool = True,
         chat_format: str = "llama3",
         fleet_cfg=None,
+        slo_monitor=None,
     ):
         # ``core`` may be a data-parallel fleet (list of replicas, built by
         # engine/fleet.build_engine_fleet when EngineConfig.dp_replicas > 1):
@@ -106,6 +107,10 @@ class JaxTpuClient(BaseLLMClient):
         self.max_new_tokens = max_new_tokens
         self.guided_json = guided_json
         self.chat_format = chat_format
+        # SLO monitor (utils/slo.py, built by from_config from llm.slo):
+        # /healthz reads it for the live burn-ratio block; None when no
+        # objective is configured (zero SLO surface).
+        self.slo_monitor = slo_monitor
 
     # ------------------------------------------------------------- factories
 
@@ -294,6 +299,13 @@ class JaxTpuClient(BaseLLMClient):
                 lora_registry=lora_registry,
                 draft_worker=draft_factory(0) if draft_factory else None,
             )
+        slo_monitor = None
+        if getattr(llm_cfg, "slo", None) is not None:
+            from runbookai_tpu.utils.slo import SLOMonitor
+
+            # None when llm.slo sets no objective: an unconfigured run
+            # must export zero runbook_slo_* series.
+            slo_monitor = SLOMonitor.from_config(llm_cfg.slo)
         return cls(
             core, tokenizer,
             temperature=llm_cfg.temperature, top_p=llm_cfg.top_p,
@@ -301,6 +313,7 @@ class JaxTpuClient(BaseLLMClient):
             max_new_tokens=llm_cfg.max_new_tokens, guided_json=llm_cfg.guided_json,
             chat_format=format_for_model(model_cfg_name, cfg.family),
             fleet_cfg=fleet_cfg,
+            slo_monitor=slo_monitor,
         )
 
     @classmethod

@@ -11,7 +11,12 @@ OpenAI-client tooling can point at a TPU slice with no code changes:
   engine's step lock) + uptime + KV-pool pressure.
 - ``GET /metrics`` — Prometheus text exposition of the process registry
   (``runbookai_tpu.utils.metrics``): request/latency per route, engine
-  TTFT/TPOT histograms, KV gauges, agent tool counters.
+  TTFT/TPOT histograms, KV gauges, agent tool counters, and (when
+  ``llm.slo`` objectives are configured) the ``runbook_slo_*`` series.
+- ``GET /debug/steps?n=N`` — the engine flight recorder's last N per-step
+  records (``engine/flight_recorder.py``): dispatch kind, tokens,
+  occupancy, queue depth, KV pressure, wall split; fleet deployments
+  merge every replica's ring into one ts-ordered timeline.
 
 Every response carries an ``x-request-id`` header (client-supplied value
 echoed, else generated); the id is attached to the handler thread's tracer
@@ -44,7 +49,7 @@ from runbookai_tpu.utils.trace import get_tracer
 # Bounded route-label cardinality: anything else is scraped as "other".
 _KNOWN_ROUTES = frozenset((
     "/v1/chat/completions", "/v1/completions", "/v1/embeddings",
-    "/v1/adapters", "/v1/models", "/healthz", "/metrics",
+    "/v1/adapters", "/v1/models", "/healthz", "/metrics", "/debug/steps",
 ))
 
 
@@ -299,7 +304,10 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             self._request_id = (self.headers.get("x-request-id")
                                 or f"req-{uuid.uuid4().hex[:16]}")
             self._status = 0
-            route = self.path if self.path in _KNOWN_ROUTES else "other"
+            # Route label from the bare path (query strings must neither
+            # split the label cardinality nor 404 a known route).
+            bare = self.path.partition("?")[0]
+            route = bare if bare in _KNOWN_ROUTES else "other"
             tracer = get_tracer()
             tracer.set_context(request_id=self._request_id)
             t0 = time.perf_counter()
@@ -341,7 +349,13 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             self._dispatch("POST", self._route_post)
 
         def _route_get(self) -> None:
-            if self.path == "/v1/models":
+            # Match every route on the bare path: a query string must not
+            # 404 a known route the metrics just labeled as served.
+            path, _, query = self.path.partition("?")
+            if path == "/debug/steps":
+                self._debug_steps(query)
+                return
+            if path == "/v1/models":
                 models = [{"id": model_name, "object": "model",
                            "owned_by": "runbookai-tpu"}]
                 if client.core.lora is not None:
@@ -351,7 +365,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                                 "parent": model_name}
                                for n in client.core.lora.names]
                 self._json(200, {"object": "list", "data": models})
-            elif self.path == "/healthz":
+            elif path == "/healthz":
                 # Snapshot under the engine's step lock: the loop thread
                 # mutates several keys per step, so a lock-free shallow
                 # copy could pair a new decode_tokens with an old
@@ -383,8 +397,14 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                         "pages_cached": kv.allocator.cached_pages,
                         "utilization": round(kv.utilization(), 4)}
                     body["metrics"] = m
+                slo = getattr(client, "slo_monitor", None)
+                if slo is not None and slo.objectives:
+                    # Live SLO state (utils/slo.py): targets vs current
+                    # percentiles and the burn ratio per objective — the
+                    # feedback signal SLO-aware scheduling will consume.
+                    body["slo"] = slo.evaluate()
                 self._json(200, body)
-            elif self.path == "/metrics":
+            elif path == "/metrics":
                 body = registry.render().encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
@@ -394,6 +414,26 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 self.wfile.write(body)
             else:
                 self._error(404, f"no route {self.path}")
+
+        def _debug_steps(self, query: str) -> None:
+            """``GET /debug/steps[?n=N]`` — the engine flight recorder's
+            last N per-step records (dispatch kind, tokens, occupancy,
+            queue depth, KV pressure, wall split). Single engine and
+            fleet both serve it: ``AsyncFleet.debug_steps`` merges the
+            replicas' rings into one ts-ordered timeline."""
+            n = 128
+            for part in query.split("&"):
+                if part.startswith("n="):
+                    try:
+                        n = max(0, int(part[2:]))
+                    except ValueError:
+                        self._error(400, f"bad n value {part[2:]!r}")
+                        return
+            snap_fn = getattr(client.engine, "debug_steps", None)
+            if snap_fn is None:
+                self._error(404, "engine has no flight recorder")
+                return
+            self._json(200, snap_fn(n))
 
         def _route_post(self) -> None:
             if self.path == "/v1/adapters":
@@ -929,6 +969,12 @@ class OpenAIServer:
     @property
     def port(self) -> int:
         return self.httpd.server_address[1]
+
+    @property
+    def client(self):
+        """The serving client behind the handler closure (tests swap its
+        ``slo_monitor`` to drive the /healthz SLO block)."""
+        return self.bridge.client
 
     def serve_forever(self) -> None:
         self.httpd.serve_forever()

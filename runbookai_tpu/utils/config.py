@@ -16,7 +16,7 @@ from pathlib import Path
 from typing import Any, Literal, Optional
 
 import yaml
-from pydantic import BaseModel, Field
+from pydantic import BaseModel, ConfigDict, Field
 
 CONFIG_DIR = ".runbook"
 CONFIG_FILE = "config.yaml"
@@ -72,6 +72,29 @@ class FleetRouterConfig(BaseModel):
     # Cross-replica retries after a pool-pressure abort. None = each
     # other replica once.
     max_retries: Optional[int] = None
+
+
+class SLOConfig(BaseModel):
+    """Latency objectives (``llm.slo``) evaluated at scrape time against
+    the engine's serving histograms (utils/slo.py). All targets are
+    milliseconds; unset = no objective, and with NO objective set the
+    process exports no ``runbook_slo_*`` series at all. A typo'd key or
+    non-positive target fails here, at load — a silently-ignored typo
+    would read as "SLO monitoring active" while exporting nothing."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    ttft_p95_ms: Optional[float] = Field(None, gt=0)
+    ttft_p99_ms: Optional[float] = Field(None, gt=0)
+    tpot_p95_ms: Optional[float] = Field(None, gt=0)
+    tpot_p99_ms: Optional[float] = Field(None, gt=0)
+    e2e_p95_ms: Optional[float] = Field(None, gt=0)
+    e2e_p99_ms: Optional[float] = Field(None, gt=0)
+
+    def targets(self) -> dict[str, float]:
+        """The configured objectives only (utils/slo.SLOMonitor input)."""
+        return {k: v for k, v in self.model_dump().items()
+                if v is not None}
 
 
 class LLMConfig(BaseModel):
@@ -134,6 +157,10 @@ class LLMConfig(BaseModel):
     # = 1 (a replica is a single-slice engine).
     dp_replicas: int = 1
     fleet: FleetRouterConfig = Field(default_factory=FleetRouterConfig)
+    # Latency SLOs evaluated at scrape time (utils/slo.py): exported as
+    # runbook_slo_{target_ms,current_ms,burn_ratio,violations_total} and
+    # an "slo" block in /healthz. No objectives set = no SLO series.
+    slo: SLOConfig = Field(default_factory=SLOConfig)
     guided_json: bool = True  # token-level JSON grammar masks for complete()
 
 

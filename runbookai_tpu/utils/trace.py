@@ -29,24 +29,45 @@ from pathlib import Path
 from typing import Any, Iterator, Optional
 
 
+# Default byte cap per trace file before rotation: a 1800s soak at full
+# span volume stays bounded on disk instead of growing the JSONL forever.
+# One rotated generation (<file>.1) is kept; RUNBOOK_TRACE_MAX_MB
+# overrides (0 = unbounded).
+DEFAULT_TRACE_MAX_BYTES = 256 * 1024 * 1024
+
+
 class Tracer:
     """Appends nested span records to a JSONL file.
 
     Thread-safe: the process-wide tracer is shared across server request
     threads and the engine loop, so span depth is tracked per-thread and
     each record is written whole under a lock.
+
+    Size-bounded: when a write would push the file past ``max_bytes``,
+    the current file rotates to ``<path>.1`` (replacing any previous
+    generation) and a fresh file begins — at most ~2× the cap on disk,
+    with the rotation counted in ``runbook_trace_rotations_total`` so a
+    soak run's dashboards see the trail turning over.
     """
 
-    def __init__(self, path: Optional[str | Path], enabled: bool = True):
+    def __init__(self, path: Optional[str | Path], enabled: bool = True,
+                 max_bytes: Optional[int] = DEFAULT_TRACE_MAX_BYTES):
         self.enabled = enabled and path is not None
         self.path = Path(path) if path else None
+        self.max_bytes = max_bytes if max_bytes else None
         self._local = threading.local()
         self._lock = threading.Lock()
         self._fh = None
+        self._bytes = 0
+        self._rotations = 0
         self._warned = False
         if self.enabled:
             self.path.parent.mkdir(parents=True, exist_ok=True)
             self._fh = open(self.path, "a", buffering=1)  # line-buffered
+            try:
+                self._bytes = self.path.stat().st_size
+            except OSError:
+                self._bytes = 0
 
     @property
     def _depth(self) -> int:
@@ -77,10 +98,37 @@ class Tracer:
 
     def _write(self, rec: dict[str, Any]) -> None:
         try:
+            line = json.dumps(rec) + "\n"
+            rotated = False
             with self._lock:
                 if self._fh is None:
                     return  # closed deliberately: silence, not a warning
-                self._fh.write(json.dumps(rec) + "\n")
+                if (self.max_bytes is not None and self._bytes > 0
+                        and self._bytes + len(line) > self.max_bytes):
+                    # Rotate the live file to ``<path>.1`` (replacing any
+                    # previous generation) and start fresh — the swap must
+                    # be atomic against the other writer threads, and it
+                    # runs once per ``max_bytes`` of trace volume, so the
+                    # bounded stall is the price of a bounded footprint.
+                    self._fh.flush()
+                    self._fh.close()
+                    os.replace(self.path,
+                               self.path.with_name(self.path.name + ".1"))
+                    self._fh = self.path.open("a", buffering=1)
+                    self._bytes = 0
+                    self._rotations += 1
+                    rotated = True
+                self._fh.write(line)
+                self._bytes += len(line)
+            if rotated:
+                # Metric outside the write lock (RBK003: the registry has
+                # its own lock and scrape callbacks must not nest under
+                # the tracer's).
+                from runbookai_tpu.utils import metrics as metrics_mod
+
+                metrics_mod.get_registry().counter(
+                    "runbook_trace_rotations_total",
+                    "Trace JSONL rotations at the byte cap").inc()
         except (OSError, ValueError) as e:
             # Disk gone / fh poisoned: stop tracing, keep serving — but
             # never silently (operators must learn their trail went dark).
@@ -153,8 +201,16 @@ def get_tracer() -> Tracer:
         else:
             path = (Path(".runbook") / "trace" / f"{os.getpid()}.jsonl"
                     if env == "1" else Path(env))
+            max_bytes: Optional[int] = DEFAULT_TRACE_MAX_BYTES
+            cap_env = os.environ.get("RUNBOOK_TRACE_MAX_MB", "")
+            if cap_env:
+                try:
+                    mb = float(cap_env)
+                    max_bytes = int(mb * 1024 * 1024) if mb > 0 else None
+                except ValueError:
+                    pass  # malformed cap keeps the default
             try:
-                _global = Tracer(path)
+                _global = Tracer(path, max_bytes=max_bytes)
             except OSError:
                 _global = _NULL
     return _global
@@ -182,6 +238,33 @@ def device_trace(logdir: str | Path) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def try_device_trace(logdir: str | Path) -> Iterator[bool]:
+    """Probe-gated :func:`device_trace`: yields True when the capture
+    started, False when ``jax.profiler`` (or its backend plumbing) is
+    unavailable — the enclosed work runs either way, so on-demand
+    profiling (``runbook profile``, ``bench.py --profile``) degrades to a
+    clean skip on dependency-free CPU CI instead of crashing the run."""
+    started = False
+    try:
+        import jax
+
+        jax.profiler.start_trace(str(logdir))
+        started = True
+    except Exception:  # noqa: BLE001 — any capture failure means "skip"
+        pass
+    try:
+        yield started
+    finally:
+        if started:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001 — a failed stop must not
+                pass  # poison the run whose work already completed
 
 
 def read_spans(path: str | Path) -> list[dict[str, Any]]:
