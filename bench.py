@@ -33,6 +33,20 @@ hit ratio and imbalance, and `outputs_digest` proves per-request streams
 byte-identical across the dp=1/dp=N arms), BENCH_SHARED_PREFIX (first S
 prompt tokens shared across requests, exercising the router's
 prefix-affinity path; default 0 keeps the historical prompt series),
+BENCH_SESSIONS (K distinct shared prefixes — K live "conversations"
+cycling across requests, the asymmetric-residency workload the kv-share
+pull seam targets; default 1 = the historical single prefix),
+BENCH_KV_SHARE (`--kv-share`: fleet-wide KV page sharing — an affinity
+miss pulls the prompt's prefix pages from the sibling that holds them
+instead of re-prefilling; details carry the cross-replica hit ratio,
+pages pulled and pull wall, and `outputs_digest` proves the pulled
+pages byte-identical to recompute), BENCH_DISAGG (`--disagg [N]`:
+prefill/decode disaggregation — the first N replicas form a prefill
+tier whose pages hand off to the decode tier at first-token time;
+details carry the tier split and per-tier traffic), BENCH_STAGGER_MS
+(inter-arrival spacing of the measured fleet window — the kv-share A/B
+runs a staggered prompt burst so siblings have pages to pull; 0 keeps
+the historical all-at-once gather),
 BENCH_PLAN (`--plan PATH`: pin the engine config to a serving-plan
 artifact from `runbook tune` — plan values become the defaults, explicit
 BENCH_* env still wins, and the plan id/hash lands in `details` so every
@@ -119,7 +133,12 @@ def reset_warmup_metrics(core) -> None:
         decode_dispatch_time_s=0.0, decode_host_time_s=0.0,
         decode_host_overlap_s=0.0, prefill_steps=0,
         decode_dispatches=0, mixed_steps=0, mixed_tokens=0,
-        mixed_time_s=0.0)
+        mixed_time_s=0.0, kv_pages_imported=0, kv_pages_exported=0,
+        kv_spill_readmits=0)
+    # The flight recorder reports page-transfer DELTAS against this mark;
+    # zeroing the counters without it would make the first measured step
+    # report a negative import delta.
+    core._flight_kv_mark = (0, 0)
     core.hist_ttft.reset()
     core.hist_tpot.reset()
     # The flight_summary block must describe the MEASURED window, not the
@@ -570,10 +589,23 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
                      max(prompt_len - 1, 0))
     shared_prefix = (rng.integers(0, 256, size=shared_len).tolist()
                      if shared_len else [])
+    # BENCH_SESSIONS=K (default 1): requests cycle through K distinct
+    # shared prefixes — K live "conversations". One session degenerates
+    # to the historical single-prefix series (same rng draws); several
+    # make prefix residency ASYMMETRIC across a fleet, which is the
+    # workload the kv-share pull seam exists for: a session's follow-up
+    # arriving while its owner replica is busy gets placed elsewhere and
+    # pulls the prefix instead of re-prefilling it.
+    n_sessions = max(1, int(os.environ.get("BENCH_SESSIONS", 1) or 1))
+    session_prefixes = [shared_prefix] + [
+        rng.integers(0, 256, size=shared_len).tolist()
+        for _ in range(n_sessions - 1)]
+    prompt_counter = iter(range(10**9))
 
     def make_prompt() -> list:
+        head = session_prefixes[next(prompt_counter) % n_sessions]
         tail = rng.integers(0, 256, size=prompt_len - shared_len).tolist()
-        return shared_prefix + tail
+        return head + tail
 
     def outputs_digest(token_lists) -> str:
         """Digest of every request's output token stream, in submission
@@ -796,6 +828,7 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
 
     from runbookai_tpu.engine.fleet import (
         AsyncFleet,
+        FleetConfig,
         build_engine_fleet,
         split_engine_budget,
     )
@@ -832,13 +865,31 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
                                advance_fn=masker.advance,
                                draft_worker_factory=draft_factory)
 
+    # KV-share / disagg A/B arms (BENCH_KV_SHARE / BENCH_DISAGG): same
+    # request set, same per-replica budgets — the only change is the
+    # router's page policy, so any TTFT/TPOT delta is attributable to it.
+    kv_share = os.environ.get("BENCH_KV_SHARE", "0") == "1"
+    disagg_n = int(os.environ.get("BENCH_DISAGG", 0) or 0)
+    # Either arm of the kv-share A/B (BENCH_KV_SHARE set to 0 OR 1, or a
+    # disagg run): warmup prompts must not carry the measured shared
+    # prefix, or warmup pre-publishes it on EVERY replica and both arms
+    # measure a pool where there is nothing left to pull. Off by default:
+    # the historical --dp affinity arm deliberately warms the prefix.
+    deshared_warmup = "BENCH_KV_SHARE" in os.environ or disagg_n > 0
+
     # Warmup compiles every program shape per replica (each replica's
     # device slice is its own executable), consuming exactly the same rng
-    # draws as the dp=1 arm so the measured prompts line up across arms.
+    # draws as the dp=1 arm so the measured prompts line up across arms
+    # (a de-shared warmup draws its replacement tokens from a SEPARATE
+    # rng, leaving the measured stream untouched).
+    warm_rng = np.random.default_rng(10_007)
     warm = min(slots_total, n_requests)
     for w in range(warm):
+        p = make_prompt()
+        if deshared_warmup:
+            p = warm_rng.integers(0, 256, size=len(p)).tolist()
         cores[w % dp].submit(EngineRequest(
-            prompt_ids=make_prompt(),
+            prompt_ids=p,
             sampling=SamplingParams(temperature=0.0,
                                     max_new_tokens=new_tokens,
                                     stop_token_ids=())))
@@ -846,14 +897,27 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
         core.run_until_idle()
         reset_warmup_metrics(core)
 
-    fleet = AsyncFleet(cores)
+    fleet = AsyncFleet(cores, FleetConfig(
+        kv_share=kv_share, disagg_prefill_replicas=disagg_n))
     prompts = [make_prompt() for _ in range(n_requests)]
     sampling = SamplingParams(temperature=0.0, max_new_tokens=new_tokens,
                               stop_token_ids=())
 
+    # BENCH_STAGGER_MS: inter-arrival spacing for the measured window.
+    # 0 (default) keeps the historical all-at-once gather; the kv-share
+    # A/B needs a stagger, because a request can only pull pages a
+    # sibling has already prefilled — an instantaneous burst routes every
+    # request before any prefix page exists anywhere.
+    stagger_s = float(os.environ.get("BENCH_STAGGER_MS", 0) or 0) / 1e3
+
+    async def _one(i: int, p: list) -> "EngineOutput":
+        if stagger_s:
+            await asyncio.sleep(i * stagger_s)
+        return await fleet.generate(p, sampling)
+
     async def _run():
         outs = await asyncio.gather(*[
-            fleet.generate(p, sampling) for p in prompts])
+            _one(i, p) for i, p in enumerate(prompts)])
         await fleet.stop()
         return outs
 
@@ -871,6 +935,8 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
     routed = fleet.routed_counts()
     replica_stats = [{
         "replica": i,
+        "tier": ("prefill" if i < disagg_n
+                 else "decode" if disagg_n else "mixed"),
         "requests_routed": routed[i],
         "decode_tokens": c.metrics["decode_tokens"],
         "decode_time_s": round(c.metrics["decode_time_s"], 3),
@@ -878,10 +944,18 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
                        / max(c.metrics["decode_time_s"], 1e-9), 2),
         "prefill_tokens": c.metrics["prefill_tokens"],
         "cached_prefix_tokens": c.metrics["cached_prefix_tokens"],
+        "kv_pages_imported": c.metrics.get("kv_pages_imported", 0),
+        "kv_pages_exported": c.metrics.get("kv_pages_exported", 0),
         "spec_drafted": c.metrics.get("spec_drafted", 0),
         "spec_accepted": c.metrics.get("spec_accepted", 0),
     } for i, c in enumerate(cores)]
     ttfts = sorted(o.ttft_ms for o in outs if o.ttft_ms is not None)
+    # Tail latency per arm through the shared serving histograms (every
+    # replica observes into the same registry series, so these are
+    # fleet-wide percentiles of the measured window) — the numbers the
+    # kv-share / disagg A/B is judged on.
+    p95_ttft = cores[0].hist_ttft.percentile(95)
+    p95_tpot = cores[0].hist_tpot.percentile(95)
     from runbookai_tpu.autotune.plan import engine_config_dict
 
     details = {
@@ -905,6 +979,9 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
         "num_pages_total": ecfg.num_pages * dp,
         "draft_model": draft_name,
         "shared_prefix": int(os.environ.get("BENCH_SHARED_PREFIX", 0)),
+        "sessions": max(1, int(os.environ.get("BENCH_SESSIONS", 1) or 1)),
+        "stagger_ms": float(os.environ.get("BENCH_STAGGER_MS", 0) or 0),
+        "kv_share_enabled": bool(kv_share or disagg_n),
         "wall_s": round(wall, 2),
         "total_tokens": total_decode + sum(c.metrics["prefill_tokens"]
                                            for c in cores),
@@ -914,6 +991,10 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
         "decode_tps_sum_per_replica": round(
             sum(r["tok_s"] for r in replica_stats), 2),
         "p50_ttft_ms": (round(ttfts[len(ttfts) // 2], 1) if ttfts else None),
+        "p95_ttft_ms": (round(p95_ttft * 1e3, 1)
+                        if p95_ttft is not None else None),
+        "p95_tpot_ms": (round(p95_tpot * 1e3, 2)
+                        if p95_tpot is not None else None),
         "lost_requests": lost,
         "outputs_digest": outputs_digest([o.token_ids for o in outs]),
         "per_replica": replica_stats,
@@ -925,6 +1006,18 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
         "flight_summary": FlightRecorder.merge_summaries(
             [c.flight.summary() for c in cores]),
     }
+    if kv_share or disagg_n:
+        # The A/B evidence for the kv-share arm: how many placements rode
+        # pulled pages, how many pages moved, what the moves cost, and how
+        # many planned pulls the staleness epoch rejected — read from the
+        # same public health snapshot the /healthz endpoint serves.
+        router_hz = fleet.health_snapshot()["router"]
+        ks = dict(router_hz["kv_share"])
+        ks["xreplica_hit_ratio"] = round(
+            ks["xreplica_hits"] / max(n_requests, 1), 4)
+        details["kv_share"] = ks
+        if disagg_n:
+            details["disagg"] = dict(router_hz["disagg"])
     prof = profile_detail(prof_dir, prof_captured)
     if prof is not None:
         details["profile"] = prof
@@ -1059,6 +1152,25 @@ def main() -> None:
             print("usage: bench.py --dp N (replica count)", file=sys.stderr)
             sys.exit(2)
         os.environ["BENCH_DP"] = sys.argv.pop(i)
+    if "--kv-share" in sys.argv:
+        # Fleet-wide KV page sharing A/B: the router pulls a prompt's
+        # prefix pages from the sibling replica that holds them
+        # (digest-checked host-staged copy) instead of re-prefilling.
+        # Pair with --dp N and BENCH_SHARED_PREFIX for the
+        # prompt-burst-over-decode workload.
+        sys.argv.remove("--kv-share")
+        os.environ["BENCH_KV_SHARE"] = "1"
+    if "--disagg" in sys.argv:
+        # Disaggregated tiers A/B: `--disagg [N]` dedicates the first N
+        # replicas (default 1) to a prefill tier; prompts prefill there
+        # and their pages hand off to the decode tier at first-token
+        # time. Implies --kv-share (the handoff IS a pull).
+        i = sys.argv.index("--disagg")
+        sys.argv.pop(i)
+        if i < len(sys.argv) and sys.argv[i].isdigit():
+            os.environ["BENCH_DISAGG"] = sys.argv.pop(i)
+        else:
+            os.environ["BENCH_DISAGG"] = "1"
     if "--plan" in sys.argv:
         # Pin the engine config to a `runbook tune` serving-plan artifact
         # (explicit BENCH_* env still overrides individual plan keys).

@@ -82,11 +82,16 @@ class Hardware:
     hbm_bw: float        # achievable bytes/s
     peak_flops: float
     dispatch_overhead_s: float
+    # Host RAM available to the KV spill tier (kv_spill_pages feasibility
+    # envelope — host bytes, never HBM). Spec-sheet default: serving hosts
+    # carry at least this much.
+    host_ram_bytes: int = 64 * GiB
 
     def to_dict(self) -> dict[str, Any]:
         return {"name": self.name, "hbm_bytes": self.hbm_bytes,
                 "hbm_bw": self.hbm_bw, "peak_flops": self.peak_flops,
-                "dispatch_overhead_s": self.dispatch_overhead_s}
+                "dispatch_overhead_s": self.dispatch_overhead_s,
+                "host_ram_bytes": self.host_ram_bytes}
 
 
 # Spec-sheet envelopes (bench.py carries the same peak-FLOPs table); "cpu"
@@ -121,10 +126,19 @@ class Candidate:
     dp_replicas: int = 1
     tp: int = 1
     max_seq_len: int = 8192
+    # Host-RAM spill tier pages (EngineConfig.kv_spill_pages; 0 = off).
+    # Budgeted against host RAM via memory_plan.host_spill_bytes, never
+    # HBM — feasibility checks the host envelope, not the pool budget.
+    kv_spill_pages: int = 0
+    # Prefill/decode disaggregation: replicas dedicated to the prefill
+    # tier (FleetConfig.disagg_prefill_replicas; 0 = symmetric). Rides in
+    # the plan's TOPOLOGY block, not the engine block — it is a fleet
+    # deployment shape, not an EngineConfig knob.
+    disagg_prefill_replicas: int = 0
 
     def engine_plan_block(self) -> dict[str, Any]:
-        """The candidate as a plan artifact's ``engine`` block (tp rides
-        in ``topology``)."""
+        """The candidate as a plan artifact's ``engine`` block (tp and the
+        disagg tier split ride in ``topology``)."""
         return {
             "page_size": self.page_size, "num_pages": self.num_pages,
             "max_batch_slots": self.max_batch_slots,
@@ -134,7 +148,14 @@ class Candidate:
             "kv_dtype": self.kv_dtype, "speculative": self.speculative,
             "dp_replicas": self.dp_replicas,
             "max_seq_len": self.max_seq_len,
+            "kv_spill_pages": self.kv_spill_pages,
         }
+
+    def topology_extras(self) -> dict[str, Any]:
+        """Topology-block keys this candidate pins beyond tp/dp (empty
+        for symmetric fleets, so existing plans hash unchanged)."""
+        return ({"disagg_prefill_replicas": self.disagg_prefill_replicas}
+                if self.disagg_prefill_replicas else {})
 
     @property
     def pool_tokens(self) -> int:
@@ -192,7 +213,9 @@ class CostModel:
             batch=cand.max_batch_slots, tp=cand.tp, weights=self.weights,
             kv_dtype_bytes=kv_bytes, kv_scale_bytes=scale_bytes,
             hbm_bytes=self.hw.hbm_bytes,
-            headroom_bytes=self.headroom_bytes)
+            headroom_bytes=self.headroom_bytes,
+            kv_spill_pages=cand.kv_spill_pages,
+            page_size=cand.page_size)
 
     def kv_pool_bytes(self, cand: Candidate,
                       plan: Optional[ServingPlan] = None) -> float:
@@ -228,6 +251,16 @@ class CostModel:
                 return False, f"tp factorization: {e}"
         if cand.dp_replicas > 1 and cand.tp > 1:
             return False, "dp_replicas > 1 requires tp == 1 (a replica is a single-slice engine)"
+        if cand.kv_spill_pages < 0:
+            return False, "kv_spill_pages must be >= 0"
+        if cand.disagg_prefill_replicas < 0:
+            return False, "disagg_prefill_replicas must be >= 0"
+        if cand.disagg_prefill_replicas:
+            if cand.disagg_prefill_replicas >= max(1, cand.dp_replicas):
+                return False, (
+                    f"disagg_prefill_replicas="
+                    f"{cand.disagg_prefill_replicas} leaves no decode tier "
+                    f"in a dp={cand.dp_replicas} fleet")
         ctx = min(workload.context_len, cand.max_seq_len)
         if workload.prompt_len >= cand.max_seq_len:
             return False, (f"prompt_len {workload.prompt_len} >= "
@@ -238,6 +271,16 @@ class CostModel:
                            "(decode slots alone consume the budget)")
         if plan is None:
             plan = self.residency(cand, max_seq_len=ctx)
+        # Every co-resident replica pins its OWN tier; budget the worst
+        # case of all dp replicas sharing one host (single-host fleets —
+        # the CPU/bench shape — and the conservative bound for pods).
+        spill_total = plan.host_spill_bytes * max(1, cand.dp_replicas)
+        if spill_total > self.hw.host_ram_bytes // 2:
+            return False, (
+                f"spill tier {spill_total / GiB:.2f} GiB across "
+                f"{max(1, cand.dp_replicas)} replica(s) exceeds half the "
+                f"host RAM envelope "
+                f"({self.hw.host_ram_bytes / GiB:.0f} GiB)")
         pool_bytes = cand.pool_tokens * plan.kv_bytes_per_token_per_chip
         if pool_bytes > plan.pool_budget_bytes:
             return False, (
@@ -272,12 +315,17 @@ class CostModel:
         cfg, hw = self.model_cfg, self.hw
 
         dp = max(1, cand.dp_replicas)
+        # Disaggregation dedicates replicas to prefill: only the decode
+        # tier contributes to the aggregate decode rate (its win — prompt
+        # bursts off the decode path — shows up as TTFT stability in the
+        # MEASURED arms, not in this roofline).
+        dp_decode = max(1, dp - cand.disagg_prefill_replicas)
         # Effective decode batch per replica: bounded by slots, by the
         # share of traffic this replica sees, and by how many average
         # contexts the page pool actually holds.
         avg_ctx = workload.prompt_len + workload.output_len / 2
         pool_contexts = cand.pool_tokens / max(avg_ctx, 1)
-        batch = min(cand.max_batch_slots, workload.concurrency / dp,
+        batch = min(cand.max_batch_slots, workload.concurrency / dp_decode,
                     pool_contexts)
         batch = max(batch, 1e-6)
 
@@ -299,7 +347,7 @@ class CostModel:
             (1.0 - workload.guided_share) * sync_s / k
             + workload.guided_share * sync_s)
         step_s = device_s + per_step_overhead
-        decode_tok_s = batch / step_s * dp
+        decode_tok_s = batch / step_s * dp_decode
 
         # TTFT floor: chunked prefill, one dispatch per chunk; the mixed
         # dispatch (budget permitting) folds each chunk into a decode step
@@ -343,13 +391,18 @@ class SearchSpace:
     dp_replicas: tuple[int, ...] = (1,)
     tp: tuple[int, ...] = (1,)
     max_seq_len: tuple[int, ...] = (8192,)
+    # Fleet-shape knobs (PR 8): off by default so existing sweeps and
+    # their plan hashes are unchanged until a space opts in.
+    kv_spill_pages: tuple[int, ...] = (0,)
+    disagg_prefill_replicas: tuple[int, ...] = (0,)
 
     def candidates(self) -> list[Candidate]:
         axes = (self.page_size, self.num_pages, self.max_batch_slots,
                 self.prefill_chunk, self.mixed_token_budget,
                 self.decode_steps_per_dispatch, self.kv_dtype,
                 self.speculative, self.dp_replicas, self.tp,
-                self.max_seq_len)
+                self.max_seq_len, self.kv_spill_pages,
+                self.disagg_prefill_replicas)
         return [Candidate(*values) for values in itertools.product(*axes)]
 
 

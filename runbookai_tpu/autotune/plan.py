@@ -43,7 +43,7 @@ ENGINE_PLAN_KEYS = frozenset({
     "max_seq_len", "block_pages", "decode_steps_per_dispatch",
     "prefill_batch", "mixed_token_budget", "mixed_dispatch",
     "overlap_decode", "speculative", "kv_dtype", "attn_impl", "qmm_impl",
-    "dp_replicas",
+    "dp_replicas", "kv_spill_pages",
 })
 
 # kv_dtype spellings a plan may use ("auto" = follow the activation dtype,
@@ -69,6 +69,7 @@ _PLAN_TO_LLM = {
     "attn_impl": "attn_impl",
     "qmm_impl": "qmm_impl",
     "dp_replicas": "dp_replicas",
+    "kv_spill_pages": "kv_spill_pages",
 }
 
 
@@ -179,6 +180,26 @@ def validate_plan(data: Any) -> list[str]:
                              or engine["mixed_token_budget"] < 1):
         problems.append("engine.mixed_token_budget must be a positive "
                         "integer or null")
+    # v1-compatible optional keys (absent in pre-PR-8 plans — they still
+    # validate; present means a host spill tier / disagg deployment).
+    if "kv_spill_pages" in engine and (
+            not isinstance(engine["kv_spill_pages"], int)
+            or isinstance(engine["kv_spill_pages"], bool)
+            or engine["kv_spill_pages"] < 0):
+        problems.append("engine.kv_spill_pages must be a non-negative "
+                        "integer (0 = spill tier disabled)")
+    topo = data.get("topology")
+    if isinstance(topo, dict) and "disagg_prefill_replicas" in topo:
+        n_pf = topo["disagg_prefill_replicas"]
+        dp = engine.get("dp_replicas", topo.get("dp_replicas", 1)) or 1
+        if (not isinstance(n_pf, int) or isinstance(n_pf, bool)
+                or n_pf < 0):
+            problems.append("topology.disagg_prefill_replicas must be a "
+                            "non-negative integer")
+        elif n_pf and isinstance(dp, int) and n_pf >= dp:
+            problems.append(
+                f"topology.disagg_prefill_replicas={n_pf} leaves no "
+                f"decode tier (dp_replicas={dp})")
     if "kv_dtype" in engine and engine["kv_dtype"] not in KV_DTYPE_NAMES:
         problems.append(f"engine.kv_dtype must be one of "
                         f"{'/'.join(KV_DTYPE_NAMES)}")

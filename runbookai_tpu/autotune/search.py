@@ -380,6 +380,9 @@ def tune(model_name: str, workload: Workload, hardware: Hardware,
         "tp": winner.tp,
         "dp_replicas": winner.dp_replicas,
         "hbm_bytes_per_chip": hardware.hbm_bytes,
+        # Fleet-shape extras (disagg tier split) — empty for symmetric
+        # fleets so pre-PR-8 plan hashes are reproducible.
+        **winner.topology_extras(),
     }
     provenance: dict[str, Any] = {
         "tool": "runbook tune",

@@ -139,6 +139,20 @@ class AsyncEngine:
             if locked:
                 self._lock.release()
 
+    async def run_locked(self, fn):
+        """Run ``fn()`` under the step lock in a worker thread and return
+        its result. The seam the fleet's KV page transfers go through:
+        export/import must see a quiesced core (no step mid-flight
+        mutating the pool arrays), and the lock wait happens off the
+        event loop so every in-flight stream keeps draining while a slow
+        step finishes."""
+
+        def _locked():
+            with self._lock:
+                return fn()
+
+        return await asyncio.to_thread(_locked)
+
     async def refresh_lora(self) -> None:
         """Swap in the registry's latest stacked adapters between steps.
         The lock wait happens in a worker thread so the event loop (and
@@ -158,6 +172,7 @@ class AsyncEngine:
         priority: int = 0,
         adapter: Optional[str] = None,
         request_id: Optional[str] = None,
+        arrival_time: Optional[float] = None,
     ) -> EngineOutput:
         """Submit one request and await its completion.
 
@@ -166,12 +181,17 @@ class AsyncEngine:
         caller-side timeout alone would leave the request decoding to
         max_new_tokens for nobody. ``request_id`` (the server's
         x-request-id) rides into the engine's tracer records for
-        trace-to-request correlation."""
+        trace-to-request correlation. ``arrival_time`` (a perf_counter
+        reading) backdates the TTFT clock to when the request entered
+        the SYSTEM — the fleet passes its routing-entry time so disagg
+        warm prefills and page pulls stay inside the measured TTFT."""
         await self.start()  # idempotent; restarts after a torn-down loop
         req = EngineRequest(prompt_ids=prompt_ids,
                             sampling=sampling or SamplingParams(),
                             priority=priority, adapter=adapter,
                             trace_id=request_id)
+        if arrival_time is not None:
+            req.arrival_time = arrival_time
         req.done_event = asyncio.Event()
         loop = asyncio.get_running_loop()
         # done_event.set() happens on a worker thread; bridge it safely.
@@ -217,6 +237,7 @@ class AsyncEngine:
         adapter: Optional[str] = None,
         request_sink: Optional[list] = None,
         request_id: Optional[str] = None,
+        arrival_time: Optional[float] = None,
     ):
         """Async iterator of token ids as the engine samples them.
 
@@ -231,6 +252,8 @@ class AsyncEngine:
                             sampling=sampling or SamplingParams(),
                             priority=priority, adapter=adapter,
                             trace_id=request_id)
+        if arrival_time is not None:
+            req.arrival_time = arrival_time
         if request_sink is not None:
             # Streaming consumers that need per-token request state
             # (logprob entries accumulate on the engine worker thread;

@@ -147,6 +147,13 @@ class EngineConfig:
     # the hot path, surfaced via GET /debug/steps and bench's
     # flight_summary. 0 disables recording entirely.
     flight_recorder_steps: int = 512
+    # Host-RAM spill tier (engine/kv_cache.HostSpillTier): retain up to
+    # this many evicted prefix-cache pages in host memory so a re-sent
+    # prompt re-admits them (one upload) instead of re-prefilling. Spill
+    # capture runs on the admission/prefill path only — never inside the
+    # decode loop. 0 disables the tier. Budgeted by
+    # memory_plan.ServingPlan.host_spill_bytes against host RAM, not HBM.
+    kv_spill_pages: int = 0
 
     @classmethod
     def from_plan(cls, engine_block: dict, *, default_kv_dtype: Any = None,
@@ -903,6 +910,7 @@ class EngineCore:
             max_seq_len=self.ecfg.max_seq_len,
             dtype=self.ecfg.kv_dtype,
             sharding=kv_sharding,
+            spill_pages=self.ecfg.kv_spill_pages,
         )
         self._kv_k = self.kv.pool.kv_k
         self._kv_v = self.kv.pool.kv_v
@@ -950,13 +958,24 @@ class EngineCore:
         # any other window. prefill_steps / decode_dispatches /
         # mixed_steps count DISPATCHES, making the 2-dispatches→1 win of
         # mixed steps directly observable.
+        # kv_pages_imported/exported count location-addressed page moves
+        # (cross-replica pulls, prefill→decode handoffs, spill readmits);
+        # kv_spill_readmits is the subset that came back from the host
+        # spill tier.
         self.metrics = {"decode_tokens": 0, "decode_steps": 0, "prefill_tokens": 0,
                         "preemptions": 0, "decode_time_s": 0.0, "prefill_time_s": 0.0,
                         "cached_prefix_tokens": 0, "spec_drafted": 0, "spec_accepted": 0,
                         "decode_dispatch_time_s": 0.0, "decode_host_time_s": 0.0,
                         "decode_host_overlap_s": 0.0, "prefill_steps": 0,
                         "decode_dispatches": 0, "mixed_steps": 0,
-                        "mixed_tokens": 0, "mixed_time_s": 0.0}
+                        "mixed_tokens": 0, "mixed_time_s": 0.0,
+                        "kv_pages_imported": 0, "kv_pages_exported": 0,
+                        "kv_spill_readmits": 0}
+        # Flight-recorder mark for page transfers: imports/exports happen
+        # BETWEEN steps (under the engine lock, not inside step()), so the
+        # per-step record reports the delta since the last recorded step
+        # rather than an intra-step delta that would always read 0.
+        self._flight_kv_mark = (0, 0)
         self.registry = metrics_mod.get_registry()
         # Flight recorder: one bounded record per step (what was the
         # engine DOING on the slow steps?). The step thread is the only
@@ -1025,6 +1044,17 @@ class EngineCore:
         reg.gauge("runbook_kv_pages_cached",
                   "Retired-but-resident prefix-cache pages"
                   ).set_function(lambda: self.kv.allocator.cached_pages)
+        # Host spill tier (0s when disabled): captures vs LRU drops — the
+        # difference is how much evicted prefix KV stays readmittable.
+        reg.counter("runbook_kv_spill_pages_total",
+                    "KV pages captured into the host spill tier at "
+                    "eviction time").set_function(
+            lambda: float(self.kv.spill.pages_spilled
+                          if self.kv.spill else 0))
+        reg.counter("runbook_kv_spill_evictions_total",
+                    "Spill-tier pages dropped by its LRU bound"
+                    ).set_function(
+            lambda: float(self.kv.spill.evictions if self.kv.spill else 0))
         reg.gauge("runbook_kv_pool_utilization",
                   "Fraction of allocatable KV pages held by live sequences"
                   ).set_function(self.kv.utilization)
@@ -1114,6 +1144,39 @@ class EngineCore:
         ``has_work`` forever). Callers must have failed/aborted the owning
         requests first; the window's tokens are lost with it."""
         self._pending = None
+
+    # ------------------------------------------------- page import / export
+
+    def export_kv_pages(self, prompt_ids: list[int],
+                        hashes: Optional[list[int]] = None,
+                        hash_seed: int = 0, skip_blocks: int = 0,
+                        max_pages: Optional[int] = None):
+        """Stage this replica's resident pages for ``prompt_ids``'s prefix
+        (cross-replica pull / prefill→decode handoff). MUST run under the
+        AsyncEngine step lock — it reads the live pool arrays. Returns an
+        :class:`~runbookai_tpu.engine.kv_cache.ExportedPages` or None
+        (nothing to export — the planned pages were evicted/re-registered
+        since the probe; the chain re-walk under the lock is the
+        staleness guard)."""
+        out = self.kv.export_pages(
+            self._kv_k, self._kv_v, prompt_ids, hashes=hashes,
+            hash_seed=hash_seed, skip_blocks=skip_blocks,
+            max_pages=max_pages)
+        if out is not None:
+            out.src_replica = self.replica_idx
+            self.metrics["kv_pages_exported"] += out.num_pages
+        return out
+
+    def import_kv_pages(self, exported) -> int:
+        """Install exported pages into this replica's pool (digest-checked,
+        retired→matchable). MUST run under the AsyncEngine step lock; the
+        pool arrays are functionally updated so the next dispatch serves
+        the imported bytes. Returns pages imported."""
+        self._kv_k, self._kv_v, n = self.kv.import_pages(
+            self._kv_k, self._kv_v, exported)
+        if n:
+            self.metrics["kv_pages_imported"] += n
+        return n
 
     def _trash_pos(self) -> int:
         return self.kv.max_pages_per_seq * self.ecfg.page_size
@@ -1278,6 +1341,16 @@ class EngineCore:
                 req.block_hashes = hash_blocks(req.prompt_ids,
                                                self.ecfg.page_size,
                                                seed=req.adapter_idx)
+            if self.kv.spill is not None:
+                # Spill-tier readmit: blocks evicted from HBM but still in
+                # host RAM come back as ordinary prefix pages, so the
+                # probe below sees them as hits instead of re-prefilling.
+                self._kv_k, self._kv_v, back = self.kv.readmit_spilled(
+                    self._kv_k, self._kv_v, req.prompt_ids,
+                    hashes=req.block_hashes, hash_seed=req.adapter_idx)
+                if back:
+                    self.metrics["kv_spill_readmits"] += back
+                    self.metrics["kv_pages_imported"] += back
             ok, matched = self.kv.probe_admit(req.prompt_ids, headroom,
                                               hashes=req.block_hashes,
                                               hash_seed=req.adapter_idx)
@@ -1499,6 +1572,15 @@ class EngineCore:
             chunk_len = min(self.ecfg.prefill_chunk,
                             len(req.prompt_ids) - req.prefill_pos)
             new_ctx = req.prefill_pos + chunk_len
+            if self.kv.spill is not None:
+                # Capture the retired pages this extension would evict into
+                # the host spill tier BEFORE they are recycled (the one
+                # point evicted bytes are still addressable).
+                alloc = self.kv.seqs.get(req.request_id)
+                need = (alloc.pages_needed(new_ctx, self.ecfg.page_size)
+                        if alloc is not None else 0)
+                if need:
+                    self.kv.spill_evictable(self._kv_k, self._kv_v, need)
             try:
                 self.kv.extend(req.request_id, new_ctx)
             except MemoryError:
@@ -2534,6 +2616,16 @@ class EngineCore:
             "wall_s": round(time.perf_counter() - t0, 6),
             "preemptions": m["preemptions"] - pre[8],
         }
+        # Page transfers land BETWEEN steps (cross-replica pulls, disagg
+        # handoffs, spill readmits run under the engine lock outside
+        # step()), so these deltas are measured against the LAST RECORDED
+        # step, not this step's start — otherwise every pull would be
+        # invisible in /debug/steps.
+        imported, exported = (m["kv_pages_imported"],
+                              m["kv_pages_exported"])
+        rec["kv_imported"] = imported - self._flight_kv_mark[0]
+        rec["kv_exported"] = exported - self._flight_kv_mark[1]
+        self._flight_kv_mark = (imported, exported)
         if self.replica_idx is not None:
             rec["replica"] = self.replica_idx
         self.flight.append(rec)

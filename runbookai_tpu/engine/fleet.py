@@ -36,15 +36,34 @@ placement is least-loaded with a round-robin tiebreak. Overflow sheds
 (``shed_queue_depth``) and a replica that aborts on pool pressure gets the
 request retried on its siblings (``max_retries``).
 
+Fleet-wide KV page sharing (``FleetConfig.kv_share``): KV pages are
+location-addressable, not replica-private — when the placed replica holds
+fewer of the prompt's prefix pages than a sibling, the router pulls the
+missing pages from that sibling (host-staged copy on CPU; the same
+export/import seam carries device-to-device transfers on TPU) instead of
+re-prefilling them. Every pull is staleness-guarded per chain (the
+export re-walks the planned chain with per-page token verification under
+the source's engine lock) and digest-checked at import, so a pulled page
+is byte-identical to recompute or it is not installed at all.
+
+Prefill/decode disaggregation (``FleetConfig.disagg_prefill_replicas``):
+the first N replicas form a prefill tier — prompts with enough full pages
+prefill there via a 1-token warm request, the pages hand off to a
+decode-tier replica through the same pull seam, and the request streams
+entirely from the decode tier, so prompt bursts never sit in front of
+decode dispatches (AIBrix, arXiv:2504.03648).
+
 Per-request streams are byte-identical to the single-engine path: the
-router only *chooses* a replica; the chosen ``AsyncEngine`` serves the
-request exactly as a standalone engine would.
+router only *chooses* a replica (and optionally pre-stages byte-identical
+KV pages); the chosen ``AsyncEngine`` serves the request exactly as a
+standalone engine would.
 """
 
 from __future__ import annotations
 
 import asyncio
 import threading
+import time as _time
 from contextvars import ContextVar
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
@@ -94,6 +113,41 @@ class FleetConfig:
     # Cross-replica retries when a replica aborts a request on pool
     # pressure. None = up to every other replica once.
     max_retries: Optional[int] = None
+    # Fleet-wide KV page sharing: when the placed replica holds fewer of
+    # the prompt's prefix pages than a sibling, pull the missing pages
+    # from that sibling (digest-checked, chain-reverified host-staged copy)
+    # before submitting, instead of re-prefilling them. Implied on by
+    # disaggregation (the prefill→decode handoff IS a pull).
+    kv_share: bool = False
+    # Minimum full-page deficit (sibling's match minus the placed
+    # replica's) worth a pull — below it, recompute is cheaper than the
+    # two lock acquisitions + copy.
+    kv_share_min_pages: int = 1
+    # Prefill/decode disaggregation: dedicate the FIRST this-many replicas
+    # to a prefill tier. Prompts with at least ``disagg_min_prompt_pages``
+    # full pages prefill there (a 1-token warm request), their pages hand
+    # off to a decode-tier replica, and the request streams entirely from
+    # the decode tier — prompt bursts never sit in front of decode
+    # dispatches. 0 = symmetric fleet (the classic router).
+    disagg_prefill_replicas: int = 0
+    # Prompts below this many full pages skip the prefill tier (the warm
+    # round-trip would cost more than the tail prefill it saves).
+    disagg_min_prompt_pages: int = 1
+
+
+@dataclass
+class _Placement:
+    """One routing decision: the chosen replica plus an optional page-pull
+    plan (source replica and how many blocks the destination already
+    holds). The plan's staleness is handled by the export itself: it
+    re-walks the chain with per-page token verification under the
+    source's engine lock, so planned pages that vanished since the probe
+    simply export nothing."""
+
+    idx: Optional[int]
+    hashes: Optional[list[int]] = None
+    pull_src: Optional[int] = None
+    pull_dst_blocks: int = 0
 
 
 def split_engine_budget(engine_cfg: EngineConfig, dp: int) -> EngineConfig:
@@ -115,6 +169,11 @@ def split_engine_budget(engine_cfg: EngineConfig, dp: int) -> EngineConfig:
     return dataclasses.replace(
         engine_cfg, dp_replicas=dp, max_batch_slots=slots_per,
         num_pages=max(2, engine_cfg.num_pages // dp),
+        # The host spill tier is per replica too: an unsplit value would
+        # hand the dp arm dp× the aggregate host bytes (and spill
+        # readmits) of the dp=1 arm — the exact fake-win this split
+        # exists to prevent. 0 stays 0 (tier disabled).
+        kv_spill_pages=engine_cfg.kv_spill_pages // dp,
         prefill_batch=max(1, min(engine_cfg.prefill_batch, slots_per)))
 
 
@@ -220,6 +279,25 @@ class AsyncFleet:
         slack = self.cfg.affinity_load_slack
         self._slack = (slack if slack is not None
                        else self.cores[0].ecfg.max_batch_slots)
+        # Disaggregated tiers: GLOBAL replica ids [0, n) form the prefill
+        # tier, the rest decode (global, not local list positions — a pod
+        # host building replicas [2, 4) of a dp=4 fleet with one prefill
+        # replica must see zero local prefill replicas, not dedicate its
+        # own replica 2). Every request STREAMS from a decode-tier
+        # replica; the prefill tier only runs warm prefills whose pages
+        # hand off. A split that leaves this fleet no decode tier is
+        # refused — it would place every request nowhere.
+        n_pf = max(0, self.cfg.disagg_prefill_replicas)
+        self._prefill_tier = [i for i, g in enumerate(self.replica_ids)
+                              if g < n_pf]
+        self._decode_tier = [i for i, g in enumerate(self.replica_ids)
+                             if g >= n_pf]
+        if n_pf and not self._decode_tier:
+            raise ValueError(
+                f"disagg_prefill_replicas={n_pf} leaves no decode tier "
+                f"in this fleet (replicas {self.replica_ids})")
+        # The handoff IS a pull, so disaggregation forces page sharing on.
+        self._kv_share = bool(self.cfg.kv_share or n_pf)
         # Router state below is mutated ONLY under this lock (routing runs
         # on event-loop threads and, for bench/eval drivers, possibly
         # several of them).
@@ -252,31 +330,42 @@ class AsyncFleet:
 
     def _route(self, prompt_ids: list[int], hash_seed: int = 0,
                exclude: frozenset[int] = frozenset(),
-               trace_id: Optional[str] = None) -> Optional[int]:
+               trace_id: Optional[str] = None) -> _Placement:
         """Pick a replica: prefix affinity under a load guard, else
-        least-loaded with round-robin tiebreak. None = shed.
+        least-loaded with round-robin tiebreak. ``idx=None`` = shed.
 
-        ``trace_id`` (the caller's x-request-id) rides into the
+        Placement is restricted to the decode tier under disaggregation;
+        with kv_share on, every replica (both tiers) is additionally
+        probed as a page-pull SOURCE, and a sibling holding at least
+        ``kv_share_min_pages`` more of the prompt's prefix than the
+        placed replica yields a pull plan the caller executes before
+        submit. ``trace_id`` (the caller's x-request-id) rides into the
         ``router.place`` trace event so a request timeline can show
         WHERE the router put it and WHY (affinity vs least-loaded) —
         routing runs on the event-loop thread, where the server
         handler's per-thread tracer context is not visible."""
+        probe = (self.cfg.affinity or self._kv_share) \
+            and len(prompt_ids) >= self._page_size
         hashes = None
-        if self.cfg.affinity and len(prompt_ids) >= self._page_size:
+        if probe:
             hashes = hash_blocks(
                 prompt_ids, self._page_size,
                 max_blocks=(len(prompt_ids) - 1) // self._page_size,
                 seed=hash_seed)
         candidates: list[tuple[int, int, int]] = []  # (idx, matched, load)
+        sources: list[tuple[int, int]] = []  # (idx, matched)
         for i, core in enumerate(self.cores):
             if i in exclude:
                 continue
             matched = (core.kv.match_prefix(prompt_ids, hashes=hashes,
                                             hash_seed=hash_seed)
                        if hashes else 0)
-            candidates.append((i, matched, self._live_load(core)))
+            if i in self._decode_tier:
+                candidates.append((i, matched, self._live_load(core)))
+            if self._kv_share and matched:
+                sources.append((i, matched))
         if not candidates:
-            return None
+            return _Placement(idx=None)
         min_load = min(load for _, _, load in candidates)
         if (self.cfg.shed_queue_depth is not None
                 and all(len(self.cores[i].waiting) >= self.cfg.shed_queue_depth
@@ -286,10 +375,13 @@ class AsyncFleet:
             if trace_id is not None:
                 shed_meta["trace_id"] = trace_id
             get_tracer().event("router.shed", **shed_meta)
-            return None
-        affine = [c for c in candidates
-                  if c[1] >= self._page_size
-                  and c[2] <= min_load + self._slack]
+            return _Placement(idx=None)
+        # kv_share probes matches even with affinity routing off — the
+        # matches then only plan pulls, never placement.
+        affine = ([c for c in candidates
+                   if c[1] >= self._page_size
+                   and c[2] <= min_load + self._slack]
+                  if self.cfg.affinity else [])
         with self._lock:
             if affine:
                 pick, _matched, _load = max(
@@ -321,6 +413,96 @@ class AsyncFleet:
             if trace_id is not None:
                 meta["trace_id"] = trace_id
             tracer.event("router.place", **meta)
+        placement = _Placement(idx=pick, hashes=hashes)
+        if sources:
+            # Page-pull plan: the richest sibling beats the placed
+            # replica's own match by at least kv_share_min_pages full
+            # pages → pull the deficit before submit. The export
+            # re-validates the chain under the source's engine lock, so
+            # a plan outdated by eviction degrades to recompute there.
+            dst_matched = next((m for i, m, _ in candidates if i == pick), 0)
+            src, src_matched = max(
+                ((i, m) for i, m in sources if i != pick),
+                key=lambda s: s[1], default=(None, 0))
+            deficit = (src_matched - dst_matched) // self._page_size
+            if src is not None and deficit >= max(
+                    1, self.cfg.kv_share_min_pages):
+                placement.pull_src = src
+                placement.pull_dst_blocks = dst_matched // self._page_size
+        return placement
+
+    # -------------------------------------------------- page pull / disagg
+
+    async def _execute_pull(self, placement: _Placement,
+                            prompt_ids: list[int], hash_seed: int,
+                            trace_id: Optional[str] = None) -> int:
+        """Run a planned page pull: export from the source replica (under
+        its engine lock, chain-reverified) and import into the placed
+        replica (under its lock, digest-checked). Both halves run in
+        worker threads — the event loop (and every live stream) stays
+        free. A stale plan (pages evicted since the probe) or full
+        destination pool degrades to recompute; the request is submitted
+        either way. Returns pages pulled."""
+        dst, src = placement.idx, placement.pull_src
+        t0 = _time.perf_counter()
+        exported = await self.replicas[src].run_locked(
+            lambda: self.cores[src].export_kv_pages(
+                prompt_ids, hashes=placement.hashes, hash_seed=hash_seed,
+                skip_blocks=placement.pull_dst_blocks))
+        if exported is None:
+            self._m_pull_stale.inc()
+            return 0
+        pulled = await self.replicas[dst].run_locked(
+            lambda: self.cores[dst].import_kv_pages(exported))
+        elapsed = _time.perf_counter() - t0
+        if pulled:
+            self._m_xreplica_hits.inc()
+            self._m_xreplica_pages.inc(pulled)
+            self._m_xreplica_seconds.inc(elapsed)
+        tracer = get_tracer()
+        if tracer.enabled:
+            # The timeline's pull span: destination + SOURCE replica,
+            # pages moved and the wall it cost (runbook timeline renders
+            # it between router.place and engine.enqueue).
+            meta = {"replica": self.replica_ids[dst],
+                    "src": self.replica_ids[src], "pages": pulled,
+                    "pull_ms": round(elapsed * 1e3, 3)}
+            if trace_id is not None:
+                meta["trace_id"] = trace_id
+            tracer.event("router.page_pull", **meta)
+        return pulled
+
+    def _full_pages(self, prompt_ids: list[int]) -> int:
+        """Full prefix pages a prompt can publish ((len-1)//page_size —
+        the engine always prefills at least the last token itself)."""
+        return max(0, (len(prompt_ids) - 1) // self._page_size)
+
+    async def _disagg_warm(self, prompt_ids: list[int], hash_seed: int,
+                           adapter: Optional[str],
+                           trace_id: Optional[str]) -> Optional[int]:
+        """Prefill ``prompt_ids`` on the prefill tier: a greedy 1-token
+        warm request on the least-loaded prefill replica computes and
+        publishes the prompt's full pages, which then hand off to the
+        decode replica at first-token time (the pull in generate /
+        generate_stream). Returns the warm replica, or None when the
+        prompt is too short to be worth the round-trip."""
+        if not self._prefill_tier \
+                or self._full_pages(prompt_ids) \
+                < max(1, self.cfg.disagg_min_prompt_pages):
+            return None
+        pick = min(self._prefill_tier,
+                   key=lambda i: self._live_load(self.cores[i]))
+        warm = SamplingParams(temperature=0.0, max_new_tokens=1,
+                              stop_token_ids=())
+        try:
+            out = await self.replicas[pick].generate(
+                prompt_ids, warm, adapter=adapter,
+                request_id=(f"{trace_id}-warm" if trace_id else None))
+        except Exception:  # noqa: BLE001 — a sick prefill tier must not
+            return None    # fail the request; decode tier recomputes
+        if out.finish_reason is FinishReason.ABORTED:
+            return None  # prefill pool pressure — recompute on decode tier
+        self._m_warm.labels(replica=str(self.replica_ids[pick])).inc()
         return pick
 
     # ----------------------------------------------------- AsyncEngine API
@@ -359,20 +541,37 @@ class AsyncFleet:
         """
         retries = (self.cfg.max_retries if self.cfg.max_retries is not None
                    else self.dp - 1)
+        # The TTFT clock starts HERE: warm prefills and page pulls below
+        # are part of the first token's latency, so they ride inside the
+        # arrival time the replica's EngineRequest is backdated to.
+        t_arrival = _time.perf_counter()
         hash_seed = self._hash_seed(adapter)
-        tried: set[int] = set()
+        if self._prefill_tier and not self.is_saturated():
+            # Disaggregation: the heavy prefill runs on the prefill tier
+            # first; its pages hand off through the pull below, so the
+            # decode replica prefills only the sub-page tail. A saturated
+            # fleet skips the warm — the most expensive work in the
+            # system must not run for a request about to be shed.
+            await self._disagg_warm(prompt_ids, hash_seed, adapter,
+                                    request_id)
+        tried: set[int] = set()  # decode-tier picks that aborted
         out: Optional[EngineOutput] = None
         for attempt in range(retries + 1):
-            idx = self._route(prompt_ids, hash_seed,
-                              exclude=frozenset(tried),
-                              trace_id=request_id)
+            placement = self._route(prompt_ids, hash_seed,
+                                    exclude=frozenset(tried),
+                                    trace_id=request_id)
+            idx = placement.idx
             if idx is None:
                 break
             if attempt:
                 self._m_retries.inc()
+            if placement.pull_src is not None:
+                await self._execute_pull(placement, prompt_ids, hash_seed,
+                                         trace_id=request_id)
             out = await self.replicas[idx].generate(
                 prompt_ids, sampling, timeout_s=timeout_s,
-                priority=priority, adapter=adapter, request_id=request_id)
+                priority=priority, adapter=adapter, request_id=request_id,
+                arrival_time=t_arrival)
             if out.finish_reason is not FinishReason.ABORTED:
                 return out
             tried.add(idx)
@@ -390,15 +589,25 @@ class AsyncFleet:
         """Route once, then yield the replica's token stream unchanged
         (no cross-replica retry mid-stream: tokens already yielded cannot
         be unsaid). Shedding raises :class:`FleetSaturated`."""
-        idx = self._route(prompt_ids, self._hash_seed(adapter),
-                          trace_id=request_id)
+        t_arrival = _time.perf_counter()  # TTFT includes warm + pull
+        hash_seed = self._hash_seed(adapter)
+        if self._prefill_tier and not self.is_saturated():
+            await self._disagg_warm(prompt_ids, hash_seed, adapter,
+                                    request_id)
+        placement = self._route(prompt_ids, hash_seed,
+                                trace_id=request_id)
+        idx = placement.idx
         if idx is None:
             raise FleetSaturated(
                 f"all {self.dp} replicas over shed_queue_depth="
                 f"{self.cfg.shed_queue_depth}")
+        if placement.pull_src is not None:
+            await self._execute_pull(placement, prompt_ids, hash_seed,
+                                     trace_id=request_id)
         agen = self.replicas[idx].generate_stream(
             prompt_ids, sampling, priority=priority, adapter=adapter,
-            request_sink=request_sink, request_id=request_id)
+            request_sink=request_sink, request_id=request_id,
+            arrival_time=t_arrival)
         try:
             async for tok in agen:
                 yield tok
@@ -462,6 +671,27 @@ class AsyncFleet:
         self._m_shed = reg.counter(
             "runbook_router_shed_total",
             "Requests shed with every replica over shed_queue_depth")
+        # Fleet-wide KV page sharing (docs/observability.md): pulls that
+        # landed pages, pages moved, wall spent moving them, and pulls
+        # whose planned pages were gone by export time.
+        self._m_xreplica_hits = reg.counter(
+            "runbook_router_xreplica_hits_total",
+            "Placements whose prefix pages were pulled from a sibling "
+            "replica instead of re-prefilled")
+        self._m_xreplica_pages = reg.counter(
+            "runbook_router_xreplica_pages_pulled_total",
+            "KV pages pulled across replicas (cross-replica prefix hits "
+            "+ prefill-tier handoffs)")
+        self._m_xreplica_seconds = reg.counter(
+            "runbook_router_xreplica_pull_seconds_total",
+            "Wall seconds spent exporting+importing pulled KV pages")
+        self._m_pull_stale = reg.counter(
+            "runbook_router_xreplica_stale_total",
+            "Planned pulls whose pages were gone by export time — the "
+            "under-lock chain re-walk found nothing (recomputed instead)")
+        self._m_warm = reg.counter(
+            "runbook_router_prefill_tier_warms_total",
+            "Disaggregated prefill-tier warm prefills", labels=("replica",))
         reg.gauge(
             "runbook_router_imbalance_ratio",
             "Max over mean of per-replica routed request counts "
@@ -509,6 +739,16 @@ class AsyncFleet:
         reg.gauge("runbook_kv_pages_cached",
                   "Retired-but-resident prefix-cache pages").set_function(
             lambda: sum(c.kv.allocator.cached_pages for c in self.cores))
+        reg.counter("runbook_kv_spill_pages_total",
+                    "KV pages captured into the host spill tier at "
+                    "eviction time").set_function(
+            lambda: float(sum(c.kv.spill.pages_spilled for c in self.cores
+                              if c.kv.spill)))
+        reg.counter("runbook_kv_spill_evictions_total",
+                    "Spill-tier pages dropped by its LRU bound"
+                    ).set_function(
+            lambda: float(sum(c.kv.spill.evictions for c in self.cores
+                              if c.kv.spill)))
         reg.gauge("runbook_kv_pool_utilization",
                   "Fraction of allocatable KV pages held by live sequences"
                   ).set_function(self._agg_utilization)
@@ -551,7 +791,7 @@ class AsyncFleet:
         falls back to the in-stream error event."""
         depth = self.cfg.shed_queue_depth
         return depth is not None and all(
-            len(core.waiting) >= depth for core in self.cores)
+            len(self.cores[i].waiting) >= depth for i in self._decode_tier)
 
     def debug_steps(self, last_n: Optional[int] = None,
                     lock_timeout: float = 0.5) -> dict:
@@ -612,6 +852,8 @@ class AsyncFleet:
             kv_cached += kv.allocator.cached_pages
             replicas.append({
                 "replica": self.replica_ids[i],
+                "tier": ("prefill" if i in self._prefill_tier
+                         else "decode" if self._prefill_tier else "mixed"),
                 "running": len(core.decoding),
                 "waiting": len(core.waiting) + len(core.prefilling),
                 "kv": {"pages_total": kv.allocator.num_pages,
@@ -619,9 +861,11 @@ class AsyncFleet:
                        "pages_cached": kv.allocator.cached_pages,
                        "utilization": round(kv.utilization(), 4)},
                 "decode_tokens": m.get("decode_tokens", 0),
+                "kv_pages_imported": m.get("kv_pages_imported", 0),
+                "kv_pages_exported": m.get("kv_pages_exported", 0),
             })
         usable = sum(c.kv.allocator.num_pages - 1 for c in self.cores)
-        return {
+        body = {
             "dp_replicas": self.dp,
             "kv": {"pages_total": kv_total, "pages_in_use": kv_used,
                    "pages_cached": kv_cached,
@@ -635,3 +879,21 @@ class AsyncFleet:
                 "imbalance_ratio": round(self._imbalance(), 4),
             },
         }
+        if self._kv_share:
+            body["router"]["kv_share"] = {
+                "xreplica_hits": int(self._m_xreplica_hits.value),
+                "pages_pulled": int(self._m_xreplica_pages.value),
+                "pull_seconds": round(self._m_xreplica_seconds.value, 4),
+                "stale_rejections": int(self._m_pull_stale.value),
+            }
+        if self._prefill_tier:
+            # The /healthz tier breakdown: which GLOBAL replica ids serve
+            # each tier (matches the replicas[].tier rows above).
+            body["router"]["disagg"] = {
+                "prefill_replicas": [self.replica_ids[i]
+                                     for i in self._prefill_tier],
+                "decode_replicas": [self.replica_ids[i]
+                                    for i in self._decode_tier],
+                "warm_prefills": int(self._m_warm.total()),
+            }
+        return body

@@ -40,7 +40,7 @@ from runbookai_tpu.utils.trace import _percentile
 STEP_RECORD_FIELDS = (
     "step", "ts", "kind", "tokens", "batch", "occupancy", "queue_depth",
     "kv_free_pages", "kv_utilization", "dispatch_s", "host_s", "overlap_s",
-    "wall_s", "preemptions", "replica",
+    "wall_s", "preemptions", "kv_imported", "kv_exported", "replica",
 )
 
 

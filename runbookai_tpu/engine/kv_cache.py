@@ -23,9 +23,10 @@ No reference counterpart (SURVEY.md §2.9 item 2 — green-field requirement).
 
 from __future__ import annotations
 
+import hashlib
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -123,6 +124,126 @@ def hash_blocks(token_ids: Sequence[int], page_size: int,
             h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
         out.append(h)
     return out
+
+
+def _kv_leaves(tree: Any) -> list:
+    """Flat leaves of one side of a pool (a bare array, or (values,
+    scales) for quantized pools) — every leaf's axis 1 is the token/row
+    axis, so page-row slicing is uniform across pool dtypes."""
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _page_rows(pages: Sequence[int], page_size: int) -> np.ndarray:
+    return np.concatenate(
+        [np.arange(p * page_size, (p + 1) * page_size) for p in pages])
+
+
+def _fetch_rows(tree: Any, rows: np.ndarray) -> list[np.ndarray]:
+    """Fetch ``rows`` of every pool leaf to the host in one gather each.
+
+    THE page-transfer sync point (docs/lint.md "page transfer" entry):
+    every path that moves KV bytes off a device pool — cross-replica
+    pull export, prefill→decode handoff, spill-tier capture — funnels
+    its device→host copy through here, one batched fetch per transfer,
+    never inside the decode loop (callers hold the engine lock between
+    steps). tests/test_lint.py pins this as the only sanctioned sync in
+    this module.
+    """
+    # runbook: noqa[RBK002] — sanctioned sync: the page-transfer fetch —
+    # one batched device→host copy per pull/handoff/spill, on the
+    # admission/routing path under the engine lock, never the decode loop.
+    return [np.asarray(jax.device_get(leaf[:, rows]))
+            for leaf in _kv_leaves(tree)]
+
+
+def _block_digest(leaves_k: Sequence[np.ndarray],
+                  leaves_v: Sequence[np.ndarray],
+                  block: int, page_size: int) -> str:
+    """Content digest of one page's K+V bytes in an exported batch.
+
+    Checked again at import time: a pulled page is installed only if it is
+    byte-identical to what the exporter read — a corrupted or re-ordered
+    transfer must downgrade to recompute, never serve wrong KV."""
+    h = hashlib.blake2b(digest_size=16)
+    lo, hi = block * page_size, (block + 1) * page_size
+    for leaf in (*leaves_k, *leaves_v):
+        h.update(np.ascontiguousarray(leaf[:, lo:hi]).tobytes())
+    return h.hexdigest()
+
+
+@dataclass
+class ExportedPages:
+    """Host-staged KV pages in transit between pools (cross-replica pull,
+    prefill→decode handoff, spill readmit). ``leaves_k``/``leaves_v`` hold
+    ALL exported pages concatenated on the row axis (block ``i`` owns rows
+    ``[i*page_size, (i+1)*page_size)``), fetched in ONE device→host copy.
+    """
+
+    page_size: int
+    hash_seed: int
+    skip_blocks: int  # chain depth of the first exported block
+    hashes: list[int]  # chain hash per exported block
+    blocks: list[tuple[int, ...]]  # token ids each page actually holds
+    leaves_k: list[np.ndarray]
+    leaves_v: list[np.ndarray]
+    digests: list[str]
+    src_version: int
+    src_replica: Optional[int] = None
+
+    @property
+    def num_pages(self) -> int:
+        return len(self.hashes)
+
+
+@dataclass
+class _SpillEntry:
+    blocks: tuple[int, ...]
+    leaves_k: list[np.ndarray]
+    leaves_v: list[np.ndarray]
+    digest: str
+
+
+class HostSpillTier:
+    """Bounded host-RAM store of evicted prefix-cache pages.
+
+    HBM pressure evicts retired pages oldest-first; with a spill tier the
+    evicted bytes drain here instead of vanishing, so the next request
+    with the same prefix re-admits them (one host→device upload) instead
+    of recomputing the prefill. LRU-bounded by ``max_pages``; keyed by the
+    chain hash (already namespaced by the LoRA ``hash_seed``), with the
+    token block stored alongside so a readmit is verified exactly like a
+    resident prefix match."""
+
+    def __init__(self, max_pages: int):
+        self.max_pages = max(0, int(max_pages))
+        self._store: OrderedDict[int, _SpillEntry] = OrderedDict()
+        self.pages_spilled = 0  # total puts (runbook_kv_spill_pages_total)
+        self.evictions = 0  # LRU drops (runbook_kv_spill_evictions_total)
+        self.readmitted = 0  # pages re-admitted into a device pool
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    def put(self, block_hash: int, blocks: tuple[int, ...],
+            leaves_k: list[np.ndarray], leaves_v: list[np.ndarray],
+            digest: str) -> None:
+        if not self.max_pages:
+            return
+        if block_hash in self._store:
+            self._store.move_to_end(block_hash)
+            return
+        while len(self._store) >= self.max_pages:
+            self._store.popitem(last=False)
+            self.evictions += 1
+        self._store[block_hash] = _SpillEntry(blocks, leaves_k, leaves_v,
+                                              digest)
+        self.pages_spilled += 1
+
+    def get(self, block_hash: int) -> Optional[_SpillEntry]:
+        entry = self._store.get(block_hash)
+        if entry is not None:
+            self._store.move_to_end(block_hash)
+        return entry
 
 
 class PageAllocator:
@@ -257,6 +378,7 @@ class KVCacheManager:
         dtype=jnp.bfloat16,
         allocator: Optional[PageAllocator] = None,
         sharding=None,
+        spill_pages: int = 0,
     ):
         self.pool = PagePool.create(n_layers, num_pages, page_size, n_kv_heads,
                                     head_dim, dtype, sharding=sharding)
@@ -278,6 +400,14 @@ class KVCacheManager:
         # verified against these so a 64-bit hash collision can never serve
         # another request's KV (cross-request leakage). Bounded by num_pages.
         self._page_tokens: dict[int, tuple[int, ...]] = {}
+        # Host-RAM spill tier (0 = disabled): evicted prefix-cache pages
+        # drain here instead of vanishing; readmit_spilled pulls them back
+        # under a fresh prefix match. Spill capture needs the allocator's
+        # retired-LRU internals, so it is a pure-Python-allocator feature
+        # (the native allocator reports no evictable inventory and the
+        # tier stays empty — correct, just cold).
+        self.spill: Optional[HostSpillTier] = (
+            HostSpillTier(spill_pages) if spill_pages > 0 else None)
 
     # ----------------------------------------------------------- prefix reuse
 
@@ -382,6 +512,254 @@ class KVCacheManager:
                 self._page_tokens[page] = tuple(
                     token_ids[b * self.page_size : (b + 1) * self.page_size])
         alloc.registered_blocks = max(alloc.registered_blocks, max_blocks)
+
+    # ------------------------------------------------- page transfer / spill
+
+    def _matched_chain(self, prompt_ids: Sequence[int],
+                       hashes: Optional[list[int]], hash_seed: int,
+                       ) -> tuple[list[int], list[int], list[tuple[int, ...]]]:
+        """Verified resident prefix: ``(pages, chain hashes, token blocks)``
+        — the same walk as :meth:`_match_pages`, keeping the hash/token
+        metadata a transfer payload needs."""
+        pages: list[int] = []
+        keep_hashes: list[int] = []
+        blocks: list[tuple[int, ...]] = []
+        for b, h in enumerate(self._prompt_hashes(prompt_ids, hashes,
+                                                  hash_seed)):
+            page = self.allocator.lookup(h)
+            if page is None:
+                break
+            blk = tuple(prompt_ids[b * self.page_size:(b + 1) * self.page_size])
+            if self._page_tokens.get(page) != blk:
+                break
+            pages.append(page)
+            keep_hashes.append(h)
+            blocks.append(blk)
+        return pages, keep_hashes, blocks
+
+    def export_pages(self, kv_k, kv_v, prompt_ids: Sequence[int],
+                     hashes: Optional[list[int]] = None, hash_seed: int = 0,
+                     skip_blocks: int = 0, max_pages: Optional[int] = None,
+                     ) -> Optional[ExportedPages]:
+        """Stage this pool's resident prefix pages for another pool.
+
+        ``kv_k``/``kv_v`` are the CALLER's live pool arrays (the engine's,
+        not ``self.pool`` — the engine's dispatch donation leaves the pool
+        handle stale after the first step). Staleness is guarded
+        PER-CHAIN: a router probe reads the prefix index lock-free, and
+        the plan it made is re-validated here under the engine lock by
+        re-walking the chain with per-page token verification — pages
+        evicted or re-registered since the probe simply fall out of the
+        walk, and a plan whose pages are gone exports nothing (the
+        requester recomputes). The global ``version`` epoch is NOT
+        compared: it moves on every admission/extension/release anywhere
+        in the pool, so on a busy source it would reject pulls whose
+        pages are still verifiably resident. Matched pages are pinned
+        (acquire/free) across the device→host copy so pool pressure
+        cannot recycle them mid-export.
+        """
+        pages, keep_hashes, blocks = self._matched_chain(prompt_ids, hashes,
+                                                         hash_seed)
+        if max_pages is not None:
+            end = skip_blocks + max(0, max_pages)
+            pages, keep_hashes, blocks = (pages[:end], keep_hashes[:end],
+                                          blocks[:end])
+        pages = pages[skip_blocks:]
+        keep_hashes = keep_hashes[skip_blocks:]
+        blocks = blocks[skip_blocks:]
+        if not pages:
+            return None
+        for p in pages:
+            self.allocator.acquire(p)
+        try:
+            rows = _page_rows(pages, self.page_size)
+            leaves_k = _fetch_rows(kv_k, rows)
+            leaves_v = _fetch_rows(kv_v, rows)
+        finally:
+            self.allocator.free(pages)
+        digests = [_block_digest(leaves_k, leaves_v, j, self.page_size)
+                   for j in range(len(pages))]
+        return ExportedPages(
+            page_size=self.page_size, hash_seed=hash_seed,
+            skip_blocks=skip_blocks, hashes=keep_hashes, blocks=blocks,
+            leaves_k=leaves_k, leaves_v=leaves_v, digests=digests,
+            src_version=self.version)
+
+    def _leaves_compatible(self, kv_k,
+                           leaves_k: Sequence[np.ndarray]) -> bool:
+        mine = _kv_leaves(kv_k)
+        if len(mine) != len(leaves_k):
+            return False
+        for leaf, data in zip(mine, leaves_k):
+            if (leaf.shape[0] != data.shape[0]
+                    or leaf.shape[2:] != data.shape[2:]
+                    or jnp.dtype(leaf.dtype) != np.dtype(data.dtype)):
+                return False
+        return True
+
+    @staticmethod
+    def _set_rows(tree, rows: np.ndarray, data_leaves: Sequence[np.ndarray],
+                  lo: int, hi: int):
+        """Write host rows ``[lo:hi)`` of each data leaf into the pool
+        tree at ``rows`` (functional update — returns the new tree)."""
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        new = [leaf.at[:, rows].set(jnp.asarray(d[:, lo:hi], dtype=leaf.dtype))
+               for leaf, d in zip(leaves, data_leaves)]
+        return jax.tree_util.tree_unflatten(treedef, new)
+
+    def _install_blocks(self, kv_k, kv_v,
+                        items: Sequence[tuple]) -> tuple[Any, Any, int]:
+        """Install verified blocks: one STRICTLY-FREE page per item, all
+        pages written in ONE functional pool update per tree.
+
+        ``items`` = ``(block_hash, tokens, leaves_k, leaves_v, lo, hi)``
+        per block, in chain order. A per-page ``.at[].set`` would
+        materialize a full pool copy per installed page — under the
+        destination engine's step lock that stalls every in-flight
+        decode, so the writes batch exactly like the export's single
+        ``_fetch_rows`` copy. Only strictly-free pages host installs —
+        never the retired prefix cache: evicting resident cache for a
+        speculative install would trade a known-hot page for a maybe-hot
+        one, and (since installed pages retire immediately) the alloc
+        would recycle the blocks installed moments earlier in this very
+        call, leaving a broken non-prefix residue. A full pool stops the
+        walk, keeping the installed prefix contiguous."""
+        ps = self.page_size
+        staged: list[tuple[int, tuple]] = []
+        for block_hash, blk, leaves_k, leaves_v, lo, hi in items:
+            if self.allocator.free_pages - self.allocator.cached_pages < 1:
+                break
+            try:
+                [page] = self.allocator.alloc(1)
+            except MemoryError:
+                break
+            self.allocator.register(page, block_hash)
+            if self.allocator.lookup(block_hash) == page:
+                self._page_tokens[page] = blk
+            staged.append((page, (leaves_k, leaves_v, lo, hi)))
+        if not staged:
+            return kv_k, kv_v, 0
+        dst_rows = _page_rows([p for p, _ in staged], ps)
+        n_leaves = len(_kv_leaves(kv_k))
+        data_k = [np.concatenate(
+            [np.ascontiguousarray(src[0][i][:, src[2]:src[3]])
+             for _, src in staged], axis=1) for i in range(n_leaves)]
+        data_v = [np.concatenate(
+            [np.ascontiguousarray(src[1][i][:, src[2]:src[3]])
+             for _, src in staged], axis=1) for i in range(n_leaves)]
+        kv_k = self._set_rows(kv_k, dst_rows, data_k, 0, len(staged) * ps)
+        kv_v = self._set_rows(kv_v, dst_rows, data_v, 0, len(staged) * ps)
+        # Retire immediately: installed pages are matchable exactly like
+        # released prefix pages, and stay evictable under pool pressure
+        # so installs can never starve live sequences.
+        self.allocator.free([p for p, _ in staged])
+        return kv_k, kv_v, len(staged)
+
+    def import_pages(self, kv_k, kv_v, exported: ExportedPages,
+                     ) -> tuple[Any, Any, int]:
+        """Install exported pages into THIS pool (returns updated arrays +
+        pages imported). Each block re-verifies its content digest before
+        installation; blocks whose hash already resolves to a verified
+        local page are skipped (the exporter raced a local prefill — fine,
+        first writer wins). Installation semantics (strictly-free pages
+        only, one batched pool write, contiguous-prefix stop on a full
+        pool) live in :meth:`_install_blocks` — partial prefixes are
+        still byte-exact wins."""
+        if exported.page_size != self.page_size \
+                or not self._leaves_compatible(kv_k, exported.leaves_k):
+            return kv_k, kv_v, 0
+        ps = self.page_size
+        items = []
+        for j in range(exported.num_pages):
+            h = exported.hashes[j]
+            blk = exported.blocks[j]
+            existing = self.allocator.lookup(h)
+            if existing is not None and self._page_tokens.get(existing) == blk:
+                continue
+            if _block_digest(exported.leaves_k, exported.leaves_v, j,
+                             ps) != exported.digests[j]:
+                break  # payload corrupted in transit — recompute instead
+            items.append((h, blk, exported.leaves_k, exported.leaves_v,
+                          j * ps, (j + 1) * ps))
+        kv_k, kv_v, imported = self._install_blocks(kv_k, kv_v, items)
+        if imported:
+            self.version += 1
+        return kv_k, kv_v, imported
+
+    def spill_evictable(self, kv_k, kv_v, want_pages: int) -> int:
+        """Drain the retired pages an upcoming ``alloc(want_pages)`` would
+        evict into the host spill tier (one batched device→host copy).
+
+        Called by the engine right before prefill page allocation when the
+        free list alone cannot satisfy the request — the only point pages
+        leave HBM with their bytes still addressable. Decode-growth
+        evictions skip this (no sync in the decode loop); those pages are
+        simply lost to the tier, which is a cold-cache miss, not an error.
+        """
+        if self.spill is None:
+            return 0
+        free = getattr(self.allocator, "_free", None)
+        retired = getattr(self.allocator, "_retired", None)
+        to_hash = getattr(self.allocator, "_page_to_hash", None)
+        if free is None or retired is None or to_hash is None:
+            return 0  # native allocator: no evictable inventory exposed
+        n_evict = min(max(0, want_pages - len(free)), len(retired))
+        if n_evict <= 0:
+            return 0
+        victims = [p for p, _ in zip(retired.keys(), range(n_evict))
+                   if to_hash.get(p) is not None
+                   and self._page_tokens.get(p) is not None]
+        if not victims:
+            return 0
+        rows = _page_rows(victims, self.page_size)
+        leaves_k = _fetch_rows(kv_k, rows)
+        leaves_v = _fetch_rows(kv_v, rows)
+        spilled = 0
+        for j, page in enumerate(victims):
+            # Copies, not views: a view would keep the WHOLE batched
+            # fetch alive per entry, so the tier's max_pages bound would
+            # stop bounding host bytes and LRU eviction would free
+            # nothing.
+            lk = [np.ascontiguousarray(
+                      leaf[:, j * self.page_size:(j + 1) * self.page_size])
+                  for leaf in leaves_k]
+            lv = [np.ascontiguousarray(
+                      leaf[:, j * self.page_size:(j + 1) * self.page_size])
+                  for leaf in leaves_v]
+            self.spill.put(to_hash[page], self._page_tokens[page], lk, lv,
+                           _block_digest(lk, lv, 0, self.page_size))
+            spilled += 1
+        return spilled
+
+    def readmit_spilled(self, kv_k, kv_v, prompt_ids: Sequence[int],
+                        hashes: Optional[list[int]] = None,
+                        hash_seed: int = 0) -> tuple[Any, Any, int]:
+        """Extend this prompt's resident prefix from the spill tier:
+        blocks past the resident match whose hash+tokens verify in the
+        tier are uploaded back into fresh pages (retired → matchable), so
+        the admission that follows sees them as ordinary prefix hits."""
+        if self.spill is None or not len(self.spill):
+            return kv_k, kv_v, 0
+        chain = self._prompt_hashes(prompt_ids, hashes, hash_seed)
+        start = len(self._match_pages(prompt_ids, hashes, hash_seed))
+        items = []
+        for b in range(start, len(chain)):
+            entry = self.spill.get(chain[b])
+            blk = tuple(prompt_ids[b * self.page_size:(b + 1) * self.page_size])
+            if entry is None or entry.blocks != blk:
+                break
+            if _block_digest(entry.leaves_k, entry.leaves_v, 0,
+                             self.page_size) != entry.digest:
+                break  # host copy corrupted — recompute
+            if not self._leaves_compatible(kv_k, entry.leaves_k):
+                break
+            items.append((chain[b], blk, entry.leaves_k, entry.leaves_v,
+                          0, self.page_size))
+        kv_k, kv_v, readmitted = self._install_blocks(kv_k, kv_v, items)
+        if readmitted:
+            self.version += 1
+            self.spill.readmitted += readmitted
+        return kv_k, kv_v, readmitted
 
     # -------------------------------------------------------------- pressure
 

@@ -41,6 +41,10 @@ class ServingPlan:
     pool_budget_bytes: int  # HBM left for the KV pool after weights+headroom
     max_seq_len: int
     batch: int
+    # Host-RAM spill tier (EngineConfig.kv_spill_pages): bytes the tier
+    # pins in HOST memory, not HBM — it never competes with the pool
+    # budget above, but an operator sizing a box must still see it.
+    host_spill_bytes: int = 0
 
     @property
     def context_bytes_per_chip(self) -> float:
@@ -67,6 +71,8 @@ class ServingPlan:
         return check_plan(core, self, tol=tol)
 
     def explain(self) -> str:
+        spill = (f"; host spill tier {self.host_spill_bytes / GiB:.2f} GiB "
+                 f"(host RAM)" if self.host_spill_bytes else "")
         return (
             f"{self.model} tp{self.tp} (kv{self.kv_shards}×pg"
             f"{self.pg_shards}): weights {self.weight_bytes_per_chip / GiB:.2f}"
@@ -75,7 +81,7 @@ class ServingPlan:
             f"{self.context_bytes_per_chip / GiB:.2f} GiB; pool budget "
             f"{self.pool_budget_bytes / GiB:.2f} GiB holds "
             f"{self.max_concurrent_contexts} concurrent (need {self.batch})"
-            f" → {'FITS' if self.fits else 'DOES NOT FIT'}"
+            f" → {'FITS' if self.fits else 'DOES NOT FIT'}" + spill
         )
 
 
@@ -89,6 +95,8 @@ def plan_serving(
     kv_scale_bytes: int = 0,
     hbm_bytes: int = 16 * GiB,
     headroom_bytes: int = int(1.5 * GiB),
+    kv_spill_pages: int = 0,
+    page_size: int = 16,
 ) -> ServingPlan:
     """Arithmetic plan for serving ``cfg`` at ``max_seq_len`` × ``batch``.
 
@@ -96,7 +104,10 @@ def plan_serving(
     "bf16". KV shards by the full tp via :func:`plan_kv_split` (heads as
     far as they divide, pages for the rest). ``kv_scale_bytes``: extra
     bytes per (token, kv head) — 4 for the int8 KV pool's f32 absmax
-    scales, 0 for raw-dtype pools.
+    scales, 0 for raw-dtype pools. ``kv_spill_pages`` × ``page_size``
+    tokens of UNSHARDED KV are additionally pinned in host RAM (the spill
+    tier holds full-width pages regardless of the device sharding) and
+    reported as ``host_spill_bytes`` — host budget, never HBM.
     """
     from runbookai_tpu.parallel.kv_split import plan_kv_split
 
@@ -122,10 +133,13 @@ def plan_serving(
                     * (cfg.head_dim * kv_dtype_bytes + kv_scale_bytes)
                     / max(plan.pg_shards, 1))
     budget = max(0, hbm_bytes - int(per_chip) - headroom_bytes)
+    spill_token = (cfg.n_layers * 2 * cfg.n_kv_heads
+                   * (cfg.head_dim * kv_dtype_bytes + kv_scale_bytes))
     return ServingPlan(
         model=cfg.name, tp=tp, kv_shards=plan.kv_shards,
         pg_shards=plan.pg_shards, hbm_bytes=hbm_bytes,
         weight_bytes_per_chip=int(per_chip),
         kv_bytes_per_token_per_chip=kv_per_token,
         pool_budget_bytes=budget, max_seq_len=max_seq_len, batch=batch,
+        host_spill_bytes=int(kv_spill_pages * page_size * spill_token),
     )

@@ -415,6 +415,13 @@ def _in_string(machine) -> bool:
     return bool(getattr(machine, "in_string", False))
 
 
+# Token headroom the generic-JSON byte budget leaves for wrap-up: enough
+# to close the deepest document a small budget can open (depth ≤ spent/2)
+# plus an in-flight escape/UTF-8 tail, without eating a 48-token request's
+# whole budget.
+_JSON_WRAPUP_RESERVE = 16
+
+
 class JsonMaskProvider:
     """Builds per-step allowed-token masks for an engine + tokenizer pair.
 
@@ -475,7 +482,20 @@ class JsonMaskProvider:
                         limits, max_token_bytes=self._longest_token)
                 req.guided_state = SchemaMachine(schema, name, limits=limits)
             else:
-                req.guided_state = JsonMachine()
+                # Budget-aware generic JSON: past ~the request's token
+                # budget (bytes ≤ tokens: every token is ≥ 1 byte) the
+                # machine enters WRAP-UP — only completion-directed bytes
+                # stay admissible — so a random-weights model closes its
+                # document INSIDE max_new_tokens instead of streaming an
+                # ever-growing string into a "length" truncation that
+                # parses as invalid JSON. The reserve leaves wrap-up room
+                # to close every open string/container (closing needs at
+                # most ~depth bytes, and depth ≤ budget/2).
+                self._bytes_table()  # populates _longest_token once
+                budget = max(4, req.sampling.max_new_tokens
+                             - _JSON_WRAPUP_RESERVE)
+                req.guided_state = JsonMachine(
+                    budget=budget, budget_bucket=self._longest_token)
         return req.guided_state
 
     def mask(self, req) -> np.ndarray:
@@ -485,7 +505,16 @@ class JsonMaskProvider:
         if cached is not None:
             return cached
         table = self._bytes_table()
-        if type(machine) is JsonMachine:
+        # The vectorized sweep's packed automaton has no byte-budget
+        # column: it is exact only while NO admissible token can cross the
+        # wrap-up boundary mid-token, i.e. while the remaining budget
+        # strictly exceeds the longest token's byte expansion (the same
+        # hazard budget_bucket caps the cache signature for). At or below
+        # the boundary, fall through to the scalar replay prober, which
+        # runs the real machine (budget bookkeeping included) per token.
+        if type(machine) is JsonMachine and (
+                machine.budget is None
+                or machine.budget > machine.budget_bucket):
             # Generic JSON: vectorized full-vocab sweep (guided_mask.py) —
             # ~max_token_len numpy passes instead of ~vocab Python replays.
             if self._vector is None:
@@ -496,7 +525,8 @@ class JsonMaskProvider:
             for tid in self._special:
                 out[tid] = False
         else:
-            # Schema machines keep the scalar prober, pre-filtered by
+            # Schema machines — and generic machines inside the wrap-up
+            # boundary — keep the scalar prober, pre-filtered by
             # admissible first byte: forced-key/enum states admit a
             # handful of first bytes, so 256 one-byte probes eliminate
             # most of the vocab before any full replay.

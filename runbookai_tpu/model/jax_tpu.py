@@ -220,6 +220,7 @@ class JaxTpuClient(BaseLLMClient):
                             in ("tpu", "axon")
                             else "xla")),
             dp_replicas=dp_replicas,
+            kv_spill_pages=getattr(llm_cfg, "kv_spill_pages", 0),
         )
         if serving_plan is not None:
             from runbookai_tpu.autotune.plan import engine_only_overrides
@@ -266,11 +267,20 @@ class JaxTpuClient(BaseLLMClient):
 
             router = getattr(llm_cfg, "fleet", None)
             if router is not None:
+                disagg = getattr(router, "disagg", None)
+                disagg_n = (disagg.prefill_replicas
+                            if disagg is not None and disagg.enabled else 0)
                 fleet_cfg = FleetConfig(
                     affinity=router.affinity,
                     affinity_load_slack=router.affinity_load_slack,
                     shed_queue_depth=router.shed_queue_depth,
-                    max_retries=router.max_retries)
+                    max_retries=router.max_retries,
+                    kv_share=getattr(router, "kv_share", False),
+                    kv_share_min_pages=getattr(router, "kv_share_min_pages",
+                                               1),
+                    disagg_prefill_replicas=disagg_n,
+                    disagg_min_prompt_pages=(disagg.min_prompt_pages
+                                             if disagg_n else 1))
             # Pod scale-out: each process builds only ITS replicas over
             # its local chips — replicas never span hosts (their device
             # slices must stay in one ICI domain). Single process owns

@@ -57,6 +57,23 @@ class MeshConfig(BaseModel):
         return self.data * self.model
 
 
+class DisaggConfig(BaseModel):
+    """Prefill/decode disaggregation (``llm.fleet.disagg``): dedicate the
+    first ``prefill_replicas`` fleet replicas to a prefill tier whose KV
+    pages hand off to the decode tier at first-token time. Requires
+    ``dp_replicas >= 2`` and must leave at least one decode replica —
+    validated at load. See docs/SERVING.md."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    enabled: bool = False
+    # Replicas 0..n-1 form the prefill tier; the rest decode.
+    prefill_replicas: int = Field(1, ge=1)
+    # Prompts with fewer full pages than this skip the prefill tier (the
+    # warm round-trip costs more than the tail prefill it saves).
+    min_prompt_pages: int = Field(1, ge=1)
+
+
 class FleetRouterConfig(BaseModel):
     """Engine-fleet router policy (engine/fleet.FleetConfig; only read
     when ``dp_replicas > 1``). See docs/SERVING.md."""
@@ -72,6 +89,14 @@ class FleetRouterConfig(BaseModel):
     # Cross-replica retries after a pool-pressure abort. None = each
     # other replica once.
     max_retries: Optional[int] = None
+    # Fleet-wide KV page sharing: on an affinity miss, pull the prompt's
+    # prefix pages from the replica that holds them (epoch-guarded,
+    # digest-checked) instead of re-prefilling. Disaggregation implies it.
+    kv_share: bool = False
+    # Minimum full-page deficit worth a pull.
+    kv_share_min_pages: int = Field(1, ge=1)
+    # Prefill/decode tier split (docs/SERVING.md "Disaggregated tiers").
+    disagg: DisaggConfig = Field(default_factory=DisaggConfig)
 
 
 class SLOConfig(BaseModel):
@@ -147,6 +172,13 @@ class LLMConfig(BaseModel):
     # Paged KV cache (engine):
     page_size: int = 16  # tokens per KV page
     num_pages: int = 2048  # page pool size (static for XLA)
+    # Host-RAM spill tier: retain up to this many evicted prefix-cache
+    # pages in host memory so re-sent prompts re-admit them instead of
+    # re-prefilling (engine/kv_cache.HostSpillTier). 0 = disabled. Host
+    # bytes ≈ pages × page_size × kv_bytes_per_token
+    # (memory_plan.ServingPlan.host_spill_bytes) — budget against host
+    # RAM, not HBM.
+    kv_spill_pages: int = Field(0, ge=0)
     max_batch_slots: int = 8  # concurrent sequences in the decode batch
     prefill_chunk: int = 512  # prefill processed in chunks of this many tokens
     decode_steps: int = 8  # decode tokens per device dispatch (host-sync amortization)
@@ -463,6 +495,17 @@ def validate_config(config: Config) -> list[str]:
         problems.append(
             "llm.dp_replicas > 1 requires llm.mesh.data/model = 1 "
             "(each fleet replica owns its own device slice)")
+    disagg = config.llm.fleet.disagg
+    if disagg.enabled:
+        if config.llm.dp_replicas < 2:
+            problems.append(
+                "llm.fleet.disagg needs llm.dp_replicas >= 2 (one prefill "
+                "replica and at least one decode replica)")
+        elif disagg.prefill_replicas >= config.llm.dp_replicas:
+            problems.append(
+                f"llm.fleet.disagg.prefill_replicas="
+                f"{disagg.prefill_replicas} leaves no decode tier in a "
+                f"dp_replicas={config.llm.dp_replicas} fleet")
     slack = config.incident.slack
     if (slack.enabled and slack.app_token
             and "mode" not in slack.model_fields_set):

@@ -255,6 +255,16 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get((), 0.0)
 
+    def total(self) -> float:
+        """Sum across every label set (equals ``value`` when unlabeled) —
+        the public 'how many in all' accessor, so callers never read the
+        private per-labelset storage."""
+        cb = self._callback_value()
+        if cb is not None:
+            return cb
+        with self._lock:
+            return float(sum(self._values.values()))
+
 
 class Gauge(_Metric):
     type = "gauge"

@@ -8,7 +8,8 @@ router placement → admit → prefill chunks → decode windows → finish/abor
 - the caller's ``x-request-id`` rides as ``ctx.request_id`` on server
   spans, ``meta.trace_id`` on the engine's lifecycle events
   (``engine.enqueue`` / ``engine.admit`` / ``engine.request``) and on the
-  fleet router's ``router.place`` / ``router.shed`` events;
+  fleet router's ``router.place`` / ``router.shed`` /
+  ``router.page_pull`` events;
 - the engine-internal request id (``r{i}-…`` when fleeted) appears as
   ``meta.request`` on lifecycle events and inside ``meta.requests`` on
   dispatch spans (``engine.prefill`` / ``engine.decode`` /
@@ -125,6 +126,16 @@ def build_timeline(spans: list[dict[str, Any]],
             ev["label"] = (f"router.place → replica {meta.get('replica')}"
                            + (" (affinity hit)" if hit else ""))
             ev["affinity"] = hit
+        elif name == "router.page_pull":
+            # Cross-replica KV pull / prefill→decode handoff: the span
+            # that proves the request rode staged pages instead of a
+            # re-prefill (replica = destination, src = the page source).
+            ev["label"] = (f"page pull ← replica {meta.get('src')} "
+                           f"({meta.get('pages')} pages, "
+                           f"{meta.get('pull_ms')} ms)")
+            ev["src"] = meta.get("src")
+            ev["pages"] = meta.get("pages")
+            ev["pull_ms"] = meta.get("pull_ms")
         elif name == "router.shed":
             ev["label"] = "router.shed (all replicas saturated)"
         elif name == "engine.request":

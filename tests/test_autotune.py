@@ -479,11 +479,14 @@ def test_validate_config_flags_plan_problems(tmp_path):
 def test_split_engine_budget_never_rounds_up():
     from runbookai_tpu.engine.fleet import split_engine_budget
 
-    total = EngineConfig(max_batch_slots=8, num_pages=1024, prefill_batch=8)
+    total = EngineConfig(max_batch_slots=8, num_pages=1024, prefill_batch=8,
+                         kv_spill_pages=512)
     per = split_engine_budget(total, 3)
     assert per.dp_replicas == 3
     assert per.max_batch_slots * 3 <= total.max_batch_slots
     assert per.num_pages * 3 <= total.num_pages
+    # The host spill tier is part of the fixed-total budget too.
+    assert per.kv_spill_pages * 3 <= total.kv_spill_pages
     assert per.prefill_batch <= per.max_batch_slots
     # Allocator minimums hold even under absurd splits.
     tiny = split_engine_budget(EngineConfig(max_batch_slots=1,
@@ -622,3 +625,87 @@ def test_trace_summary_reports_dispatch_counters(tmp_path, capsys):
     assert main(["metrics", "--trace", str(path), "--span", "mixed"]) == 0
     out = json.loads(capsys.readouterr().out)
     assert list(out) == ["engine.mixed"]
+
+
+# ------------------------------------- fleet-shape knobs (kv spill, disagg)
+
+
+def test_plan_v1_without_fleet_keys_still_validates():
+    """Migration contract: pre-PR-8 plans carry neither
+    engine.kv_spill_pages nor topology.disagg_prefill_replicas. The
+    schema stays v1-compatible — they validate unchanged and resolve to
+    a disabled spill tier / symmetric fleet."""
+    data = json.loads((REPO / "plans" / "llama3-test.cpu.json").read_text())
+    assert "kv_spill_pages" not in data["engine"]
+    assert "disagg_prefill_replicas" not in data.get("topology", {})
+    assert validate_plan(data) == []
+    ecfg = EngineConfig.from_plan(data["engine"])
+    assert ecfg.kv_spill_pages == 0
+
+
+def test_plan_fleet_keys_validated():
+    base = json.loads((REPO / "plans" / "llama3-test.cpu.json").read_text())
+    # Well-formed new keys: no schema complaint beyond the content hash
+    # (the fixture's hash no longer matches once keys are added).
+    data = json.loads(json.dumps(base))
+    data["engine"]["kv_spill_pages"] = 64
+    data.setdefault("topology", {})["disagg_prefill_replicas"] = 1
+    data["topology"]["dp_replicas"] = 2
+    data["engine"]["dp_replicas"] = 2
+    probs = validate_plan(data)
+    assert all("kv_spill_pages" not in p for p in probs), probs
+    assert all("disagg" not in p for p in probs), probs
+    # Malformed values are named precisely.
+    bad = json.loads(json.dumps(base))
+    bad["engine"]["kv_spill_pages"] = -1
+    assert any("kv_spill_pages" in p for p in validate_plan(bad))
+    bad2 = json.loads(json.dumps(base))
+    bad2["engine"]["dp_replicas"] = 2
+    bad2.setdefault("topology", {})["disagg_prefill_replicas"] = 2
+    assert any("no decode tier" in p for p in validate_plan(bad2))
+
+
+def test_candidate_fleet_knobs_feasibility_and_block():
+    """kv_spill_pages budgets against HOST RAM (never the HBM pool) and
+    disagg splits must leave a decode tier; both knobs ride in the plan
+    blocks so Candidate/plan schema stay in sync."""
+    model = CostModel(CFG, HARDWARE["cpu"])
+    wl = Workload(prompt_len=32, output_len=16, concurrency=4)
+    base = Candidate(page_size=4, num_pages=64, max_batch_slots=2,
+                     prefill_chunk=16, max_seq_len=256)
+    ok, why = model.check_feasible(base, wl)
+    assert ok, why
+    # A sane spill tier stays feasible; the block carries the knob.
+    spill = Candidate(**{**base.__dict__, "kv_spill_pages": 128})
+    ok, why = model.check_feasible(spill, wl)
+    assert ok, why
+    assert spill.engine_plan_block()["kv_spill_pages"] == 128
+    assert base.topology_extras() == {}
+    # An absurd tier (beyond half the host-RAM envelope) is refused.
+    huge = Candidate(**{**base.__dict__, "kv_spill_pages": 10**9})
+    ok, why = model.check_feasible(huge, wl)
+    assert not ok and "host RAM" in why
+    # Disagg must leave a decode tier.
+    bad = Candidate(**{**base.__dict__, "dp_replicas": 2,
+                       "disagg_prefill_replicas": 2})
+    ok, why = model.check_feasible(bad, wl)
+    assert not ok and "decode tier" in why
+    good = Candidate(**{**base.__dict__, "dp_replicas": 2,
+                        "disagg_prefill_replicas": 1})
+    ok, why = model.check_feasible(good, wl)
+    assert ok, why
+    assert good.topology_extras() == {"disagg_prefill_replicas": 1}
+    # Residency reports the spill tier in HOST bytes, leaving the HBM
+    # pool budget untouched.
+    plan_off = model.residency(base)
+    plan_on = model.residency(spill)
+    assert plan_off.host_spill_bytes == 0 and plan_on.host_spill_bytes > 0
+    assert plan_on.pool_budget_bytes == plan_off.pool_budget_bytes
+
+
+def test_search_space_fleet_axes_default_off():
+    """Existing sweeps (and their plan hashes) are unchanged until a
+    space opts into the new axes."""
+    for cand in smoke_space().candidates():
+        assert cand.kv_spill_pages == 0
+        assert cand.disagg_prefill_replicas == 0

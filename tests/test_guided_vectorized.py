@@ -148,7 +148,8 @@ class _FakeTok:
 class _Req:
     def __init__(self, guided="json"):
         self.guided_state = None
-        self.sampling = type("S", (), {"guided": guided})()
+        self.sampling = type("S", (), {"guided": guided,
+                                       "max_new_tokens": 64})()
 
 
 def test_provider_uses_vectorized_path_and_matches():

@@ -725,13 +725,24 @@ class TestTreeIsClean:
         draft = self._rbk002_sites(
             ROOT / "runbookai_tpu" / "engine" / "draft.py")
         assert draft == {"draft": 1}, draft
-        # The fleet router is HOST-ONLY code: routing reads the replicas'
-        # prefix-cache indexes and pool counters, never device state. A
-        # noqa[RBK002] appearing here would mean the router started
-        # syncing the device on the placement path — a per-request stall
-        # the fleet exists to avoid. RBK004 lock discipline covers the
-        # module through the standard engine/ tag (fleet.py's shared
-        # router state mutates only under AsyncFleet._lock).
+        # The page-transfer path (fleet-wide KV sharing / disagg handoff /
+        # spill capture) funnels every device→host copy through ONE
+        # sanctioned fetch helper: export_pages and spill_evictable both
+        # call _fetch_rows, so a second annotation in this module means a
+        # transfer path stopped batching its copy.
+        kv_cache = self._rbk002_sites(
+            ROOT / "runbookai_tpu" / "engine" / "kv_cache.py")
+        assert kv_cache == {"_fetch_rows": 1}, kv_cache
+        # The fleet router itself stays HOST-ONLY code: routing reads the
+        # replicas' prefix-cache indexes and pool counters, never device
+        # state, and a planned page pull executes through the engines'
+        # export/import APIs (whose sync is the kv_cache._fetch_rows site
+        # above, under the source engine's lock in a worker thread) — a
+        # noqa[RBK002] appearing in fleet.py would mean the router started
+        # syncing the device inline on the placement path. RBK004 lock
+        # discipline covers the module through the standard engine/ tag
+        # (fleet.py's shared router state mutates only under
+        # AsyncFleet._lock).
         fleet = self._rbk002_sites(
             ROOT / "runbookai_tpu" / "engine" / "fleet.py")
         assert fleet == {}, fleet

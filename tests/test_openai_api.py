@@ -135,11 +135,15 @@ def test_bad_sampling_params_are_400(server):
 
 async def test_generate_timeout_aborts_request():
     # Engine-level timeout must abort (free slot/pages), not just raise.
+    # timeout_s must sit BELOW any possible completion time: with the
+    # process's XLA cache warm (earlier tests compile the same program
+    # shapes), 256 greedy tokens can finish inside 50ms on CPU and the
+    # timeout never fires — 1ms cannot be beaten by a real generation.
     client = JaxTpuClient.for_testing(max_new_tokens=256)
     with pytest.raises(TimeoutError):
         await client.engine.generate(
             client.tokenizer.encode("a long prompt to decode"),
-            client._sampling(), timeout_s=0.05)
+            client._sampling(), timeout_s=0.001)
     core = client.core
     import asyncio as _a
     for _ in range(300):
