@@ -47,6 +47,12 @@ details carry the tier split and per-tier traffic), BENCH_STAGGER_MS
 (inter-arrival spacing of the measured fleet window — the kv-share A/B
 runs a staggered prompt burst so siblings have pages to pull; 0 keeps
 the historical all-at-once gather),
+BENCH_CLASSES (`--classes`: the two-class flood arm — a batch flood plus
+interactive requests through one engine, per-class TTFT/TPOT against a
+flood-free interactive baseline; BENCH_SCHED=0 collapses the classes
+into the FIFO arm, BENCH_BATCH_REQS / BENCH_INT_REQS size the flood and
+the interactive set; digests are per class and byte-identical across
+arms — BENCHLOG r9),
 BENCH_PLAN (`--plan PATH`: pin the engine config to a serving-plan
 artifact from `runbook tune` — plan values become the defaults, explicit
 BENCH_* env still wins, and the plan id/hash lands in `details` so every
@@ -617,6 +623,31 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
             [list(map(int, ids)) for ids in token_lists]).encode()
         ).hexdigest()
 
+    if os.environ.get("BENCH_CLASSES"):
+        if os.environ.get("BENCH_DP") or plan is not None:
+            # Refusing beats silently measuring something else: a
+            # `--classes --dp 4` run would otherwise bank a single-core
+            # figure labeled as if it covered the requested fleet.
+            raise ValueError(
+                "BENCH_CLASSES measures the single-engine scheduler arm "
+                "and does not compose with --dp/--plan (run them as "
+                "separate arms)")
+        # Two-class flood arm (`--classes` / BENCH_CLASSES=1): a batch
+        # flood plus staggered interactive requests through ONE engine,
+        # measuring per-class TTFT/TPOT against a flood-free interactive
+        # baseline. BENCH_SCHED=0 is the FIFO arm (every request in one
+        # class); the default arm runs the weighted-deficit scheduler
+        # with real priority classes. Digests are per class and must be
+        # byte-identical across the two arms (scheduling reorders admits,
+        # never alters a stream).
+        run_classes_bench(cfg, params, tok, ecfg, masker, probe,
+                          n_requests=n_requests, prompt_len=prompt_len,
+                          new_tokens=new_tokens, make_prompt=make_prompt,
+                          outputs_digest=outputs_digest,
+                          on_accel=on_accel, quantized=quantized,
+                          weights_path=weights_path)
+        return
+
     dp_env = os.environ.get("BENCH_DP")
     dp = int(dp_env) if dp_env else pick("dp_replicas", 1)
     dp = max(1, dp)
@@ -801,6 +832,144 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
             details["bge_encode"] = {"error": str(e)[-300:]}
     if not probe.get("ok", True):
         details["tpu_error"] = probe.get("error")
+    emit(round(decode_tps, 2), "tok/s", details)
+
+
+def run_classes_bench(cfg, params, tok, ecfg, masker, probe, *,
+                      n_requests, prompt_len, new_tokens, make_prompt,
+                      outputs_digest, on_accel, quantized,
+                      weights_path) -> None:
+    """The two-class flood arm (BENCHLOG r9 protocol): prove interactive
+    tail latency holds under a concurrent batch flood.
+
+    Three measured windows on one engine:
+
+    1. **flood-free**: the interactive set alone (its unloaded p95 TTFT
+       is the yardstick);
+    2. **flood**: BENCH_BATCH_REQS batch requests all in the waiting
+       queue, THEN the interactive set arrives behind them. Under the
+       FIFO arm (BENCH_SCHED=0: one class) interactive queues behind the
+       whole flood; under the scheduler arm the weighted-deficit queue
+       interleaves admits 8:1, so interactive p95 TTFT should stay within
+       ~1.5x its flood-free value while FIFO degrades with flood size.
+
+    Per-class TTFT/TPOT, admit/throttle/shed counters, per-class output
+    digests (byte-identical across arms — scheduling must reorder admits,
+    never change tokens) and the flight recorder's per-class slot
+    occupancy land in ``details``.
+    """
+    import jax.numpy as jnp
+
+    from runbookai_tpu.engine.engine import EngineCore
+    from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+    from runbookai_tpu.sched import PRIORITY_BATCH, PRIORITY_INTERACTIVE
+    from runbookai_tpu.utils.metrics import get_registry
+    from runbookai_tpu.utils.weights import quality_marker
+
+    sched_on = os.environ.get("BENCH_SCHED", "1") != "0"
+    n_batch = int(os.environ.get("BENCH_BATCH_REQS", n_requests))
+    n_int = int(os.environ.get("BENCH_INT_REQS", 4))
+
+    core = EngineCore(cfg, params, tok, ecfg,
+                      mask_fn=masker.mask, advance_fn=masker.advance)
+
+    def make_req(priority: int, max_new=new_tokens):
+        return EngineRequest(
+            prompt_ids=make_prompt(),
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=max_new,
+                                    stop_token_ids=()),
+            priority=priority)
+
+    def class_stats(reqs):
+        ttfts = sorted(r.ttft_ms for r in reqs if r.ttft_ms is not None)
+        tpots = sorted(
+            ((r.finish_time - r.first_token_time) * 1e3
+             / (r.num_generated - 1))
+            for r in reqs
+            if r.finish_time and r.first_token_time
+            and r.num_generated > 1)
+
+        def pct(values, q):
+            if not values:
+                return None
+            idx = min(len(values) - 1, int(round(q / 100 * (len(values) - 1))))
+            return round(values[idx], 2)
+
+        return {
+            "requests": len(reqs),
+            "p50_ttft_ms": pct(ttfts, 50),
+            "p95_ttft_ms": pct(ttfts, 95),
+            "p50_tpot_ms": pct(tpots, 50),
+            "p95_tpot_ms": pct(tpots, 95),
+            "outputs_digest": outputs_digest(
+                [r.all_out_ids for r in reqs]),
+        }
+
+    # Warmup compiles the program shapes; excluded from every window.
+    for _ in range(min(ecfg.max_batch_slots, n_int + n_batch)):
+        core.submit(make_req(PRIORITY_INTERACTIVE))
+    core.run_until_idle()
+    reset_warmup_metrics(core)
+
+    # Window 1: flood-free interactive baseline. The prompt stream is
+    # drawn fresh per window (make_prompt advances one rng), so byte
+    # parity across arms compares the SAME window index in each arm.
+    base_reqs = [make_req(PRIORITY_INTERACTIVE) for _ in range(n_int)]
+    for r in base_reqs:
+        core.submit(r)
+    core.run_until_idle()
+    base = class_stats(base_reqs)
+    reset_warmup_metrics(core)
+
+    # Window 2: batch flood first, interactive arrives behind it. The
+    # FIFO arm collapses the classes (everything batch-priority — one
+    # class is FIFO-by-arrival under either policy).
+    int_priority = PRIORITY_INTERACTIVE if sched_on else PRIORITY_BATCH
+    batch_reqs = [make_req(PRIORITY_BATCH) for _ in range(n_batch)]
+    int_reqs = [make_req(int_priority) for _ in range(n_int)]
+    t0 = time.perf_counter()
+    for r in batch_reqs + int_reqs:
+        core.submit(r)
+    core.run_until_idle()
+    wall = time.perf_counter() - t0
+
+    m = core.metrics
+    reg = get_registry()
+    interactive = class_stats(int_reqs)
+    batch = class_stats(batch_reqs)
+    base_p95 = base.get("p95_ttft_ms")
+    flood_p95 = interactive.get("p95_ttft_ms")
+    details = {
+        "arm": "sched" if sched_on else "fifo",
+        "sched_policy": ecfg.sched_policy if sched_on else "fifo",
+        "model": cfg.name,
+        "weights": "int8" if quantized else "float32",
+        "quality": quality_marker(weights_path),
+        "platform": probe.get("platform"),
+        "device_kind": probe.get("kind"),
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "batch_slots": ecfg.max_batch_slots,
+        "wall_s": round(wall, 2),
+        "classes": {"interactive": interactive, "batch": batch},
+        "flood_free_interactive": base,
+        # THE acceptance ratio: interactive p95 TTFT under flood over its
+        # flood-free value (scheduler arm target: <= 1.5; the FIFO arm
+        # grows with flood size).
+        "interactive_ttft_ratio": (
+            round(flood_p95 / base_p95, 3)
+            if base_p95 and flood_p95 else None),
+        "throttled_total": (reg.get("runbook_admission_throttled_total")
+                            .value
+                            if reg.get("runbook_admission_throttled_total")
+                            else 0.0),
+        "shed_total": (reg.get("runbook_router_shed_total").total()
+                       if reg.get("runbook_router_shed_total") else 0.0),
+        "preemptions": m["preemptions"],
+        "flight_summary": core.flight.summary(),
+        "kv_dtype": str(jnp.dtype(ecfg.kv_dtype).name),
+    }
+    decode_tps = m["decode_tokens"] / max(m["decode_time_s"], 1e-9)
     emit(round(decode_tps, 2), "tok/s", details)
 
 
@@ -1132,6 +1301,11 @@ def main() -> None:
     if "--no-mixed" in sys.argv:
         sys.argv.remove("--no-mixed")
         os.environ["BENCH_MIXED"] = "0"
+    if "--classes" in sys.argv:
+        # Two-class flood A/B (BENCHLOG r9): batch flood + staggered
+        # interactive through one engine; BENCH_SCHED=0 is the FIFO arm.
+        sys.argv.remove("--classes")
+        os.environ["BENCH_CLASSES"] = "1"
     if "--profile" in sys.argv:
         # On-demand XProf capture around the measured window
         # (BENCH_PROFILE=DIR|1): TensorBoard-readable trace dir, or a
@@ -1204,6 +1378,7 @@ def main() -> None:
     # or --plan run must not perturb it (env restored right after).
     dp_env = os.environ.pop("BENCH_DP", None)
     plan_env = os.environ.pop("BENCH_PLAN", None)
+    classes_env = os.environ.pop("BENCH_CLASSES", None)
     try:
         cpu_sanity = _spawn_inner(
             os.environ.get("BENCH_CPU_MODEL", "llama3-test"), False,
@@ -1213,6 +1388,8 @@ def main() -> None:
             os.environ["BENCH_DP"] = dp_env
         if plan_env is not None:
             os.environ["BENCH_PLAN"] = plan_env
+        if classes_env is not None:
+            os.environ["BENCH_CLASSES"] = classes_env
     sanity_line = None
     if cpu_sanity is not None:
         d = cpu_sanity.get("details", {})
@@ -1241,6 +1418,7 @@ def main() -> None:
     if not on_accel and cpu_sanity is not None and \
             os.environ.get("BENCH_DP", "1") in ("", "1") and \
             "BENCH_PLAN" not in os.environ and \
+            "BENCH_CLASSES" not in os.environ and \
             os.environ.get("BENCH_CPU_MODEL", "llama3-test") == model_name:
         # The fallback headline IS the cpu-sanity config — don't run it
         # twice. (A --dp run's headline is the fleet arm, and a --plan

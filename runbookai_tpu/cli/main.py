@@ -757,6 +757,68 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def _render_tenants(snap: dict) -> str:
+    """Table view of a /tenants snapshot (or of configured policies)."""
+    if not snap.get("enabled"):
+        return "tenant admission control is disabled (llm.tenants)"
+    cols = ("tenant", "class", "rpm", "tok/min", "admitted", "throttled",
+            "budget left")
+    rows = []
+    for name, row in sorted(snap.get("tenants", {}).items()):
+        throttled = (row.get("throttled_rate", 0)
+                     + row.get("throttled_tokens", 0))
+        rows.append((
+            name, str(row.get("priority", "-")),
+            str(row.get("rate_limit_rpm") or "-"),
+            str(row.get("token_budget_per_min") or "-"),
+            str(row.get("admitted", 0)), str(throttled),
+            str(row.get("budget_remaining_tokens", "-"))))
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    out = ["  ".join(c.ljust(w) for c, w in zip(cols, widths))]
+    for r in rows:
+        out.append("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def cmd_tenants(args) -> int:
+    """``runbook tenants`` — live tenant-accounting state. Prefers a
+    running server's ``GET /tenants`` (live bucket levels + counters);
+    with no server reachable, falls back to rendering the CONFIGURED
+    ``llm.tenants`` policies so the command is useful pre-deploy too."""
+    import urllib.error
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/tenants"
+    snap = None
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as r:
+            snap = json.loads(r.read())
+        source = url
+    except (urllib.error.URLError, OSError, TimeoutError, ValueError):
+        config = _load(args)
+        tcfg = config.llm.tenants
+        source = "config (no server at %s)" % args.url
+        snap = {"enabled": tcfg.enabled, "tenants": {}}
+        if tcfg.enabled:
+            blocks = dict(tcfg.keys)
+            blocks["default"] = tcfg.default
+            for name, block in blocks.items():
+                snap["tenants"][name] = {
+                    "priority": block.priority,
+                    "rate_limit_rpm": block.rate_limit_rpm,
+                    "token_budget_per_min": block.token_budget_per_min,
+                    "admitted": 0, "throttled_rate": 0,
+                    "throttled_tokens": 0,
+                }
+    if args.json:
+        print(json.dumps(snap, indent=2))
+    else:
+        print(f"# {source}")
+        print(_render_tenants(snap))
+    return 0
+
+
 def cmd_timeline(args) -> int:
     """``runbook timeline <request-id> --trace <file>`` — stitch one
     request's trace JSONL records (enqueue → router placement → admit →
@@ -1355,6 +1417,17 @@ def build_parser() -> argparse.ArgumentParser:
     prof.add_argument("--prompt-len", type=int, default=128)
     prof.add_argument("--new-tokens", type=int, default=32)
     prof.set_defaults(fn=cmd_profile)
+
+    tn = sub.add_parser(
+        "tenants", help="tenant accounting state: live /tenants from a "
+                        "running server, else the configured llm.tenants "
+                        "policies")
+    tn.add_argument("--url", default="http://127.0.0.1:8000",
+                    help="server base URL (GET <url>/tenants)")
+    tn.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the table")
+    tn.add_argument("--timeout", type=float, default=10.0)
+    tn.set_defaults(fn=cmd_tenants)
 
     met = sub.add_parser(
         "metrics", help="scrape a server's /metrics or summarize a trace")

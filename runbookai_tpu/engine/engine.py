@@ -53,6 +53,7 @@ from runbookai_tpu.models.llama import (
     forward_ragged_impl,
 )
 from runbookai_tpu.ops.sampling import sample_tokens
+from runbookai_tpu.sched import class_label, class_name
 from runbookai_tpu.utils import metrics as metrics_mod
 from runbookai_tpu.utils.trace import annotate, get_tracer
 
@@ -154,6 +155,15 @@ class EngineConfig:
     # decode loop. 0 disables the tier. Budgeted by
     # memory_plan.ServingPlan.host_spill_bytes against host RAM, not HBM.
     kv_spill_pages: int = 0
+    # Waiting-queue policy (runbookai_tpu/sched/): "wdrr" interleaves
+    # priority classes by weighted-deficit stride — a batch flood cannot
+    # starve interactive admits AND interactive load cannot starve batch
+    # (FCFS within a class; single-class traffic is plain FIFO either
+    # way). "priority" keeps the classic strict priority-then-FCFS sort.
+    sched_policy: str = "wdrr"
+    # Priority class -> admission-share weight (wdrr only). None = the
+    # package default {batch: 1, interactive: 8}.
+    sched_weights: Optional[dict] = None
 
     @classmethod
     def from_plan(cls, engine_block: dict, *, default_kv_dtype: Any = None,
@@ -928,6 +938,20 @@ class EngineCore:
         self.prefilling: list[EngineRequest] = []
         self.decoding: list[EngineRequest] = []
         self.finished: list[EngineRequest] = []
+        # Admission-order policy (sched/wdrr.py): stride interleave of
+        # priority classes, or None for the classic strict-priority sort.
+        self._sched = None
+        if self.ecfg.sched_policy == "wdrr":
+            from runbookai_tpu.sched.wdrr import WeightedDeficitScheduler
+
+            self._sched = WeightedDeficitScheduler(self.ecfg.sched_weights)
+        elif self.ecfg.sched_policy != "priority":
+            raise ValueError(
+                f"sched_policy {self.ecfg.sched_policy!r} not one of "
+                f"wdrr/priority")
+        # SLO feedback controller (sched/feedback.py), attached by the
+        # client when llm.sched.feedback is on; None = no behavior change.
+        self.feedback = None
         self._slots: list[Optional[EngineRequest]] = [None] * self.ecfg.max_batch_slots
         self._last_token: dict[str, int] = {}
         # Overlapped decode pipeline state: the device-resident feed of each
@@ -1023,6 +1047,18 @@ class EngineCore:
             "runbook_queue_wait_seconds",
             "Submission-to-admission wait (first admission only)",
             buckets=m.QUEUE_WAIT_BUCKETS)
+        # Per-class scheduling surface (sched/): queue-wait and admit
+        # counts by priority class — the starvation signal the WDRR
+        # policy is judged on (docs/observability.md PromQL).
+        self.hist_class_queue_wait = reg.histogram(
+            "runbook_sched_queue_wait_seconds",
+            "Submission-to-admission wait per priority class (first "
+            "admission only)", labels=("cls",),
+            buckets=m.QUEUE_WAIT_BUCKETS)
+        self._m_class_admits = reg.counter(
+            "runbook_sched_admits_total",
+            "Requests admitted to prefill, per priority class",
+            labels=("cls",))
         self.hist_mixed_tokens = reg.histogram(
             "runbook_mixed_tokens_per_dispatch",
             "Real (unpadded) tokens per unified mixed prefill+decode "
@@ -1036,6 +1072,16 @@ class EngineCore:
                   "Requests queued or prefilling"
                   ).set_function(lambda: len(self.waiting)
                                  + len(self.prefilling))
+        g_cls_wait = reg.gauge(
+            "runbook_sched_waiting_requests",
+            "Requests queued or prefilling, per priority class",
+            labels=("cls",))
+        g_cls_wait.clear_functions()
+        for label in ("interactive", "batch", "other"):
+            g_cls_wait.labels(cls=label).set_function(
+                lambda lb=label: float(sum(
+                    1 for r in list(self.waiting) + list(self.prefilling)
+                    if class_label(r.priority) == lb)))
         reg.gauge("runbook_kv_pages_total", "KV pool size in pages"
                   ).set_function(lambda: self.kv.allocator.num_pages)
         reg.gauge("runbook_kv_pages_in_use",
@@ -1315,11 +1361,19 @@ class EngineCore:
     def _admit(self) -> None:
         free_slots = sum(s is None for s in self._slots)
         in_flight = len(self.prefilling)
-        # Priority classes first, FCFS within a class. Stable sort on each
-        # admission pass keeps re-queued (preempted) requests ahead of
-        # same-priority newcomers via their original arrival_time.
+        # Admission order (FCFS within a class either way; ordering by
+        # arrival_time keeps re-queued preempted requests ahead of
+        # same-priority newcomers): the weighted-deficit scheduler
+        # interleaves classes in weight proportion — a batch flood can no
+        # longer starve interactive admits, and steady interactive load
+        # can no longer starve batch (sched/wdrr.py) — while the classic
+        # "priority" policy keeps the strict priority-then-FCFS sort.
         if len(self.waiting) > 1:
-            self.waiting.sort(key=lambda r: (-r.priority, r.arrival_time))
+            if self._sched is not None:
+                self.waiting = self._sched.order(self.waiting)
+            else:
+                self.waiting.sort(key=lambda r: (-r.priority,
+                                                 r.arrival_time))
         while self.waiting and (free_slots - in_flight) > 0:
             req = self.waiting[0]
             # Headroom never exceeds what the request could actually generate;
@@ -1373,6 +1427,10 @@ class EngineCore:
                     continue
                 break
             self.waiting.pop(0)
+            if self._sched is not None:
+                # Advance the class's stride pass for the ACTUAL admission
+                # (ordering alone never charges a class).
+                self._sched.commit(req.priority)
             # Reuse resident pages for the shared prompt prefix (same system
             # prompt across agent iterations): prefill resumes at the first
             # novel token.
@@ -1382,18 +1440,22 @@ class EngineCore:
                                           hash_seed=req.adapter_idx)
             req.state = RequestState.PREFILL
             req.prefill_pos = cached
+            cls = class_label(req.priority)
             if not req.folded_out_ids:
                 # First admission only: a preempted request re-matching
                 # its OWN published pages is recompute avoidance, not a
                 # prompt-cache hit the client should be billed less for.
                 req.cached_tokens = cached
-                self.hist_queue_wait.observe(
-                    time.perf_counter() - req.arrival_time)
+                wait_s = time.perf_counter() - req.arrival_time
+                self.hist_queue_wait.observe(wait_s)
+                self.hist_class_queue_wait.labels(cls=cls).observe(wait_s)
+            self._m_class_admits.labels(cls=cls).inc()
             self.metrics["cached_prefix_tokens"] += cached
             self.prefilling.append(req)
             in_flight += 1
             if self.tracer.enabled:
                 meta = {"request": req.request_id, "cached_tokens": cached,
+                        "cls": class_name(req.priority),
                         "queue_ms": round((time.perf_counter()
                                            - req.arrival_time) * 1e3, 3)}
                 if self.replica_idx is not None:
@@ -2573,6 +2635,11 @@ class EngineCore:
             if self.prefilling:
                 self._run_prefill()
             self._run_decode()
+        if self.feedback is not None:
+            # SLO feedback (sched/feedback.py): every interval window the
+            # controller moves the mixed-dispatch prefill share one level
+            # against the live TPOT burn. None (the default) = untouched.
+            self.feedback.on_step(self)
         if recording:
             self._record_step(t0, pre)
         return self.finished[before:]
@@ -2600,9 +2667,17 @@ class EngineCore:
         else:
             kind = "idle"
         batch = len(self.decoding)
+        # Per-class batch occupancy: who holds the decode slots this step
+        # (the starvation picture /debug/steps is read for — a batch
+        # flood squeezing interactive out shows up here first).
+        classes: dict[str, int] = {}
+        for r in self.decoding:
+            label = class_name(r.priority)
+            classes[label] = classes.get(label, 0) + 1
         rec = {
             "ts": round(time.time(), 6),
             "kind": kind,
+            "classes": classes,
             "tokens": (m["prefill_tokens"] - pre[3]
                        + m["decode_tokens"] - pre[4]),
             "batch": batch,

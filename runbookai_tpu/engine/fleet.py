@@ -81,6 +81,7 @@ from runbookai_tpu.engine.request import (
     FleetSaturated,
     SamplingParams,
 )
+from runbookai_tpu.sched import class_label
 from runbookai_tpu.utils import metrics as metrics_mod
 from runbookai_tpu.utils.trace import get_tracer
 
@@ -352,7 +353,12 @@ class AsyncFleet:
                 prompt_ids, self._page_size,
                 max_blocks=(len(prompt_ids) - 1) // self._page_size,
                 seed=hash_seed)
-        candidates: list[tuple[int, int, int]] = []  # (idx, matched, load)
+        # (idx, matched, load, queue_depth): load is the full live count
+        # (waiting + prefilling + decoding); queue_depth is the not-yet-
+        # decoding backlog — the tiebreak between equally-loaded replicas
+        # (two replicas both at load 8 are NOT equal when one has 8
+        # decoding and the other 8 queued behind a long prefill).
+        candidates: list[tuple[int, int, int, int]] = []
         sources: list[tuple[int, int]] = []  # (idx, matched)
         for i, core in enumerate(self.cores):
             if i in exclude:
@@ -361,15 +367,22 @@ class AsyncFleet:
                                             hash_seed=hash_seed)
                        if hashes else 0)
             if i in self._decode_tier:
-                candidates.append((i, matched, self._live_load(core)))
+                depth = len(core.waiting) + len(core.prefilling)
+                candidates.append((i, matched, self._live_load(core),
+                                   depth))
+                # The depth the router actually saw for this decision —
+                # a stored gauge, so a dashboard can join placement
+                # choices against the backlog they were made under.
+                self._m_depth.labels(
+                    replica=str(self.replica_ids[i])).set(depth)
             if self._kv_share and matched:
                 sources.append((i, matched))
         if not candidates:
             return _Placement(idx=None)
-        min_load = min(load for _, _, load in candidates)
+        min_load = min(load for _, _, load, _ in candidates)
         if (self.cfg.shed_queue_depth is not None
                 and all(len(self.cores[i].waiting) >= self.cfg.shed_queue_depth
-                        for i, _, _ in candidates)):
+                        for i, _, _, _ in candidates)):
             self._m_shed.inc()
             shed_meta = {"dp": self.dp}
             if trace_id is not None:
@@ -384,15 +397,21 @@ class AsyncFleet:
                   if self.cfg.affinity else [])
         with self._lock:
             if affine:
-                pick, _matched, _load = max(
+                pick, _matched, _load, _depth = max(
                     affine, key=lambda c: (c[1], -c[2]))
                 self._affinity_hits += 1
                 self._m_affinity.inc()
             else:
-                tied = [c[0] for c in candidates if c[2] == min_load]
-                # Round-robin among equally loaded replicas so a cold
-                # fleet spreads a burst instead of flooding replica 0.
-                pick = min(tied, key=lambda i: (i - self._rr) % self.dp)
+                # Queue-depth-aware least-loaded: load ties break on the
+                # waiting+prefilling backlog first (the replica whose
+                # live count is decode-heavy starts this request sooner
+                # than one with the same count queued), then round-robin
+                # so a cold fleet spreads a burst instead of flooding
+                # replica 0.
+                tied = [c for c in candidates if c[2] == min_load]
+                min_depth = min(c[3] for c in tied)
+                tied_ids = [c[0] for c in tied if c[3] == min_depth]
+                pick = min(tied_ids, key=lambda i: (i - self._rr) % self.dp)
                 self._rr = (pick + 1) % self.dp
             self._routed[pick] += 1
             case = CURRENT_CASE.get()
@@ -420,7 +439,8 @@ class AsyncFleet:
             # pages → pull the deficit before submit. The export
             # re-validates the chain under the source's engine lock, so
             # a plan outdated by eviction degrades to recompute there.
-            dst_matched = next((m for i, m, _ in candidates if i == pick), 0)
+            dst_matched = next((m for i, m, _, _ in candidates
+                                if i == pick), 0)
             src, src_matched = max(
                 ((i, m) for i, m in sources if i != pick),
                 key=lambda s: s[1], default=(None, 0))
@@ -692,6 +712,13 @@ class AsyncFleet:
         self._m_warm = reg.counter(
             "runbook_router_prefill_tier_warms_total",
             "Disaggregated prefill-tier warm prefills", labels=("replica",))
+        # Stored-value gauge (not a callback): the waiting+prefilling
+        # depth each candidate replica showed at the LAST routing
+        # decision — joins placements against the backlog they saw.
+        self._m_depth = reg.gauge(
+            "runbook_router_observed_queue_depth",
+            "Waiting+prefilling depth per replica as observed by the "
+            "router at its most recent placement", labels=("replica",))
         reg.gauge(
             "runbook_router_imbalance_ratio",
             "Max over mean of per-replica routed request counts "
@@ -730,6 +757,17 @@ class AsyncFleet:
                   "Requests queued or prefilling").set_function(
             lambda: sum(len(c.waiting) + len(c.prefilling)
                         for c in self.cores))
+        g_cls_wait = reg.gauge(
+            "runbook_sched_waiting_requests",
+            "Requests queued or prefilling, per priority class",
+            labels=("cls",))
+        g_cls_wait.clear_functions()
+        for label in ("interactive", "batch", "other"):
+            g_cls_wait.labels(cls=label).set_function(
+                lambda lb=label: float(sum(
+                    1 for c in self.cores
+                    for r in list(c.waiting) + list(c.prefilling)
+                    if class_label(r.priority) == lb)))
         reg.gauge("runbook_kv_pages_total", "KV pool size in pages"
                   ).set_function(
             lambda: sum(c.kv.allocator.num_pages for c in self.cores))

@@ -9,8 +9,9 @@ One :class:`StepRecord`-shaped dict is appended per
 :meth:`EngineCore.step`: step index, dispatch kind (the PR 4 counters:
 prefill / decode / mixed — plus ``prefill+decode`` for a split step that
 ran both, and ``idle`` for a drain-only step), real tokens this dispatch,
-batch occupancy, queue depth, KV-pool free pages, the dispatch/host/
-overlap wall split, preemptions, and the replica index when fleeted.
+batch occupancy (total AND per priority class — the scheduler-fairness
+picture), queue depth, KV-pool free pages, the dispatch/host/overlap wall
+split, preemptions, and the replica index when fleeted.
 
 Design constraints (pinned by ``tests/test_observability.py``):
 
@@ -38,9 +39,10 @@ from runbookai_tpu.utils.trace import _percentile
 # The per-step record keys, in emission order (documentation + the
 # /debug/steps shape test import this so the wire contract is pinned).
 STEP_RECORD_FIELDS = (
-    "step", "ts", "kind", "tokens", "batch", "occupancy", "queue_depth",
-    "kv_free_pages", "kv_utilization", "dispatch_s", "host_s", "overlap_s",
-    "wall_s", "preemptions", "kv_imported", "kv_exported", "replica",
+    "step", "ts", "kind", "classes", "tokens", "batch", "occupancy",
+    "queue_depth", "kv_free_pages", "kv_utilization", "dispatch_s",
+    "host_s", "overlap_s", "wall_s", "preemptions", "kv_imported",
+    "kv_exported", "replica",
 )
 
 
@@ -110,6 +112,7 @@ class FlightRecorder:
         occupancy percentiles report the worst replica (the one whose
         batch ran fullest — the capacity-planning signal)."""
         kinds: dict[str, int] = {}
+        classes: dict[str, int] = {}
         merged: dict[str, Any] = {
             "steps_recorded": 0, "steps_total": 0, "capacity": 0,
             "tokens": 0, "occupancy_p50": 0.0, "occupancy_p95": 0.0,
@@ -118,6 +121,8 @@ class FlightRecorder:
         for s in summaries:
             for kind, count in s.get("dispatch_kinds", {}).items():
                 kinds[kind] = kinds.get(kind, 0) + count
+            for cls, count in s.get("class_slot_steps", {}).items():
+                classes[cls] = classes.get(cls, 0) + count
             for key in ("steps_recorded", "steps_total", "capacity",
                         "tokens"):
                 merged[key] += s.get(key, 0)
@@ -125,6 +130,7 @@ class FlightRecorder:
                         "kv_utilization_peak", "queue_depth_peak"):
                 merged[key] = max(merged[key], s.get(key, 0))
         merged["dispatch_kinds"] = dict(sorted(kinds.items()))
+        merged["class_slot_steps"] = dict(sorted(classes.items()))
         return merged
 
     def summary(self) -> dict[str, Any]:
@@ -133,6 +139,7 @@ class FlightRecorder:
         p50/p95, and the KV-pressure peak over the retained window."""
         records = self.snapshot()
         kinds: dict[str, int] = {}
+        classes: dict[str, int] = {}
         occ: list[float] = []
         kv_peak = 0.0
         queue_peak = 0
@@ -140,6 +147,11 @@ class FlightRecorder:
         for rec in records:
             kinds[str(rec.get("kind", "?"))] = (
                 kinds.get(str(rec.get("kind", "?")), 0) + 1)
+            for cls, n in (rec.get("classes") or {}).items():
+                # Slot-steps per priority class: who actually occupied
+                # the decode batch over the window (the scheduler's
+                # fairness evidence in bench flight summaries).
+                classes[str(cls)] = classes.get(str(cls), 0) + int(n)
             occ.append(float(rec.get("occupancy", 0.0)))
             kv_peak = max(kv_peak, float(rec.get("kv_utilization", 0.0)))
             queue_peak = max(queue_peak, int(rec.get("queue_depth", 0)))
@@ -150,6 +162,7 @@ class FlightRecorder:
             "steps_total": self.total_steps,
             "capacity": self.capacity,
             "dispatch_kinds": dict(sorted(kinds.items())),
+            "class_slot_steps": dict(sorted(classes.items())),
             "tokens": tokens,
             "occupancy_p50": round(_percentile(occ, 50), 4),
             "occupancy_p95": round(_percentile(occ, 95), 4),

@@ -82,6 +82,7 @@ class JaxTpuClient(BaseLLMClient):
         chat_format: str = "llama3",
         fleet_cfg=None,
         slo_monitor=None,
+        tenants=None,
     ):
         # ``core`` may be a data-parallel fleet (list of replicas, built by
         # engine/fleet.build_engine_fleet when EngineConfig.dp_replicas > 1):
@@ -111,6 +112,11 @@ class JaxTpuClient(BaseLLMClient):
         # /healthz reads it for the live burn-ratio block; None when no
         # objective is configured (zero SLO surface).
         self.slo_monitor = slo_monitor
+        # Tenant admission governor (sched/tenants.py, built by
+        # from_config from llm.tenants): the OpenAI server gates every
+        # chat/completions request through it BEFORE enqueue. None = no
+        # tenant surface.
+        self.tenants = tenants
 
     # ------------------------------------------------------------- factories
 
@@ -222,6 +228,24 @@ class JaxTpuClient(BaseLLMClient):
             dp_replicas=dp_replicas,
             kv_spill_pages=getattr(llm_cfg, "kv_spill_pages", 0),
         )
+        sched_cfg = getattr(llm_cfg, "sched", None)
+        if sched_cfg is not None:
+            # Priority-class scheduling policy (llm.sched → sched/wdrr.py):
+            # the weighted-deficit interleave by default, with the two
+            # canonical class weights from config.
+            import dataclasses as _dc
+
+            from runbookai_tpu.sched import (
+                PRIORITY_BATCH,
+                PRIORITY_INTERACTIVE,
+            )
+
+            ecfg = _dc.replace(
+                ecfg, sched_policy=sched_cfg.policy,
+                sched_weights={
+                    PRIORITY_BATCH: sched_cfg.batch_weight,
+                    PRIORITY_INTERACTIVE: sched_cfg.interactive_weight,
+                })
         if serving_plan is not None:
             from runbookai_tpu.autotune.plan import engine_only_overrides
 
@@ -316,6 +340,24 @@ class JaxTpuClient(BaseLLMClient):
             # None when llm.slo sets no objective: an unconfigured run
             # must export zero runbook_slo_* series.
             slo_monitor = SLOMonitor.from_config(llm_cfg.slo)
+        if sched_cfg is not None and getattr(sched_cfg, "feedback", False):
+            # SLO feedback (llm.sched.feedback → sched/feedback.py): one
+            # controller per core — each core's prefill share is its own
+            # actuator, all read the same process-wide TPOT burn. A
+            # feedback config without the tpot_p95_ms objective raises
+            # here (an open loop labeled closed is worse than failing).
+            from runbookai_tpu.sched import MixedBudgetController
+
+            for c in (core if isinstance(core, list) else [core]):
+                c.feedback = MixedBudgetController.for_core(sched_cfg,
+                                                            slo_monitor)
+        tenants = None
+        if getattr(llm_cfg, "tenants", None) is not None:
+            from runbookai_tpu.sched import TenantGovernor
+
+            # None when llm.tenants is absent/disabled: zero tenant
+            # surface, the server admits everything exactly as before.
+            tenants = TenantGovernor.from_config(llm_cfg.tenants)
         return cls(
             core, tokenizer,
             temperature=llm_cfg.temperature, top_p=llm_cfg.top_p,
@@ -324,6 +366,7 @@ class JaxTpuClient(BaseLLMClient):
             chat_format=format_for_model(model_cfg_name, cfg.family),
             fleet_cfg=fleet_cfg,
             slo_monitor=slo_monitor,
+            tenants=tenants,
         )
 
     @classmethod

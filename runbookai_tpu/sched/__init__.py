@@ -1,0 +1,106 @@
+"""SLO-aware multi-tenant scheduling and admission control.
+
+The control half of ROADMAP item 4 (the measurement half — SLO burn
+ratios, flight recorder, timelines — landed with the observability layer
+in utils/slo.py and engine/flight_recorder.py). AIBrix's lesson
+(PAPERS.md, arXiv:2504.03648) is that one fleet can hold interactive
+tails flat under batch floods only when admission, queueing and the
+serving knobs all consume the live SLO signal; this package turns that
+signal into control at three layers:
+
+- :mod:`runbookai_tpu.sched.tenants` — per-tenant (API-key) token
+  budgets and request rate limits, enforced by ``server/openai_api.py``
+  BEFORE enqueue (a throttled tenant gets 429 + ``Retry-After`` and
+  never consumes an engine slot). Configured under ``llm.tenants``.
+
+- :mod:`runbookai_tpu.sched.wdrr` — priority-class weighted-deficit
+  (stride) scheduling of the engine's waiting queue: interactive and
+  batch requests share admission in weight proportion, so a batch flood
+  can no longer starve interactive admits AND a steady interactive load
+  can no longer starve the batch tier (strict priority would). FCFS
+  within a class; preemption keeps preferring the lowest class.
+  Configured under ``llm.sched``.
+
+- :mod:`runbookai_tpu.sched.feedback` — the SLO feedback loop: a
+  controller that reads the live TPOT p95 burn ratio each step window
+  and adapts the engine's mixed-dispatch prefill token share (shrink
+  the prefill side of a mixed step while decode is over its latency
+  target, grow it back while decode idles under it). Off by default
+  (``llm.sched.feedback``); disabled it is bit-for-bit today's engine.
+
+Priority classes are plain ints on :class:`EngineRequest.priority`
+(higher = more latency-sensitive); this module names the two canonical
+classes so config files, the ``x-priority`` header, metrics labels and
+the flight recorder all spell them the same way.
+"""
+
+from __future__ import annotations
+
+PRIORITY_BATCH = 0
+PRIORITY_INTERACTIVE = 1
+
+# Canonical class names for metric labels / config / the x-priority
+# header. Other ints are legal engine priorities; they render as "p<n>"
+# and scrape under the bounded "other" label.
+CLASS_NAMES = {PRIORITY_BATCH: "batch", PRIORITY_INTERACTIVE: "interactive"}
+_NAME_CLASSES = {v: k for k, v in CLASS_NAMES.items()}
+
+
+def class_name(priority: int) -> str:
+    """Human/metric name of a priority class ("batch", "interactive",
+    else "p<n>")."""
+    return CLASS_NAMES.get(priority, f"p{priority}")
+
+
+def class_label(priority: int) -> str:
+    """Bounded metric-label spelling: canonical names pass through, every
+    other priority scrapes as "other" (label cardinality must not follow
+    arbitrary caller ints)."""
+    return CLASS_NAMES.get(priority, "other")
+
+
+def class_priority(name: "str | int") -> int:
+    """Parse a class spelling ("interactive"/"batch", or a bare int) to
+    the engine priority. Raises ValueError on anything else — a typo'd
+    ``x-priority`` header or config class must fail loudly, not silently
+    serve the wrong tier."""
+    if isinstance(name, bool):
+        raise ValueError(f"not a priority class: {name!r}")
+    if isinstance(name, int):
+        return name
+    text = str(name).strip().lower()
+    if text in _NAME_CLASSES:
+        return _NAME_CLASSES[text]
+    try:
+        return int(text)
+    except ValueError:
+        raise ValueError(
+            f"unknown priority class {name!r} (expected 'interactive', "
+            f"'batch', or an integer)") from None
+
+
+from runbookai_tpu.sched.feedback import MixedBudgetController  # noqa: E402
+from runbookai_tpu.sched.tenants import (  # noqa: E402
+    Admission,
+    TenantGovernor,
+    TenantPolicy,
+)
+from runbookai_tpu.sched.wdrr import (  # noqa: E402
+    DEFAULT_WEIGHTS,
+    WeightedDeficitScheduler,
+)
+
+__all__ = [
+    "PRIORITY_BATCH",
+    "PRIORITY_INTERACTIVE",
+    "CLASS_NAMES",
+    "class_name",
+    "class_label",
+    "class_priority",
+    "Admission",
+    "TenantGovernor",
+    "TenantPolicy",
+    "DEFAULT_WEIGHTS",
+    "WeightedDeficitScheduler",
+    "MixedBudgetController",
+]

@@ -1,0 +1,200 @@
+"""SLO feedback: adapt the mixed-dispatch prefill share from TPOT burn.
+
+The serving knob with the cleanest latency trade is the unified mixed
+dispatch's token budget split (engine ``_mix_pf_tokens``): every prefill
+token riding a mixed step stretches that step's wall for every decoding
+request in the batch, so when p95 TPOT is over its SLO the cheapest
+relief is to shrink the prefill share (prompts take more steps to
+prefill — TTFT pays — but decode latency recovers), and when decode is
+comfortably under target the share grows back toward the configured
+budget so prompt bursts regain their throughput.
+
+This controller closes that loop against the live SLO signal
+(utils/slo.py → the ``runbook_tpot_seconds`` histogram the engine
+already observes): every ``interval_steps`` engine steps it computes the
+TPOT p95 burn ratio over THAT WINDOW's observations (bucket-snapshot
+diffs — the process-lifetime percentile would need hours of bad samples
+to move after a day of good ones, making the knob inert exactly during
+an incident) and moves the engine's prefill share ONE level along
+a small fixed ladder (fractions of the configured budget, aligned to the
+ragged block so each level is a real mixed-program shape). Discrete
+levels matter: ``_mix_pf_tokens`` sizes the compiled ragged buffer, so a
+continuous controller would compile a new XLA program per adjustment —
+the ladder bounds compile count to ``len(levels)`` for process lifetime.
+
+Clamps are hard: the share never shrinks below ``min_fraction`` of the
+configured budget (one ragged block at least — prefill must always make
+progress; this is a latency trade, not admission control) and never
+grows past the configured budget. Disabled (``llm.sched.feedback:
+false``, the default) the engine never constructs a controller and
+serves bit-for-bit today's behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from runbookai_tpu.utils import metrics as metrics_mod
+
+# The objective the controller consumes (a config'd llm.slo target).
+TPOT_OBJECTIVE = "tpot_p95_ms"
+
+
+class MixedBudgetController:
+    """One controller per EngineCore (its level index and step counter
+    are core state); all controllers read the same process-wide TPOT
+    histogram, so a fleet's replicas move together.
+
+    ``monitor`` is a :class:`runbookai_tpu.utils.slo.SLOMonitor` whose
+    objectives include ``tpot_p95_ms``; construction refuses anything
+    else — a controller silently wired to no signal would read as
+    "feedback active" while controlling nothing.
+    """
+
+    def __init__(self, monitor: Any, *, interval_steps: int = 32,
+                 shrink_at: float = 1.0, grow_at: float = 0.7,
+                 min_fraction: float = 0.25, min_window_obs: int = 8,
+                 registry: Optional[metrics_mod.MetricsRegistry] = None):
+        if monitor is None or TPOT_OBJECTIVE not in getattr(
+                monitor, "objectives", {}):
+            raise ValueError(
+                f"feedback needs an llm.slo {TPOT_OBJECTIVE} objective "
+                f"(the controller's input signal)")
+        if not 0 < grow_at <= shrink_at:
+            raise ValueError(
+                f"need 0 < grow_at <= shrink_at, got grow_at={grow_at} "
+                f"shrink_at={shrink_at}")
+        if not 0 < min_fraction <= 1.0:
+            raise ValueError(f"min_fraction must be in (0, 1], got "
+                             f"{min_fraction}")
+        self.monitor = monitor
+        self.interval_steps = max(1, int(interval_steps))
+        self.shrink_at = float(shrink_at)
+        self.grow_at = float(grow_at)
+        self.min_fraction = float(min_fraction)
+        # Decision windows need this many NEW observations before the
+        # percentile is trusted (a 2-sample "p95" is noise, not signal).
+        self.min_window_obs = max(1, int(min_window_obs))
+        self._steps = 0
+        self._levels: list[int] = []
+        self._level = 0  # index into _levels; 0 = the configured budget
+        # Bucket-count snapshot at the last consumed decision window:
+        # burn is computed over the observations SINCE it, never the
+        # process-lifetime histogram (whose percentile would take hours
+        # of bad samples to move after a day of good ones — inert
+        # exactly when the controller must act).
+        self._window_mark: Optional[list[float]] = None
+        reg = registry or metrics_mod.get_registry()
+        self._m_adjust = reg.counter(
+            "runbook_sched_feedback_adjustments_total",
+            "Mixed prefill-share moves by the SLO feedback controller",
+            labels=("direction",))
+        # Labeled per replica: each core runs its OWN controller (step
+        # counters and levels diverge under uneven load), so an
+        # unlabeled gauge would report whichever replica bound last.
+        self._g_share = reg.gauge(
+            "runbook_sched_mixed_prefill_tokens",
+            "Live prefill-token share of a mixed dispatch per replica "
+            "(the SLO feedback controller's actuator; constant when "
+            "feedback is off)", labels=("replica",))
+
+    def _build_levels(self, core: Any) -> None:
+        """The ladder for this core: fractions of its configured prefill
+        share, each rounded UP to a whole ragged block, deduped, floored
+        at one block. Level 0 is the configured budget."""
+        from runbookai_tpu.engine.engine import _RAGGED_BLOCK as rq
+
+        base = int(core._mix_pf_tokens)
+        fractions = (1.0, 0.75, 0.5, self.min_fraction)
+        seen: list[int] = []
+        for f in sorted(set(fractions), reverse=True):
+            if f < self.min_fraction:
+                continue
+            tokens = max(rq, -(-int(base * f) // rq) * rq)
+            if tokens not in seen:
+                seen.append(tokens)
+        self._levels = seen  # descending: [base, ..., min]
+
+    def burn(self) -> Optional[float]:
+        """TPOT p95 burn ratio over THIS decision window's observations
+        (None = too few new samples to trust). The window mark advances
+        only when a window is consumed, so sparse traffic accumulates
+        until it carries signal instead of being dropped."""
+        hist = self.monitor.histogram(TPOT_OBJECTIVE)
+        if hist is None:
+            return None
+        counts = hist.bucket_counts()
+        if self._window_mark is None:
+            # First window reads everything observed so far (a synthetic
+            # over-SLO fixture must register on the first decision).
+            self._window_mark = [0.0] * len(counts)
+        if any(now < then for now, then in zip(counts, self._window_mark)):
+            # The histogram was reset under us (bench warmup, tests):
+            # resync rather than serving a garbage negative window.
+            self._window_mark = counts
+            return None
+        window = sum(now - then
+                     for now, then in zip(counts, self._window_mark))
+        if window < self.min_window_obs:
+            return None
+        current_s = hist.percentile_since(
+            self.monitor.objectives[TPOT_OBJECTIVE]["q"],
+            self._window_mark)
+        self._window_mark = counts
+        if current_s is None:
+            return None
+        return (current_s * 1e3
+                / self.monitor.objectives[TPOT_OBJECTIVE]["target_ms"])
+
+    def on_step(self, core: Any) -> None:
+        """Engine-step hook (called by ``EngineCore.step``): every
+        ``interval_steps`` steps, move the prefill share one ladder level
+        against the live burn. O(1) per step off the decision windows."""
+        if not self._levels:
+            self._build_levels(core)
+            replica = getattr(core, "replica_idx", None)
+            self._g_share.labels(
+                replica=str(replica if replica is not None else 0)
+            ).set_function(lambda: float(core._mix_pf_tokens))
+        self._steps += 1
+        if self._steps % self.interval_steps:
+            return
+        burn = self.burn()
+        if burn is None:
+            return
+        if burn > self.shrink_at and self._level < len(self._levels) - 1:
+            self._level += 1
+            self._m_adjust.labels(direction="shrink").inc()
+        elif burn < self.grow_at and self._level > 0:
+            self._level -= 1
+            self._m_adjust.labels(direction="grow").inc()
+        else:
+            return
+        core._mix_pf_tokens = self._levels[self._level]
+
+    def state(self) -> dict:
+        return {"level": self._level, "levels": list(self._levels),
+                "steps": self._steps}
+
+    @classmethod
+    def for_core(cls, sched_cfg: Any, monitor: Any,
+                 ) -> Optional["MixedBudgetController"]:
+        """Build from an ``llm.sched`` block when feedback is enabled AND
+        the SLO monitor carries the TPOT objective; None otherwise (the
+        engine then has no controller and no behavior change). A config
+        that asks for feedback WITHOUT the objective raises — silently
+        serving an open loop labeled as closed would be worse than
+        failing at load."""
+        if sched_cfg is None or not getattr(sched_cfg, "feedback", False):
+            return None
+        if monitor is None or TPOT_OBJECTIVE not in getattr(
+                monitor, "objectives", {}):
+            raise ValueError(
+                "llm.sched.feedback: true requires llm.slo.tpot_p95_ms "
+                "(the controller's input signal)")
+        return cls(
+            monitor,
+            interval_steps=getattr(sched_cfg, "feedback_interval_steps", 32),
+            shrink_at=getattr(sched_cfg, "feedback_shrink_at", 1.0),
+            grow_at=getattr(sched_cfg, "feedback_grow_at", 0.7),
+            min_fraction=getattr(sched_cfg, "feedback_min_fraction", 0.25))

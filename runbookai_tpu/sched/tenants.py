@@ -1,0 +1,297 @@
+"""Per-tenant (API-key) admission control: token budgets + rate limits.
+
+The server half of multi-tenant isolation (``llm.tenants`` →
+``server/openai_api.py``): every chat/completions request resolves its
+tenant from the ``Authorization: Bearer`` (or ``x-api-key``) header and
+must pass BOTH of the tenant's buckets before it is enqueued —
+
+- a **request-rate** bucket (``rate_limit_rpm``): classic token bucket,
+  capacity = one minute's worth, refilled continuously;
+- a **token-budget** bucket (``token_budget_per_min``): the request
+  RESERVES ``prompt_tokens + n·max_new_tokens`` up front (the worst it
+  can cost) and the unused remainder is refunded at :meth:`settle` when
+  the true completion size is known — so a tenant cannot overshoot its
+  budget by in-flight requests, and short completions don't burn a long
+  reservation.
+
+A throttled request never reaches the engine (no slot, no KV pages, no
+queue entry) and carries ``retry_after_s`` — the earliest time the
+failing bucket can cover it — which the HTTP layer sends as
+``Retry-After`` on the 429.
+
+Unknown keys (and anonymous requests) share ONE "default"-policy state:
+per-key state for arbitrary caller strings would be an unbounded-memory
+DoS vector, and the aggregate-anonymous-pool semantic is what a public
+endpoint wants anyway. Configured tenants are bounded by config, so each
+gets its own buckets and metric labelset (``runbook_tenant_*``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from runbookai_tpu.sched import PRIORITY_INTERACTIVE, class_priority
+from runbookai_tpu.utils import metrics as metrics_mod
+
+# Aggregate tenant label for unknown/anonymous keys (bounded cardinality).
+DEFAULT_TENANT = "default"
+
+
+@dataclass
+class TenantPolicy:
+    """Limits for one tenant (``llm.tenants.keys.<name>`` /
+    ``llm.tenants.default``). ``None`` = that limit unenforced."""
+
+    rate_limit_rpm: Optional[float] = None
+    token_budget_per_min: Optional[float] = None
+    # Scheduling class of the tenant's requests ("interactive"/"batch");
+    # the x-priority header can DEMOTE a request, never promote past it.
+    priority: str = "interactive"
+    # The bearer secret selecting this tenant. None = the tenant's NAME
+    # doubles as the key — acceptable only for non-secret identifiers,
+    # because names are exported verbatim (metric labels, /tenants, the
+    # CLI) while api_key never leaves the governor.
+    api_key: Optional[str] = None
+
+
+class _Bucket:
+    """Continuous-refill token bucket. Not thread-safe on its own — the
+    governor's lock serializes every touch."""
+
+    __slots__ = ("capacity", "rate", "level", "_ts")
+
+    def __init__(self, capacity: float, rate_per_s: float, now: float):
+        self.capacity = float(capacity)
+        self.rate = float(rate_per_s)
+        self.level = float(capacity)
+        self._ts = now
+
+    def _refill(self, now: float) -> None:
+        if now > self._ts:
+            self.level = min(self.capacity,
+                             self.level + (now - self._ts) * self.rate)
+        self._ts = now
+
+    def try_take(self, n: float, now: float) -> tuple[bool, float]:
+        """(took, retry_after_s). ``retry_after_s`` is how long until the
+        bucket could cover ``n`` (capped at the full-capacity wait for
+        requests larger than the bucket — they can never pass, but the
+        caller still gets a finite, honest hint)."""
+        self._refill(now)
+        if self.level >= n:
+            self.level -= n
+            return True, 0.0
+        deficit = min(n, self.capacity) - self.level
+        return False, max(deficit, 0.0) / self.rate if self.rate > 0 else 60.0
+
+    def credit(self, n: float, now: float) -> None:
+        self._refill(now)
+        self.level = min(self.capacity, self.level + n)
+
+
+@dataclass
+class _TenantState:
+    policy: TenantPolicy
+    rate: Optional[_Bucket]
+    tokens: Optional[_Bucket]
+    admitted: int = 0
+    throttled_rate: int = 0
+    throttled_tokens: int = 0
+    tokens_charged: float = 0.0
+
+
+@dataclass
+class Admission:
+    """One admission decision. ``allowed=False`` → the HTTP layer answers
+    429 with ``Retry-After: ceil(retry_after_s)`` and must NOT submit.
+    ``allowed=True`` carries the reservation to :meth:`TenantGovernor.
+    settle` (exactly once) and the tenant's scheduling class."""
+
+    allowed: bool
+    tenant: str
+    priority: int = PRIORITY_INTERACTIVE
+    retry_after_s: float = 0.0
+    reason: Optional[str] = None  # "rate_limit" | "token_budget"
+    reserved_tokens: float = 0.0
+    _settled: bool = field(default=False, repr=False)
+
+
+class TenantGovernor:
+    """The server-side admission gate over the configured tenant set."""
+
+    def __init__(self, policies: dict[str, TenantPolicy],
+                 default: Optional[TenantPolicy] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[metrics_mod.MetricsRegistry] = None):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._states: dict[str, _TenantState] = {}
+        # Secret -> tenant-name resolution. A tenant WITH an api_key is
+        # selected ONLY by it (its public name must not work as a
+        # credential); without one, the name doubles as the key.
+        self._key_to_name: dict[str, str] = {}
+        for name, policy in policies.items():
+            self._states[name] = self._make_state(policy)
+            self._key_to_name[policy.api_key or name] = name
+        self._states.setdefault(
+            DEFAULT_TENANT, self._make_state(default or TenantPolicy()))
+        reg = registry or metrics_mod.get_registry()
+        self._m_requests = reg.counter(
+            "runbook_tenant_requests_total",
+            "Tenant admission decisions at the server "
+            "(outcome: admitted | throttled_rate | throttled_tokens)",
+            labels=("tenant", "outcome"))
+        self._m_tokens = reg.counter(
+            "runbook_tenant_tokens_total",
+            "Tokens charged against tenant budgets (prompt + completion, "
+            "settled at the true completion size)", labels=("tenant",))
+        self._m_throttled = reg.counter(
+            "runbook_admission_throttled_total",
+            "Requests refused 429 at the server before enqueue (rate "
+            "limit or token budget)")
+        g_budget = reg.gauge(
+            "runbook_tenant_budget_remaining_tokens",
+            "Live token-budget bucket level per tenant (absent when the "
+            "tenant has no token budget configured)", labels=("tenant",))
+        g_budget.clear_functions()
+        for name, state in self._states.items():
+            if state.tokens is not None:
+                g_budget.labels(tenant=name).set_function(
+                    lambda n=name: self._budget_level(n))
+
+    def _make_state(self, policy: TenantPolicy) -> _TenantState:
+        now = self._clock()
+        rate = tokens = None
+        if policy.rate_limit_rpm:
+            rate = _Bucket(policy.rate_limit_rpm,
+                           policy.rate_limit_rpm / 60.0, now)
+        if policy.token_budget_per_min:
+            tokens = _Bucket(policy.token_budget_per_min,
+                             policy.token_budget_per_min / 60.0, now)
+        return _TenantState(policy=policy, rate=rate, tokens=tokens)
+
+    def _budget_level(self, name: str) -> float:
+        with self._lock:
+            state = self._states[name]
+            if state.tokens is None:
+                raise LookupError(f"{name}: no token budget")
+            state.tokens._refill(self._clock())
+            return state.tokens.level
+
+    def resolve(self, api_key: Optional[str]) -> str:
+        """Tenant name for a request's bearer secret (unknown/absent
+        keys pool under the bounded ``default`` tenant)."""
+        if api_key and api_key in self._key_to_name:
+            return self._key_to_name[api_key]
+        return DEFAULT_TENANT
+
+    def admit(self, api_key: Optional[str], prompt_tokens: int,
+              max_new_tokens: int) -> Admission:
+        """Charge both buckets for one request; reserve the worst-case
+        token cost. Never touches the engine — a refusal costs nothing."""
+        tenant = self.resolve(api_key)
+        reserve = float(max(0, prompt_tokens) + max(0, max_new_tokens))
+        now = self._clock()
+        with self._lock:
+            state = self._states[tenant]
+            priority = class_priority(state.policy.priority)
+            if state.rate is not None:
+                ok, retry = state.rate.try_take(1.0, now)
+                if not ok:
+                    state.throttled_rate += 1
+                    self._throttle_metrics(tenant, "throttled_rate")
+                    return Admission(False, tenant, priority=priority,
+                                     retry_after_s=retry,
+                                     reason="rate_limit")
+            if state.tokens is not None:
+                ok, retry = state.tokens.try_take(reserve, now)
+                if not ok:
+                    if state.rate is not None:
+                        state.rate.credit(1.0, now)  # the request never ran
+                    state.throttled_tokens += 1
+                    self._throttle_metrics(tenant, "throttled_tokens")
+                    return Admission(False, tenant, priority=priority,
+                                     retry_after_s=retry,
+                                     reason="token_budget")
+            state.admitted += 1
+        self._m_requests.labels(tenant=tenant, outcome="admitted").inc()
+        return Admission(True, tenant, priority=priority,
+                         reserved_tokens=reserve)
+
+    def _throttle_metrics(self, tenant: str, outcome: str) -> None:
+        # Counter bumps are their own locks; called with self._lock held
+        # only because the caller is mid-decision — no I/O, no blocking.
+        self._m_requests.labels(tenant=tenant, outcome=outcome).inc()
+        self._m_throttled.inc()
+
+    def settle(self, admission: Admission, actual_tokens: int) -> None:
+        """Refund the unused part of an admitted reservation once the
+        true ``prompt + completion`` size is known (idempotent: the HTTP
+        handler's error paths and success path may both reach it)."""
+        if not admission.allowed or admission._settled:
+            return
+        admission._settled = True
+        actual = float(max(0, actual_tokens))
+        refund = max(0.0, admission.reserved_tokens - actual)
+        charged = min(admission.reserved_tokens, actual)
+        now = self._clock()
+        with self._lock:
+            state = self._states[admission.tenant]
+            if state.tokens is not None and refund > 0:
+                state.tokens.credit(refund, now)
+            state.tokens_charged += charged
+        if charged:
+            self._m_tokens.labels(tenant=admission.tenant).inc(charged)
+
+    def snapshot(self) -> dict[str, Any]:
+        """Live per-tenant state for ``GET /tenants`` and the
+        ``runbook tenants`` CLI."""
+        now = self._clock()
+        out: dict[str, Any] = {"enabled": True, "tenants": {}}
+        with self._lock:
+            for name, state in sorted(self._states.items()):
+                row: dict[str, Any] = {
+                    "priority": state.policy.priority,
+                    "rate_limit_rpm": state.policy.rate_limit_rpm,
+                    "token_budget_per_min":
+                        state.policy.token_budget_per_min,
+                    "admitted": state.admitted,
+                    "throttled_rate": state.throttled_rate,
+                    "throttled_tokens": state.throttled_tokens,
+                    "tokens_charged": round(state.tokens_charged, 1),
+                }
+                if state.rate is not None:
+                    state.rate._refill(now)
+                    row["rate_remaining"] = round(state.rate.level, 2)
+                if state.tokens is not None:
+                    state.tokens._refill(now)
+                    row["budget_remaining_tokens"] = round(
+                        state.tokens.level, 1)
+                out["tenants"][name] = row
+        return out
+
+    @classmethod
+    def from_config(cls, tenants_cfg: Any,
+                    registry: Optional[metrics_mod.MetricsRegistry] = None,
+                    ) -> Optional["TenantGovernor"]:
+        """Build from an ``llm.tenants`` config block (utils/config.
+        TenantsConfig). None when the block is absent or disabled — the
+        server then runs with zero tenant surface, exactly as before."""
+        if tenants_cfg is None or not getattr(tenants_cfg, "enabled", False):
+            return None
+
+        def to_policy(block: Any) -> TenantPolicy:
+            return TenantPolicy(
+                rate_limit_rpm=getattr(block, "rate_limit_rpm", None),
+                token_budget_per_min=getattr(block, "token_budget_per_min",
+                                             None),
+                priority=getattr(block, "priority", "interactive"),
+                api_key=getattr(block, "api_key", None))
+
+        policies = {name: to_policy(block)
+                    for name, block in (tenants_cfg.keys or {}).items()}
+        return cls(policies, default=to_policy(tenants_cfg.default),
+                   registry=registry)

@@ -1,0 +1,134 @@
+"""Weighted-deficit (stride) scheduling of the engine's waiting queue.
+
+The engine's classic admission order was strict priority then FCFS: a
+steady interactive load starves batch forever, and — since every HTTP
+request used to arrive at the same priority — a batch flood FIFO-starves
+interactive. Stride scheduling fixes both with one mechanism: each class
+owns a *pass* value advancing by ``stride = UNIT / weight`` per admitted
+request, and admission always takes the head of the class with the
+smallest pass. Over any window, class admits converge to the weight
+ratio (8:1 interactive:batch by default) while staying FCFS within a
+class — the weighted-deficit queue ROADMAP item 4 names.
+
+Two properties the engine relies on:
+
+- **Ordering is pure, admission advances.** :meth:`order` simulates the
+  interleave over local pass copies (the engine may admit only a prefix
+  of the order when slots/pages run out); only :meth:`commit` — called
+  per ACTUAL admission — advances the persisted pass. A request the
+  engine could not admit never charges its class.
+- **No credit hoarding across idle.** A class absent (or idle) for a
+  while re-joins at the floor of the active classes' passes, like a
+  stride task joining at the global virtual time — otherwise a batch
+  tier quiet for an hour would bank an hour of credit and flood the
+  next thousand slots, exactly the latency spike this scheduler exists
+  to prevent.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from runbookai_tpu.sched import PRIORITY_BATCH, PRIORITY_INTERACTIVE
+
+# Default class weights: interactive admits ~8 requests for every batch
+# admit under contention. Batch still progresses (1 in 9) — never starves.
+DEFAULT_WEIGHTS: dict[int, float] = {
+    PRIORITY_BATCH: 1.0,
+    PRIORITY_INTERACTIVE: 8.0,
+}
+
+# Stride numerator. Any positive constant works (only stride RATIOS
+# matter); a highly-composite value keeps common weights' strides exact
+# in binary floating point.
+_STRIDE_UNIT = 840.0
+
+
+class WeightedDeficitScheduler:
+    """Per-class stride state + the waiting-list interleave.
+
+    ``weights`` maps priority class → relative admission share. Unknown
+    positive classes scale linearly above the largest configured weight
+    (monotone: a higher class never gets a smaller share), unknown
+    non-positive classes weigh 1.0 — so arbitrary caller ints stay legal
+    engine priorities without any config.
+    """
+
+    def __init__(self, weights: Optional[dict[int, float]] = None):
+        self.weights = dict(DEFAULT_WEIGHTS if weights is None else weights)
+        for cls, w in self.weights.items():
+            if not w > 0:
+                raise ValueError(
+                    f"class {cls} weight must be > 0, got {w}")
+        self._pass: dict[int, float] = {}
+
+    def weight_of(self, priority: int) -> float:
+        w = self.weights.get(priority)
+        if w is not None:
+            return w
+        if priority <= 0:
+            return 1.0
+        top = max(self.weights.values(), default=1.0)
+        return top * priority
+
+    def _stride(self, priority: int) -> float:
+        return _STRIDE_UNIT / self.weight_of(priority)
+
+    def _normalize(self, active: list[int]) -> None:
+        """Bound every active class's banked credit to ONE stride.
+
+        The virtual time is the LEADER's pass (the most-served active
+        class); in a steady interleave every class's pass stays within
+        one max-stride of it, so any class further behind — idle for a
+        while, or never seen — is carrying banked credit from a period
+        it wasn't competing in. Clamp it up to ``leader − max_stride``:
+        a returning class gets at most one immediate admit (its fair
+        in-rotation deficit), never a burst proportional to its idle
+        time. (Clamping to the minimum KNOWN pass instead would be a
+        no-op for a previously-served class whose stale pass IS the
+        minimum — the hoarding bug this replaces.)"""
+        known = [self._pass[c] for c in active if c in self._pass]
+        leader = max(known) if known else 0.0
+        floor = leader - max(self._stride(c) for c in active)
+        for c in active:
+            self._pass[c] = max(self._pass.get(c, floor), floor)
+
+    def order(self, waiting: list) -> list:
+        """Interleave ``waiting`` by class stride, FCFS (arrival time)
+        within a class. Pure with respect to admission state: only the
+        normalization clamp touches the persisted passes — simulation
+        runs on local copies, so ordering twice equals ordering once."""
+        if len(waiting) < 2:
+            return list(waiting)
+        buckets: dict[int, list] = {}
+        for req in sorted(waiting, key=lambda r: r.arrival_time):
+            buckets.setdefault(req.priority, []).append(req)
+        if len(buckets) == 1:
+            return next(iter(buckets.values()))
+        self._normalize(list(buckets))
+        local = {c: self._pass[c] for c in buckets}
+        heads = {c: 0 for c in buckets}
+        out: list = []
+        while len(out) < len(waiting):
+            # Smallest pass admits next; ties go to the higher class so a
+            # cold start (all passes equal) serves interactive first.
+            c = min((cls for cls in buckets if heads[cls] < len(buckets[cls])),
+                    key=lambda cls: (local[cls], -cls))
+            out.append(buckets[c][heads[c]])
+            heads[c] += 1
+            local[c] += self._stride(c)
+        return out
+
+    def commit(self, priority: int) -> None:
+        """Advance the admitted request's class pass (call once per
+        ACTUAL admission, after :meth:`order` chose it)."""
+        self._pass[priority] = (self._pass.get(priority, 0.0)
+                                + self._stride(priority))
+
+    def snapshot(self) -> dict:
+        """Live pass/weight state per class (debug surface)."""
+        return {
+            "weights": {str(c): w for c, w in sorted(self.weights.items())},
+            "pass": {str(c): round(p, 3)
+                     for c, p in sorted(self._pass.items())},
+        }

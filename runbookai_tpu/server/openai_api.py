@@ -15,8 +15,21 @@ OpenAI-client tooling can point at a TPU slice with no code changes:
   ``llm.slo`` objectives are configured) the ``runbook_slo_*`` series.
 - ``GET /debug/steps?n=N`` — the engine flight recorder's last N per-step
   records (``engine/flight_recorder.py``): dispatch kind, tokens,
-  occupancy, queue depth, KV pressure, wall split; fleet deployments
-  merge every replica's ring into one ts-ordered timeline.
+  occupancy (total + per priority class), queue depth, KV pressure, wall
+  split; fleet deployments merge every replica's ring into one
+  ts-ordered timeline.
+- ``GET /tenants`` — live tenant-accounting state (``sched/tenants.py``):
+  per-tenant policy, bucket levels, admit/throttle counters.
+
+Multi-tenant admission (``llm.tenants`` → ``runbookai_tpu/sched``): every
+chat/completions request resolves its tenant from ``Authorization:
+Bearer`` / ``x-api-key`` and must pass the tenant's rate and token-budget
+buckets BEFORE enqueue — a throttled request is answered ``429`` with
+``Retry-After`` and never consumes an engine slot. Requests carry a
+priority class (the tenant's configured class, or an explicit
+``x-priority: interactive|batch`` header) into the engine's
+weighted-deficit scheduler; fleet sheds and engine pool-pressure aborts
+answer ``503`` with ``Retry-After``.
 
 Every response carries an ``x-request-id`` header (client-supplied value
 echoed, else generated); the id is attached to the handler thread's tracer
@@ -43,6 +56,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
 from runbookai_tpu.engine.request import FleetSaturated
+from runbookai_tpu.sched import (
+    CLASS_NAMES,
+    PRIORITY_INTERACTIVE,
+    class_priority,
+)
 from runbookai_tpu.utils.metrics import REQUEST_LATENCY_BUCKETS, get_registry
 from runbookai_tpu.utils.trace import get_tracer
 
@@ -50,7 +68,13 @@ from runbookai_tpu.utils.trace import get_tracer
 _KNOWN_ROUTES = frozenset((
     "/v1/chat/completions", "/v1/completions", "/v1/embeddings",
     "/v1/adapters", "/v1/models", "/healthz", "/metrics", "/debug/steps",
+    "/tenants",
 ))
+
+# Retry-After for fleet sheds / engine pool-pressure 503s: the backlog
+# drains in engine-step time, so "about a second" is the honest hint (a
+# tenant throttle's Retry-After is computed from its bucket instead).
+_SHED_RETRY_AFTER_S = 1
 
 
 def messages_to_prompt_parts(messages: list[dict[str, Any]]):
@@ -323,17 +347,101 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 request_latency.labels(route=route, method=method).observe(
                     time.perf_counter() - t0)
 
-        def _json(self, code: int, payload: dict) -> None:
+        def _json(self, code: int, payload: dict,
+                  headers: Optional[dict] = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, str(value))
             self.end_headers()
             self.wfile.write(body)
 
-        def _error(self, code: int, message: str) -> None:
+        def _error(self, code: int, message: str,
+                   retry_after: Optional[float] = None,
+                   err_type: str = "invalid_request_error") -> None:
+            import math
+
+            headers = None
+            if retry_after is not None:
+                # Both throttles (429) and sheds (503) tell the client
+                # WHEN to come back — integer seconds, never 0 (a zero
+                # would read as "retry immediately", i.e. a retry storm).
+                headers = {"Retry-After": max(1, math.ceil(retry_after))}
             self._json(code, {"error": {"message": message,
-                                        "type": "invalid_request_error"}})
+                                        "type": err_type}},
+                       headers=headers)
+
+        def _api_key(self) -> Optional[str]:
+            """Tenant key of this request: ``Authorization: Bearer`` wins,
+            ``x-api-key`` is the fallback, absent = anonymous (pools
+            under the default tenant)."""
+            auth = self.headers.get("Authorization") or ""
+            if auth.lower().startswith("bearer "):
+                return auth[7:].strip() or None
+            return self.headers.get("x-api-key")
+
+        def _priority_override(self) -> Optional[int]:
+            """Explicit ``x-priority`` header, or None to follow the
+            tenant's configured class. Only the canonical class names
+            are accepted from the NETWORK — arbitrary ints would let any
+            client mint a priority class with an arbitrarily large
+            scheduler weight (internal callers keep free-form ints on
+            the engine API). Raises ValueError on junk (→ 400)."""
+            hdr = self.headers.get("x-priority")
+            if hdr is None:
+                return None
+            priority = class_priority(hdr)
+            if priority not in CLASS_NAMES:
+                raise ValueError(
+                    f"x-priority must be one of "
+                    f"{sorted(CLASS_NAMES.values())}, got {hdr!r}")
+            return priority
+
+        def _admit_tenant(self, prompt_tokens: int, max_new_tokens: int):
+            """Tenant admission BEFORE enqueue (sched/tenants.py):
+            returns ``(admission, priority)`` — admission is None when no
+            governor is configured. A throttled request is answered 429 +
+            Retry-After here and ``(None, None)`` is returned; the caller
+            must then bail without touching the engine."""
+            # Header parse FIRST: a junk x-priority must 400 before any
+            # bucket is charged (no refund bookkeeping for bad input).
+            override = self._priority_override()  # caller catches ValueError
+            governor = getattr(client, "tenants", None)
+            admission = None
+            if governor is not None:
+                admission = governor.admit(self._api_key(), prompt_tokens,
+                                           max_new_tokens)
+                if not admission.allowed:
+                    limit = ("rate limit" if admission.reason == "rate_limit"
+                             else "token budget")
+                    self._error(
+                        429,
+                        f"tenant {admission.tenant!r} is over its {limit}; "
+                        f"retry after {max(1.0, admission.retry_after_s):.0f}s",
+                        retry_after=admission.retry_after_s,
+                        err_type="rate_limit_error")
+                    return None, None
+            # Untenanted server traffic defaults to the interactive
+            # class: a human is usually waiting on an HTTP response, and
+            # batch tiers must OPT IN (tenant config or header).
+            ceiling = (admission.priority if admission is not None
+                       else PRIORITY_INTERACTIVE)
+            if override is not None:
+                # The header can DEMOTE a request below its tenant's
+                # class, never promote past it — a tenant configured
+                # batch must not self-escalate into the interactive tier
+                # by setting a header.
+                priority = min(override, ceiling)
+            else:
+                priority = ceiling
+            return admission, priority
+
+        def _settle_tenant(self, admission, actual_tokens: int) -> None:
+            governor = getattr(client, "tenants", None)
+            if governor is not None and admission is not None:
+                governor.settle(admission, actual_tokens)
 
         def _read_json(self) -> dict:
             length = int(self.headers.get("Content-Length", 0))
@@ -404,6 +512,15 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     # feedback signal SLO-aware scheduling will consume.
                     body["slo"] = slo.evaluate()
                 self._json(200, body)
+            elif path == "/tenants":
+                # Tenant accounting state (sched/tenants.py): configured
+                # policies, live bucket levels, admit/throttle counters —
+                # the `runbook tenants` CLI renders this. Without a
+                # governor the surface reports itself disabled (not 404:
+                # the CLI distinguishes "off" from "no server").
+                governor = getattr(client, "tenants", None)
+                self._json(200, governor.snapshot() if governor is not None
+                           else {"enabled": False, "tenants": {}})
             elif path == "/metrics":
                 body = registry.render().encode()
                 self.send_response(200)
@@ -500,9 +617,22 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                                        fmt=client.chat_format)
             ids = client.tokenizer.encode(prompt)
 
+            # Tenant admission BEFORE the engine sees anything: a tenant
+            # over its rate limit or token budget gets 429 + Retry-After
+            # and never consumes a slot, a KV page, or a queue entry.
+            try:
+                admission, priority = self._admit_tenant(
+                    len(ids), n * sampling.max_new_tokens)
+            except ValueError as e:  # junk x-priority header
+                self._error(400, str(e))
+                return
+            if priority is None:
+                return  # throttled; 429 already sent
+
             try:
                 if body.get("stream"):
                     if n != 1:
+                        self._settle_tenant(admission, 0)
                         self._error(400, "stream with n > 1 is unsupported")
                         return
                     # Fleet shedding: refuse BEFORE committing SSE headers
@@ -511,14 +641,17 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     # event inside _stream_response).
                     saturated = getattr(client.engine, "is_saturated", None)
                     if saturated is not None and saturated():
+                        self._settle_tenant(admission, 0)
                         self._error(503, "all fleet replicas are "
-                                         "saturated (request shed)")
+                                         "saturated (request shed)",
+                                    retry_after=_SHED_RETRY_AFTER_S)
                         return
                     so = body.get("stream_options") or {}
                     self._stream_response(
                         ids, sampling, adapter,
                         top_logprobs=top_logprobs,
-                        include_usage=bool(so.get("include_usage")))
+                        include_usage=bool(so.get("include_usage")),
+                        priority=priority, admission=admission)
                 else:
                     # The engine-side timeout ABORTS a stalled request
                     # (frees slot + KV pages) before raising; the bridge
@@ -545,12 +678,14 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                         return await asyncio.gather(*[
                             client.engine.generate(
                                 ids, _choice_sampling(i),
-                                timeout_s=request_timeout, adapter=adapter,
+                                timeout_s=request_timeout,
+                                priority=priority, adapter=adapter,
                                 request_id=self._request_id)
                             for i in range(n)], return_exceptions=True)
 
                     outs = bridge.run(_gen_n(), timeout=request_timeout + 60)
                     if any(isinstance(o, BaseException) for o in outs):
+                        self._settle_tenant(admission, 0)
                         err = next(o for o in outs
                                    if isinstance(o, BaseException))
                         if isinstance(err, (TimeoutError, _FutTimeout)):
@@ -559,11 +694,18 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                             raise err
                         return
                     if any(o.finish_reason.value == "aborted" for o in outs):
-                        # Admission fail-fast (prompt can never fit) or
-                        # mid-decode abort: an error, not a completion.
+                        # Admission fail-fast (prompt can never fit), a
+                        # fleet shed, or a mid-decode abort: an error, not
+                        # a completion — and a failed request is never
+                        # billed against the tenant's budget.
+                        self._settle_tenant(admission, 0)
                         self._error(503, "request aborted by the engine "
-                                         "(insufficient KV capacity)")
+                                         "(insufficient KV capacity)",
+                                    retry_after=_SHED_RETRY_AFTER_S)
                         return
+                    self._settle_tenant(
+                        admission,
+                        len(ids) + sum(o.decode_tokens for o in outs))
 
                     def choice(i, o):
                         c = {"index": i,
@@ -599,9 +741,12 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                                           for i, o in enumerate(outs)]
                     self._json(200, payload)
             except (TimeoutError, _FutTimeout):
+                self._settle_tenant(admission, 0)
                 self._error(504, "generation timed out")
             except BrokenPipeError:
-                pass  # client went away; engine abort handled in stream path
+                # Client went away; engine abort handled in stream path.
+                # The reservation is refunded (failed work isn't billed).
+                self._settle_tenant(admission, 0)
 
         def _legacy_completions(self) -> None:
             """Legacy `/v1/completions`: raw-prompt text completion, no
@@ -611,6 +756,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             int (top-N per sampled token), and adapter-as-model routing
             matches the chat endpoint. Streaming is not offered on the
             legacy surface — use `/v1/chat/completions`."""
+            admission = None
             try:
                 body = self._read_json()
                 if body.get("stream"):
@@ -651,6 +797,15 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 # and the usage count, so they cannot disagree.
                 all_ids = [client.tokenizer.encode(p) for p in prompts]
 
+                # Same tenant gate as the chat endpoint: the reservation
+                # covers every prompt and all n completions per prompt.
+                prompt_total = sum(len(ids) for ids in all_ids)
+                admission, priority = self._admit_tenant(
+                    prompt_total,
+                    n * len(all_ids) * sampling.max_new_tokens)
+                if priority is None:
+                    return  # throttled; 429 + Retry-After already sent
+
                 async def _gen_all():
                     import dataclasses as _dc
 
@@ -663,13 +818,14 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                                                  seed=sampling.seed + i)
                             jobs.append(client.engine.generate(
                                 ids, sp, timeout_s=request_timeout,
-                                adapter=adapter,
+                                priority=priority, adapter=adapter,
                                 request_id=self._request_id))
                     return await asyncio.gather(*jobs,
                                                 return_exceptions=True)
 
                 outs = bridge.run(_gen_all(), timeout=request_timeout + 60)
                 if any(isinstance(o, BaseException) for o in outs):
+                    self._settle_tenant(admission, 0)
                     err = next(o for o in outs
                                if isinstance(o, BaseException))
                     if isinstance(err, (TimeoutError, _FutTimeout)):
@@ -677,9 +833,14 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                         return
                     raise err
                 if any(o.finish_reason.value == "aborted" for o in outs):
+                    self._settle_tenant(admission, 0)
                     self._error(503, "request aborted by the engine "
-                                     "(insufficient KV capacity)")
+                                     "(insufficient KV capacity)",
+                                retry_after=_SHED_RETRY_AFTER_S)
                     return
+                self._settle_tenant(
+                    admission,
+                    prompt_total + sum(o.decode_tokens for o in outs))
 
                 def legacy_lp(o, text_start: int):
                     if not lp_n or not o.logprobs:
@@ -728,11 +889,13 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     },
                 })
             except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._settle_tenant(admission, 0)
                 self._error(400, str(e))
             except (TimeoutError, _FutTimeout):
+                self._settle_tenant(admission, 0)
                 self._error(504, "generation timed out")
             except BrokenPipeError:
-                pass  # client went away
+                self._settle_tenant(admission, 0)  # client went away
 
         def _embeddings(self) -> None:
             """OpenAI embeddings API over the on-device bge encoder (the
@@ -836,7 +999,9 @@ def make_handler(bridge: _EngineBridge, model_name: str,
 
         def _stream_response(self, ids, sampling, adapter=None,
                              top_logprobs: int = 0,
-                             include_usage: bool = False) -> None:
+                             include_usage: bool = False,
+                             priority: int = PRIORITY_INTERACTIVE,
+                             admission=None) -> None:
             from runbookai_tpu.model.jax_tpu import stream_text
 
             self.send_response(200)
@@ -869,8 +1034,8 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             # last chunk — OpenAI streams logprobs in the deltas.
             req_sink: list = []
             agen = stream_text(client.engine, client.tokenizer, ids,
-                               sampling, state=state, adapter=adapter,
-                               request_sink=req_sink,
+                               sampling, state=state, priority=priority,
+                               adapter=adapter, request_sink=req_sink,
                                request_id=getattr(self, "_request_id", None))
             lp_sent = 0
 
@@ -891,13 +1056,26 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 return out
 
             try:
-                for piece in bridge.stream(agen, timeout=request_timeout):
-                    payload = _chunk_payload(
-                        model_name, {"content": piece}, None, chunk_id)
-                    lp = chunk_logprobs()
-                    if lp is not None:
-                        payload["choices"][0]["logprobs"] = lp
-                    send_chunk(payload)
+                try:
+                    for piece in bridge.stream(agen,
+                                               timeout=request_timeout):
+                        payload = _chunk_payload(
+                            model_name, {"content": piece}, None, chunk_id)
+                        lp = chunk_logprobs()
+                        if lp is not None:
+                            payload["choices"][0]["logprobs"] = lp
+                        send_chunk(payload)
+                finally:
+                    # Settle the tenant reservation at the TRUE size: the
+                    # tokens the client actually received are billed even
+                    # on disconnect; the unused tail of the reservation is
+                    # refunded. Zero generated tokens means the engine
+                    # never served this request (shed / abort) — full
+                    # refund (sched/tenants.py).
+                    n_streamed = state.get("n_tokens", 0)
+                    self._settle_tenant(
+                        admission,
+                        (len(ids) + n_streamed) if n_streamed else 0)
                 # max_tokens truncation reports "length", like non-stream.
                 finish = ("length"
                           if not state.get("saw_stop")

@@ -99,6 +99,77 @@ class FleetRouterConfig(BaseModel):
     disagg: DisaggConfig = Field(default_factory=DisaggConfig)
 
 
+class TenantPolicyConfig(BaseModel):
+    """Limits for one tenant key (``llm.tenants.keys.<name>``) or the
+    anonymous pool (``llm.tenants.default``). Unset limit = unenforced.
+    Enforced by the OpenAI server BEFORE enqueue (sched/tenants.py): a
+    throttled request gets 429 + Retry-After and never consumes an
+    engine slot."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    # Requests per minute (token bucket, capacity = one minute's worth).
+    rate_limit_rpm: Optional[float] = Field(None, gt=0)
+    # Tokens per minute (prompt + completion; worst case reserved at
+    # admission, unused part refunded when the completion size is known).
+    token_budget_per_min: Optional[float] = Field(None, gt=0)
+    # Scheduling class of this tenant's requests; the x-priority header
+    # can DEMOTE a request (never promote past this class).
+    priority: Literal["interactive", "batch"] = "interactive"
+    # The secret that selects this tenant (Authorization: Bearer /
+    # x-api-key). SET THIS: the tenant's NAME (the llm.tenants.keys map
+    # key) appears verbatim in /tenants, `runbook tenants` and the
+    # runbook_tenant_* metric labels — with api_key unset, the name
+    # itself is matched as the bearer token, which is only acceptable
+    # for non-secret identifiers.
+    api_key: Optional[str] = None
+
+
+class TenantsConfig(BaseModel):
+    """Per-tenant (API-key) admission control (``llm.tenants``). Off by
+    default: the server then has zero tenant surface. Unknown/anonymous
+    keys share the ``default`` policy's ONE bucket set (bounded state —
+    arbitrary caller keys must not allocate server memory)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    enabled: bool = False
+    default: TenantPolicyConfig = Field(default_factory=TenantPolicyConfig)
+    # Tenant NAME -> policy. The name is the public identifier (metric
+    # labels, /tenants, CLI); the matching secret is the policy's
+    # api_key (falling back to the name itself when unset — only for
+    # non-secret identifiers).
+    keys: dict[str, TenantPolicyConfig] = Field(default_factory=dict)
+
+
+class SchedConfig(BaseModel):
+    """Engine scheduling policy (``llm.sched`` → sched/wdrr.py +
+    sched/feedback.py). See docs/SERVING.md "Scheduling and tenancy"."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    # "wdrr": weighted-deficit (stride) interleave of priority classes —
+    # a batch flood cannot starve interactive admits, and interactive
+    # load cannot starve batch. "priority": the classic strict
+    # priority-then-FCFS sort.
+    policy: Literal["wdrr", "priority"] = "wdrr"
+    # Admission share weights of the two canonical classes (wdrr only).
+    interactive_weight: float = Field(8.0, gt=0)
+    batch_weight: float = Field(1.0, gt=0)
+    # SLO feedback loop: adapt the mixed-dispatch prefill share from the
+    # live TPOT p95 burn ratio (requires llm.slo.tpot_p95_ms; fails at
+    # load without it). Off = bit-for-bit today's engine.
+    feedback: bool = False
+    feedback_interval_steps: int = Field(32, ge=1)
+    # Burn thresholds: shrink the prefill share above shrink_at, grow it
+    # back below grow_at (hysteresis band between them).
+    feedback_shrink_at: float = Field(1.0, gt=0)
+    feedback_grow_at: float = Field(0.7, gt=0)
+    # The share never shrinks below this fraction of the configured
+    # mixed budget's prefill side (clamped to one ragged block).
+    feedback_min_fraction: float = Field(0.25, gt=0, le=1.0)
+
+
 class SLOConfig(BaseModel):
     """Latency objectives (``llm.slo``) evaluated at scrape time against
     the engine's serving histograms (utils/slo.py). All targets are
@@ -193,6 +264,11 @@ class LLMConfig(BaseModel):
     # runbook_slo_{target_ms,current_ms,burn_ratio,violations_total} and
     # an "slo" block in /healthz. No objectives set = no SLO series.
     slo: SLOConfig = Field(default_factory=SLOConfig)
+    # Priority-class scheduling + SLO feedback (runbookai_tpu/sched/).
+    sched: SchedConfig = Field(default_factory=SchedConfig)
+    # Per-tenant (API-key) token budgets and rate limits, enforced by
+    # the OpenAI server before enqueue (runbookai_tpu/sched/tenants.py).
+    tenants: TenantsConfig = Field(default_factory=TenantsConfig)
     guided_json: bool = True  # token-level JSON grammar masks for complete()
 
 
@@ -506,6 +582,19 @@ def validate_config(config: Config) -> list[str]:
                 f"llm.fleet.disagg.prefill_replicas="
                 f"{disagg.prefill_replicas} leaves no decode tier in a "
                 f"dp_replicas={config.llm.dp_replicas} fleet")
+    if (config.llm.sched.feedback
+            and config.llm.slo.tpot_p95_ms is None):
+        problems.append(
+            "llm.sched.feedback: true requires llm.slo.tpot_p95_ms — the "
+            "controller's input signal (sched/feedback.py)")
+    sched = config.llm.sched
+    if sched.feedback_grow_at > sched.feedback_shrink_at:
+        # MixedBudgetController refuses this at engine build; the
+        # pre-flight validator must catch it first, not a serve crash.
+        problems.append(
+            f"llm.sched.feedback_grow_at={sched.feedback_grow_at} must "
+            f"be <= feedback_shrink_at={sched.feedback_shrink_at} "
+            f"(the hysteresis band would be inverted)")
     slack = config.incident.slack
     if (slack.enabled and slack.app_token
             and "mode" not in slack.model_fields_set):

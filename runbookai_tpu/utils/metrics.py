@@ -358,7 +358,10 @@ class Histogram(_Metric):
         bucket; the ``+Inf`` bucket clamps to the last finite bound).
         Accuracy is bounded by bucket width — good enough for tail-latency
         tracking (``bench.py`` p95s), not for exact SLO math."""
-        counts, total, _ = self._state(key)
+        return self._interpolate(self._state(key)[0], q)
+
+    def _interpolate(self, counts: list[float], q: float) -> Optional[float]:
+        total = sum(counts)
         if total == 0:
             return None
         target = max(1.0, math.ceil(q / 100.0 * total))
@@ -371,6 +374,25 @@ class Histogram(_Metric):
             cum += c
             lower = upper
         return self.buckets[-1]
+
+    def bucket_counts(self, key: tuple[str, ...] = ()) -> list[float]:
+        """Per-bucket observation counts (finite buckets + the ``+Inf``
+        overflow) — a snapshot for windowed percentiles."""
+        with self._lock:
+            state = list(self._hist.get(key)
+                         or [0.0] * (len(self.buckets) + 2))
+        return state[:-1]
+
+    def percentile_since(self, q: float, baseline: Sequence[float],
+                         key: tuple[str, ...] = ()) -> Optional[float]:
+        """q-th percentile of the observations made SINCE ``baseline``
+        (a prior :meth:`bucket_counts` snapshot) — the windowed view a
+        feedback controller needs: a process-lifetime percentile takes
+        hours of bad samples to move after a day of good ones. None when
+        the window is empty (or the histogram was reset under us)."""
+        counts = [max(0.0, now - then)
+                  for now, then in zip(self.bucket_counts(key), baseline)]
+        return self._interpolate(counts, q)
 
     def samples(self):
         out = []

@@ -22,8 +22,9 @@ unconfigured deployment scrapes no ``runbook_slo_*`` at all):
   percentile (bucket-interpolated; the series is absent until the
   histogram has observations);
 - ``runbook_slo_burn_ratio{objective=...}`` — current / target; > 1 means
-  the objective is burning. This is the feedback input ROADMAP item 4's
-  ``mixed_token_budget`` controller will consume;
+  the objective is burning. The sched/feedback.py controller consumes
+  the same objective WINDOWED (bucket-snapshot diffs via
+  :meth:`SLOMonitor.histogram`), not this lifetime gauge;
 - ``runbook_slo_violations_total{objective=...}`` — evaluations (scrapes
   and ``/healthz`` probes) that observed the objective breached. A rate
   over it is "fraction of recent looks that saw a breach", not a request
@@ -115,6 +116,13 @@ class SLOMonitor:
     def _histogram(self, key: str) -> Optional[metrics_mod.Histogram]:
         metric = self.registry.get(self.objectives[key]["hist"])
         return metric if isinstance(metric, metrics_mod.Histogram) else None
+
+    def histogram(self, key: str) -> Optional[metrics_mod.Histogram]:
+        """The live histogram behind an objective (None until the engine
+        registers it). Public so consumers that need WINDOWED views —
+        the sched/feedback controller diffs bucket snapshots per
+        decision window — can reach the source series."""
+        return self._histogram(key)
 
     def current_ms(self, key: str) -> Optional[float]:
         """The objective's live percentile in ms (None = no data yet)."""

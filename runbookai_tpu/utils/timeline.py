@@ -121,6 +121,7 @@ def build_timeline(spans: list[dict[str, Any]],
             ev["request"] = meta.get("request")
             ev["cached_tokens"] = meta.get("cached_tokens")
             ev["queue_ms"] = meta.get("queue_ms")
+            ev["cls"] = meta.get("cls")
         elif name == "router.place":
             hit = meta.get("affinity")
             ev["label"] = (f"router.place → replica {meta.get('replica')}"
@@ -198,7 +199,7 @@ def render_timeline(tl: dict[str, Any], max_events: int = 60) -> str:
             continue
         extras = []
         for key in ("k", "batch", "tokens", "prefill_rows", "generated",
-                    "cached_tokens", "queue_ms", "prompt_tokens",
+                    "cached_tokens", "queue_ms", "cls", "prompt_tokens",
                     "ttft_ms"):
             if ev.get(key) is not None:
                 extras.append(f"{key}={ev[key]}")
@@ -220,6 +221,7 @@ def lifecycle_summary(spans: list[dict[str, Any]]) -> dict[str, Any]:
     from runbookai_tpu.utils.trace import _percentile
 
     queue_ms: list[float] = []
+    by_class: dict[str, list[float]] = {}
     placements: dict[str, int] = {}
     affinity_hits = 0
     sheds = 0
@@ -231,6 +233,12 @@ def lifecycle_summary(spans: list[dict[str, Any]]) -> dict[str, Any]:
             admits += 1
             if meta.get("queue_ms") is not None:
                 queue_ms.append(float(meta["queue_ms"]))
+                # Per-priority-class breakdown (the admit event carries
+                # its class since the sched/ layer landed): the
+                # starvation picture — batch may legitimately wait,
+                # interactive must not.
+                cls = str(meta.get("cls") or "unknown")
+                by_class.setdefault(cls, []).append(float(meta["queue_ms"]))
         elif name == "router.place":
             replica = str(meta.get("replica", "?"))
             placements[replica] = placements.get(replica, 0) + 1
@@ -239,15 +247,23 @@ def lifecycle_summary(spans: list[dict[str, Any]]) -> dict[str, Any]:
         elif name == "router.shed":
             sheds += 1
     queue_ms.sort()
+
+    def _dist(values: list[float]) -> dict[str, Any]:
+        values = sorted(values)
+        return {
+            "count": len(values),
+            "p50": round(_percentile(values, 50), 3),
+            "p95": round(_percentile(values, 95), 3),
+            "max": round(values[-1], 3) if values else 0.0,
+        }
+
     out: dict[str, Any] = {
         "admissions": admits,
-        "queue_wait_ms": {
-            "count": len(queue_ms),
-            "p50": round(_percentile(queue_ms, 50), 3),
-            "p95": round(_percentile(queue_ms, 95), 3),
-            "max": round(queue_ms[-1], 3) if queue_ms else 0.0,
-        },
+        "queue_wait_ms": _dist(queue_ms),
     }
+    if by_class:
+        out["queue_wait_ms_by_class"] = {
+            cls: _dist(values) for cls, values in sorted(by_class.items())}
     if placements or sheds:
         total = sum(placements.values())
         out["router"] = {

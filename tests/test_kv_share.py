@@ -333,6 +333,53 @@ async def test_busy_source_churn_does_not_falsify_pull(bare_client):
     await fleet.stop()
 
 
+async def test_concurrent_admit_churn_never_stale_rejects(bare_client):
+    """Satellite regression for the per-chain staleness guard: a busy
+    source replica churns `KVCacheManager.version` on EVERY admit /
+    extend / release, so a replica-wide epoch compare would stale-reject
+    nearly every pull exactly when sharing matters. The guard is the
+    under-lock chain re-walk instead — so under CONCURRENT admit traffic
+    on the source, `runbook_router_xreplica_stale_total` must stay 0 and
+    the pulls must land pages."""
+    import asyncio
+
+    client = JaxTpuClient.for_testing(max_new_tokens=16, dp_replicas=2)
+    fleet = AsyncFleet(client.cores,
+                       FleetConfig(affinity=False, kv_share=True))
+    prompt = ids("churny source: stable prefix page chain 07")
+    out1 = await fleet.generate(prompt, sp())
+    stale0 = fleet._m_pull_stale.value
+    pulled_total = 0
+    for round_idx in range(3):
+        placement = await _pull_placement(fleet, prompt, tries=4)
+        src = placement.pull_src
+        version0 = client.cores[src].kv.version
+
+        async def churn(i, src=src):
+            return await fleet.replicas[src].generate(
+                ids(f"concurrent admit churn traffic {i:02d}"), sp(4))
+
+        # Concurrent admits in flight on the source WHILE the pull
+        # executes: every admit/extend/release bumps the version epoch.
+        churns = [asyncio.ensure_future(churn(3 * round_idx + i))
+                  for i in range(3)]
+        pulled = await fleet._execute_pull(placement, prompt, 0)
+        await asyncio.gather(*churns)
+        pulled_total += pulled
+        assert client.cores[src].kv.version > version0  # churn happened
+        # Drop the destination's freshly-pulled pages so the next round
+        # plans a pull again (recycling every free+retired page).
+        dst_kv = client.cores[placement.idx].kv
+        taken = dst_kv.allocator.alloc(dst_kv.allocator.free_pages)
+        dst_kv.allocator.free(taken)
+    assert pulled_total > 0
+    assert fleet._m_pull_stale.value == stale0  # ZERO stale rejections
+    # The pulled pages serve byte-identical streams.
+    out2 = await fleet.generate(prompt, sp())
+    assert out2.token_ids == out1.token_ids
+    await fleet.stop()
+
+
 async def test_mid_pull_preemption_degrades_to_recompute():
     client = JaxTpuClient.for_testing(max_new_tokens=16, dp_replicas=2)
     fleet = AsyncFleet(client.cores,

@@ -746,3 +746,18 @@ class TestTreeIsClean:
         fleet = self._rbk002_sites(
             ROOT / "runbookai_tpu" / "engine" / "fleet.py")
         assert fleet == {}, fleet
+
+    def test_sched_package_has_zero_noqa_sites(self):
+        """The scheduler/admission subsystem is pure host-side control
+        code: no device syncs, no blocking I/O under locks, nothing to
+        sanction. ZERO `runbook: noqa` markers — a suppression appearing
+        here means control-path code started doing data-path work."""
+        sched_files = sorted(
+            (ROOT / "runbookai_tpu" / "sched").glob("*.py"))
+        assert sched_files, "sched package missing"
+        for path in sched_files:
+            assert "runbook: noqa" not in path.read_text(), (
+                f"unexpected noqa marker in {path}")
+        findings = analyze_paths([ROOT / "runbookai_tpu" / "sched"],
+                                 root=ROOT)
+        assert findings == [], "\n".join(f.format() for f in findings)

@@ -29,11 +29,12 @@ from runbookai_tpu.utils.timeline import (
 
 
 def rec(i, kind="decode", **kw):
-    base = {"ts": float(i), "kind": kind, "tokens": 2, "batch": 1,
-            "occupancy": 0.25, "queue_depth": 0, "kv_free_pages": 10,
-            "kv_utilization": 0.1, "dispatch_s": 0.001, "host_s": 0.0005,
-            "overlap_s": 0.0, "wall_s": 0.002, "preemptions": 0,
-            "kv_imported": 0, "kv_exported": 0}
+    base = {"ts": float(i), "kind": kind, "classes": {}, "tokens": 2,
+            "batch": 1, "occupancy": 0.25, "queue_depth": 0,
+            "kv_free_pages": 10, "kv_utilization": 0.1,
+            "dispatch_s": 0.001, "host_s": 0.0005, "overlap_s": 0.0,
+            "wall_s": 0.002, "preemptions": 0, "kv_imported": 0,
+            "kv_exported": 0}
     base.update(kw)
     return base
 
