@@ -109,6 +109,16 @@ LAST_BANKED_TPU = {
 }
 
 
+def token_streams_digest(token_lists) -> str:
+    """Digest of a list of output token streams, in submission order —
+    equal digests across two arms prove they served byte-identical
+    per-request streams (the --dp and --models contracts)."""
+    import hashlib
+
+    return hashlib.md5(json.dumps(
+        [list(map(int, ids)) for ids in token_lists]).encode()).hexdigest()
+
+
 def make_result(value: float, unit: str, details: dict) -> dict:
     return {
         "metric": "decode_tokens_per_sec_per_chip",
@@ -448,6 +458,25 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     def resolve_impl(value: str, default: str) -> str:
         return default if value == "auto" else value
 
+    models_env = os.environ.get("BENCH_MODELS")
+    if models_env:
+        # Multi-model fleet arm (`--models A,B[:dp]`): interleaved
+        # traffic across named model groups through ONE fleet, with
+        # per-model digests proven byte-identical to dedicated
+        # single-model engines. A plan is per model×topology and the
+        # dp/classes arms are single-model — refusing beats silently
+        # measuring something else.
+        if plan is not None or os.environ.get("BENCH_DP") \
+                or os.environ.get("BENCH_CLASSES"):
+            raise ValueError(
+                "BENCH_MODELS measures the multi-model fleet arm and "
+                "does not compose with --plan/--dp/--classes (run them "
+                "as separate arms; per-group plans belong in llm.models)")
+        run_multimodel_bench(models_env, probe, n_requests=n_requests,
+                             prompt_len=prompt_len, new_tokens=new_tokens,
+                             on_accel=on_accel)
+        return
+
     overlap = (os.environ["BENCH_OVERLAP"] != "0"
                if "BENCH_OVERLAP" in os.environ
                else bool(pick("overlap_decode", True)))
@@ -613,15 +642,9 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         tail = rng.integers(0, 256, size=prompt_len - shared_len).tolist()
         return head + tail
 
-    def outputs_digest(token_lists) -> str:
-        """Digest of every request's output token stream, in submission
-        order — equal digests across the dp=1 and dp=N arms prove the
-        fleet served byte-identical per-request streams."""
-        import hashlib
-
-        return hashlib.md5(json.dumps(
-            [list(map(int, ids)) for ids in token_lists]).encode()
-        ).hexdigest()
+    # Digest of every request's output token stream, in submission order —
+    # equal digests across arms prove byte-identical per-request streams.
+    outputs_digest = token_streams_digest
 
     if os.environ.get("BENCH_CLASSES"):
         if os.environ.get("BENCH_DP") or plan is not None:
@@ -833,6 +856,190 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     if not probe.get("ok", True):
         details["tpu_error"] = probe.get("error")
     emit(round(decode_tps, 2), "tok/s", details)
+
+
+def parse_models_spec(spec: str) -> list[tuple[str, int]]:
+    """``A,B:2`` -> [("A", 1), ("B", 2)] — validated against the model
+    catalog; at least two distinct groups (one group is just --dp)."""
+    from runbookai_tpu.models.llama import CONFIGS
+
+    groups: list[tuple[str, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, dp_s = part.partition(":")
+        if name not in CONFIGS:
+            raise ValueError(f"--models: unknown model config {name!r} "
+                             f"(see models/llama.CONFIGS)")
+        groups.append((name, max(1, int(dp_s or 1))))
+    names = [n for n, _ in groups]
+    if len(groups) < 2 or len(set(names)) != len(names):
+        raise ValueError("--models needs >= 2 distinct model configs "
+                         "(a one-group fleet is just --dp)")
+    return groups
+
+
+def run_multimodel_bench(models_spec: str, probe: dict, *, n_requests,
+                         prompt_len, new_tokens, on_accel) -> None:
+    """The ``--models`` arm: the same interleaved request set served two
+    ways — (a) dedicated single-model engines, one per group, each
+    serving its own per-model subset; (b) ONE multi-model fleet
+    (runbookai_tpu/fleet) routing every request by its model name. Same
+    per-group EngineConfig, same seeded params per group, greedy
+    sampling — so the per-model output digests must be EQUAL across the
+    arms: model-aware routing chooses a group's replica, it never
+    changes what that replica samples. The headline is the fleet arm's
+    aggregate decode rate; per-group throughput rides in details."""
+    import asyncio
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from runbookai_tpu.engine.engine import EngineConfig, EngineCore
+    from runbookai_tpu.engine.fleet import AsyncFleet, build_engine_fleet
+    from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+    from runbookai_tpu.fleet.multimodel import ModelGroup, MultiModelFleet
+    from runbookai_tpu.models.llama import CONFIGS, init_params
+    from runbookai_tpu.utils.tokens import ByteTokenizer
+    from runbookai_tpu.utils.weights import quality_marker
+
+    groups = parse_models_spec(models_spec)
+    dtype = jnp.bfloat16 if on_accel else jnp.float32
+    slots = int(os.environ.get("BENCH_SLOTS", 4))
+    num_pages = int(os.environ.get("BENCH_PAGES", 512))
+    ecfg = EngineConfig(
+        page_size=16, num_pages=num_pages, max_batch_slots=slots,
+        prefill_chunk=128, max_seq_len=2048, kv_dtype=dtype,
+        decode_steps_per_dispatch=8,
+        attn_impl="pallas" if on_accel else "xla")
+    tok = ByteTokenizer()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, size=prompt_len).tolist()
+               for _ in range(n_requests)]
+    # Submission-order interleave: request i belongs to group i % G, so
+    # both arms serve identical per-model subsets in identical order.
+    assign = [i % len(groups) for i in range(n_requests)]
+    sampling = SamplingParams(temperature=0.0, max_new_tokens=new_tokens,
+                              stop_token_ids=())
+    params = {name: init_params(jax.random.PRNGKey(1000 + gi),
+                                CONFIGS[name], dtype=dtype)
+              for gi, (name, _) in enumerate(groups)}
+
+    # Arm (a): dedicated single-model engines — the byte-identity
+    # baseline. Unmeasured (digests only); each engine is released
+    # before the fleet arm builds.
+    dedicated_digests = {}
+    for gi, (name, _dp) in enumerate(groups):
+        core = EngineCore(CONFIGS[name], params[name], tok, ecfg)
+        reqs = [EngineRequest(prompt_ids=list(p), sampling=sampling)
+                for p, a in zip(prompts, assign) if a == gi]
+        for r in reqs:
+            core.submit(r)
+        core.run_until_idle()
+        dedicated_digests[name] = token_streams_digest(
+            [r.all_out_ids for r in reqs])
+        del core
+
+    # Arm (b): one multi-model fleet — global replica indices assigned
+    # contiguously across groups AND disjoint carved device slices,
+    # exactly like fleet/build.py (without the carve, a dp>1 group
+    # would slice jax.devices() from 0 while a dp=1 sibling timeshares
+    # device 0 — per-group tok_s measured under hidden contention).
+    all_devices = list(jax.devices())
+    total_dp = sum(dp for _, dp in groups)
+    carve = len(all_devices) >= total_dp
+    start = 0
+    model_groups = []
+    for gi, (name, dp) in enumerate(groups):
+        import dataclasses as _dc
+
+        cores = build_engine_fleet(
+            CONFIGS[name], params[name], tok,
+            _dc.replace(ecfg, dp_replicas=dp),
+            replica_indices=list(range(start, start + dp)),
+            devices=(all_devices[start:start + dp] if carve else []),
+            pin_devices=carve)
+        start += dp
+        model_groups.append(ModelGroup(
+            name=name, tokenizer=tok,
+            fleet=AsyncFleet(cores, model_label=name,
+                             clear_labeled=(gi == 0))))
+    fleet = MultiModelFleet(model_groups)
+    all_cores = fleet.cores
+    # Warmup compiles each group's program shapes outside the measured
+    # window (fresh rng stream — the measured prompts stay untouched).
+    warm_rng = np.random.default_rng(10_007)
+    for g in model_groups:
+        for core in g.cores:
+            core.submit(EngineRequest(
+                prompt_ids=warm_rng.integers(
+                    0, 256, size=prompt_len).tolist(),
+                sampling=SamplingParams(temperature=0.0,
+                                        max_new_tokens=new_tokens,
+                                        stop_token_ids=())))
+            core.run_until_idle()
+            reset_warmup_metrics(core)
+
+    async def _run():
+        outs = await asyncio.gather(*[
+            fleet.generate(list(p), sampling, model=groups[a][0])
+            for p, a in zip(prompts, assign)])
+        await fleet.stop()
+        return outs
+
+    t0 = _time.perf_counter()
+    outs = asyncio.run(_run())
+    wall = _time.perf_counter() - t0
+
+    per_model = {}
+    identical = True
+    for gi, (name, dp) in enumerate(groups):
+        g_outs = [o for o, a in zip(outs, assign) if a == gi]
+        digest = token_streams_digest([o.token_ids for o in g_outs])
+        match = digest == dedicated_digests[name]
+        identical = identical and match
+        g_cores = fleet.groups[name].cores
+        decode = sum(c.metrics["decode_tokens"] for c in g_cores)
+        decode_t = max(c.metrics["decode_time_s"] for c in g_cores)
+        per_model[name] = {
+            "dp": dp,
+            "requests": len(g_outs),
+            "decode_tokens": decode,
+            "tok_s": round(decode / max(decode_t, 1e-9), 2),
+            "lost_requests": sum(1 for o in g_outs
+                                 if o.finish_reason.value == "aborted"),
+            "outputs_digest": digest,
+            "dedicated_digest": dedicated_digests[name],
+            "byte_identical": match,
+        }
+    total_decode = sum(c.metrics["decode_tokens"] for c in all_cores)
+    max_decode_t = max(c.metrics["decode_time_s"] for c in all_cores)
+    from runbookai_tpu.autotune.plan import engine_config_dict
+
+    details = {
+        "engine_config": engine_config_dict(all_cores[0].ecfg),
+        "models": [name for name, _ in groups],
+        "multi_model": True,
+        "weights": str(jnp.dtype(dtype).name),
+        "quality": quality_marker(None),
+        "platform": probe.get("platform"),
+        "device_kind": probe.get("kind"),
+        "requests": n_requests,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "batch_slots_per_replica": slots,
+        "num_pages_per_replica": num_pages,
+        "wall_s": round(wall, 2),
+        "total_throughput_tok_s": round(
+            (total_decode + sum(c.metrics["prefill_tokens"]
+                                for c in all_cores)) / wall, 2),
+        "per_model": per_model,
+        "byte_identical": identical,
+    }
+    emit(round(total_decode / max(max_decode_t, 1e-9), 2), "tok/s",
+         details)
 
 
 def run_classes_bench(cfg, params, tok, ecfg, masker, probe, *,
@@ -1248,6 +1455,21 @@ def run_inner(model_name: str, on_accel: bool, probe: dict) -> None:
             except ValueError:
                 dp = 1  # invalid plans fail in run_bench with
                 # load_plan's real error, not here
+        models_env = os.environ.get("BENCH_MODELS")
+        if models_env:
+            # A multi-model CPU fleet needs one virtual device per
+            # TOTAL replica across groups (spec parse errors fall
+            # through to run_bench, which raises the real message).
+            total = 0
+            for part in models_env.split(","):
+                part = part.strip()
+                if part:
+                    _, _, dp_s = part.partition(":")
+                    try:
+                        total += max(1, int(dp_s or 1))
+                    except ValueError:
+                        total += 1
+            dp = max(dp, total)
         force_cpu_platform(max(1, dp))
     try:
         run_bench(model_name, on_accel, probe)
@@ -1345,6 +1567,18 @@ def main() -> None:
             os.environ["BENCH_DISAGG"] = sys.argv.pop(i)
         else:
             os.environ["BENCH_DISAGG"] = "1"
+    if "--models" in sys.argv:
+        # Multi-model fleet A/B: `--models A,B[:dp]` serves interleaved
+        # per-model traffic through one fleet; per-model digests must
+        # equal dedicated single-model engines'. Does not compose with
+        # --plan/--dp/--classes (refused in run_bench).
+        i = sys.argv.index("--models")
+        sys.argv.pop(i)
+        if i >= len(sys.argv) or sys.argv[i].startswith("-"):
+            print("usage: bench.py --models A,B[:dp] (model config names)",
+                  file=sys.stderr)
+            sys.exit(2)
+        os.environ["BENCH_MODELS"] = sys.argv.pop(i)
     if "--plan" in sys.argv:
         # Pin the engine config to a `runbook tune` serving-plan artifact
         # (explicit BENCH_* env still overrides individual plan keys).
@@ -1379,6 +1613,7 @@ def main() -> None:
     dp_env = os.environ.pop("BENCH_DP", None)
     plan_env = os.environ.pop("BENCH_PLAN", None)
     classes_env = os.environ.pop("BENCH_CLASSES", None)
+    models_env = os.environ.pop("BENCH_MODELS", None)
     try:
         cpu_sanity = _spawn_inner(
             os.environ.get("BENCH_CPU_MODEL", "llama3-test"), False,
@@ -1390,6 +1625,8 @@ def main() -> None:
             os.environ["BENCH_PLAN"] = plan_env
         if classes_env is not None:
             os.environ["BENCH_CLASSES"] = classes_env
+        if models_env is not None:
+            os.environ["BENCH_MODELS"] = models_env
     sanity_line = None
     if cpu_sanity is not None:
         d = cpu_sanity.get("details", {})
@@ -1419,6 +1656,7 @@ def main() -> None:
             os.environ.get("BENCH_DP", "1") in ("", "1") and \
             "BENCH_PLAN" not in os.environ and \
             "BENCH_CLASSES" not in os.environ and \
+            "BENCH_MODELS" not in os.environ and \
             os.environ.get("BENCH_CPU_MODEL", "llama3-test") == model_name:
         # The fallback headline IS the cpu-sanity config — don't run it
         # twice. (A --dp run's headline is the fleet arm, and a --plan

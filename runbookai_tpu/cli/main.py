@@ -528,13 +528,18 @@ def cmd_simulate(args) -> int:
               f"{', '.join(sorted(FAULT_TYPES))}", file=sys.stderr)
         return 1
 
+    models = [m for m in (getattr(args, "models", None) or "").split(",")
+              if m] or None
+
     if args.sim_cmd == "generate":
         scenarios = generate_scenarios(
             args.n, seed=args.seed, fault_type=args.fault,
-            adversarial=getattr(args, "adversarial", None))
+            adversarial=getattr(args, "adversarial", None), models=models)
         paths = write_scenarios(scenarios, args.out)
         for s, p in zip(scenarios, paths):
             line = f"{s.scenario_id}  {s.truth['fault_type']:22s}  {p}"
+            if s.model:
+                line += f"  model={s.model}"
             if args.reveal:
                 line += f"\n    truth: {s.truth['root_cause']}"
             print(line)
@@ -586,13 +591,15 @@ def cmd_simulate(args) -> int:
     if args.sim_cmd == "eval":
         scenarios = generate_scenarios(
             args.n, seed=args.seed, fault_type=args.fault,
-            adversarial=getattr(args, "adversarial", None))
+            adversarial=getattr(args, "adversarial", None), models=models)
         cases = [to_eval_case(s) for s in scenarios]
         # Per-family + adversarial-split accuracy (VERDICT r4 #4): the
         # breakdown is what separates reasoning from keyword overlap.
+        # Multi-model runs add a per-served-model split next to them.
         labels = {s.scenario_id: {
             "fault_family": s.truth["fault_type"],
             "adversarial": s.truth.get("adversarial", "none"),
+            **({"model": s.model} if s.model else {}),
         } for s in scenarios}
         # Deterministic triage baseline: what timeline+topology analysis
         # alone scores (agent/signal_triage.py) — the floor any LLM-led
@@ -649,12 +656,26 @@ def cmd_serve(args) -> int:
         print("serve requires llm.provider: jax-tpu (a real engine to serve)",
               file=sys.stderr)
         return 1
+    problems = [p for p in validate_config(config) if "llm." in p]
+    if problems:
+        for p in problems:
+            print(f"config error: {p}", file=sys.stderr)
+        return 1
     client = JaxTpuClient.from_config(config.llm)
+    # Multi-model fleets serve under the DEFAULT group's name; the
+    # request's model field selects any group (GET /v1/models lists all).
+    served_name = (config.llm.models[0].name if config.llm.models
+                   else config.llm.model)
+    if client.multi_model is not None:
+        groups = ", ".join(
+            f"{g.name} (dp={g.fleet.dp})"
+            for g in client.multi_model.groups.values())
+        print(f"multi-model fleet: {groups}", file=sys.stderr)
     # Surface the serving memory plan (engine/memory_plan.py) so operators
     # see what their context/batch choice costs before traffic arrives.
     from runbookai_tpu.models.llama import CONFIGS as _MODEL_CONFIGS
 
-    if config.llm.model in _MODEL_CONFIGS:
+    if not config.llm.models and config.llm.model in _MODEL_CONFIGS:
         from runbookai_tpu.engine.memory_plan import plan_serving
 
         plan = plan_serving(
@@ -685,11 +706,11 @@ def cmd_serve(args) -> int:
     elif emb_cfg.enabled:
         print("note: /v1/embeddings disabled — set knowledge.embedder."
               "model_path to serve real bge embeddings", file=sys.stderr)
-    server = OpenAIServer(client, model_name=config.llm.model,
+    server = OpenAIServer(client, model_name=served_name,
                           host=args.host, port=args.port,
                           allow_runtime_adapters=args.allow_adapter_loading,
                           embedder=embedder)
-    print(f"serving {config.llm.model} at http://{args.host}:{server.port}/v1 "
+    print(f"serving {served_name} at http://{args.host}:{server.port}/v1 "
           f"(POST /v1/chat/completions"
           + (", /v1/embeddings" if embedder else "")
           + ", GET /v1/models, /healthz, /metrics, /debug/steps)")
@@ -1288,6 +1309,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="harden scenarios: stale red-herring signals on a non-culprit "
              "service, a concurrent second fault, or a dropped telemetry "
              "modality")
+    sim_gen.add_argument(
+        "--models", default=None, metavar="A,B",
+        help="assign served model groups round-robin (multi-model "
+             "fleets, llm.models) so eval load exercises model routing")
     sim_sub.add_parser("faults", help="list fault types")
     sim_inv = sim_sub.add_parser("investigate",
                                  help="run the agent against a scenario")
@@ -1305,6 +1330,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--adversarial", default=None,
         choices=["misleading_symptom", "two_fault", "signal_dropout", "mix"],
         help="run the hardened split (reported separately in breakdown)")
+    sim_eval.add_argument(
+        "--models", default=None, metavar="A,B",
+        help="round-robin cases across served model groups (llm.models); "
+             "per-model pass rates land in the breakdown and "
+             "summary.json gains model_attribution")
     sim_prov = sim_sub.add_parser(
         "provision",
         help="real-infra mode: map a scenario onto actual AWS breakage "

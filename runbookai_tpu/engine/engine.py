@@ -1029,7 +1029,15 @@ class EngineCore:
                          "runbook_replica_waiting_requests",
                          "runbook_replica_kv_pool_utilization",
                          "runbook_replica_decode_tokens_total",
-                         "runbook_router_imbalance_ratio"):
+                         "runbook_router_imbalance_ratio",
+                         # Multi-model rollups (fleet/multimodel.py):
+                         # falling back to one engine must release the
+                         # dead groups' cores exactly like the replica
+                         # gauges above.
+                         "runbook_model_running_requests",
+                         "runbook_model_waiting_requests",
+                         "runbook_model_kv_pool_utilization",
+                         "runbook_model_decode_tokens_total"):
                 stale = reg.get(name)
                 if stale is not None:
                     stale.clear_functions()

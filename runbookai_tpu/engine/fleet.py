@@ -178,6 +178,84 @@ def split_engine_budget(engine_cfg: EngineConfig, dp: int) -> EngineConfig:
         prefill_batch=max(1, min(engine_cfg.prefill_batch, slots_per)))
 
 
+def _agg_utilization(cores: Sequence[EngineCore]) -> float:
+    usable = sum(c.kv.allocator.num_pages - 1 for c in cores)
+    used = sum(c.kv.pages_in_use for c in cores)
+    return used / usable if usable > 0 else 0.0
+
+
+def _agg_prefix_hit_ratio(cores: Sequence[EngineCore]) -> float:
+    cached = sum(c.metrics.get("cached_prefix_tokens", 0) for c in cores)
+    total = cached + sum(c.metrics.get("prefill_tokens", 0) for c in cores)
+    return cached / total if total else 0.0
+
+
+def _agg_overlap_ratio(cores: Sequence[EngineCore]) -> float:
+    host = sum(c.metrics.get("decode_host_time_s", 0.0) for c in cores)
+    overlap = sum(c.metrics.get("decode_host_overlap_s", 0.0)
+                  for c in cores)
+    return overlap / host if host > 0 else 0.0
+
+
+def install_fleet_aggregates(cores: Sequence[EngineCore]) -> None:
+    """Re-bind the unlabeled engine metric names to aggregates over
+    ``cores`` — the fleet-wide truth an existing single-engine dashboard
+    keeps reading. Last bind wins: a single fleet binds its own replicas
+    here; a multi-model fleet calls this once more with the union of
+    every group's cores so the process-wide names cover all groups."""
+    cores = list(cores)
+    reg = metrics_mod.get_registry()
+    reg.gauge("runbook_running_requests",
+              "Requests holding a decode slot").set_function(
+        lambda: sum(len(c.decoding) for c in cores))
+    reg.gauge("runbook_waiting_requests",
+              "Requests queued or prefilling").set_function(
+        lambda: sum(len(c.waiting) + len(c.prefilling) for c in cores))
+    g_cls_wait = reg.gauge(
+        "runbook_sched_waiting_requests",
+        "Requests queued or prefilling, per priority class",
+        labels=("cls",))
+    g_cls_wait.clear_functions()
+    for label in ("interactive", "batch", "other"):
+        g_cls_wait.labels(cls=label).set_function(
+            lambda lb=label: float(sum(
+                1 for c in cores
+                for r in list(c.waiting) + list(c.prefilling)
+                if class_label(r.priority) == lb)))
+    reg.gauge("runbook_kv_pages_total", "KV pool size in pages"
+              ).set_function(
+        lambda: sum(c.kv.allocator.num_pages for c in cores))
+    reg.gauge("runbook_kv_pages_in_use",
+              "KV pages referenced by live sequences").set_function(
+        lambda: sum(c.kv.pages_in_use for c in cores))
+    reg.gauge("runbook_kv_pages_cached",
+              "Retired-but-resident prefix-cache pages").set_function(
+        lambda: sum(c.kv.allocator.cached_pages for c in cores))
+    reg.counter("runbook_kv_spill_pages_total",
+                "KV pages captured into the host spill tier at "
+                "eviction time").set_function(
+        lambda: float(sum(c.kv.spill.pages_spilled for c in cores
+                          if c.kv.spill)))
+    reg.counter("runbook_kv_spill_evictions_total",
+                "Spill-tier pages dropped by its LRU bound"
+                ).set_function(
+        lambda: float(sum(c.kv.spill.evictions for c in cores
+                          if c.kv.spill)))
+    reg.gauge("runbook_kv_pool_utilization",
+              "Fraction of allocatable KV pages held by live sequences"
+              ).set_function(lambda: _agg_utilization(cores))
+    reg.gauge("runbook_prefix_cache_hit_ratio",
+              "Cached prompt tokens / (cached + prefilled) since start"
+              ).set_function(lambda: _agg_prefix_hit_ratio(cores))
+    reg.gauge("runbook_decode_overlap_ratio",
+              "Fraction of host decode work hidden behind device "
+              "execution by the lagged pipeline (0 in forced-sync mode)"
+              ).set_function(lambda: _agg_overlap_ratio(cores))
+    for key, name, help_text in LEGACY_COUNTER_EXPORTS:
+        reg.counter(name, help_text).set_function(
+            lambda k=key: float(sum(c.metrics.get(k, 0) for c in cores)))
+
+
 def build_engine_fleet(
     model_cfg,
     params,
@@ -192,6 +270,7 @@ def build_engine_fleet(
     draft_worker_factory: Optional[Callable[[int], Any]] = None,
     devices: Optional[Sequence[Any]] = None,
     replica_indices: Optional[Sequence[int]] = None,
+    pin_devices: bool = False,
 ) -> list[EngineCore]:
     """Construct the fleet's ``EngineCore`` replicas.
 
@@ -207,6 +286,9 @@ def build_engine_fleet(
     ``devices=jax.local_devices()`` so replicas never span hosts.
     ``draft_worker_factory(i)`` builds a per-replica draft worker (one
     worker cannot serve two cores — its slot state is per-engine).
+    ``pin_devices`` pins params/mesh to the computed slice even for a
+    single-replica build — a multi-model fleet's dp=1 groups must each
+    own THEIR device, not all share the default one.
     """
     import jax
 
@@ -242,7 +324,7 @@ def build_engine_fleet(
     for pos, i in enumerate(indices):
         mesh_i = None
         params_i = params
-        if dp > 1 and slices[pos] is not None:
+        if (dp > 1 or pin_devices) and slices[pos] is not None:
             mesh_i = build_mesh(devices=slices[pos])
             # DP means replicated weights: each replica's slice holds its
             # own copy, placed once here so per-dispatch transfers never
@@ -260,13 +342,27 @@ def build_engine_fleet(
 
 
 class AsyncFleet:
-    """AsyncEngine-compatible facade over N replicas + the router."""
+    """AsyncEngine-compatible facade over N replicas + the router.
+
+    ``model_label`` names the served model this fleet's metric series
+    carry (``runbook_router_*{model=...}`` / ``runbook_replica_*``) —
+    a multi-model fleet (``runbookai_tpu/fleet``) builds one AsyncFleet
+    per model group, so the label is what separates the groups on a
+    dashboard. Default: the model config's own name. ``clear_labeled``
+    controls whether construction drops every existing labelset callback
+    first (the single-fleet rebuild behavior); a multi-model builder
+    clears once for its first group so sibling groups' bindings survive.
+    """
 
     def __init__(self, cores: Sequence[EngineCore],
-                 fleet_cfg: Optional[FleetConfig] = None):
+                 fleet_cfg: Optional[FleetConfig] = None,
+                 model_label: Optional[str] = None,
+                 clear_labeled: bool = True):
         if not cores:
             raise ValueError("a fleet needs at least one EngineCore")
         self.cores = list(cores)
+        self.model = (model_label
+                      or getattr(cores[0].cfg, "name", None) or "default")
         self.replicas = [AsyncEngine(core) for core in self.cores]
         self.dp = len(self.cores)
         # GLOBAL replica ids for everything operator-facing (metric
@@ -307,7 +403,7 @@ class AsyncFleet:
         self._rr = 0
         self._affinity_hits = 0
         self._case_routes: dict[str, dict[int, int]] = {}
-        self._install_metrics()
+        self._install_metrics(clear=clear_labeled)
 
     # ------------------------------------------------------------- routing
 
@@ -374,6 +470,7 @@ class AsyncFleet:
                 # a stored gauge, so a dashboard can join placement
                 # choices against the backlog they were made under.
                 self._m_depth.labels(
+                    model=self.model,
                     replica=str(self.replica_ids[i])).set(depth)
             if self._kv_share and matched:
                 sources.append((i, matched))
@@ -424,7 +521,8 @@ class AsyncFleet:
                 per = self._case_routes.setdefault(case, {})
                 gid = self.replica_ids[pick]
                 per[gid] = per.get(gid, 0) + 1
-        self._m_requests.labels(replica=str(self.replica_ids[pick])).inc()
+        self._m_requests.labels(
+            model=self.model, replica=str(self.replica_ids[pick])).inc()
         tracer = get_tracer()
         if tracer.enabled:
             meta = {"replica": self.replica_ids[pick],
@@ -522,7 +620,8 @@ class AsyncFleet:
             return None    # fail the request; decode tier recomputes
         if out.finish_reason is FinishReason.ABORTED:
             return None  # prefill pool pressure — recompute on decode tier
-        self._m_warm.labels(replica=str(self.replica_ids[pick])).inc()
+        self._m_warm.labels(model=self.model,
+                            replica=str(self.replica_ids[pick])).inc()
         return pick
 
     # ----------------------------------------------------- AsyncEngine API
@@ -671,155 +770,125 @@ class AsyncFleet:
             hits, total = self._affinity_hits, sum(self._routed)
         return hits / total if total else 0.0
 
-    def _install_metrics(self) -> None:
-        """Router metrics + per-replica labeled gauges, and the unlabeled
+    def _install_metrics(self, clear: bool = True) -> None:
+        """Router metrics + per-replica labeled gauges — every series
+        carries the fleet's ``model`` label so a multi-model deployment
+        separates its groups with plain PromQL — and the unlabeled
         engine names re-bound to cross-replica aggregates so an existing
-        dashboard keeps reading fleet-wide truth. Labeled callbacks are
-        cleared first: a larger previous fleet's stale replica labelsets
-        must not keep scraping dead engines."""
+        dashboard keeps reading fleet-wide truth. With ``clear``, labeled
+        callbacks are dropped first: a larger previous fleet's stale
+        replica labelsets must not keep scraping dead engines."""
         reg = metrics_mod.get_registry()
+        model = self.model
         self._m_requests = reg.counter(
             "runbook_router_requests_total",
-            "Requests placed by the fleet router", labels=("replica",))
+            "Requests placed by the fleet router",
+            labels=("model", "replica"))
         self._m_affinity = reg.counter(
             "runbook_router_affinity_hits_total",
             "Placements onto a replica already holding the request's "
-            "prefix pages (>= one full page matched)")
+            "prefix pages (>= one full page matched)",
+            labels=("model",)).labels(model=model)
         self._m_retries = reg.counter(
             "runbook_router_retries_total",
-            "Cross-replica retries after a replica aborted on pool pressure")
+            "Cross-replica retries after a replica aborted on pool "
+            "pressure", labels=("model",)).labels(model=model)
         self._m_shed = reg.counter(
             "runbook_router_shed_total",
-            "Requests shed with every replica over shed_queue_depth")
+            "Requests shed with every replica over shed_queue_depth",
+            labels=("model",)).labels(model=model)
         # Fleet-wide KV page sharing (docs/observability.md): pulls that
         # landed pages, pages moved, wall spent moving them, and pulls
         # whose planned pages were gone by export time.
         self._m_xreplica_hits = reg.counter(
             "runbook_router_xreplica_hits_total",
             "Placements whose prefix pages were pulled from a sibling "
-            "replica instead of re-prefilled")
+            "replica instead of re-prefilled",
+            labels=("model",)).labels(model=model)
         self._m_xreplica_pages = reg.counter(
             "runbook_router_xreplica_pages_pulled_total",
             "KV pages pulled across replicas (cross-replica prefix hits "
-            "+ prefill-tier handoffs)")
+            "+ prefill-tier handoffs)",
+            labels=("model",)).labels(model=model)
         self._m_xreplica_seconds = reg.counter(
             "runbook_router_xreplica_pull_seconds_total",
-            "Wall seconds spent exporting+importing pulled KV pages")
+            "Wall seconds spent exporting+importing pulled KV pages",
+            labels=("model",)).labels(model=model)
         self._m_pull_stale = reg.counter(
             "runbook_router_xreplica_stale_total",
             "Planned pulls whose pages were gone by export time — the "
-            "under-lock chain re-walk found nothing (recomputed instead)")
+            "under-lock chain re-walk found nothing (recomputed instead)",
+            labels=("model",)).labels(model=model)
         self._m_warm = reg.counter(
             "runbook_router_prefill_tier_warms_total",
-            "Disaggregated prefill-tier warm prefills", labels=("replica",))
+            "Disaggregated prefill-tier warm prefills",
+            labels=("model", "replica"))
         # Stored-value gauge (not a callback): the waiting+prefilling
         # depth each candidate replica showed at the LAST routing
         # decision — joins placements against the backlog they saw.
         self._m_depth = reg.gauge(
             "runbook_router_observed_queue_depth",
             "Waiting+prefilling depth per replica as observed by the "
-            "router at its most recent placement", labels=("replica",))
-        reg.gauge(
+            "router at its most recent placement",
+            labels=("model", "replica"))
+        g_imbalance = reg.gauge(
             "runbook_router_imbalance_ratio",
             "Max over mean of per-replica routed request counts "
-            "(1.0 = perfectly balanced, dp = everything on one replica)"
-        ).set_function(self._imbalance)
+            "(1.0 = perfectly balanced, dp = everything on one replica)",
+            labels=("model",))
         per_replica = (
             (reg.gauge("runbook_replica_running_requests",
                        "Requests holding a decode slot, per fleet replica",
-                       labels=("replica",)),
+                       labels=("model", "replica")),
              lambda c: float(len(c.decoding))),
             (reg.gauge("runbook_replica_waiting_requests",
                        "Requests queued or prefilling, per fleet replica",
-                       labels=("replica",)),
+                       labels=("model", "replica")),
              lambda c: float(len(c.waiting) + len(c.prefilling))),
             (reg.gauge("runbook_replica_kv_pool_utilization",
                        "Fraction of allocatable KV pages held by live "
-                       "sequences, per fleet replica", labels=("replica",)),
+                       "sequences, per fleet replica",
+                       labels=("model", "replica")),
              lambda c: c.kv.utilization()),
             (reg.counter("runbook_replica_decode_tokens_total",
                          "Tokens sampled by decode dispatches, per fleet "
-                         "replica", labels=("replica",)),
+                         "replica", labels=("model", "replica")),
              lambda c: float(c.metrics.get("decode_tokens", 0))),
         )
+        if clear:
+            g_imbalance.clear_functions()
+            for metric, _fn in per_replica:
+                metric.clear_functions()
+            # A previous MULTI-MODEL fleet's per-group rollups must not
+            # keep scraping (and pinning) its dead cores either — a
+            # multi-model build re-binds them right after its groups'
+            # fleets construct (fleet/multimodel._install_metrics).
+            for name in ("runbook_model_running_requests",
+                         "runbook_model_waiting_requests",
+                         "runbook_model_kv_pool_utilization",
+                         "runbook_model_decode_tokens_total"):
+                stale = reg.get(name)
+                if stale is not None:
+                    stale.clear_functions()
+        g_imbalance.labels(model=model).set_function(self._imbalance)
         for metric, fn in per_replica:
-            metric.clear_functions()
             for gid, core in zip(self.replica_ids, self.cores):
-                metric.labels(replica=str(gid)).set_function(
+                metric.labels(model=model, replica=str(gid)).set_function(
                     lambda c=core, f=fn: f(c))
         # Unlabeled engine names → fleet aggregates (each core's
         # _install_metrics bound them to itself during construction; the
-        # last rebind wins, and the fleet is constructed last).
-        reg.gauge("runbook_running_requests",
-                  "Requests holding a decode slot").set_function(
-            lambda: sum(len(c.decoding) for c in self.cores))
-        reg.gauge("runbook_waiting_requests",
-                  "Requests queued or prefilling").set_function(
-            lambda: sum(len(c.waiting) + len(c.prefilling)
-                        for c in self.cores))
-        g_cls_wait = reg.gauge(
-            "runbook_sched_waiting_requests",
-            "Requests queued or prefilling, per priority class",
-            labels=("cls",))
-        g_cls_wait.clear_functions()
-        for label in ("interactive", "batch", "other"):
-            g_cls_wait.labels(cls=label).set_function(
-                lambda lb=label: float(sum(
-                    1 for c in self.cores
-                    for r in list(c.waiting) + list(c.prefilling)
-                    if class_label(r.priority) == lb)))
-        reg.gauge("runbook_kv_pages_total", "KV pool size in pages"
-                  ).set_function(
-            lambda: sum(c.kv.allocator.num_pages for c in self.cores))
-        reg.gauge("runbook_kv_pages_in_use",
-                  "KV pages referenced by live sequences").set_function(
-            lambda: sum(c.kv.pages_in_use for c in self.cores))
-        reg.gauge("runbook_kv_pages_cached",
-                  "Retired-but-resident prefix-cache pages").set_function(
-            lambda: sum(c.kv.allocator.cached_pages for c in self.cores))
-        reg.counter("runbook_kv_spill_pages_total",
-                    "KV pages captured into the host spill tier at "
-                    "eviction time").set_function(
-            lambda: float(sum(c.kv.spill.pages_spilled for c in self.cores
-                              if c.kv.spill)))
-        reg.counter("runbook_kv_spill_evictions_total",
-                    "Spill-tier pages dropped by its LRU bound"
-                    ).set_function(
-            lambda: float(sum(c.kv.spill.evictions for c in self.cores
-                              if c.kv.spill)))
-        reg.gauge("runbook_kv_pool_utilization",
-                  "Fraction of allocatable KV pages held by live sequences"
-                  ).set_function(self._agg_utilization)
-        reg.gauge("runbook_prefix_cache_hit_ratio",
-                  "Cached prompt tokens / (cached + prefilled) since start"
-                  ).set_function(self._agg_prefix_hit_ratio)
-        reg.gauge("runbook_decode_overlap_ratio",
-                  "Fraction of host decode work hidden behind device "
-                  "execution by the lagged pipeline (0 in forced-sync mode)"
-                  ).set_function(self._agg_overlap_ratio)
-        for key, name, help_text in LEGACY_COUNTER_EXPORTS:
-            reg.counter(name, help_text).set_function(
-                lambda k=key: float(sum(c.metrics.get(k, 0)
-                                        for c in self.cores)))
+        # last rebind wins, and the fleet is constructed last — a
+        # multi-model fleet rebinds them once more over ALL groups).
+        install_fleet_aggregates(self.cores)
 
     def _agg_utilization(self) -> float:
-        usable = sum(c.kv.allocator.num_pages - 1 for c in self.cores)
-        used = sum(c.kv.pages_in_use for c in self.cores)
-        return used / usable if usable > 0 else 0.0
+        return _agg_utilization(self.cores)
 
     def _agg_prefix_hit_ratio(self) -> float:
-        cached = sum(c.metrics.get("cached_prefix_tokens", 0)
-                     for c in self.cores)
-        total = cached + sum(c.metrics.get("prefill_tokens", 0)
-                             for c in self.cores)
-        return cached / total if total else 0.0
+        return _agg_prefix_hit_ratio(self.cores)
 
     def _agg_overlap_ratio(self) -> float:
-        host = sum(c.metrics.get("decode_host_time_s", 0.0)
-                   for c in self.cores)
-        overlap = sum(c.metrics.get("decode_host_overlap_s", 0.0)
-                      for c in self.cores)
-        return overlap / host if host > 0 else 0.0
+        return _agg_overlap_ratio(self.cores)
 
     def is_saturated(self) -> bool:
         """True when a placement would shed right now (every replica's

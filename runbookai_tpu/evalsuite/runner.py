@@ -128,13 +128,34 @@ async def run_live(
     # begin_case tags the asyncio task; every routed request inside it is
     # credited to the case, however deep in the agent stack it happens.
     begin_case = getattr(engine, "begin_case", None)
+    # Multi-model fleets: a case carrying a `model` pins every engine
+    # call it makes (however deep in the agent stack) to that served
+    # group via the fleet's CURRENT_MODEL contextvar — the eval suite is
+    # then a real multi-model load generator, not a single-group one.
+    set_model = getattr(engine, "set_case_model", None)
+    replica_models = getattr(engine, "replica_models", None)
+    if set_model is None and any(c.model for c in cases):
+        # Say so LOUDLY: a per-model breakdown printed over cases that
+        # all silently ran on one default engine would read as a
+        # multi-model result that never happened.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "eval cases carry a `model` but the engine has no model "
+            "routing (llm.models not configured) — every case runs on "
+            "the default model")
     sem = asyncio.Semaphore(eff_concurrency)
     t0 = time.perf_counter()
 
     async def run_case(case: EvalCase) -> dict[str, Any]:
         async with sem:
             token = begin_case(case.case_id) if begin_case else None
+            model_token = None
             try:
+                # Inside the try: a case naming an unserved model is a
+                # FAILED case row, never a crashed eval run.
+                if set_model and case.model:
+                    model_token = set_model(case.model)
                 orch = InvestigationOrchestrator(
                     llm, _executor_for_case(case),
                     machine=InvestigationStateMachine(
@@ -163,13 +184,24 @@ async def run_live(
                        "passed": False,
                        "error": f"{type(exc).__name__}: {exc}"}
             finally:
+                if model_token is not None:
+                    engine.reset_case_model(model_token)
                 if token is not None:
                     engine.end_case(token)
             if begin_case:
+                routes = engine.case_routes(case.case_id)
                 out["replica_requests"] = {
-                    f"r{i}": n
-                    for i, n in sorted(
-                        engine.case_routes(case.case_id).items())}
+                    f"r{i}": n for i, n in sorted(routes.items())}
+                if replica_models:
+                    # Per-model attribution (multi-model fleets): how
+                    # many engine calls each served group handled for
+                    # this case — summed into summary.json next to the
+                    # per-replica totals.
+                    per_model: dict[str, int] = {}
+                    for i, n in routes.items():
+                        name = replica_models.get(i, "unknown")
+                        per_model[name] = per_model.get(name, 0) + n
+                    out["model_requests"] = dict(sorted(per_model.items()))
             return out
 
     report.cases = list(await asyncio.gather(*(run_case(c) for c in cases)))
@@ -206,12 +238,19 @@ def write_reports(reports: list[BenchmarkReport], out_dir: str | Path) -> Path:
     # Fleet runs: total engine requests each replica served, summed from
     # the per-case attribution run_live recorded.
     replica_totals: dict[str, int] = {}
+    model_totals: dict[str, int] = {}
     for report in reports:
         for c in report.cases:
             for rep, n in (c.get("replica_requests") or {}).items():
                 replica_totals[rep] = replica_totals.get(rep, 0) + n
+            for name, n in (c.get("model_requests") or {}).items():
+                model_totals[name] = model_totals.get(name, 0) + n
     if replica_totals:
         summary["replica_attribution"] = dict(sorted(replica_totals.items()))
+    if model_totals:
+        # Multi-model fleets: the same totals grouped by served model —
+        # which group actually absorbed the eval load.
+        summary["model_attribution"] = dict(sorted(model_totals.items()))
     path = out / "summary.json"
     path.write_text(json.dumps(summary, indent=2))
     return path

@@ -38,6 +38,9 @@ class EvalCase:
     incident_id: str = ""
     fixtures: Optional[dict[str, Any]] = None  # simulated-cloud fixture override
     mock_result: Optional[dict[str, Any]] = None  # offline mode
+    # Served model group to run this case against (multi-model fleets);
+    # None = the client's default model, exactly the historical behavior.
+    model: Optional[str] = None
 
     @classmethod
     def from_dict(cls, raw: dict[str, Any]) -> "EvalCase":
@@ -55,6 +58,7 @@ class EvalCase:
             incident_id=str(raw.get("incident_id", "")),
             fixtures=raw.get("fixtures"),
             mock_result=raw.get("mock_result") or raw.get("mockResult"),
+            model=raw.get("model"),
         )
 
 

@@ -1,0 +1,41 @@
+"""Multi-model fleet serving (ROADMAP item 5a).
+
+One ``AsyncFleet``-compatible surface over named model groups: replicas
+are partitioned per served model (``llm.models`` — each group built from
+its own derived ``LLMConfig``/serving plan), the router dispatches on
+the request's ``model`` field into the owning group's prefix-affinity /
+least-loaded placement, ``GET /v1/models`` lists the full catalog, and
+every metric/flight-record/health row carries the model it serves.
+
+- :mod:`runbookai_tpu.fleet.multimodel` — :class:`MultiModelFleet` /
+  :class:`ModelGroup`, the engine-level facade.
+- :mod:`runbookai_tpu.fleet.build` — config -> cores: the ONE engine
+  construction path (also used by the single-model client), group
+  config derivation, global replica index / device carving.
+
+The single-model path is untouched by construction: ``llm.models``
+absent means ``JaxTpuClient.from_config`` builds exactly the classic
+engine or dp fleet (parity pinned in tests/test_multimodel.py).
+"""
+
+from runbookai_tpu.fleet.build import (
+    BuiltGroup,
+    build_group,
+    build_multi_model_fleet,
+    derive_group_llm,
+)
+from runbookai_tpu.fleet.multimodel import (
+    CURRENT_MODEL,
+    ModelGroup,
+    MultiModelFleet,
+)
+
+__all__ = [
+    "BuiltGroup",
+    "build_group",
+    "build_multi_model_fleet",
+    "derive_group_llm",
+    "CURRENT_MODEL",
+    "ModelGroup",
+    "MultiModelFleet",
+]

@@ -1,0 +1,418 @@
+"""Model-group construction: ``LLMConfig`` -> engine cores, once.
+
+This module owns THE engine-construction path — the code that used to
+live inline in ``JaxTpuClient.from_config``. The single-model client and
+the multi-model fleet both call :func:`build_group`, so there is exactly
+one place where a config's plan is applied, weights are discovered,
+meshes are planned, and cores are built — multi-model serving cannot
+drift from the single-model path it must stay byte-identical to.
+
+Multi-model (``llm.models``): each group entry derives its own
+``LLMConfig`` from the base ``llm`` block (:func:`derive_group_llm`;
+group ``overrides`` beat the group ``plan`` beat the base — the same
+explicit-beats-plan precedence as ``llm.plan``),
+:func:`build_multi_model_fleet` assigns GLOBAL replica indices
+contiguously across groups, carves the host's devices into disjoint
+per-group slices when there are enough, and fronts each group's cores
+with an :class:`~runbookai_tpu.engine.fleet.AsyncFleet` labeled with the
+group's served name.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+from runbookai_tpu.engine.engine import (
+    EngineConfig,
+    EngineCore,
+    resolve_kv_dtype,
+)
+from runbookai_tpu.fleet.multimodel import ModelGroup, MultiModelFleet
+
+
+@dataclass
+class BuiltGroup:
+    """One constructed model group (or the whole single-model build)."""
+
+    cores: list[EngineCore]
+    tokenizer: Any
+    chat_format: str
+    model_cfg: Any           # LlamaConfig actually loaded
+    llm_cfg: Any             # the (plan-applied) LLMConfig it was built from
+    fleet_cfg: Optional[Any] = None   # engine.fleet.FleetConfig or None
+    lora_registry: Optional[Any] = None
+
+    @property
+    def core(self) -> EngineCore:
+        return self.cores[0]
+
+
+def apply_group_plan(llm_cfg):
+    """Resolve ``llm.plan`` onto the config (explicit YAML keys keep
+    winning — ``autotune.plan.apply_plan_to_llm``); returns the
+    (possibly) rewritten config and the loaded plan (or ``None``)."""
+    serving_plan = None
+    if getattr(llm_cfg, "plan", None):
+        from runbookai_tpu.autotune.plan import apply_plan_to_llm, load_plan
+
+        serving_plan = load_plan(llm_cfg.plan)
+        if serving_plan.model != llm_cfg.model:
+            raise ValueError(
+                f"llm.plan {serving_plan.plan_id!r} was tuned for "
+                f"model {serving_plan.model!r}, not {llm_cfg.model!r} "
+                f"— plans are per model×topology; re-run `runbook tune`")
+        llm_cfg = apply_plan_to_llm(llm_cfg, serving_plan)
+    return llm_cfg, serving_plan
+
+
+def build_group(llm_cfg, *,
+                replica_indices: Optional[Sequence[int]] = None,
+                devices: Optional[Sequence[Any]] = None,
+                pin_devices: bool = False) -> BuiltGroup:
+    """Build one model's engine cores from its ``LLMConfig``.
+
+    With ``replica_indices=None`` this is exactly the historical
+    single-model construction (including the multihost pod split and the
+    TP/mesh path). A multi-model caller passes the group's GLOBAL
+    replica indices and its carved device slice instead — group builds
+    always go through ``build_engine_fleet`` (even dp=1) so every
+    replica carries its global index and, with ``pin_devices``, owns its
+    device slice.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from runbookai_tpu.model.chat_template import format_for_model
+    from runbookai_tpu.model.guided import JsonMaskProvider
+    from runbookai_tpu.model.schema_guided import orchestrator_schemas
+    from runbookai_tpu.models.hf_loader import load_or_init
+    from runbookai_tpu.utils.tokens import load_tokenizer
+    from runbookai_tpu.utils.weights import discover_weights
+
+    llm_cfg, serving_plan = apply_group_plan(llm_cfg)
+    model_path = discover_weights(llm_cfg.model, llm_cfg.model_path)
+    tokenizer = load_tokenizer(llm_cfg.tokenizer_path or model_path)
+    mesh = None
+    shardings = None
+    model_cfg_name = llm_cfg.model
+    # int8 = weight-only quantization; activations and KV stay bf16.
+    quantize = llm_cfg.dtype == "int8"
+    dtype = jnp.float32 if llm_cfg.dtype == "float32" else jnp.bfloat16
+    dp_replicas = max(1, getattr(llm_cfg, "dp_replicas", 1))
+    if dp_replicas > 1 and llm_cfg.mesh.device_count > 1:
+        # Replicas are single-slice engines; sharding a model WITHIN a
+        # replica on top of dp is a later composition — refuse loudly
+        # rather than silently building N full-mesh engines that all
+        # claim the same devices.
+        raise ValueError(
+            "llm.dp_replicas > 1 requires llm.mesh.data/model = 1 "
+            "(each fleet replica owns its own device slice)")
+    if llm_cfg.mesh.device_count > 1:
+        from runbookai_tpu.models.llama import CONFIGS
+        from runbookai_tpu.parallel.kv_split import plan_kv_split
+        from runbookai_tpu.parallel.mesh import build_mesh
+        from runbookai_tpu.parallel.sharding import param_shardings
+
+        # KV layout planning: tp past the GQA head count factors onto
+        # (model=kv_shards, seq=pg_shards) so the page pool shards by
+        # the FULL tp (parallel/kv_split.py) instead of replicating.
+        plan = (plan_kv_split(CONFIGS[llm_cfg.model], llm_cfg.mesh.model)
+                if llm_cfg.model in CONFIGS else None)
+        if plan is not None and plan.split:
+            mesh = build_mesh(llm_cfg.mesh.data, model=plan.kv_shards,
+                              seq=plan.pg_shards)
+        else:
+            mesh = build_mesh(llm_cfg.mesh.data, llm_cfg.mesh.model)
+        if model_cfg_name in CONFIGS:
+            shardings = param_shardings(CONFIGS[model_cfg_name], mesh)
+            if quantize:
+                from runbookai_tpu.models.quant import shardings_with_quant
+
+                shardings = shardings_with_quant(shardings)
+    cfg, params = load_or_init(
+        model_cfg_name, model_path, dtype=dtype, shardings=shardings,
+        quantize_int8=quantize,
+    )
+    kv_dtype = resolve_kv_dtype(llm_cfg.kv_cache_dtype, dtype)
+    ecfg = EngineConfig(
+        page_size=llm_cfg.page_size,
+        num_pages=llm_cfg.num_pages,
+        max_batch_slots=llm_cfg.max_batch_slots,
+        prefill_chunk=llm_cfg.prefill_chunk,
+        max_seq_len=min(llm_cfg.max_seq_len, cfg.max_seq_len),
+        kv_dtype=kv_dtype,
+        decode_steps_per_dispatch=llm_cfg.decode_steps,
+        # The Pallas ragged-paged kernels are the TPU hot path (VERDICT r1
+        # weak #3); the XLA gather path stays the portable fallback. On a
+        # TP mesh the kernels run per head-shard via shard_map
+        # (ops/paged_attention_pallas.py) — forward_impl itself falls
+        # back to XLA attention only when GQA heads don't divide the
+        # model axis (where the pool replicates anyway).
+        attn_impl=(llm_cfg.attn_impl if llm_cfg.attn_impl != "auto"
+                   else ("pallas"
+                         if jax.default_backend() in ("tpu", "axon")
+                         else "xla")),
+        # The Pallas quantized matmul streams int8 weight tiles (half
+        # the bf16 HBM bytes, the decode bound) — on-TPU default for
+        # int8 weights; meaningless for unquantized ones.
+        qmm_impl=(llm_cfg.qmm_impl if llm_cfg.qmm_impl != "auto"
+                  else ("pallas"
+                        if quantize and jax.default_backend()
+                        in ("tpu", "axon")
+                        else "xla")),
+        dp_replicas=dp_replicas,
+        kv_spill_pages=getattr(llm_cfg, "kv_spill_pages", 0),
+    )
+    sched_cfg = getattr(llm_cfg, "sched", None)
+    if sched_cfg is not None:
+        # Priority-class scheduling policy (llm.sched → sched/wdrr.py):
+        # the weighted-deficit interleave by default, with the two
+        # canonical class weights from config.
+        import dataclasses as _dc
+
+        from runbookai_tpu.sched import PRIORITY_BATCH, PRIORITY_INTERACTIVE
+
+        ecfg = _dc.replace(
+            ecfg, sched_policy=sched_cfg.policy,
+            sched_weights={
+                PRIORITY_BATCH: sched_cfg.batch_weight,
+                PRIORITY_INTERACTIVE: sched_cfg.interactive_weight,
+            })
+    if serving_plan is not None:
+        from runbookai_tpu.autotune.plan import engine_only_overrides
+
+        # Plan keys with no llm.* spelling (speculative,
+        # mixed_token_budget, prefill_batch, block_pages, …) apply
+        # straight onto the engine config. (Named serving_plan: the
+        # TP branch above rebinds `plan` to a KVSplitPlan.)
+        overrides = engine_only_overrides(serving_plan)
+        if overrides:
+            import dataclasses as _dc
+
+            ecfg = _dc.replace(ecfg, **overrides)
+    lora_registry = None
+    if getattr(llm_cfg, "lora_adapters", None):
+        from runbookai_tpu.models.lora import LoraRegistry
+
+        lora_registry = LoraRegistry(
+            cfg, rank=llm_cfg.lora_rank,
+            targets=tuple(llm_cfg.lora_targets), dtype=dtype)
+        for name, path in llm_cfg.lora_adapters.items():
+            lora_registry.load_peft_dir(name, path)
+    draft_factory = None
+    if llm_cfg.draft_model:
+        from runbookai_tpu.engine.draft import DraftWorker
+
+        dcfg, dparams = load_or_init(
+            llm_cfg.draft_model, llm_cfg.draft_model_path, dtype=dtype)
+
+        def draft_factory(_idx: int) -> "DraftWorker":
+            # One worker per replica: its slot/page state is
+            # per-engine and cannot be shared across cores.
+            return DraftWorker(
+                dcfg, dparams, max_batch_slots=ecfg.max_batch_slots,
+                max_seq_len=ecfg.max_seq_len, page_size=ecfg.page_size,
+                attn_impl=ecfg.attn_impl)
+    masker = JsonMaskProvider(tokenizer, schemas=orchestrator_schemas())
+    fleet_cfg = None
+    if dp_replicas > 1 or replica_indices is not None:
+        from runbookai_tpu.engine.fleet import FleetConfig
+
+        router = getattr(llm_cfg, "fleet", None)
+        if router is not None:
+            disagg = getattr(router, "disagg", None)
+            disagg_n = (disagg.prefill_replicas
+                        if disagg is not None and disagg.enabled else 0)
+            fleet_cfg = FleetConfig(
+                affinity=router.affinity,
+                affinity_load_slack=router.affinity_load_slack,
+                shed_queue_depth=router.shed_queue_depth,
+                max_retries=router.max_retries,
+                kv_share=getattr(router, "kv_share", False),
+                kv_share_min_pages=getattr(router, "kv_share_min_pages", 1),
+                disagg_prefill_replicas=disagg_n,
+                disagg_min_prompt_pages=(disagg.min_prompt_pages
+                                         if disagg_n else 1))
+    if replica_indices is not None:
+        # Multi-model group build: cores always come from
+        # build_engine_fleet so each carries its GLOBAL replica index
+        # (request-id namespace, metric labels) and — with enough
+        # devices — its own pinned slice, dp=1 groups included.
+        from runbookai_tpu.engine.fleet import build_engine_fleet
+
+        cores = build_engine_fleet(
+            cfg, params, tokenizer, ecfg,
+            mask_fn=masker.mask, advance_fn=masker.advance,
+            lora_registry=lora_registry,
+            draft_worker_factory=draft_factory,
+            devices=devices,
+            replica_indices=list(replica_indices),
+            pin_devices=pin_devices,
+        )
+    elif dp_replicas > 1:
+        from runbookai_tpu.engine.fleet import build_engine_fleet
+
+        # Pod scale-out: each process builds only ITS replicas over
+        # its local chips — replicas never span hosts (their device
+        # slices must stay in one ICI domain). Single process owns
+        # the whole fleet over the (== local) global device list.
+        host_indices = None
+        fleet_devices = None
+        if jax.process_count() > 1:
+            from runbookai_tpu.parallel.multihost import local_replica_range
+
+            host_indices = list(local_replica_range(dp_replicas))
+            fleet_devices = jax.local_devices()
+        cores = build_engine_fleet(
+            cfg, params, tokenizer, ecfg,
+            mask_fn=masker.mask, advance_fn=masker.advance,
+            lora_registry=lora_registry,
+            draft_worker_factory=draft_factory,
+            devices=fleet_devices,
+            replica_indices=host_indices,
+        )
+    else:
+        cores = [EngineCore(
+            cfg, params, tokenizer, ecfg,
+            mask_fn=masker.mask, advance_fn=masker.advance, mesh=mesh,
+            lora_registry=lora_registry,
+            draft_worker=draft_factory(0) if draft_factory else None,
+        )]
+    return BuiltGroup(
+        cores=cores, tokenizer=tokenizer,
+        chat_format=format_for_model(model_cfg_name, cfg.family),
+        model_cfg=cfg, llm_cfg=llm_cfg, fleet_cfg=fleet_cfg,
+        lora_registry=lora_registry)
+
+
+def wire_feedback(cores: Sequence[EngineCore], llm_cfg,
+                  slo_monitor) -> None:
+    """SLO feedback controllers (llm.sched.feedback → sched/feedback.py):
+    one per core — each core's prefill share is its own actuator, all
+    reading the same process-wide TPOT burn. No-op when feedback is off;
+    a feedback config without the tpot_p95_ms objective raises here (an
+    open loop labeled closed is worse than failing)."""
+    sched_cfg = getattr(llm_cfg, "sched", None)
+    if sched_cfg is None or not getattr(sched_cfg, "feedback", False):
+        return
+    from runbookai_tpu.sched import MixedBudgetController
+
+    for core in cores:
+        core.feedback = MixedBudgetController.for_core(sched_cfg,
+                                                       slo_monitor)
+
+
+def derive_group_llm(base, entry):
+    """Group entry -> the group's own ``LLMConfig``.
+
+    ``model_copy(update=...)`` keeps the base block's explicitly-set
+    keys in ``model_fields_set`` and adds the group's — so the group
+    plan's apply (which only fills UNSET keys) sees exactly the intended
+    precedence: group overrides > base explicit YAML > group plan >
+    defaults. The derived config is re-validated as a whole (and the
+    COERCED result returned, with the copy's fields_set restored — a
+    YAML-quoted "512" must land as int 512, and a typo'd value must
+    fail here at load, not at engine build)."""
+    from runbookai_tpu.utils.config import RESERVED_GROUP_OVERRIDE_KEYS
+
+    reserved = RESERVED_GROUP_OVERRIDE_KEYS & set(entry.overrides)
+    if reserved:
+        raise ValueError(
+            f"llm.models[{entry.name!r}].overrides cannot set "
+            f"{sorted(reserved)} — these are group-entry fields "
+            f"(set them on the entry itself)")
+    update: dict[str, Any] = {
+        "model": entry.model or entry.name,
+        "dp_replicas": entry.dp_replicas,
+        "plan": entry.plan,
+        "models": [],
+    }
+    if entry.model_path is not None:
+        update["model_path"] = entry.model_path
+    if entry.tokenizer_path is not None:
+        update["tokenizer_path"] = entry.tokenizer_path
+    update["lora_adapters"] = dict(entry.adapters)
+    update.update(entry.overrides)
+    derived = base.model_copy(update=update)
+    # Whole-config validation (model_copy skips it): coerce/check the
+    # override values against the pydantic field types, and KEEP the
+    # coerced model. Its fields_set would claim every field explicit, so
+    # restore the copy's — the plan-precedence bookkeeping.
+    # warnings=False: the pre-coercion copy may hold YAML-typed values
+    # (that is the point — model_validate below coerces or rejects them).
+    coerced = type(base).model_validate(derived.model_dump(warnings=False))
+    object.__setattr__(coerced, "__pydantic_fields_set__",
+                       set(derived.model_fields_set))
+    return coerced
+
+
+def build_multi_model_fleet(llm_cfg, slo_monitor=None) -> MultiModelFleet:
+    """``llm.models`` -> a :class:`MultiModelFleet`.
+
+    Global replica indices are assigned contiguously in list order
+    (group 0 gets ``r0..``, the next group continues), and the host's
+    devices are carved into disjoint per-group slices when there are at
+    least as many devices as total replicas — otherwise every group
+    timeshares the default device (the CPU tier-1 case).
+    """
+    import jax
+
+    entries = list(getattr(llm_cfg, "models", None) or [])
+    if not entries:
+        raise ValueError("llm.models is empty — nothing to serve")
+    if jax.process_count() > 1:
+        raise ValueError(
+            "llm.models does not compose with multihost pods yet "
+            "(per-group host placement is a later composition)")
+    if llm_cfg.mesh.device_count > 1:
+        raise ValueError(
+            "llm.models requires llm.mesh.data/model = 1 (each group "
+            "replica owns its own device slice; TP within a group is a "
+            "later composition)")
+    names = [e.name for e in entries]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate served model names in llm.models: "
+                         f"{names}")
+    total = sum(max(1, e.dp_replicas) for e in entries)
+    all_devices = list(jax.devices())
+    carve = len(all_devices) >= total
+    if not carve:
+        # Too few devices for disjoint per-group slices: EVERY replica
+        # timeshares the default device (devices=[] below makes each
+        # group's slice computation come up empty, so nothing pins).
+        # Passing devices=None instead would let each dp>1 group slice
+        # ALL devices independently — overlapping pinned meshes with
+        # two models' weights double-committed on the same chips.
+        # Legitimate on CPU tier-1; loud on an accelerator.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "llm.models: %d total replicas but only %d device(s) — "
+            "every group will timeshare the default device",
+            total, len(all_devices))
+    groups: list[ModelGroup] = []
+    start = 0
+    for i, entry in enumerate(entries):
+        dp = max(1, entry.dp_replicas)
+        derived = derive_group_llm(llm_cfg, entry)
+        built = build_group(
+            derived,
+            replica_indices=range(start, start + dp),
+            devices=(all_devices[start:start + dp] if carve else []),
+            pin_devices=carve,
+        )
+        wire_feedback(built.cores, derived, slo_monitor)
+        from runbookai_tpu.engine.fleet import AsyncFleet
+
+        fleet = AsyncFleet(built.cores, built.fleet_cfg,
+                           model_label=entry.name,
+                           # One clear for the whole build: later groups
+                           # must not drop the labelsets their siblings
+                           # just bound.
+                           clear_labeled=(i == 0))
+        groups.append(ModelGroup(
+            name=entry.name, fleet=fleet, tokenizer=built.tokenizer,
+            chat_format=built.chat_format, llm_cfg=built.llm_cfg))
+        start += dp
+    return MultiModelFleet(groups)

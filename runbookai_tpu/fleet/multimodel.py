@@ -1,0 +1,348 @@
+"""Multi-model fleet: named model groups behind one serving surface.
+
+AIBrix's production premise (PAPERS.md, arXiv:2504.03648) is that LLM
+infrastructure is multi-model by default — routing, capacity and failure
+isolation are managed *per model*, not per deployment. This module is the
+engine-level half of that premise for the in-tree stack:
+
+- a :class:`ModelGroup` is one served model: its own replicas (an
+  :class:`~runbookai_tpu.engine.fleet.AsyncFleet` built from the group's
+  derived ``LLMConfig``/plan — see ``fleet/build.py``), its own tokenizer
+  and chat format, and its own LoRA adapter namespace;
+- :class:`MultiModelFleet` fronts the groups with the same
+  ``generate``/``generate_stream``/``start``/``stop`` surface as
+  ``AsyncEngine``/``AsyncFleet`` plus a ``model`` dimension: callers name
+  a group (or set :data:`CURRENT_MODEL` for a whole asyncio task) and the
+  request is served entirely by that group's router and replicas — the
+  existing prefix-affinity / least-loaded / queue-depth placement runs
+  *within* the group, so per-request streams are byte-identical to a
+  dedicated single-model fleet serving the same group config.
+
+Replica indices are GLOBAL across groups (group 0 owns ``r0..``, the next
+group continues where it left off), so request-id namespaces, metric
+``replica`` labels and flight-recorder rows stay unambiguous fleet-wide;
+the ``model`` label/tag separates the groups.
+
+The single-model path never constructs this class: ``llm.models`` absent
+means ``JaxTpuClient.from_config`` builds exactly the classic engine or
+AsyncFleet, bit for bit (pinned by tests/test_multimodel.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time as _time
+from contextvars import ContextVar
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+from runbookai_tpu.engine.engine import EngineCore
+from runbookai_tpu.engine.fleet import (
+    AsyncFleet,
+    _agg_utilization,
+    install_fleet_aggregates,
+)
+from runbookai_tpu.utils import metrics as metrics_mod
+
+# Per-asyncio-task model attribution: the eval runner (and any agent-side
+# caller that serves a whole workload against one group) sets this around
+# a case instead of threading ``model=`` through every engine call —
+# contextvars flow through awaits exactly like the router's CURRENT_CASE.
+CURRENT_MODEL: ContextVar[Optional[str]] = ContextVar(
+    "runbook_fleet_model", default=None)
+
+
+@dataclass
+class ModelGroup:
+    """One served model: a replica fleet plus the per-model pieces the
+    serving surface needs (tokenizer, chat format, adapter names)."""
+
+    name: str
+    fleet: AsyncFleet
+    tokenizer: Any
+    chat_format: str = "llama3"
+    # The group's derived LLMConfig — /healthz provenance and the
+    # feedback/sched wiring read it; never consulted on the hot path.
+    llm_cfg: Any = None
+
+    @property
+    def cores(self) -> list[EngineCore]:
+        return self.fleet.cores
+
+    @property
+    def adapter_names(self) -> list[str]:
+        lora = self.cores[0].lora
+        return list(lora.names) if lora is not None else []
+
+    @property
+    def page_size(self) -> int:
+        return self.cores[0].ecfg.page_size
+
+
+class MultiModelFleet:
+    """AsyncEngine-compatible facade over named model groups.
+
+    Everything model-agnostic delegates to the resolved group's
+    ``AsyncFleet``; everything fleet-wide (aggregate metrics, merged
+    health/debug snapshots, eval attribution) unions the groups.
+    """
+
+    def __init__(self, groups: Sequence[ModelGroup]):
+        if not groups:
+            raise ValueError("a multi-model fleet needs at least one group")
+        self.groups: dict[str, ModelGroup] = {}
+        for g in groups:
+            if g.name in self.groups:
+                raise ValueError(f"duplicate model group {g.name!r}")
+            self.groups[g.name] = g
+        self.default = groups[0].name
+        self.cores = [c for g in groups for c in g.cores]
+        # Total replica count: the eval suite scales its concurrency
+        # budget by this, exactly as it does for a single AsyncFleet.
+        self.dp = len(self.cores)
+        # GLOBAL replica id -> served model name (eval attribution, the
+        # merged /debug/steps tags, dashboards joining replica series).
+        self.replica_models: dict[int, str] = {
+            gid: g.name for g in groups for gid in g.fleet.replica_ids}
+        if len(self.replica_models) != self.dp:
+            raise ValueError(
+                "model groups must use disjoint global replica indices "
+                f"(got {[g.fleet.replica_ids for g in groups]})")
+        # Process-wide unlabeled names cover ALL groups (each group's
+        # AsyncFleet bound them to its own cores during construction;
+        # this final rebind wins).
+        install_fleet_aggregates(self.cores)
+        self._install_metrics()
+
+    # ------------------------------------------------------------ resolution
+
+    def served_ids(self) -> list[str]:
+        """Every name a request's ``model`` field may carry: group names
+        first (serving order), then each group's adapters."""
+        out = list(self.groups)
+        for g in self.groups.values():
+            out.extend(g.adapter_names)
+        return out
+
+    def resolve(self, requested: Optional[str]) -> tuple[str, Optional[str]]:
+        """``model`` field -> ``(group_name, adapter)``. Absent/empty
+        means the default group; a group name selects it; an adapter
+        name resolves WITHIN its owning group (config validation pins
+        global adapter uniqueness). Unknown names raise ``KeyError`` —
+        the HTTP layer answers 404, never silent base-model serving."""
+        if not requested:
+            return self.default, None
+        if requested in self.groups:
+            return requested, None
+        for name, g in self.groups.items():
+            if requested in g.adapter_names:
+                return name, requested
+        raise KeyError(
+            f"model {requested!r} not found; served: {self.served_ids()}")
+
+    def group(self, model: Optional[str] = None) -> ModelGroup:
+        name = model or CURRENT_MODEL.get() or self.default
+        g = self.groups.get(name)
+        if g is None:
+            raise KeyError(
+                f"model {name!r} not found; served: {self.served_ids()}")
+        return g
+
+    def engine_for(self, model: Optional[str] = None) -> AsyncFleet:
+        """The resolved group's AsyncFleet — the HTTP layer serves the
+        request directly through it, so streams are the group fleet's
+        own, byte for byte."""
+        return self.group(model).fleet
+
+    def served_models(self) -> list[dict]:
+        """``GET /v1/models`` catalog rows: every group, then every
+        adapter with its group as ``parent`` (vLLM-style)."""
+        rows = [{"id": g.name, "object": "model",
+                 "owned_by": "runbookai-tpu",
+                 "dp_replicas": g.fleet.dp}
+                for g in self.groups.values()]
+        for g in self.groups.values():
+            rows.extend({"id": name, "object": "model",
+                         "owned_by": "runbookai-tpu", "parent": g.name}
+                        for name in g.adapter_names)
+        return rows
+
+    # ----------------------------------------------------- AsyncEngine API
+
+    async def start(self) -> None:
+        for g in self.groups.values():
+            await g.fleet.start()
+
+    async def stop(self) -> None:
+        await asyncio.gather(*(g.fleet.stop()
+                               for g in self.groups.values()))
+
+    async def refresh_lora(self) -> None:
+        await asyncio.gather(*(g.fleet.refresh_lora()
+                               for g in self.groups.values()))
+
+    async def generate(self, prompt_ids, sampling=None, timeout_s=None,
+                       priority: int = 0, adapter: Optional[str] = None,
+                       request_id: Optional[str] = None,
+                       model: Optional[str] = None):
+        return await self.group(model).fleet.generate(
+            prompt_ids, sampling, timeout_s=timeout_s, priority=priority,
+            adapter=adapter, request_id=request_id)
+
+    async def generate_stream(self, prompt_ids, sampling=None,
+                              priority: int = 0,
+                              adapter: Optional[str] = None,
+                              request_sink: Optional[list] = None,
+                              request_id: Optional[str] = None,
+                              model: Optional[str] = None):
+        agen = self.group(model).fleet.generate_stream(
+            prompt_ids, sampling, priority=priority, adapter=adapter,
+            request_sink=request_sink, request_id=request_id)
+        try:
+            async for tok in agen:
+                yield tok
+        finally:
+            await agen.aclose()
+
+    def is_saturated(self, model: Optional[str] = None) -> bool:
+        """A specific group's shed state, or (no model) whether EVERY
+        group would shed — the conservative fleet-wide answer."""
+        if model is not None:
+            return self.group(model).fleet.is_saturated()
+        return all(g.fleet.is_saturated() for g in self.groups.values())
+
+    # -------------------------------------------------- eval attribution
+
+    def begin_case(self, case_id: str):
+        """Tag this asyncio task's routing with ``case_id`` (the shared
+        router contextvar — every group's fleet reads the same one)."""
+        return next(iter(self.groups.values())).fleet.begin_case(case_id)
+
+    def end_case(self, token) -> None:
+        next(iter(self.groups.values())).fleet.end_case(token)
+
+    def set_case_model(self, model: str):
+        """Attribute (and route) this asyncio task's engine calls to
+        ``model`` until :meth:`reset_case_model` — how the eval runner
+        exercises multi-model routing without threading ``model=``
+        through the orchestrator."""
+        if model not in self.groups:
+            raise KeyError(
+                f"model {model!r} not found; served: {list(self.groups)}")
+        return CURRENT_MODEL.set(model)
+
+    def reset_case_model(self, token) -> None:
+        CURRENT_MODEL.reset(token)
+
+    def case_routes(self, case_id: str) -> dict[int, int]:
+        """Pop {global_replica: count} for a finished case, merged across
+        groups (indices are globally disjoint, so this is a plain
+        union)."""
+        merged: dict[int, int] = {}
+        for g in self.groups.values():
+            for rid, n in g.fleet.case_routes(case_id).items():
+                merged[rid] = merged.get(rid, 0) + n
+        return merged
+
+    # ------------------------------------------------------- observability
+
+    def _install_metrics(self) -> None:
+        """Per-model rollup gauges (the per-replica series already carry
+        the model label; these are the direct per-group saturation
+        signals the docs' PromQL alerts read)."""
+        reg = metrics_mod.get_registry()
+        per_model = (
+            (reg.gauge("runbook_model_running_requests",
+                       "Requests holding a decode slot, per served model "
+                       "group", labels=("model",)),
+             lambda g: float(sum(len(c.decoding) for c in g.cores))),
+            (reg.gauge("runbook_model_waiting_requests",
+                       "Requests queued or prefilling, per served model "
+                       "group", labels=("model",)),
+             lambda g: float(sum(len(c.waiting) + len(c.prefilling)
+                                 for c in g.cores))),
+            (reg.gauge("runbook_model_kv_pool_utilization",
+                       "Fraction of allocatable KV pages held by live "
+                       "sequences, per served model group",
+                       labels=("model",)),
+             lambda g: _agg_utilization(g.cores)),
+            (reg.counter("runbook_model_decode_tokens_total",
+                         "Tokens sampled by decode dispatches, per served "
+                         "model group", labels=("model",)),
+             lambda g: float(sum(c.metrics.get("decode_tokens", 0)
+                                 for c in g.cores))),
+        )
+        for metric, fn in per_model:
+            metric.clear_functions()
+            for g in self.groups.values():
+                metric.labels(model=g.name).set_function(
+                    lambda gg=g, f=fn: f(gg))
+
+    def health_snapshot(self, lock_timeout: float = 0.5) -> dict:
+        """``/healthz`` body: the classic fleet-wide totals (summed
+        metrics dict, pooled KV stats, every replica row — each stamped
+        with its model) PLUS a per-group ``models`` block, under ONE
+        shared lock budget across all groups."""
+        deadline = _time.monotonic() + lock_timeout
+        models: dict[str, dict] = {}
+        agg: dict = {}
+        replicas: list[dict] = []
+        kv_total = kv_used = kv_cached = 0
+        for name, g in self.groups.items():
+            budget = max(0.0, deadline - _time.monotonic())
+            snap = g.fleet.health_snapshot(lock_timeout=budget)
+            for row in snap["replicas"]:
+                row["model"] = name
+            replicas.extend(snap["replicas"])
+            for k, v in snap["metrics"].items():
+                agg[k] = agg.get(k, 0) + v
+            kv_total += snap["kv"]["pages_total"]
+            kv_used += snap["kv"]["pages_in_use"]
+            kv_cached += snap["kv"]["pages_cached"]
+            models[name] = {
+                "dp_replicas": snap["dp_replicas"],
+                "adapters": g.adapter_names,
+                "kv": snap["kv"],
+                "router": snap["router"],
+                "decode_tokens": snap["metrics"].get("decode_tokens", 0),
+            }
+        usable = sum(c.kv.allocator.num_pages - 1 for c in self.cores)
+        return {
+            "dp_replicas": self.dp,
+            "multi_model": True,
+            "models": models,
+            "kv": {"pages_total": kv_total, "pages_in_use": kv_used,
+                   "pages_cached": kv_cached,
+                   "utilization": (round(kv_used / usable, 4)
+                                   if usable else 0.0)},
+            "metrics": agg,
+            "replicas": replicas,
+        }
+
+    def debug_steps(self, last_n: Optional[int] = None,
+                    lock_timeout: float = 0.5) -> dict:
+        """Fleet-wide flight records merged across groups, each record
+        tagged with its serving model — one ts-ordered timeline under
+        one shared lock budget (the single-fleet contract)."""
+        deadline = _time.monotonic() + lock_timeout
+        merged: list[dict] = []
+        capacity = 0
+        steps_total = 0
+        for name, g in self.groups.items():
+            budget = max(0.0, deadline - _time.monotonic())
+            snap = g.fleet.debug_steps(last_n, lock_timeout=budget)
+            for row in snap["steps"]:
+                row["model"] = name
+            merged.extend(snap["steps"])
+            capacity += snap["capacity"]
+            steps_total += snap["steps_total"]
+        merged.sort(key=lambda r: r.get("ts", 0.0))
+        if last_n is not None:
+            n = max(0, int(last_n))
+            merged = merged[-n:] if n else []
+        return {"capacity": capacity, "steps_total": steps_total,
+                "dp_replicas": self.dp, "models": list(self.groups),
+                "steps": merged}
+
+
+__all__ = ["CURRENT_MODEL", "ModelGroup", "MultiModelFleet"]

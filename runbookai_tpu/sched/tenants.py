@@ -39,6 +39,12 @@ from runbookai_tpu.utils import metrics as metrics_mod
 # Aggregate tenant label for unknown/anonymous keys (bounded cardinality).
 DEFAULT_TENANT = "default"
 
+# Retry-After hint for a kv_page_limit refusal: the ledger drains when
+# in-flight requests COMPLETE (no refill rate exists to compute an exact
+# wait from), so the hint is the shortest honest "come back soon" that
+# cannot read as "retry immediately".
+KV_PAGES_RETRY_S = 2.0
+
 
 @dataclass
 class TenantPolicy:
@@ -47,9 +53,20 @@ class TenantPolicy:
 
     rate_limit_rpm: Optional[float] = None
     token_budget_per_min: Optional[float] = None
+    # Estimated KV pages the tenant may hold IN FLIGHT. An admission
+    # ledger, not a rate: each admitted request reserves
+    # ceil((prompt + n·max_new) / page_size) pages and releases them at
+    # settle, so a long-context tenant cannot crowd the page pool while
+    # staying inside its per-minute token budget (ROADMAP item 4's
+    # admission-cost-model leftover).
+    kv_page_limit: Optional[int] = None
     # Scheduling class of the tenant's requests ("interactive"/"batch");
     # the x-priority header can DEMOTE a request, never promote past it.
     priority: str = "interactive"
+    # Pin the tenant to one served model group (multi-model fleets):
+    # requests without a model field route there; explicit different
+    # models are refused 403 by the server (tenant-affine placement).
+    model: Optional[str] = None
     # The bearer secret selecting this tenant. None = the tenant's NAME
     # doubles as the key — acceptable only for non-secret identifiers,
     # because names are exported verbatim (metric labels, /tenants, the
@@ -100,7 +117,12 @@ class _TenantState:
     admitted: int = 0
     throttled_rate: int = 0
     throttled_tokens: int = 0
+    throttled_kv_pages: int = 0
+    refused_kv_oversized: int = 0
     tokens_charged: float = 0.0
+    # Estimated KV pages currently reserved by admitted-but-unsettled
+    # requests (the kv_page_limit ledger).
+    kv_pages_in_flight: float = 0.0
 
 
 @dataclass
@@ -114,8 +136,10 @@ class Admission:
     tenant: str
     priority: int = PRIORITY_INTERACTIVE
     retry_after_s: float = 0.0
-    reason: Optional[str] = None  # "rate_limit" | "token_budget"
+    reason: Optional[str] = None  # "rate_limit" | "token_budget" | "kv_pages"
     reserved_tokens: float = 0.0
+    # Estimated KV pages this admission reserved (released at settle).
+    reserved_pages: float = 0.0
     _settled: bool = field(default=False, repr=False)
 
 
@@ -141,9 +165,9 @@ class TenantGovernor:
         reg = registry or metrics_mod.get_registry()
         self._m_requests = reg.counter(
             "runbook_tenant_requests_total",
-            "Tenant admission decisions at the server "
-            "(outcome: admitted | throttled_rate | throttled_tokens)",
-            labels=("tenant", "outcome"))
+            "Tenant admission decisions at the server (outcome: admitted "
+            "| throttled_rate | throttled_tokens | throttled_kv_pages | "
+            "refused_kv_oversized)", labels=("tenant", "outcome"))
         self._m_tokens = reg.counter(
             "runbook_tenant_tokens_total",
             "Tokens charged against tenant budgets (prompt + completion, "
@@ -161,6 +185,16 @@ class TenantGovernor:
             if state.tokens is not None:
                 g_budget.labels(tenant=name).set_function(
                     lambda n=name: self._budget_level(n))
+        g_pages = reg.gauge(
+            "runbook_tenant_kv_pages_in_flight",
+            "Estimated KV pages reserved by a tenant's admitted, "
+            "not-yet-settled requests (absent without kv_page_limit)",
+            labels=("tenant",))
+        g_pages.clear_functions()
+        for name, state in self._states.items():
+            if state.policy.kv_page_limit is not None:
+                g_pages.labels(tenant=name).set_function(
+                    lambda n=name: self._pages_in_flight(n))
 
     def _make_state(self, policy: TenantPolicy) -> _TenantState:
         now = self._clock()
@@ -181,6 +215,10 @@ class TenantGovernor:
             state.tokens._refill(self._clock())
             return state.tokens.level
 
+    def _pages_in_flight(self, name: str) -> float:
+        with self._lock:
+            return self._states[name].kv_pages_in_flight
+
     def resolve(self, api_key: Optional[str]) -> str:
         """Tenant name for a request's bearer secret (unknown/absent
         keys pool under the bounded ``default`` tenant)."""
@@ -188,12 +226,24 @@ class TenantGovernor:
             return self._key_to_name[api_key]
         return DEFAULT_TENANT
 
+    def pinned_model(self, api_key: Optional[str]) -> Optional[str]:
+        """The tenant's pinned model group (multi-model fleets), or
+        None. Read-only — never charges a bucket."""
+        with self._lock:
+            return self._states[self.resolve(api_key)].policy.model
+
     def admit(self, api_key: Optional[str], prompt_tokens: int,
-              max_new_tokens: int) -> Admission:
-        """Charge both buckets for one request; reserve the worst-case
-        token cost. Never touches the engine — a refusal costs nothing."""
+              max_new_tokens: int,
+              kv_pages: float = 0.0) -> Admission:
+        """Charge every configured bucket for one request; reserve the
+        worst-case token cost and (``kv_pages``, the caller's
+        ceil((prompt + n·max_new)/page_size) estimate) the KV pages it
+        may pin. Never touches the engine — a refusal costs nothing.
+        Refusals name the failing bucket in ``reason`` so the 429 can
+        say WHICH limit the tenant hit."""
         tenant = self.resolve(api_key)
         reserve = float(max(0, prompt_tokens) + max(0, max_new_tokens))
+        pages = float(max(0.0, kv_pages))
         now = self._clock()
         with self._lock:
             state = self._states[tenant]
@@ -216,10 +266,46 @@ class TenantGovernor:
                     return Admission(False, tenant, priority=priority,
                                      retry_after_s=retry,
                                      reason="token_budget")
+            limit = state.policy.kv_page_limit
+            if limit is not None and pages > 0 \
+                    and state.kv_pages_in_flight + pages > limit:
+                # Refuse and refund the buckets already charged (the
+                # request never ran). Two distinct refusals: a request
+                # whose OWN estimate exceeds the limit can never be
+                # admitted — retrying is futile, so the reason says
+                # "oversized" and carries no retry hint (the HTTP layer
+                # answers a non-retryable 400, not a 429). Otherwise
+                # the ledger drains at request COMPLETION, not on a
+                # clock — a heuristic come-back-soon hint.
+                if state.rate is not None:
+                    state.rate.credit(1.0, now)
+                if state.tokens is not None:
+                    state.tokens.credit(reserve, now)
+                if pages > limit:
+                    # NOT a throttle: the 400 is terminal, so it must
+                    # not ride the 429-throttle counters the docs'
+                    # alerts read (an operator would raise the limit
+                    # for a request no headroom could ever admit).
+                    state.refused_kv_oversized += 1
+                    self._m_requests.labels(
+                        tenant=tenant,
+                        outcome="refused_kv_oversized").inc()
+                    return Admission(False, tenant, priority=priority,
+                                     retry_after_s=0.0,
+                                     reason="kv_pages_oversized")
+                state.throttled_kv_pages += 1
+                self._throttle_metrics(tenant, "throttled_kv_pages")
+                return Admission(False, tenant, priority=priority,
+                                 retry_after_s=KV_PAGES_RETRY_S,
+                                 reason="kv_pages")
+            if limit is not None:
+                state.kv_pages_in_flight += pages
+            else:
+                pages = 0.0  # nothing to release at settle
             state.admitted += 1
         self._m_requests.labels(tenant=tenant, outcome="admitted").inc()
         return Admission(True, tenant, priority=priority,
-                         reserved_tokens=reserve)
+                         reserved_tokens=reserve, reserved_pages=pages)
 
     def _throttle_metrics(self, tenant: str, outcome: str) -> None:
         # Counter bumps are their own locks; called with self._lock held
@@ -229,8 +315,10 @@ class TenantGovernor:
 
     def settle(self, admission: Admission, actual_tokens: int) -> None:
         """Refund the unused part of an admitted reservation once the
-        true ``prompt + completion`` size is known (idempotent: the HTTP
-        handler's error paths and success path may both reach it)."""
+        true ``prompt + completion`` size is known, and release the
+        request's KV-page reservation — the request is done holding
+        pool pages either way (idempotent: the HTTP handler's error
+        paths and success path may both reach it)."""
         if not admission.allowed or admission._settled:
             return
         admission._settled = True
@@ -242,6 +330,10 @@ class TenantGovernor:
             state = self._states[admission.tenant]
             if state.tokens is not None and refund > 0:
                 state.tokens.credit(refund, now)
+            if admission.reserved_pages:
+                state.kv_pages_in_flight = max(
+                    0.0, state.kv_pages_in_flight
+                    - admission.reserved_pages)
             state.tokens_charged += charged
         if charged:
             self._m_tokens.labels(tenant=admission.tenant).inc(charged)
@@ -263,6 +355,15 @@ class TenantGovernor:
                     "throttled_tokens": state.throttled_tokens,
                     "tokens_charged": round(state.tokens_charged, 1),
                 }
+                if state.policy.model:
+                    row["model"] = state.policy.model
+                if state.policy.kv_page_limit is not None:
+                    row["kv_page_limit"] = state.policy.kv_page_limit
+                    row["kv_pages_in_flight"] = round(
+                        state.kv_pages_in_flight, 1)
+                    row["throttled_kv_pages"] = state.throttled_kv_pages
+                    row["refused_kv_oversized"] = \
+                        state.refused_kv_oversized
                 if state.rate is not None:
                     state.rate._refill(now)
                     row["rate_remaining"] = round(state.rate.level, 2)
@@ -288,7 +389,9 @@ class TenantGovernor:
                 rate_limit_rpm=getattr(block, "rate_limit_rpm", None),
                 token_budget_per_min=getattr(block, "token_budget_per_min",
                                              None),
+                kv_page_limit=getattr(block, "kv_page_limit", None),
                 priority=getattr(block, "priority", "interactive"),
+                model=getattr(block, "model", None),
                 api_key=getattr(block, "api_key", None))
 
         policies = {name: to_policy(block)

@@ -6,7 +6,9 @@ OpenAI-client tooling can point at a TPU slice with no code changes:
 
 - ``POST /v1/chat/completions`` — non-streaming and ``stream: true`` (SSE
   ``data:`` chunks, ``[DONE]`` terminator).
-- ``GET /v1/models`` — the single served model.
+- ``GET /v1/models`` — the served catalog: the single model (plus its
+  LoRA adapters), or under ``llm.models`` every model group with its
+  replica count and group-local adapters.
 - ``GET /healthz`` — liveness + engine metrics snapshot (taken under the
   engine's step lock) + uptime + KV-pool pressure.
 - ``GET /metrics`` — Prometheus text exposition of the process registry
@@ -21,11 +23,21 @@ OpenAI-client tooling can point at a TPU slice with no code changes:
 - ``GET /tenants`` — live tenant-accounting state (``sched/tenants.py``):
   per-tenant policy, bucket levels, admit/throttle counters.
 
+Multi-model routing (``llm.models`` → ``runbookai_tpu/fleet``): the
+request's ``model`` field resolves to a served model group (adapter
+names resolve within their owning group; unknown names are 404s, never
+silent base-model serving), and EVERYTHING downstream — prompt
+encoding, sampling limits, admission page estimates, the stream itself
+— uses the resolved group's tokenizer/chat-format/engine. A tenant may
+be pinned to one group (``llm.tenants.keys.<name>.model``): requests
+without a model field route there, explicit different groups are 403s.
+
 Multi-tenant admission (``llm.tenants`` → ``runbookai_tpu/sched``): every
 chat/completions request resolves its tenant from ``Authorization:
-Bearer`` / ``x-api-key`` and must pass the tenant's rate and token-budget
-buckets BEFORE enqueue — a throttled request is answered ``429`` with
-``Retry-After`` and never consumes an engine slot. Requests carry a
+Bearer`` / ``x-api-key`` and must pass the tenant's rate, token-budget,
+and in-flight KV-page buckets BEFORE enqueue — a throttled request is
+answered ``429`` naming the failing bucket, with ``Retry-After``, and
+never consumes an engine slot. Requests carry a
 priority class (the tenant's configured class, or an explicit
 ``x-priority: interactive|batch`` header) into the engine's
 weighted-deficit scheduler; fleet sheds and engine pool-pressure aborts
@@ -196,14 +208,22 @@ def _logprob_entry(tokenizer, e: dict, top_n: int) -> dict:
     return out
 
 
-def parse_openai_sampling(body: dict, client) -> tuple[Any, int, int]:
+def parse_openai_sampling(body: dict, client, tokenizer=None,
+                          defaults=None) -> tuple[Any, int, int]:
     """Shared OpenAI sampling-field parsing for the chat and legacy
     completions endpoints: stop, n, logprobs, penalties, seed,
     logit_bias, max_tokens (and its max_completion_tokens alias).
     Returns (sampling, n, top_logprobs); raises ValueError on invalid
-    input (the handlers map that to HTTP 400)."""
+    input (the handlers map that to HTTP 400). ``tokenizer`` and
+    ``defaults`` are the RESOLVED model group's pieces under multi-model
+    serving — stop ids and the logit_bias vocab check are per group, and
+    a group's derived config (``llm.models[].overrides``) supplies the
+    temperature/top_p/top_k/max_new_tokens fallbacks for fields the
+    request leaves unset. Both default to the client's."""
     from runbookai_tpu.engine.request import SamplingParams
 
+    tokenizer = tokenizer if tokenizer is not None else client.tokenizer
+    defaults = defaults if defaults is not None else client
     stop = body.get("stop") or []
     if isinstance(stop, str):
         stop = [stop]
@@ -239,17 +259,17 @@ def parse_openai_sampling(body: dict, client) -> tuple[Any, int, int]:
         if not -100.0 <= b_val <= 100.0:
             raise ValueError("logit_bias values must be in [-100, 100]")
         tid = int(tok_id)
-        if not 0 <= tid < client.tokenizer.vocab_size:
+        if not 0 <= tid < tokenizer.vocab_size:
             raise ValueError(f"logit_bias token id {tid} out of vocab range")
         logit_bias.append((tid, b_val))
     sampling = SamplingParams(
-        temperature=float(body.get("temperature", client.temperature)),
-        top_p=float(body.get("top_p", client.top_p)),
-        top_k=int(body.get("top_k", client.top_k)),
+        temperature=float(body.get("temperature", defaults.temperature)),
+        top_p=float(body.get("top_p", defaults.top_p)),
+        top_k=int(body.get("top_k", defaults.top_k)),
         max_new_tokens=int(body.get("max_tokens")
                            or body.get("max_completion_tokens")
-                           or client.max_new_tokens),
-        stop_token_ids=(client.tokenizer.eot_id, client.tokenizer.eos_id),
+                           or defaults.max_new_tokens),
+        stop_token_ids=(tokenizer.eot_id, tokenizer.eos_id),
         stop_strings=tuple(stop),
         logprobs=((top_logprobs or 1) if want_logprobs else 0),
         presence_penalty=presence,
@@ -399,12 +419,17 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     f"{sorted(CLASS_NAMES.values())}, got {hdr!r}")
             return priority
 
-        def _admit_tenant(self, prompt_tokens: int, max_new_tokens: int):
+        def _admit_tenant(self, prompt_tokens: int, max_new_tokens: int,
+                          kv_pages: float = 0.0):
             """Tenant admission BEFORE enqueue (sched/tenants.py):
             returns ``(admission, priority)`` — admission is None when no
             governor is configured. A throttled request is answered 429 +
             Retry-After here and ``(None, None)`` is returned; the caller
-            must then bail without touching the engine."""
+            must then bail without touching the engine. ``kv_pages`` is
+            the request's estimated worst-case KV footprint
+            (ceil((prompt + n·max_new)/page_size)) — tenants with a
+            kv_page_limit reserve it for the request's lifetime, and the
+            429 names WHICH bucket refused."""
             # Header parse FIRST: a junk x-priority must 400 before any
             # bucket is charged (no refund bookkeeping for bad input).
             override = self._priority_override()  # caller catches ValueError
@@ -412,10 +437,25 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             admission = None
             if governor is not None:
                 admission = governor.admit(self._api_key(), prompt_tokens,
-                                           max_new_tokens)
+                                           max_new_tokens,
+                                           kv_pages=kv_pages)
                 if not admission.allowed:
-                    limit = ("rate limit" if admission.reason == "rate_limit"
-                             else "token budget")
+                    if admission.reason == "kv_pages_oversized":
+                        # The request ALONE exceeds the tenant's page
+                        # ledger: no amount of waiting admits it, so a
+                        # retryable 429 would loop a compliant client
+                        # forever — refuse it outright.
+                        self._error(
+                            400,
+                            f"request exceeds tenant "
+                            f"{admission.tenant!r}'s kv_page_limit "
+                            f"(estimated pages > limit); shrink the "
+                            f"prompt or max_tokens")
+                        return None, None
+                    limit = {"rate_limit": "rate limit",
+                             "token_budget": "token budget",
+                             "kv_pages": "kv page budget",
+                             }.get(admission.reason, "limit")
                     self._error(
                         429,
                         f"tenant {admission.tenant!r} is over its {limit}; "
@@ -443,6 +483,65 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             if governor is not None and admission is not None:
                 governor.settle(admission, actual_tokens)
 
+        def _resolve_model(self, requested):
+            """Resolve the request's ``model`` field to the serving
+            pieces: ``(model_out, adapter, engine, tokenizer,
+            chat_format, page_size, sampling_defaults)`` — or ``None``
+            with the error already sent (404 unknown model, 403
+            tenant-pin violation).
+
+            Multi-model fleets (``llm.models``) dispatch to the owning
+            group: group name -> that group, adapter name -> its group
+            with the adapter selected, absent -> the tenant's pinned
+            group or the default. The single-model path is exactly the
+            historical logic (adapter-as-model within the one engine).
+            Everything downstream — prompt encoding, sampling limits,
+            admission page estimates, the stream itself — uses the
+            RESOLVED group's tokenizer/engine, so a request never mixes
+            one model's tokenizer with another's replicas."""
+            governor = getattr(client, "tenants", None)
+            pinned = (governor.pinned_model(self._api_key())
+                      if governor is not None else None)
+            mm = getattr(client, "multi_model", None)
+            if mm is not None:
+                try:
+                    group_name, adapter = mm.resolve(requested or pinned)
+                except KeyError as e:
+                    self._error(404, str(e.args[0]) if e.args else str(e))
+                    return None
+                if pinned is not None and group_name != pinned:
+                    # Tenant-affine placement: the pin is an isolation
+                    # boundary, not a default — a pinned tenant naming
+                    # another group is refused, never silently re-routed.
+                    self._error(
+                        403,
+                        f"tenant {governor.resolve(self._api_key())!r} "
+                        f"is pinned to model {pinned!r}; requested "
+                        f"{requested!r}", err_type="permission_error")
+                    return None
+                group = mm.groups[group_name]
+                # The group's derived config supplies sampling
+                # fallbacks (llm.models[].overrides — e.g. a per-group
+                # max_new_tokens); client-level defaults otherwise.
+                return ((requested or group_name), adapter, group.fleet,
+                        group.tokenizer, group.chat_format,
+                        group.page_size, group.llm_cfg or client)
+            adapter = None
+            if requested and requested != model_name:
+                names = (client.core.lora.names
+                         if client.core.lora is not None else [])
+                if requested in names:
+                    adapter = requested
+                else:
+                    # vLLM semantics: unknown model names are errors,
+                    # not silent base-model serving.
+                    self._error(404, f"model {requested!r} not found; "
+                                     f"served: {[model_name] + names}")
+                    return None
+            return (requested or model_name, adapter, client.engine,
+                    client.tokenizer, client.chat_format,
+                    client.core.ecfg.page_size, client)
+
         def _read_json(self) -> dict:
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
@@ -464,6 +563,14 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 self._debug_steps(query)
                 return
             if path == "/v1/models":
+                mm = getattr(client, "multi_model", None)
+                if mm is not None:
+                    # Full served catalog: every model group (with its
+                    # replica count) and every group's adapters, each
+                    # adapter parented to its group.
+                    self._json(200, {"object": "list",
+                                     "data": mm.served_models()})
+                    return
                 models = [{"id": model_name, "object": "model",
                            "owned_by": "runbookai-tpu"}]
                 if client.core.lora is not None:
@@ -571,24 +678,17 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 if not messages:
                     raise ValueError("messages is required")
                 system, history, user = messages_to_prompt_parts(messages)
-                # vLLM-style multi-LoRA: a request whose model equals a
-                # registered adapter name routes through that adapter.
-                requested = body.get("model")
-                adapter = None
-                if requested and requested != model_name:
-                    names = (client.core.lora.names
-                             if client.core.lora is not None else [])
-                    if requested in names:
-                        adapter = requested
-                    else:
-                        # vLLM semantics: unknown model names are errors,
-                        # not silent base-model serving.
-                        self._error(404, f"model {requested!r} not found; "
-                                         f"served: {[model_name] + names}")
-                        return
+                # Model-field routing: a multi-model fleet dispatches to
+                # the owning group (unknown model -> 404, tenant pin ->
+                # 403); single-model keeps vLLM-style adapter-as-model.
+                resolved = self._resolve_model(body.get("model"))
+                if resolved is None:
+                    return  # 404/403 already sent
+                (model_out, adapter, eng, tok, chat_fmt, page_size,
+                 sp_defaults) = resolved
                 # Client-supplied values: coercion failures are 400s too.
-                sampling, n, top_logprobs = parse_openai_sampling(body,
-                                                                  client)
+                sampling, n, top_logprobs = parse_openai_sampling(
+                    body, client, tokenizer=tok, defaults=sp_defaults)
                 # response_format json_object -> grammar-constrained
                 # decoding (the engine's guided JSON automaton): output is
                 # a valid-JSON prefix by construction, and a COMPLETE
@@ -611,18 +711,30 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 self._error(400, str(e))
                 return
 
+            import math
+
             from runbookai_tpu.model.chat_template import build_chat_prompt
 
             prompt = build_chat_prompt(system, user, history=history,
-                                       fmt=client.chat_format)
-            ids = client.tokenizer.encode(prompt)
+                                       fmt=chat_fmt)
+            ids = tok.encode(prompt)
 
             # Tenant admission BEFORE the engine sees anything: a tenant
-            # over its rate limit or token budget gets 429 + Retry-After
-            # and never consumes a slot, a KV page, or a queue entry.
+            # over its rate limit, token budget, or in-flight KV-page
+            # ledger gets 429 + Retry-After and never consumes a slot, a
+            # KV page, or a queue entry. The page estimate is the
+            # request's worst case at the RESOLVED group's page size:
+            # the n choices run as n CONCURRENT engine requests, each
+            # holding its own live copy of the prompt's pages while it
+            # decodes (in-flight prefills don't share; only retired
+            # prefix pages do) — so the prompt counts n times here even
+            # though the token budget counts it once.
             try:
                 admission, priority = self._admit_tenant(
-                    len(ids), n * sampling.max_new_tokens)
+                    len(ids), n * sampling.max_new_tokens,
+                    kv_pages=math.ceil(
+                        n * (len(ids) + sampling.max_new_tokens)
+                        / max(1, page_size)))
             except ValueError as e:  # junk x-priority header
                 self._error(400, str(e))
                 return
@@ -638,8 +750,10 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     # Fleet shedding: refuse BEFORE committing SSE headers
                     # so a saturated pod answers a real 503 (the check-
                     # then-route race falls back to an in-stream error
-                    # event inside _stream_response).
-                    saturated = getattr(client.engine, "is_saturated", None)
+                    # event inside _stream_response). The RESOLVED
+                    # group's saturation is what matters — one model's
+                    # flood must not shed a healthy sibling's stream.
+                    saturated = getattr(eng, "is_saturated", None)
                     if saturated is not None and saturated():
                         self._settle_tenant(admission, 0)
                         self._error(503, "all fleet replicas are "
@@ -651,7 +765,8 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                         ids, sampling, adapter,
                         top_logprobs=top_logprobs,
                         include_usage=bool(so.get("include_usage")),
-                        priority=priority, admission=admission)
+                        priority=priority, admission=admission,
+                        engine=eng, tokenizer=tok, model=model_out)
                 else:
                     # The engine-side timeout ABORTS a stalled request
                     # (frees slot + KV pages) before raising; the bridge
@@ -676,7 +791,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                         # its engine-side timeout) — nothing keeps decoding
                         # unobserved after an error response.
                         return await asyncio.gather(*[
-                            client.engine.generate(
+                            eng.generate(
                                 ids, _choice_sampling(i),
                                 timeout_s=request_timeout,
                                 priority=priority, adapter=adapter,
@@ -717,13 +832,12 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                                                else "stop")}
                         if o.logprobs is not None:
                             c["logprobs"] = {"content": [
-                                _logprob_entry(client.tokenizer, e,
-                                               top_logprobs)
+                                _logprob_entry(tok, e, top_logprobs)
                                 for e in o.logprobs]}
                         return c
 
                     payload = _completion_payload(
-                        model_name, "",
+                        model_out, "",
                         {"prompt_tokens": len(ids),
                          "completion_tokens": sum(o.decode_tokens
                                                   for o in outs)})
@@ -772,21 +886,17 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                         "prompt must be a string or list of strings")
                 if len(prompts) > 8:
                     raise ValueError("at most 8 prompts per request")
-                # Same routing policy as chat: adapters serve as model
-                # names, unknown names are 404s — never silent base-model
-                # serving.
+                # Same routing policy as chat: model-field dispatch
+                # (multi-model groups / adapter-as-model), unknown names
+                # are 404s — never silent base-model serving.
                 requested = body.get("model")
-                adapter = None
-                if requested and requested != model_name:
-                    names = (client.core.lora.names
-                             if client.core.lora is not None else [])
-                    if requested in names:
-                        adapter = requested
-                    else:
-                        self._error(404, f"model {requested!r} not found; "
-                                         f"served: {[model_name] + names}")
-                        return
-                sampling, n, _ = parse_openai_sampling(body, client)
+                resolved = self._resolve_model(requested)
+                if resolved is None:
+                    return  # 404/403 already sent
+                (model_out, adapter, eng, tok, _fmt, page_size,
+                 sp_defaults) = resolved
+                sampling, n, _ = parse_openai_sampling(
+                    body, client, tokenizer=tok, defaults=sp_defaults)
                 # Classic logprobs is an int: top-N alternatives per token.
                 lp_n = int(body.get("logprobs") or 0)
                 if not 0 <= lp_n <= 5:
@@ -795,14 +905,22 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 echo = bool(body.get("echo"))
                 # Tokenize each prompt ONCE: the same ids feed the engine
                 # and the usage count, so they cannot disagree.
-                all_ids = [client.tokenizer.encode(p) for p in prompts]
+                all_ids = [tok.encode(p) for p in prompts]
 
                 # Same tenant gate as the chat endpoint: the reservation
-                # covers every prompt and all n completions per prompt.
+                # covers every prompt and all n completions per prompt
+                # (tokens AND estimated KV pages — each of the n×len(
+                # prompts) concurrent requests holds its own live prompt
+                # copy, so prompts count n times in the page estimate).
+                import math
+
                 prompt_total = sum(len(ids) for ids in all_ids)
+                reserve_new = n * len(all_ids) * sampling.max_new_tokens
                 admission, priority = self._admit_tenant(
-                    prompt_total,
-                    n * len(all_ids) * sampling.max_new_tokens)
+                    prompt_total, reserve_new,
+                    kv_pages=math.ceil(
+                        (n * prompt_total + reserve_new)
+                        / max(1, page_size)))
                 if priority is None:
                     return  # throttled; 429 + Retry-After already sent
 
@@ -816,7 +934,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                             if sampling.seed is not None and i:
                                 sp = _dc.replace(sampling,
                                                  seed=sampling.seed + i)
-                            jobs.append(client.engine.generate(
+                            jobs.append(eng.generate(
                                 ids, sp, timeout_s=request_timeout,
                                 priority=priority, adapter=adapter,
                                 request_id=self._request_id))
@@ -848,12 +966,12 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     tokens, tlps, tops, offsets = [], [], [], []
                     off = text_start
                     for e in o.logprobs:
-                        raw = client.tokenizer.id_to_bytes(
+                        raw = tok.id_to_bytes(
                             e["token_id"]).decode("utf-8", "replace")
                         tokens.append(raw)
                         tlps.append(e["logprob"])
                         tops.append({
-                            client.tokenizer.id_to_bytes(t).decode(
+                            tok.id_to_bytes(t).decode(
                                 "utf-8", "replace"): lp
                             for t, lp in e["top"][:lp_n]})
                         offsets.append(off)
@@ -880,7 +998,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     "id": f"cmpl-{uuid.uuid4().hex[:12]}",
                     "object": "text_completion",
                     "created": int(time.time()),
-                    "model": requested or model_name,
+                    "model": model_out,
                     "choices": choices,
                     "usage": {
                         "prompt_tokens": prompt_tokens,
@@ -958,6 +1076,14 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 self._error(403, "runtime adapter loading is disabled; "
                                  "start with --allow-adapter-loading")
                 return
+            if getattr(client, "multi_model", None) is not None:
+                # Runtime loads would need a target-group parameter and
+                # per-group refresh; configure multi-model adapters in
+                # llm.models[].adapters instead (loaded at startup).
+                self._error(400, "runtime adapter loading is not "
+                                 "supported with llm.models; configure "
+                                 "llm.models[].adapters")
+                return
             if client.core.lora is None:
                 self._error(400, "engine has no LoRA registry (configure "
                                  "llm.lora_rank/lora_targets)")
@@ -1001,9 +1127,17 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                              top_logprobs: int = 0,
                              include_usage: bool = False,
                              priority: int = PRIORITY_INTERACTIVE,
-                             admission=None) -> None:
+                             admission=None, engine=None, tokenizer=None,
+                             model: Optional[str] = None) -> None:
             from runbookai_tpu.model.jax_tpu import stream_text
 
+            # The resolved model group's pieces (multi-model routing);
+            # defaults keep the historical single-engine behavior for
+            # direct callers.
+            engine = engine if engine is not None else client.engine
+            tokenizer = (tokenizer if tokenizer is not None
+                         else client.tokenizer)
+            model = model or model_name
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -1023,7 +1157,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 self.wfile.flush()
 
             chunk_id = f"chatcmpl-{uuid.uuid4().hex[:12]}"
-            send_chunk(_chunk_payload(model_name, {"role": "assistant"},
+            send_chunk(_chunk_payload(model, {"role": "assistant"},
                                       None, chunk_id))
             state: dict = {}
             # Shared with JaxTpuClient.chat_stream: one copy of the
@@ -1033,7 +1167,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             # each chunk carries the entries for tokens consumed since the
             # last chunk — OpenAI streams logprobs in the deltas.
             req_sink: list = []
-            agen = stream_text(client.engine, client.tokenizer, ids,
+            agen = stream_text(engine, tokenizer, ids,
                                sampling, state=state, priority=priority,
                                adapter=adapter, request_sink=req_sink,
                                request_id=getattr(self, "_request_id", None))
@@ -1050,7 +1184,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 if upto <= lp_sent:
                     return None
                 out = {"content": [
-                    _logprob_entry(client.tokenizer, e, top_logprobs)
+                    _logprob_entry(tokenizer, e, top_logprobs)
                     for e in entries[lp_sent:upto]]}
                 lp_sent = upto
                 return out
@@ -1060,7 +1194,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     for piece in bridge.stream(agen,
                                                timeout=request_timeout):
                         payload = _chunk_payload(
-                            model_name, {"content": piece}, None, chunk_id)
+                            model, {"content": piece}, None, chunk_id)
                         lp = chunk_logprobs()
                         if lp is not None:
                             payload["choices"][0]["logprobs"] = lp
@@ -1081,7 +1215,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                           if not state.get("saw_stop")
                           and state.get("n_tokens", 0)
                           >= sampling.max_new_tokens else "stop")
-                final = _chunk_payload(model_name, {}, finish, chunk_id)
+                final = _chunk_payload(model, {}, finish, chunk_id)
                 lp_tail = chunk_logprobs()  # entries past the last piece
                 if lp_tail is not None:
                     final["choices"][0]["logprobs"] = lp_tail
@@ -1094,7 +1228,7 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                         "id": chunk_id,
                         "object": "chat.completion.chunk",
                         "created": int(time.time()),
-                        "model": model_name,
+                        "model": model,
                         "choices": [],
                         "usage": {"prompt_tokens": len(ids),
                                   "completion_tokens": n_out,

@@ -68,17 +68,27 @@ class Scenario:
     query: str
     fixtures: dict[str, Any]
     truth: dict[str, Any] = field(default_factory=dict)
+    # Served model group this investigation should run against (multi-
+    # model fleets): `runbook eval --simulate` / `simulate eval --models`
+    # assign groups round-robin so the generated load exercises
+    # model-field routing; None = the default model (single-model runs
+    # are unchanged).
+    model: str | None = None
 
     def to_json(self) -> str:
-        return json.dumps({"scenario_id": self.scenario_id,
-                           "query": self.query, "truth": self.truth,
-                           "fixtures": self.fixtures}, indent=2)
+        doc = {"scenario_id": self.scenario_id,
+               "query": self.query, "truth": self.truth,
+               "fixtures": self.fixtures}
+        if self.model is not None:
+            doc["model"] = self.model
+        return json.dumps(doc, indent=2)
 
     @classmethod
     def from_json(cls, text: str) -> "Scenario":
         d = json.loads(text)
         return cls(scenario_id=d["scenario_id"], query=d["query"],
-                   fixtures=d["fixtures"], truth=d.get("truth", {}))
+                   fixtures=d["fixtures"], truth=d.get("truth", {}),
+                   model=d.get("model"))
 
 
 # ------------------------------------------------------------ fault kit
@@ -588,9 +598,18 @@ def _generate_locked(seed: int, fault_type: str | None) -> Scenario:
 
 def generate_scenarios(n: int, seed: int = 0,
                        fault_type: str | None = None,
-                       adversarial: str | None = None) -> list[Scenario]:
-    return [generate_scenario(seed + i, fault_type, adversarial=adversarial)
-            for i in range(n)]
+                       adversarial: str | None = None,
+                       models: list[str] | None = None) -> list[Scenario]:
+    """``models`` assigns each scenario a served model group round-robin
+    (deterministic in i, so the same seed+models always produces the
+    same assignment) — multi-model fleet runs then exercise the
+    model-field routing path on every case."""
+    out = [generate_scenario(seed + i, fault_type, adversarial=adversarial)
+           for i in range(n)]
+    if models:
+        for i, s in enumerate(out):
+            s.model = models[i % len(models)]
+    return out
 
 
 def to_eval_case(s: Scenario):
@@ -605,6 +624,7 @@ def to_eval_case(s: Scenario):
         expected_services=[s.truth["root_cause_service"]],
         incident_id=s.scenario_id,
         fixtures=s.fixtures,
+        model=s.model,
     )
 
 

@@ -113,6 +113,18 @@ class TenantPolicyConfig(BaseModel):
     # Tokens per minute (prompt + completion; worst case reserved at
     # admission, unused part refunded when the completion size is known).
     token_budget_per_min: Optional[float] = Field(None, gt=0)
+    # Estimated KV pages the tenant may hold IN FLIGHT (reserved at
+    # admission from prompt + n*max_tokens, released when the request
+    # settles). A concurrency ledger, not a per-minute rate: it stops a
+    # long-context tenant from crowding the page pool while staying
+    # inside its token budget. None = unenforced.
+    kv_page_limit: Optional[int] = Field(None, gt=0)
+    # Pin this tenant to one served model group (``llm.models`` entry
+    # name): requests without a ``model`` field route to the pinned
+    # group, and an explicit different model is refused 403 — the
+    # tenant-affine placement half of multi-model serving. Only
+    # meaningful with ``llm.models`` set (validated).
+    model: Optional[str] = None
     # Scheduling class of this tenant's requests; the x-priority header
     # can DEMOTE a request (never promote past this class).
     priority: Literal["interactive", "batch"] = "interactive"
@@ -193,6 +205,54 @@ class SLOConfig(BaseModel):
                 if v is not None}
 
 
+# Keys a model-group entry owns (or that cannot nest): a group's
+# ``overrides`` must not rewrite them behind the entry's back — replica
+# accounting, plan validation and adapter resolution all read the ENTRY
+# fields (enforced at load by validate_config AND at build by
+# fleet/build.derive_group_llm).
+RESERVED_GROUP_OVERRIDE_KEYS = frozenset((
+    "model", "model_path", "tokenizer_path", "plan", "dp_replicas",
+    "lora_adapters", "models", "tenants",
+))
+
+
+class ModelGroupConfig(BaseModel):
+    """One served model group of a multi-model fleet (``llm.models``).
+
+    Each group is a full replica set built from its own derived
+    ``LLMConfig``: the base ``llm`` block supplies every unspecified
+    knob, the group's ``plan`` (if any) fills the gaps a serving-plan
+    artifact pins, and ``overrides`` beats both — the same
+    explicit-beats-plan precedence as a single-model ``llm.plan``
+    (docs/CONFIG.md "Multi-model fleets")."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    # Served model id: what OpenAI requests put in "model", what the
+    # /v1/models catalog lists, what metric labels carry.
+    name: str
+    # Model catalog config name (models/llama.CONFIGS). Default: name.
+    model: Optional[str] = None
+    # Weights / tokenizer for this group (None = base llm values, which
+    # usually means discovery/random-init per group model name).
+    model_path: Optional[str] = None
+    tokenizer_path: Optional[str] = None
+    # Serving-plan artifact sizing THIS group's per-replica budget
+    # (slots/pages/dispatch knobs) — per-model plans from `runbook tune`.
+    plan: Optional[str] = None
+    # Replicas dedicated to this group (global fleet indices are
+    # assigned contiguously across groups, in list order).
+    dp_replicas: int = Field(1, ge=1)
+    # Multi-LoRA adapters served WITHIN this group: adapter name ->
+    # HF PEFT dir. Adapter names resolve in the group's namespace and
+    # are listed under the group in /v1/models.
+    adapters: dict[str, str] = Field(default_factory=dict)
+    # llm.* field overrides for this group only (page_size, num_pages,
+    # max_batch_slots, kv_cache_dtype, ...). Keys are validated against
+    # LLMConfig at load; values win over the group plan AND the base.
+    overrides: dict[str, Any] = Field(default_factory=dict)
+
+
 class LLMConfig(BaseModel):
     provider: Literal["jax-tpu", "mock"] = "mock"
     model: str = "llama3-8b-instruct"
@@ -260,6 +320,15 @@ class LLMConfig(BaseModel):
     # = 1 (a replica is a single-slice engine).
     dp_replicas: int = 1
     fleet: FleetRouterConfig = Field(default_factory=FleetRouterConfig)
+    # Multi-model fleet (runbookai_tpu/fleet/): partition replicas into
+    # named model groups, each built from its own derived LLMConfig (base
+    # llm block + group plan + group overrides), served behind ONE
+    # OpenAI endpoint that routes on the request's "model" field. Empty
+    # (the default) = exactly today's single-model fleet, bit for bit.
+    # With models set, dp_replicas/fleet.disagg/mesh>1 on the BASE block
+    # are refused at load (each group sizes its own replicas; tiering
+    # within groups is a later composition) — see validate_config.
+    models: list[ModelGroupConfig] = Field(default_factory=list)
     # Latency SLOs evaluated at scrape time (utils/slo.py): exported as
     # runbook_slo_{target_ms,current_ms,burn_ratio,violations_total} and
     # an "slo" block in /healthz. No objectives set = no SLO series.
@@ -536,6 +605,93 @@ def set_config_value(config: Config, dotted_key: str, value: str) -> Config:
     return Config.model_validate(data)
 
 
+def _validate_models(config: Config) -> list[str]:
+    """``llm.models`` (multi-model fleet) pre-flight checks: unique
+    served names, adapter names that cannot shadow a group, overrides
+    that actually name LLMConfig fields, per-group plans that exist and
+    match their group's model, and base-block knobs that do not compose
+    with model groups. Tenant model pins must name a served group."""
+    problems: list[str] = []
+    groups = config.llm.models
+    if not groups:
+        for name, policy in config.llm.tenants.keys.items():
+            if policy.model:
+                problems.append(
+                    f"llm.tenants.keys.{name}.model={policy.model!r} "
+                    f"needs llm.models (there is no model catalog to "
+                    f"pin the tenant to)")
+        return problems
+    if config.llm.dp_replicas != 1:
+        problems.append(
+            "llm.models and llm.dp_replicas do not compose: each group "
+            "sizes its own replicas via models[].dp_replicas")
+    if config.llm.mesh.device_count > 1:
+        problems.append(
+            "llm.models requires llm.mesh.data/model = 1 (each group "
+            "replica owns its own device slice; TP within a group is a "
+            "later composition)")
+    if config.llm.fleet.disagg.enabled:
+        problems.append(
+            "llm.models and llm.fleet.disagg do not compose yet "
+            "(prefill/decode tiering is per-fleet, not per-group)")
+    served: set[str] = set()
+    for i, group in enumerate(groups):
+        where = f"llm.models[{i}] ({group.name!r})"
+        if group.name in served:
+            problems.append(f"{where}: duplicate served model name")
+        served.add(group.name)
+        bad = set(group.overrides) - set(LLMConfig.model_fields)
+        if bad:
+            problems.append(
+                f"{where}: overrides name unknown llm.* keys "
+                f"{sorted(bad)}")
+        reserved = RESERVED_GROUP_OVERRIDE_KEYS & set(group.overrides)
+        if reserved:
+            problems.append(
+                f"{where}: overrides cannot set {sorted(reserved)} — "
+                f"these are group-entry fields (set them on the entry "
+                f"itself)")
+        if group.plan:
+            if not Path(group.plan).is_file():
+                problems.append(f"{where}: plan does not exist: "
+                                f"{group.plan}")
+            else:
+                from runbookai_tpu.autotune.plan import load_plan
+
+                try:
+                    plan = load_plan(group.plan)
+                except ValueError as e:
+                    problems.append(f"{where}: plan: {e}")
+                else:
+                    want = group.model or group.name
+                    if plan.model != want:
+                        problems.append(
+                            f"{where}: plan was tuned for model "
+                            f"{plan.model!r} but the group serves "
+                            f"{want!r}")
+    adapters = {name for g in groups for name in g.adapters}
+    shadowing = adapters & served
+    for name in sorted(shadowing):
+        problems.append(
+            f"llm.models: adapter name {name!r} shadows a served model "
+            f"group (the request's model field could mean either)")
+    seen_adapters: set[str] = set()
+    for group in groups:
+        dup = seen_adapters & set(group.adapters)
+        for name in sorted(dup):
+            problems.append(
+                f"llm.models: adapter name {name!r} appears in more "
+                f"than one group (adapter-as-model requests would be "
+                f"ambiguous)")
+        seen_adapters |= set(group.adapters)
+    for name, policy in config.llm.tenants.keys.items():
+        if policy.model and policy.model not in served:
+            problems.append(
+                f"llm.tenants.keys.{name}.model={policy.model!r} is not "
+                f"a served model group (served: {sorted(served)})")
+    return problems
+
+
 def validate_config(config: Config) -> list[str]:
     """Return human-readable problems (reference validateConfig :292)."""
     problems: list[str] = []
@@ -582,6 +738,7 @@ def validate_config(config: Config) -> list[str]:
                 f"llm.fleet.disagg.prefill_replicas="
                 f"{disagg.prefill_replicas} leaves no decode tier in a "
                 f"dp_replicas={config.llm.dp_replicas} fleet")
+    problems.extend(_validate_models(config))
     if (config.llm.sched.feedback
             and config.llm.slo.tpot_p95_ms is None):
         problems.append(

@@ -102,6 +102,14 @@ class _Child:
         self._metric._set_key_function(self._key, fn)
         return self
 
+    @property
+    def value(self) -> float:
+        """Stored value of THIS labelset (the labeled twin of
+        ``Counter.value`` — fleet health snapshots read their own
+        model's series, never a cross-group total)."""
+        with self._metric._lock:
+            return self._metric._values.get(self._key, 0.0)
+
 
 class _Metric:
     type = "untyped"

@@ -307,13 +307,20 @@ async def test_router_metrics_scrape_and_aggregates(fleet2_client):
     await fleet.generate(ids("one more for the scrape"), sp(4))
     await fleet.stop()
     text = get_registry().render()
-    assert 'runbook_router_requests_total{replica="0"}' in text
-    assert 'runbook_router_requests_total{replica="1"}' in text
+    # Every router/replica series carries the served-model label (the
+    # multi-model dimension; single-model fleets label their one model).
+    assert ('runbook_router_requests_total'
+            '{model="llama3-test",replica="0"}') in text
+    assert ('runbook_router_requests_total'
+            '{model="llama3-test",replica="1"}') in text
     assert "runbook_router_affinity_hits_total" in text
     assert "runbook_router_imbalance_ratio" in text
-    assert 'runbook_replica_running_requests{replica="0"}' in text
-    assert 'runbook_replica_kv_pool_utilization{replica="1"}' in text
-    assert 'runbook_replica_decode_tokens_total{replica="0"}' in text
+    assert ('runbook_replica_running_requests'
+            '{model="llama3-test",replica="0"}') in text
+    assert ('runbook_replica_kv_pool_utilization'
+            '{model="llama3-test",replica="1"}') in text
+    assert ('runbook_replica_decode_tokens_total'
+            '{model="llama3-test",replica="0"}') in text
     # Unlabeled engine names now read fleet-wide aggregates.
     total = sum(c.metrics["decode_tokens"] for c in fleet.cores)
     assert get_registry().get(

@@ -747,6 +747,24 @@ class TestTreeIsClean:
             ROOT / "runbookai_tpu" / "engine" / "fleet.py")
         assert fleet == {}, fleet
 
+    def test_fleet_package_has_zero_noqa_sites(self):
+        """The multi-model fleet is pure host-side control code like the
+        scheduler: group resolution, config derivation, metric rollups.
+        Engine construction happens through the same builders the
+        single-model path uses (whose sanctioned syncs are pinned
+        above), so ZERO `runbook: noqa` markers here — a suppression
+        appearing means routing/built code started syncing devices or
+        blocking under locks."""
+        fleet_files = sorted(
+            (ROOT / "runbookai_tpu" / "fleet").glob("*.py"))
+        assert fleet_files, "fleet package missing"
+        for path in fleet_files:
+            assert "runbook: noqa" not in path.read_text(), (
+                f"unexpected noqa marker in {path}")
+        findings = analyze_paths([ROOT / "runbookai_tpu" / "fleet"],
+                                 root=ROOT)
+        assert findings == [], "\n".join(f.format() for f in findings)
+
     def test_sched_package_has_zero_noqa_sites(self):
         """The scheduler/admission subsystem is pure host-side control
         code: no device syncs, no blocking I/O under locks, nothing to

@@ -817,5 +817,7 @@ def test_router_breaks_load_ties_on_queue_depth():
         core1.decoding.clear()
     # The depth each candidate showed is exported as a labeled gauge.
     text = metrics_mod.get_registry().render()
-    assert 'runbook_router_observed_queue_depth{replica="0"} 2' in text
-    assert 'runbook_router_observed_queue_depth{replica="1"} 0' in text
+    assert ('runbook_router_observed_queue_depth'
+            '{model="llama3-test",replica="0"} 2') in text
+    assert ('runbook_router_observed_queue_depth'
+            '{model="llama3-test",replica="1"} 0') in text
