@@ -63,6 +63,17 @@ a clean skip when jax.profiler capture is unavailable), BENCH_SLO (JSON
 dict of llm.slo-style targets, e.g. '{"tpot_p95_ms": 40}' — evaluated
 against the measured window's histograms into details.slo with the
 per-objective burn ratio).
+BENCH_OBS (=0 disables the workload-fingerprint taps — the byte-identity
+baseline; default on: every measured window banks
+`details.workload_fingerprint`, the live traffic in the autotuner's
+Workload schema, so BENCHLOG arms double as fingerprint fixtures),
+BENCH_SHIFT (`--shift`: the ROADMAP item 3 scenario — a short-chat phase
+then a long-context/guided phase through one engine; details.workload
+carries the per-phase drift scores and whether the stale threshold was
+crossed, with digests byte-identical to a BENCH_OBS=0 run),
+BENCH_SOAK (`--soak [SECONDS]`: time-bounded closed-loop mixed traffic;
+compose with `--models A,B` to soak a two-group multi-model fleet —
+gates on zero lost requests and banks per-group fingerprints).
 Every artifact's `details.engine_config` records the core's fully
 resolved EngineConfig (post probe-gating), flags or no flags; every
 measured window also carries `details.flight_summary` (step-level
@@ -160,6 +171,20 @@ def reset_warmup_metrics(core) -> None:
     # The flight_summary block must describe the MEASURED window, not the
     # warmup compiles.
     core.flight.reset()
+
+
+def make_bench_fingerprinter(cores, model_name: str):
+    """Workload fingerprinter over a bench arm's cores (None when
+    BENCH_OBS=0 — the taps are never installed, so the disabled run is
+    the byte-identity baseline for the read-only-layer claim). The
+    window is wide enough that one measured window never ages out."""
+    if os.environ.get("BENCH_OBS", "1") == "0":
+        return None
+    from runbookai_tpu.obs import WorkloadFingerprinter
+
+    fp = WorkloadFingerprinter(cores, model=model_name, window_s=3600.0)
+    fp.install_taps()
+    return fp
 
 
 def profile_context():
@@ -459,6 +484,31 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         return default if value == "auto" else value
 
     models_env = os.environ.get("BENCH_MODELS")
+    soak_env = os.environ.get("BENCH_SOAK")
+    if os.environ.get("BENCH_SHIFT") and (
+            soak_env or models_env or os.environ.get("BENCH_CLASSES")):
+        # The soak/models/classes branches run first and would otherwise
+        # silently win — the operator must never believe they measured
+        # the traffic-shift scenario when a different arm was banked.
+        raise ValueError(
+            "BENCH_SHIFT measures the single-engine traffic-shift arm "
+            "and does not compose with --soak/--models/--classes (run "
+            "them as separate arms)")
+    if soak_env:
+        # Soak arm (`--soak [S]`): time-bounded mixed traffic through a
+        # live fleet — optionally a TWO-GROUP fleet via `--models A,B`
+        # (ROADMAP carry-over: soak runs must exercise multi-model
+        # serving, not just one engine). Refuses exactly the
+        # combinations --models refuses.
+        if plan is not None or os.environ.get("BENCH_DP") \
+                or os.environ.get("BENCH_CLASSES"):
+            raise ValueError(
+                "BENCH_SOAK measures the soak arm and does not compose "
+                "with --plan/--dp/--classes (run them as separate arms)")
+        run_soak_bench(float(soak_env), models_env, model_name, probe,
+                       prompt_len=prompt_len, new_tokens=new_tokens,
+                       on_accel=on_accel)
+        return
     if models_env:
         # Multi-model fleet arm (`--models A,B[:dp]`): interleaved
         # traffic across named model groups through ONE fleet, with
@@ -671,6 +721,26 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
                           weights_path=weights_path)
         return
 
+    if os.environ.get("BENCH_SHIFT"):
+        # Traffic-shift arm (`--shift`): short-chat phase then a
+        # long-context/guided phase through ONE engine — the ROADMAP
+        # item 3 scenario. Proves runbook_workload_drift_score crosses
+        # the stale threshold on the shift while the digest stays
+        # byte-identical to a fingerprinting-disabled run (BENCH_OBS=0).
+        if os.environ.get("BENCH_DP") or plan is not None:
+            raise ValueError(
+                "BENCH_SHIFT measures the single-engine traffic-shift "
+                "arm and does not compose with --dp/--plan (run them as "
+                "separate arms)")
+        run_shift_bench(cfg, params, tok, ecfg, masker, probe,
+                        model_name=model_name, n_requests=n_requests,
+                        prompt_len=prompt_len, new_tokens=new_tokens,
+                        make_prompt=make_prompt,
+                        outputs_digest=outputs_digest,
+                        on_accel=on_accel, quantized=quantized,
+                        weights_path=weights_path)
+        return
+
     dp_env = os.environ.get("BENCH_DP")
     dp = int(dp_env) if dp_env else pick("dp_replicas", 1)
     dp = max(1, dp)
@@ -696,6 +766,11 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     core = EngineCore(cfg, params, tok, ecfg,
                       mask_fn=masker.mask, advance_fn=masker.advance,
                       draft_worker=draft_worker)
+    # Workload fingerprinting (runbookai_tpu/obs): BENCHLOG arms double
+    # as fingerprint fixtures — the end-of-run fingerprint rides in
+    # details. BENCH_OBS=0 removes the taps entirely (the byte-identity
+    # A/B for the read-only-layer claim).
+    fingerprinter = make_bench_fingerprinter([core], model_name)
 
     def make_req(max_new=new_tokens, guided=None):
         return EngineRequest(
@@ -715,6 +790,8 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
     # Counters + latency histograms restart with the measured run so the
     # p95s below exclude warmup-compile TTFTs.
     reset_warmup_metrics(core)
+    if fingerprinter is not None:
+        fingerprinter.reset()  # the fingerprint describes the measured window
 
     reqs = [make_req() for _ in range(n_requests)]
     prof_ctx, prof_dir = profile_context()
@@ -803,6 +880,11 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
         # recorder): what kinds of dispatches ran, how full the batch
         # sat, and the KV-pressure peak the run actually hit.
         "flight_summary": core.flight.summary(),
+        # End-of-run workload fingerprint (obs/): the measured window's
+        # traffic in the autotuner's Workload schema — None with
+        # BENCH_OBS=0 (taps never installed).
+        "workload_fingerprint": (fingerprinter.fingerprint()
+                                 if fingerprinter is not None else None),
         "outputs_digest": outputs_digest([r.all_out_ids for r in reqs]),
         "spec_drafted": m.get("spec_drafted", 0),
         "spec_accepted": m.get("spec_accepted", 0),
@@ -880,6 +962,75 @@ def parse_models_spec(spec: str) -> list[tuple[str, int]]:
     return groups
 
 
+def bench_group_engine_config(on_accel: bool):
+    """The per-replica EngineConfig every model-group arm (--models,
+    --soak) builds from the BENCH_* env — ONE spelling so the arms
+    cannot measure differently-configured fleets."""
+    import jax.numpy as jnp
+
+    from runbookai_tpu.engine.engine import EngineConfig
+
+    dtype = jnp.bfloat16 if on_accel else jnp.float32
+    return EngineConfig(
+        page_size=16, num_pages=int(os.environ.get("BENCH_PAGES", 512)),
+        max_batch_slots=int(os.environ.get("BENCH_SLOTS", 4)),
+        prefill_chunk=128, max_seq_len=2048, kv_dtype=dtype,
+        decode_steps_per_dispatch=8,
+        attn_impl="pallas" if on_accel else "xla")
+
+
+def build_bench_model_groups(groups, params_by_name, tok, ecfg, *,
+                             warm_prompt_len, warm_new_tokens,
+                             warm_seed=10_007):
+    """Shared --models/--soak fleet construction: global replica indices
+    assigned contiguously across groups AND disjoint carved device
+    slices, exactly like fleet/build.py (without the carve, a dp>1 group
+    would slice jax.devices() from 0 while a dp=1 sibling timeshares
+    device 0 — per-group tok_s measured under hidden contention). Warmup
+    compiles each group's program shapes outside the measured window
+    (its own rng stream — measured prompts stay untouched) and resets
+    the warmup counters. Returns the MultiModelFleet."""
+    import jax
+
+    from runbookai_tpu.engine.fleet import AsyncFleet, build_engine_fleet
+    from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+    from runbookai_tpu.fleet.multimodel import ModelGroup, MultiModelFleet
+    from runbookai_tpu.models.llama import CONFIGS
+
+    all_devices = list(jax.devices())
+    total_dp = sum(dp for _, dp in groups)
+    carve = len(all_devices) >= total_dp
+    start = 0
+    model_groups = []
+    for gi, (name, dp) in enumerate(groups):
+        import dataclasses as _dc
+
+        cores = build_engine_fleet(
+            CONFIGS[name], params_by_name[name], tok,
+            _dc.replace(ecfg, dp_replicas=dp),
+            replica_indices=list(range(start, start + dp)),
+            devices=(all_devices[start:start + dp] if carve else []),
+            pin_devices=carve)
+        start += dp
+        model_groups.append(ModelGroup(
+            name=name, tokenizer=tok,
+            fleet=AsyncFleet(cores, model_label=name,
+                             clear_labeled=(gi == 0))))
+    fleet = MultiModelFleet(model_groups)
+    warm_rng = np.random.default_rng(warm_seed)
+    for g in model_groups:
+        for core in g.cores:
+            core.submit(EngineRequest(
+                prompt_ids=warm_rng.integers(
+                    0, 256, size=warm_prompt_len).tolist(),
+                sampling=SamplingParams(temperature=0.0,
+                                        max_new_tokens=warm_new_tokens,
+                                        stop_token_ids=())))
+            core.run_until_idle()
+            reset_warmup_metrics(core)
+    return fleet
+
+
 def run_multimodel_bench(models_spec: str, probe: dict, *, n_requests,
                          prompt_len, new_tokens, on_accel) -> None:
     """The ``--models`` arm: the same interleaved request set served two
@@ -897,23 +1048,16 @@ def run_multimodel_bench(models_spec: str, probe: dict, *, n_requests,
     import jax
     import jax.numpy as jnp
 
-    from runbookai_tpu.engine.engine import EngineConfig, EngineCore
-    from runbookai_tpu.engine.fleet import AsyncFleet, build_engine_fleet
+    from runbookai_tpu.engine.engine import EngineCore
     from runbookai_tpu.engine.request import EngineRequest, SamplingParams
-    from runbookai_tpu.fleet.multimodel import ModelGroup, MultiModelFleet
     from runbookai_tpu.models.llama import CONFIGS, init_params
     from runbookai_tpu.utils.tokens import ByteTokenizer
     from runbookai_tpu.utils.weights import quality_marker
 
     groups = parse_models_spec(models_spec)
-    dtype = jnp.bfloat16 if on_accel else jnp.float32
-    slots = int(os.environ.get("BENCH_SLOTS", 4))
-    num_pages = int(os.environ.get("BENCH_PAGES", 512))
-    ecfg = EngineConfig(
-        page_size=16, num_pages=num_pages, max_batch_slots=slots,
-        prefill_chunk=128, max_seq_len=2048, kv_dtype=dtype,
-        decode_steps_per_dispatch=8,
-        attn_impl="pallas" if on_accel else "xla")
+    ecfg = bench_group_engine_config(on_accel)
+    dtype = ecfg.kv_dtype
+    slots, num_pages = ecfg.max_batch_slots, ecfg.num_pages
     tok = ByteTokenizer()
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, 256, size=prompt_len).tolist()
@@ -942,45 +1086,12 @@ def run_multimodel_bench(models_spec: str, probe: dict, *, n_requests,
             [r.all_out_ids for r in reqs])
         del core
 
-    # Arm (b): one multi-model fleet — global replica indices assigned
-    # contiguously across groups AND disjoint carved device slices,
-    # exactly like fleet/build.py (without the carve, a dp>1 group
-    # would slice jax.devices() from 0 while a dp=1 sibling timeshares
-    # device 0 — per-group tok_s measured under hidden contention).
-    all_devices = list(jax.devices())
-    total_dp = sum(dp for _, dp in groups)
-    carve = len(all_devices) >= total_dp
-    start = 0
-    model_groups = []
-    for gi, (name, dp) in enumerate(groups):
-        import dataclasses as _dc
-
-        cores = build_engine_fleet(
-            CONFIGS[name], params[name], tok,
-            _dc.replace(ecfg, dp_replicas=dp),
-            replica_indices=list(range(start, start + dp)),
-            devices=(all_devices[start:start + dp] if carve else []),
-            pin_devices=carve)
-        start += dp
-        model_groups.append(ModelGroup(
-            name=name, tokenizer=tok,
-            fleet=AsyncFleet(cores, model_label=name,
-                             clear_labeled=(gi == 0))))
-    fleet = MultiModelFleet(model_groups)
+    # Arm (b): one multi-model fleet (shared construction + warmup —
+    # build_bench_model_groups).
+    fleet = build_bench_model_groups(
+        groups, params, tok, ecfg, warm_prompt_len=prompt_len,
+        warm_new_tokens=new_tokens)
     all_cores = fleet.cores
-    # Warmup compiles each group's program shapes outside the measured
-    # window (fresh rng stream — the measured prompts stay untouched).
-    warm_rng = np.random.default_rng(10_007)
-    for g in model_groups:
-        for core in g.cores:
-            core.submit(EngineRequest(
-                prompt_ids=warm_rng.integers(
-                    0, 256, size=prompt_len).tolist(),
-                sampling=SamplingParams(temperature=0.0,
-                                        max_new_tokens=new_tokens,
-                                        stop_token_ids=())))
-            core.run_until_idle()
-            reset_warmup_metrics(core)
 
     async def _run():
         outs = await asyncio.gather(*[
@@ -1180,6 +1291,234 @@ def run_classes_bench(cfg, params, tok, ecfg, masker, probe, *,
     emit(round(decode_tps, 2), "tok/s", details)
 
 
+def run_shift_bench(cfg, params, tok, ecfg, masker, probe, *,
+                    model_name, n_requests, prompt_len, new_tokens,
+                    make_prompt, outputs_digest, on_accel, quantized,
+                    weights_path) -> None:
+    """The ``--shift`` arm (ROADMAP item 3's scenario): traffic shifts
+    mid-run from short-chat to a long-context/guided mix through ONE
+    engine, and the workload monitor must SEE it.
+
+    The reference descriptor is the arm's NOMINAL short-chat workload
+    (prompt_len/new_tokens/request count — what a plan tuned for this
+    traffic would carry as provenance). Phase 1 serves exactly that
+    traffic and its measured fingerprint is scored against the nominal
+    reference — a real measurement, not a tautology. Phase 2 serves
+    4x-length grammar-guided requests scored against the same reference.
+    The acceptance contract: ``drift_phase2`` crosses the stale
+    threshold while ``drift_phase1`` stays under it, and
+    ``outputs_digest`` is byte-identical to a BENCH_OBS=0 run — the
+    fingerprint layer observes, it never touches a stream."""
+    import jax.numpy as jnp
+
+    from runbookai_tpu.engine.engine import EngineCore
+    from runbookai_tpu.engine.request import EngineRequest, SamplingParams
+    from runbookai_tpu.obs import DEFAULT_DRIFT_THRESHOLD, drift_score
+    from runbookai_tpu.utils.weights import quality_marker
+
+    core = EngineCore(cfg, params, tok, ecfg,
+                      mask_fn=masker.mask, advance_fn=masker.advance)
+    fingerprinter = make_bench_fingerprinter([core], model_name)
+    long_len = min(prompt_len * 4,
+                   max(prompt_len, ecfg.max_seq_len - new_tokens - 8))
+    rng = np.random.default_rng(4242)
+
+    def submit(length: int, guided):
+        req = EngineRequest(
+            prompt_ids=rng.integers(0, 256, size=length).tolist(),
+            sampling=SamplingParams(temperature=0.0,
+                                    max_new_tokens=new_tokens,
+                                    stop_token_ids=(), guided=guided))
+        core.submit(req)
+        return req
+
+    # Warmup compiles both phases' shapes (incl. the masked-sampling
+    # program) outside every measured window.
+    warm = [submit(prompt_len, None), submit(long_len, "json")]
+    core.run_until_idle()
+    del warm
+    reset_warmup_metrics(core)
+    if fingerprinter is not None:
+        fingerprinter.reset()
+
+    # The drift yardstick: the nominal short-chat workload this arm was
+    # "tuned" for — independent of anything measured, so drift_phase1 is
+    # a real comparison (measured vs nominal), never score(x, x).
+    reference = {"prompt_len": prompt_len, "output_len": new_tokens,
+                 "concurrency": max(1, n_requests),
+                 "guided_share": 0.0, "spec_hit_rate": 0.0}
+
+    t0 = time.perf_counter()
+    phase1 = [submit(prompt_len, None) for _ in range(n_requests)]
+    core.run_until_idle()
+    drift1 = None
+    if fingerprinter is not None:
+        fp1 = fingerprinter.fingerprint()
+        drift1 = (drift_score(fp1["workload"], reference)
+                  if fp1 is not None else None)
+        # Phase 2 is its own window: clear the request samples AND the
+        # flight ring, or phase-1 step records would contaminate the
+        # phase-2 concurrency fold.
+        fingerprinter.reset()
+        core.flight.reset()
+
+    phase2 = [submit(long_len, "json") for _ in range(n_requests)]
+    core.run_until_idle()
+    wall = time.perf_counter() - t0
+    fingerprint = drift2 = None
+    if fingerprinter is not None:
+        fingerprint = fingerprinter.fingerprint()
+        if fingerprint is not None:
+            drift2 = drift_score(fingerprint["workload"], reference)
+
+    from runbookai_tpu.autotune.plan import engine_config_dict
+
+    m = core.metrics
+    threshold = DEFAULT_DRIFT_THRESHOLD
+    details = {
+        "arm": "shift",
+        "engine_config": engine_config_dict(core.ecfg),
+        "model": model_name,
+        "weights": "int8" if quantized else "float32",
+        "quality": quality_marker(weights_path),
+        "platform": probe.get("platform"),
+        "device_kind": probe.get("kind"),
+        "requests": 2 * n_requests,
+        "prompt_len": prompt_len,
+        "long_prompt_len": long_len,
+        "new_tokens": new_tokens,
+        "wall_s": round(wall, 2),
+        "obs_enabled": fingerprinter is not None,
+        "workload": {
+            "reference": reference,
+            "drift_phase1": drift1,
+            "drift_phase2": drift2,
+            "stale_threshold": threshold,
+            "crossed": (drift2 is not None and drift2 > threshold),
+        },
+        "workload_fingerprint": fingerprint,
+        "flight_summary": core.flight.summary(),
+        # ONE digest over both phases in submission order: equal between
+        # BENCH_OBS=1 and BENCH_OBS=0 runs, or the layer is not read-only.
+        "outputs_digest": outputs_digest(
+            [r.all_out_ids for r in phase1 + phase2]),
+        "kv_dtype": str(jnp.dtype(ecfg.kv_dtype).name),
+        "preemptions": m["preemptions"],
+    }
+    decode_tps = m["decode_tokens"] / max(m["decode_time_s"], 1e-9)
+    emit(round(decode_tps, 2), "tok/s", details)
+
+
+def run_soak_bench(duration_s: float, models_spec: str | None,
+                   model_name: str, probe: dict, *, prompt_len,
+                   new_tokens, on_accel) -> None:
+    """The ``--soak [S]`` arm: time-bounded closed-loop mixed traffic
+    through a live fleet. With ``--models A,B`` the soak drives a
+    TWO-GROUP multi-model fleet (ROADMAP carry-over — soak coverage must
+    include model routing), otherwise the single configured model. The
+    gate is production shape, not throughput: zero lost requests, every
+    group served, and the end-of-run fingerprint banked per group."""
+    import asyncio
+    import time as _time
+
+    import jax
+
+    from runbookai_tpu.engine.flight_recorder import FlightRecorder
+    from runbookai_tpu.engine.request import SamplingParams
+    from runbookai_tpu.models.llama import CONFIGS, init_params
+    from runbookai_tpu.utils.tokens import ByteTokenizer
+
+    groups = (parse_models_spec(models_spec) if models_spec
+              else [(model_name, 1)])
+    ecfg = bench_group_engine_config(on_accel)
+    slots = ecfg.max_batch_slots
+    tok = ByteTokenizer()
+    params = {name: init_params(jax.random.PRNGKey(1000 + gi),
+                                CONFIGS[name], dtype=ecfg.kv_dtype)
+              for gi, (name, _) in enumerate(groups)}
+    # Shared construction + warmup with the --models arm
+    # (build_bench_model_groups); fingerprinters install AFTER warmup so
+    # the measured loop alone feeds the banked fingerprints.
+    fleet = build_bench_model_groups(
+        groups, params, tok, ecfg, warm_prompt_len=prompt_len,
+        warm_new_tokens=new_tokens, warm_seed=20_011)
+    model_groups = list(fleet.groups.values())
+    total_dp = fleet.dp
+    fingerprinters = {
+        g.name: make_bench_fingerprinter(g.cores, g.name)
+        for g in model_groups}
+
+    names = [name for name, _ in groups]
+    counts = {name: {"requests": 0, "lost": 0} for name in names}
+    rng = np.random.default_rng(77)
+    prompt_lens = [max(16, prompt_len // 2), prompt_len]
+
+    async def worker(wid: int, deadline: float) -> None:
+        i = wid
+        while _time.monotonic() < deadline:
+            name = names[i % len(names)]
+            i += 1
+            prompt = rng.integers(
+                0, 256, size=prompt_lens[i % len(prompt_lens)]).tolist()
+            out = await fleet.generate(
+                prompt,
+                SamplingParams(temperature=0.0, max_new_tokens=new_tokens,
+                               stop_token_ids=()),
+                model=name)
+            counts[name]["requests"] += 1
+            if out.finish_reason.value == "aborted":
+                counts[name]["lost"] += 1
+
+    async def _run() -> None:
+        deadline = _time.monotonic() + duration_s
+        await asyncio.gather(*[worker(w, deadline)
+                               for w in range(2 * max(1, total_dp))])
+        await fleet.stop()
+
+    t0 = _time.perf_counter()
+    asyncio.run(_run())
+    wall = _time.perf_counter() - t0
+
+    per_model = {}
+    for g in model_groups:
+        decode = sum(c.metrics["decode_tokens"] for c in g.cores)
+        decode_t = max(c.metrics["decode_time_s"] for c in g.cores)
+        fp = fingerprinters[g.name]
+        per_model[g.name] = {
+            "dp": g.fleet.dp,
+            **counts[g.name],
+            "decode_tokens": decode,
+            "tok_s": round(decode / max(decode_t, 1e-9), 2),
+            "workload_fingerprint": (fp.fingerprint()
+                                     if fp is not None else None),
+        }
+    all_cores = fleet.cores
+    total_decode = sum(c.metrics["decode_tokens"] for c in all_cores)
+    max_decode_t = max(c.metrics["decode_time_s"] for c in all_cores)
+    from runbookai_tpu.autotune.plan import engine_config_dict
+
+    details = {
+        "arm": "soak",
+        "engine_config": engine_config_dict(all_cores[0].ecfg),
+        "models": names,
+        "multi_model": len(names) > 1,
+        "duration_s": duration_s,
+        "wall_s": round(wall, 2),
+        "platform": probe.get("platform"),
+        "device_kind": probe.get("kind"),
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "batch_slots_per_replica": slots,
+        "requests": sum(c["requests"] for c in counts.values()),
+        "lost_requests": sum(c["lost"] for c in counts.values()),
+        "per_model": per_model,
+        "flight_summary": FlightRecorder.merge_summaries(
+            [c.flight.summary() for c in all_cores]),
+    }
+    emit(round(total_decode / max(max_decode_t, 1e-9), 2), "tok/s",
+         details)
+
+
 def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
                     n_requests, prompt_len, new_tokens, make_prompt,
                     outputs_digest, on_accel, quantized, weights_path,
@@ -1240,6 +1579,7 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
                                mask_fn=masker.mask,
                                advance_fn=masker.advance,
                                draft_worker_factory=draft_factory)
+    fingerprinter = make_bench_fingerprinter(cores, cfg.name)
 
     # KV-share / disagg A/B arms (BENCH_KV_SHARE / BENCH_DISAGG): same
     # request set, same per-replica budgets — the only change is the
@@ -1272,6 +1612,8 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
     for core in cores:
         core.run_until_idle()
         reset_warmup_metrics(core)
+    if fingerprinter is not None:
+        fingerprinter.reset()
 
     fleet = AsyncFleet(cores, FleetConfig(
         kv_share=kv_share, disagg_prefill_replicas=disagg_n))
@@ -1381,6 +1723,9 @@ def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
         # peaks = the worst replica (engine/flight_recorder.py).
         "flight_summary": FlightRecorder.merge_summaries(
             [c.flight.summary() for c in cores]),
+        # End-of-run workload fingerprint across every replica (obs/).
+        "workload_fingerprint": (fingerprinter.fingerprint()
+                                 if fingerprinter is not None else None),
     }
     if kv_share or disagg_n:
         # The A/B evidence for the kv-share arm: how many placements rode
@@ -1567,6 +1912,23 @@ def main() -> None:
             os.environ["BENCH_DISAGG"] = sys.argv.pop(i)
         else:
             os.environ["BENCH_DISAGG"] = "1"
+    if "--shift" in sys.argv:
+        # Traffic-shift arm: short-chat then long-context/guided through
+        # one engine; the workload fingerprint's drift must cross the
+        # stale threshold while digests stay byte-identical to a
+        # BENCH_OBS=0 run (runbookai_tpu/obs).
+        sys.argv.remove("--shift")
+        os.environ["BENCH_SHIFT"] = "1"
+    if "--soak" in sys.argv:
+        # Soak arm: `--soak [SECONDS]` (default 30) of closed-loop mixed
+        # traffic; compose with `--models A,B` for a two-group fleet.
+        i = sys.argv.index("--soak")
+        sys.argv.pop(i)
+        if i < len(sys.argv) and not sys.argv[i].startswith("-") \
+                and sys.argv[i].replace(".", "", 1).isdigit():
+            os.environ["BENCH_SOAK"] = sys.argv.pop(i)
+        else:
+            os.environ["BENCH_SOAK"] = "30"
     if "--models" in sys.argv:
         # Multi-model fleet A/B: `--models A,B[:dp]` serves interleaved
         # per-model traffic through one fleet; per-model digests must
@@ -1610,23 +1972,17 @@ def main() -> None:
     sanity_budget = min(480.0, max(60.0, watchdog_s - (time.monotonic() - t0) - 600.0))
     # The sanity line is the round-over-round single-engine series; a --dp
     # or --plan run must not perturb it (env restored right after).
-    dp_env = os.environ.pop("BENCH_DP", None)
-    plan_env = os.environ.pop("BENCH_PLAN", None)
-    classes_env = os.environ.pop("BENCH_CLASSES", None)
-    models_env = os.environ.pop("BENCH_MODELS", None)
+    arm_vars = ("BENCH_DP", "BENCH_PLAN", "BENCH_CLASSES", "BENCH_MODELS",
+                "BENCH_SOAK", "BENCH_SHIFT")
+    saved_arms = {var: os.environ.pop(var, None) for var in arm_vars}
     try:
         cpu_sanity = _spawn_inner(
             os.environ.get("BENCH_CPU_MODEL", "llama3-test"), False,
             cpu_probe, sanity_budget)
     finally:
-        if dp_env is not None:
-            os.environ["BENCH_DP"] = dp_env
-        if plan_env is not None:
-            os.environ["BENCH_PLAN"] = plan_env
-        if classes_env is not None:
-            os.environ["BENCH_CLASSES"] = classes_env
-        if models_env is not None:
-            os.environ["BENCH_MODELS"] = models_env
+        for var, value in saved_arms.items():
+            if value is not None:
+                os.environ[var] = value
     sanity_line = None
     if cpu_sanity is not None:
         d = cpu_sanity.get("details", {})
@@ -1657,6 +2013,8 @@ def main() -> None:
             "BENCH_PLAN" not in os.environ and \
             "BENCH_CLASSES" not in os.environ and \
             "BENCH_MODELS" not in os.environ and \
+            "BENCH_SOAK" not in os.environ and \
+            "BENCH_SHIFT" not in os.environ and \
             os.environ.get("BENCH_CPU_MODEL", "llama3-test") == model_name:
         # The fallback headline IS the cpu-sanity config — don't run it
         # twice. (A --dp run's headline is the fleet arm, and a --plan

@@ -70,6 +70,43 @@ class Workload:
                 "guided_share": self.guided_share,
                 "spec_hit_rate": self.spec_hit_rate}
 
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "Workload":
+        """The inverse of :meth:`to_dict` — what ``runbook tune
+        --workload`` reads, so a live descriptor emitted by ``runbook
+        workload --emit-descriptor`` (runbookai_tpu/obs) round-trips into
+        a sweep unchanged. Unknown keys are REJECTED: a typo'd or
+        stale-schema descriptor must fail loudly, not tune against a
+        half-read workload."""
+        if not isinstance(data, dict):
+            raise ValueError(
+                f"workload descriptor must be a JSON object, got "
+                f"{type(data).__name__}")
+        known = {"prompt_len", "output_len", "concurrency",
+                 "guided_share", "spec_hit_rate"}
+        unknown = sorted(str(k) for k in set(data) - known)
+        if unknown:
+            raise ValueError(
+                f"unknown workload descriptor keys: {', '.join(unknown)} "
+                f"(expected a subset of {', '.join(sorted(known))})")
+        base = cls()
+        try:
+            return cls(
+                prompt_len=int(data.get("prompt_len", base.prompt_len)),
+                output_len=int(data.get("output_len", base.output_len)),
+                concurrency=int(data.get("concurrency",
+                                         base.concurrency)),
+                guided_share=float(data.get("guided_share",
+                                            base.guided_share)),
+                spec_hit_rate=float(data.get("spec_hit_rate",
+                                             base.spec_hit_rate)))
+        except (TypeError, ValueError) as e:
+            # null / list / non-numeric values must surface as the same
+            # ValueError contract unknown keys do — the CLI catches it
+            # and prints the friendly message instead of a traceback.
+            raise ValueError(
+                f"bad workload descriptor value: {e}") from e
+
 
 @dataclass(frozen=True)
 class Hardware:

@@ -778,6 +778,20 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def _fetch_json(url: str, timeout: float) -> dict:
+    """GET ``url`` and parse the JSON body — the one scrape used by the
+    live-server subcommands (tenants, workload), so their transport and
+    error surfaces cannot drift apart. Raises ``OSError``/``ValueError``
+    on unreachable/unparseable; callers pick their fallback."""
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        body = json.loads(r.read())
+    if not isinstance(body, dict):
+        raise ValueError(f"{url} returned non-object JSON")
+    return body
+
+
 def _render_tenants(snap: dict) -> str:
     """Table view of a /tenants snapshot (or of configured policies)."""
     if not snap.get("enabled"):
@@ -807,16 +821,12 @@ def cmd_tenants(args) -> int:
     running server's ``GET /tenants`` (live bucket levels + counters);
     with no server reachable, falls back to rendering the CONFIGURED
     ``llm.tenants`` policies so the command is useful pre-deploy too."""
-    import urllib.error
-    import urllib.request
-
     url = args.url.rstrip("/") + "/tenants"
     snap = None
     try:
-        with urllib.request.urlopen(url, timeout=args.timeout) as r:
-            snap = json.loads(r.read())
+        snap = _fetch_json(url, args.timeout)
         source = url
-    except (urllib.error.URLError, OSError, TimeoutError, ValueError):
+    except (OSError, TimeoutError, ValueError):
         config = _load(args)
         tcfg = config.llm.tenants
         source = "config (no server at %s)" % args.url
@@ -838,6 +848,120 @@ def cmd_tenants(args) -> int:
         print(f"# {source}")
         print(_render_tenants(snap))
     return 0
+
+
+def _render_workload(snap: dict) -> str:
+    """Table view of a /debug/workload snapshot."""
+    if not snap.get("enabled"):
+        return "workload fingerprinting is disabled (llm.obs.enabled)"
+    cols = ("model", "reqs", "prompt p50", "out p50", "conc", "guided",
+            "spec", "prefix$", "drift", "stale", "reference")
+    rows = []
+    entries = dict(snap.get("models", {}))
+    merged = snap.get("merged")
+    if merged is not None and len(entries) > 1:
+        entries["(fleet)"] = {"fingerprint": merged,
+                              "drift_score": snap.get("drift_score"),
+                              "plan_stale": snap.get("plan_stale"),
+                              "reference_source": "worst group"}
+    for name, m in entries.items():
+        fp = m.get("fingerprint")
+        if fp is None:
+            rows.append((name, "0", "-", "-", "-", "-", "-", "-", "-",
+                         "-", m.get("reference_source", "-")))
+            continue
+        wl = fp["workload"]
+        drift = m.get("drift_score")
+        stale = m.get("plan_stale")
+        rows.append((
+            name, str(fp["window"]["samples"]),
+            str(wl["prompt_len"]), str(wl["output_len"]),
+            str(wl["concurrency"]), f"{wl['guided_share']:.2f}",
+            f"{wl['spec_hit_rate']:.2f}",
+            f"{fp['prefix_cache_share']:.2f}",
+            "-" if drift is None else f"{drift:.3f}",
+            "-" if stale is None else ("STALE" if stale else "ok"),
+            m.get("reference_source", "-")))
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    out = ["  ".join(c.ljust(w) for c, w in zip(cols, widths))]
+    for r in rows:
+        out.append("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    out.append(f"drift threshold: {snap.get('drift_threshold')}")
+    return "\n".join(out)
+
+
+def cmd_workload(args) -> int:
+    """``runbook workload`` — live traffic fingerprints + plan drift
+    from a running server's ``GET /debug/workload``
+    (``runbookai_tpu/obs``). ``--watch`` re-renders every ``--interval``
+    seconds; ``--emit-descriptor out.json`` writes the live tuner
+    descriptor — JSON that feeds ``runbook tune --workload out.json``
+    unchanged (the ROADMAP item 3 hand-off)."""
+    import time as _time
+
+    url = args.url.rstrip("/") + "/debug/workload"
+
+    def scrape() -> dict | None:
+        try:
+            return _fetch_json(url, args.timeout)
+        except (OSError, TimeoutError, ValueError) as e:
+            print(f"could not scrape {url}: {e}", file=sys.stderr)
+            return None
+
+    snap = scrape()
+    if snap is None:
+        return 1
+    if args.emit_descriptor:
+        from runbookai_tpu.autotune.cost_model import Workload
+        from runbookai_tpu.obs import descriptor_json
+
+        if not snap.get("enabled"):
+            print("workload fingerprinting is disabled on this server "
+                  "(llm.obs.enabled) — nothing to emit", file=sys.stderr)
+            return 1
+        models = snap.get("models", {})
+        if args.model:
+            entry = models.get(args.model)
+            if entry is None:
+                print(f"model {args.model!r} not served; served: "
+                      f"{sorted(models)}", file=sys.stderr)
+                return 1
+            fp = entry.get("fingerprint")
+        else:
+            # One served model -> its fingerprint; several -> the merged
+            # fleet-wide one (name a group with --model to split them).
+            only = (next(iter(models.values()))["fingerprint"]
+                    if len(models) == 1 else None)
+            fp = only if only is not None else snap.get("merged")
+        if fp is None:
+            print("fingerprint window is empty (no completed requests "
+                  "yet) — nothing to emit", file=sys.stderr)
+            return 1
+        payload = descriptor_json(fp)
+        # Round-trip gate BEFORE writing: the emitted bytes must parse
+        # back into the tuner's own schema, or the hand-off is broken.
+        Workload.from_dict(json.loads(payload))
+        out = Path(args.emit_descriptor)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(payload)
+        print(f"wrote {out} (feed it to `runbook tune --workload {out}`)")
+        return 0
+    while True:
+        if args.json:
+            print(json.dumps(snap, indent=2))
+        else:
+            print(f"# {url}")
+            print(_render_workload(snap))
+        if not args.watch:
+            return 0
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        snap = scrape()
+        if snap is None:
+            return 1
 
 
 def cmd_timeline(args) -> int:
@@ -945,19 +1069,41 @@ def cmd_tune(args) -> int:
     # when the flags pin everything.
     config = _load(args) if args.out is None or (
         args.model is None and not args.smoke) else None
+    # --workload FILE: a live descriptor emitted by `runbook workload
+    # --emit-descriptor` (or any Workload.to_dict JSON) replaces the
+    # per-field flags — the obs/ -> autotune hand-off.
+    file_workload = None
+    if getattr(args, "workload", None):
+        try:
+            file_workload = Workload.from_dict(
+                json.loads(Path(args.workload).read_text()))
+        except (OSError, ValueError) as e:
+            print(f"could not read workload descriptor "
+                  f"{args.workload}: {e}", file=sys.stderr)
+            return 1
     if args.smoke:
         model = args.model or "llama3-test"
         space = smoke_space()
-        workload = Workload(prompt_len=min(args.prompt_len, 48),
-                            output_len=min(args.output_len, 16),
-                            concurrency=min(args.concurrency, 4))
+        src = file_workload or Workload(
+            prompt_len=args.prompt_len, output_len=args.output_len,
+            concurrency=args.concurrency,
+            guided_share=getattr(args, "guided_share", 0.0),
+            spec_hit_rate=getattr(args, "spec_hit_rate", 0.0))
+        # The smoke path bounds the sweep to the tiny CPU model's
+        # envelope whatever the descriptor says — a live long-context
+        # fingerprint must still smoke in seconds.
+        workload = Workload(prompt_len=min(src.prompt_len, 48),
+                            output_len=min(src.output_len, 16),
+                            concurrency=min(src.concurrency, 4),
+                            guided_share=src.guided_share,
+                            spec_hit_rate=src.spec_hit_rate)
         baseline = Candidate(page_size=4, num_pages=256,
                              max_batch_slots=4, prefill_chunk=32,
                              kv_dtype="auto", max_seq_len=256)
         hw, weights = HARDWARE["cpu"], "bf16"
     else:
         model = args.model or config.llm.model
-        workload = Workload(
+        workload = file_workload or Workload(
             prompt_len=args.prompt_len, output_len=args.output_len,
             concurrency=args.concurrency, guided_share=args.guided_share,
             spec_hit_rate=args.spec_hit_rate)
@@ -1392,6 +1538,11 @@ def build_parser() -> argparse.ArgumentParser:
     tune.add_argument("--concurrency", type=int, default=16)
     tune.add_argument("--guided-share", type=float, default=0.0)
     tune.add_argument("--spec-hit-rate", type=float, default=0.0)
+    tune.add_argument("--workload", default=None, metavar="JSON",
+                      help="workload descriptor file (Workload.to_dict "
+                           "JSON — e.g. from `runbook workload "
+                           "--emit-descriptor`); replaces the per-field "
+                           "workload flags")
     tune.add_argument("--dp", default=None, metavar="1,2,4",
                       help="dp_replicas axis values (comma-separated)")
     tune.add_argument("--tp", default=None, metavar="1,8,16",
@@ -1419,6 +1570,26 @@ def build_parser() -> argparse.ArgumentParser:
         "validate", help="schema + content-hash check (CI gate)")
     plan_val.add_argument("paths", nargs="+")
     plan.set_defaults(fn=cmd_plan)
+
+    wl = sub.add_parser(
+        "workload", help="live workload fingerprints + plan drift from "
+                         "a running server (GET /debug/workload)")
+    wl.add_argument("--url", default="http://127.0.0.1:8000",
+                    help="server base URL")
+    wl.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the table")
+    wl.add_argument("--watch", action="store_true",
+                    help="re-render every --interval seconds")
+    wl.add_argument("--interval", type=float, default=5.0)
+    wl.add_argument("--model", default=None,
+                    help="with --emit-descriptor: which served model "
+                         "group's fingerprint to emit (default: the one "
+                         "group, or the merged fleet view)")
+    wl.add_argument("--emit-descriptor", default=None, metavar="OUT",
+                    help="write the live tuner descriptor as JSON; feeds "
+                         "`runbook tune --workload OUT` unchanged")
+    wl.add_argument("--timeout", type=float, default=10.0)
+    wl.set_defaults(fn=cmd_workload)
 
     tl = sub.add_parser(
         "timeline", help="render one request's span tree from a trace "

@@ -1000,6 +1000,11 @@ class EngineCore:
         # per-step record reports the delta since the last recorded step
         # rather than an intra-step delta that would always read 0.
         self._flight_kv_mark = (0, 0)
+        # Workload-fingerprint tap (runbookai_tpu/obs): called once per
+        # finishing request from _observe_finish with the EngineRequest.
+        # None = no observer; the callee appends to a bounded deque — one
+        # O(1) call off the dispatch path, never inside a dispatch.
+        self.workload_tap = None
         self.registry = metrics_mod.get_registry()
         # Flight recorder: one bounded record per step (what was the
         # engine DOING on the slow steps?). The step thread is the only
@@ -1555,6 +1560,13 @@ class EngineCore:
         if req.trace_id is not None:
             meta["trace_id"] = req.trace_id
         self.tracer.event("engine.request", **meta)
+        if self.workload_tap is not None:
+            # Workload fingerprinting (obs/): sample the finished request.
+            # Best-effort — observation must never fail a request.
+            try:
+                self.workload_tap(req)
+            except Exception:  # noqa: BLE001 — observer errors stay silent
+                pass
 
     def _finish(self, req: EngineRequest, reason: FinishReason) -> None:
         req.state = RequestState.FINISHED

@@ -149,6 +149,11 @@ class _Placement:
     hashes: Optional[list[int]] = None
     pull_src: Optional[int] = None
     pull_dst_blocks: int = 0
+    # Full pages the probe planned to pull (source match minus the
+    # destination's). An export that lands SHORT of this count was
+    # truncated between probe and copy — the mid-pull-preemption signal
+    # the stale reason label attributes.
+    pull_pages: int = 0
 
 
 def split_engine_budget(engine_cfg: EngineConfig, dp: int) -> EngineConfig:
@@ -547,6 +552,7 @@ class AsyncFleet:
                     1, self.cfg.kv_share_min_pages):
                 placement.pull_src = src
                 placement.pull_dst_blocks = dst_matched // self._page_size
+                placement.pull_pages = deficit
         return placement
 
     # -------------------------------------------------- page pull / disagg
@@ -560,7 +566,16 @@ class AsyncFleet:
         worker threads — the event loop (and every live stream) stays
         free. A stale plan (pages evicted since the probe) or full
         destination pool degrades to recompute; the request is submitted
-        either way. Returns pages pulled."""
+        either way. Returns pages pulled.
+
+        Staleness is attributed per failure mode
+        (``runbook_router_xreplica_stale_total{reason=}``):
+        ``epoch_moved`` — the under-lock chain re-walk found NOTHING (the
+        planned pages were evicted/re-registered since the probe);
+        ``mid_pull_preempt`` — the export landed SHORT of the planned
+        deficit (the chain truncated while the pull was in flight; the
+        partial prefix still installs); ``digest_mismatch`` — the import
+        rejected a corrupted payload block."""
         dst, src = placement.idx, placement.pull_src
         t0 = _time.perf_counter()
         exported = await self.replicas[src].run_locked(
@@ -568,10 +583,25 @@ class AsyncFleet:
                 prompt_ids, hashes=placement.hashes, hash_seed=hash_seed,
                 skip_blocks=placement.pull_dst_blocks))
         if exported is None:
-            self._m_pull_stale.inc()
+            self._m_stale["epoch_moved"].inc()
             return 0
-        pulled = await self.replicas[dst].run_locked(
-            lambda: self.cores[dst].import_kv_pages(exported))
+
+        def _import() -> tuple[int, bool]:
+            core = self.cores[dst]
+            n = core.import_kv_pages(exported)
+            # Both reads under the destination's engine lock: the flag
+            # belongs to exactly this import call.
+            return n, core.kv.last_import_digest_mismatch
+
+        pulled, digest_bad = await self.replicas[dst].run_locked(_import)
+        # ONE reason per pull (stale_rejections() sums the labels, so a
+        # pull that both truncated AND hit a bad digest must not count
+        # twice): corruption outranks truncation as the thing to page on.
+        if digest_bad:
+            self._m_stale["digest_mismatch"].inc()
+        elif placement.pull_pages \
+                and exported.num_pages < placement.pull_pages:
+            self._m_stale["mid_pull_preempt"].inc()
         elapsed = _time.perf_counter() - t0
         if pulled:
             self._m_xreplica_hits.inc()
@@ -580,15 +610,30 @@ class AsyncFleet:
         tracer = get_tracer()
         if tracer.enabled:
             # The timeline's pull span: destination + SOURCE replica,
-            # pages moved and the wall it cost (runbook timeline renders
-            # it between router.place and engine.enqueue).
+            # pages moved, the wall it cost, and the OWNING CHAIN id —
+            # the tail block hash of the pulled prefix chain (chained
+            # hashing makes it identify the whole prefix), so repeated
+            # pulls of one hot conversation join up across timelines.
+            chain = (exported.hashes[-1] if exported.hashes
+                     else (placement.hashes[-1] if placement.hashes
+                           else 0))
             meta = {"replica": self.replica_ids[dst],
                     "src": self.replica_ids[src], "pages": pulled,
+                    "chain": f"{chain & 0xFFFFFFFFFFFFFFFF:016x}",
                     "pull_ms": round(elapsed * 1e3, 3)}
             if trace_id is not None:
                 meta["trace_id"] = trace_id
             tracer.event("router.page_pull", **meta)
         return pulled
+
+    def stale_rejections(self) -> int:
+        """Total stale-pull count across reasons for THIS fleet's model
+        label (the /healthz ``kv_share.stale_rejections`` figure): pulls
+        whose PLAN was not fully honored — at most one count per pull. A
+        ``mid_pull_preempt`` entry still installed its partial prefix;
+        the per-reason breakdown separates those from true no-page
+        rejections."""
+        return int(sum(child.value for child in self._m_stale.values()))
 
     def _full_pages(self, prompt_ids: list[int]) -> int:
         """Full prefix pages a prompt can publish ((len-1)//page_size —
@@ -814,11 +859,21 @@ class AsyncFleet:
             "runbook_router_xreplica_pull_seconds_total",
             "Wall seconds spent exporting+importing pulled KV pages",
             labels=("model",)).labels(model=model)
-        self._m_pull_stale = reg.counter(
+        # Stale pulls with a BOUNDED failure-mode label: epoch_moved
+        # (chain gone at export), mid_pull_preempt (chain truncated
+        # mid-pull — partial prefix still lands), digest_mismatch
+        # (corrupted payload rejected at import).
+        m_stale = reg.counter(
             "runbook_router_xreplica_stale_total",
-            "Planned pulls whose pages were gone by export time — the "
-            "under-lock chain re-walk found nothing (recomputed instead)",
-            labels=("model",)).labels(model=model)
+            "Planned pulls that fell short of their plan, by reason: the "
+            "under-lock export re-walk found nothing (epoch_moved), the "
+            "chain truncated mid-pull (mid_pull_preempt), or the import "
+            "rejected a corrupted block (digest_mismatch)",
+            labels=("model", "reason"))
+        self._m_stale = {
+            reason: m_stale.labels(model=model, reason=reason)
+            for reason in ("epoch_moved", "mid_pull_preempt",
+                           "digest_mismatch")}
         self._m_warm = reg.counter(
             "runbook_router_prefill_tier_warms_total",
             "Disaggregated prefill-tier warm prefills",
@@ -991,7 +1046,10 @@ class AsyncFleet:
                 "xreplica_hits": int(self._m_xreplica_hits.value),
                 "pages_pulled": int(self._m_xreplica_pages.value),
                 "pull_seconds": round(self._m_xreplica_seconds.value, 4),
-                "stale_rejections": int(self._m_pull_stale.value),
+                "stale_rejections": self.stale_rejections(),
+                "stale_by_reason": {
+                    reason: int(child.value)
+                    for reason, child in self._m_stale.items()},
             }
         if self._prefill_tier:
             # The /healthz tier breakdown: which GLOBAL replica ids serve

@@ -396,6 +396,12 @@ class KVCacheManager:
         # their caches on it, so a steady-state decode step rebuilds
         # nothing and a stale table can never survive an allocation.
         self.version = 0
+        # Whether the LAST import_pages call hit a content-digest
+        # mismatch (payload corrupted in transit). Set under the engine
+        # lock alongside the import itself; the fleet router reads it to
+        # attribute the stale-pull reason label
+        # (runbook_router_xreplica_stale_total{reason="digest_mismatch"}).
+        self.last_import_digest_mismatch = False
         # Token ids actually stored in each published page — matches are
         # verified against these so a 64-bit hash collision can never serve
         # another request's KV (cross-request leakage). Bounded by num_pages.
@@ -665,6 +671,7 @@ class KVCacheManager:
         only, one batched pool write, contiguous-prefix stop on a full
         pool) live in :meth:`_install_blocks` — partial prefixes are
         still byte-exact wins."""
+        self.last_import_digest_mismatch = False
         if exported.page_size != self.page_size \
                 or not self._leaves_compatible(kv_k, exported.leaves_k):
             return kv_k, kv_v, 0
@@ -678,7 +685,10 @@ class KVCacheManager:
                 continue
             if _block_digest(exported.leaves_k, exported.leaves_v, j,
                              ps) != exported.digests[j]:
-                break  # payload corrupted in transit — recompute instead
+                # Payload corrupted in transit — recompute instead. The
+                # flag lets the puller label WHY its plan fell short.
+                self.last_import_digest_mismatch = True
+                break
             items.append((h, blk, exported.leaves_k, exported.leaves_v,
                           j * ps, (j + 1) * ps))
         kv_k, kv_v, imported = self._install_blocks(kv_k, kv_v, items)

@@ -82,6 +82,7 @@ class JaxTpuClient(BaseLLMClient):
         slo_monitor=None,
         tenants=None,
         engine=None,
+        workload_monitor=None,
     ):
         # ``core`` may be a data-parallel fleet (list of replicas, built by
         # engine/fleet.build_engine_fleet when EngineConfig.dp_replicas > 1):
@@ -123,6 +124,11 @@ class JaxTpuClient(BaseLLMClient):
         # chat/completions request through it BEFORE enqueue. None = no
         # tenant surface.
         self.tenants = tenants
+        # Workload monitor (runbookai_tpu/obs, built by from_config from
+        # llm.obs): live fingerprints + plan-drift + replica health.
+        # /debug/workload, the /healthz workload block and the `runbook
+        # workload` CLI all read it; None = zero workload surface.
+        self.workload_monitor = workload_monitor
 
     # --------------------------------------------------------- model groups
 
@@ -200,6 +206,15 @@ class JaxTpuClient(BaseLLMClient):
             # None when llm.tenants is absent/disabled: zero tenant
             # surface, the server admits everything exactly as before.
             tenants = TenantGovernor.from_config(llm_cfg.tenants)
+        def build_workload_monitor(cores=None, multi_model=None):
+            # llm.obs (runbookai_tpu/obs): None when disabled — zero
+            # workload surface, no runbook_workload_* series.
+            from runbookai_tpu.obs import WorkloadMonitor
+
+            return WorkloadMonitor.from_config(
+                llm_cfg, cores=cores, multi_model=multi_model,
+                slo_monitor=slo_monitor, tenants=tenants)
+
         if getattr(llm_cfg, "models", None):
             engine = build_multi_model_fleet(llm_cfg,
                                              slo_monitor=slo_monitor)
@@ -211,7 +226,8 @@ class JaxTpuClient(BaseLLMClient):
                 max_new_tokens=llm_cfg.max_new_tokens,
                 guided_json=llm_cfg.guided_json,
                 chat_format=default.chat_format,
-                slo_monitor=slo_monitor, tenants=tenants, engine=engine)
+                slo_monitor=slo_monitor, tenants=tenants, engine=engine,
+                workload_monitor=build_workload_monitor(multi_model=engine))
         built = build_group(llm_cfg)
         wire_feedback(built.cores, built.llm_cfg, slo_monitor)
         return cls(
@@ -225,6 +241,7 @@ class JaxTpuClient(BaseLLMClient):
             fleet_cfg=built.fleet_cfg,
             slo_monitor=slo_monitor,
             tenants=tenants,
+            workload_monitor=build_workload_monitor(cores=built.cores),
         )
 
     @classmethod

@@ -1,0 +1,40 @@
+"""Continuous workload observation (``runbookai_tpu/obs``).
+
+The observation half of ROADMAP item 3's closed tuning loop: fold the
+flight recorder's step records and the engine's request stream into the
+autotuner's ``Workload`` schema, score the live fingerprint's drift
+against the serving plan's provenance workload, and export a composite
+per-replica health signal. Read-only by design — nothing here changes a
+plan or moves traffic, so byte-identity with an unmonitored engine is
+structural (pinned by tests/test_obs.py).
+"""
+
+from runbookai_tpu.obs.fingerprint import (
+    DEFAULT_DRIFT_THRESHOLD,
+    DESCRIPTOR_KEYS,
+    RequestSample,
+    WorkloadFingerprinter,
+    build_fingerprint,
+    descriptor_json,
+    drift_score,
+)
+from runbookai_tpu.obs.monitor import (
+    FingerprintHistory,
+    WorkloadMonitor,
+    reference_descriptor,
+    replica_health,
+)
+
+__all__ = [
+    "DEFAULT_DRIFT_THRESHOLD",
+    "DESCRIPTOR_KEYS",
+    "FingerprintHistory",
+    "RequestSample",
+    "WorkloadFingerprinter",
+    "WorkloadMonitor",
+    "build_fingerprint",
+    "descriptor_json",
+    "drift_score",
+    "reference_descriptor",
+    "replica_health",
+]

@@ -20,6 +20,11 @@ OpenAI-client tooling can point at a TPU slice with no code changes:
   occupancy (total + per priority class), queue depth, KV pressure, wall
   split; fleet deployments merge every replica's ring into one
   ts-ordered timeline.
+- ``GET /debug/workload`` — live workload fingerprints + plan-drift
+  (``runbookai_tpu/obs``): per served model group, the live traffic
+  folded into the autotuner's ``Workload`` schema with its drift score
+  against the serving plan's provenance workload, plus a merged
+  fleet-wide view.
 - ``GET /tenants`` — live tenant-accounting state (``sched/tenants.py``):
   per-tenant policy, bucket levels, admit/throttle counters.
 
@@ -80,7 +85,7 @@ from runbookai_tpu.utils.trace import get_tracer
 _KNOWN_ROUTES = frozenset((
     "/v1/chat/completions", "/v1/completions", "/v1/embeddings",
     "/v1/adapters", "/v1/models", "/healthz", "/metrics", "/debug/steps",
-    "/tenants",
+    "/debug/workload", "/tenants",
 ))
 
 # Retry-After for fleet sheds / engine pool-pressure 503s: the backlog
@@ -562,6 +567,16 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             if path == "/debug/steps":
                 self._debug_steps(query)
                 return
+            if path == "/debug/workload":
+                # Live workload fingerprints + plan-drift (obs/): per
+                # served model group with a merged fleet-wide view.
+                # Without a monitor the surface reports itself disabled
+                # (not 404 — the CLI distinguishes "off" from "no
+                # server"), matching /tenants.
+                monitor = getattr(client, "workload_monitor", None)
+                self._json(200, monitor.snapshot() if monitor is not None
+                           else {"enabled": False, "models": {}})
+                return
             if path == "/v1/models":
                 mm = getattr(client, "multi_model", None)
                 if mm is not None:
@@ -618,6 +633,12 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     # percentiles and the burn ratio per objective — the
                     # feedback signal SLO-aware scheduling will consume.
                     body["slo"] = slo.evaluate()
+                monitor = getattr(client, "workload_monitor", None)
+                if monitor is not None:
+                    # Live workload fingerprint + plan-drift (obs/):
+                    # per-group for multi-model fleets, merged
+                    # fleet-wide like debug_steps.
+                    body["workload"] = monitor.snapshot()
                 self._json(200, body)
             elif path == "/tenants":
                 # Tenant accounting state (sched/tenants.py): configured

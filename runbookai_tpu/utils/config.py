@@ -205,6 +205,54 @@ class SLOConfig(BaseModel):
                 if v is not None}
 
 
+class WorkloadDescriptorConfig(BaseModel):
+    """A tuner workload descriptor spelled in config
+    (``llm.obs.workload``) — the drift reference when no serving plan is
+    pinned. Fields mirror ``autotune.cost_model.Workload`` exactly, so
+    the same dict feeds ``runbook tune``."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    prompt_len: int = Field(512, ge=1)
+    output_len: int = Field(128, ge=1)
+    concurrency: int = Field(8, ge=1)
+    guided_share: float = Field(0.0, ge=0.0, le=1.0)
+    spec_hit_rate: float = Field(0.0, ge=0.0)
+
+    def to_descriptor(self) -> dict[str, Any]:
+        return self.model_dump()
+
+
+class ObsConfig(BaseModel):
+    """Continuous workload fingerprinting + drift detection
+    (``llm.obs`` → ``runbookai_tpu/obs``). On by default: the layer is
+    read-only (one O(1) tap per finished request; everything else is
+    scrape-time), changes no plan and moves no traffic, so enabling it
+    cannot perturb served bytes. ``enabled: false`` removes every
+    ``runbook_workload_*`` / ``runbook_plan_stale`` /
+    ``runbook_replica_health`` series and the ``/debug/workload``
+    surface reports itself disabled."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    enabled: bool = True
+    # Sliding fingerprint window (seconds) and its sample bound.
+    window_s: float = Field(300.0, gt=0)
+    max_samples: int = Field(4096, ge=16)
+    # Drift score above which runbook_plan_stale{model} scrapes 1 — the
+    # retune trigger (docs/observability.md has the PromQL alert).
+    drift_threshold: float = Field(0.35, gt=0, le=1.0)
+    # Rotated on-disk fingerprint history (None = no persistence):
+    # one JSON per interval with window provenance, oldest pruned past
+    # history_max_files.
+    history_dir: Optional[str] = None
+    history_max_files: int = Field(64, ge=1)
+    history_interval_s: float = Field(60.0, ge=0)
+    # Drift reference when no serving plan is pinned (plan provenance
+    # wins when llm.plan / llm.models[].plan is set).
+    workload: Optional[WorkloadDescriptorConfig] = None
+
+
 # Keys a model-group entry owns (or that cannot nest): a group's
 # ``overrides`` must not rewrite them behind the entry's back — replica
 # accounting, plan validation and adapter resolution all read the ENTRY
@@ -338,6 +386,10 @@ class LLMConfig(BaseModel):
     # Per-tenant (API-key) token budgets and rate limits, enforced by
     # the OpenAI server before enqueue (runbookai_tpu/sched/tenants.py).
     tenants: TenantsConfig = Field(default_factory=TenantsConfig)
+    # Continuous workload fingerprinting + plan-drift detection
+    # (runbookai_tpu/obs): runbook_workload_* / runbook_plan_stale /
+    # runbook_replica_health series, /debug/workload, `runbook workload`.
+    obs: ObsConfig = Field(default_factory=ObsConfig)
     guided_json: bool = True  # token-level JSON grammar masks for complete()
 
 

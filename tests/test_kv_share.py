@@ -323,10 +323,10 @@ async def test_busy_source_churn_does_not_falsify_pull(bare_client):
     # traffic, which is exactly when sharing matters.
     await fleet.replicas[placement.pull_src].generate(
         ids("churn traffic on the source replica"), sp(4))
-    stale0 = fleet._m_pull_stale.value
+    stale0 = fleet.stale_rejections()
     pulled = await fleet._execute_pull(placement, prompt, 0)
     assert pulled > 0
-    assert fleet._m_pull_stale.value == stale0
+    assert fleet.stale_rejections() == stale0
     # And the pulled pages serve the same bytes.
     out2 = await fleet.generate(prompt, sp())
     assert out2.token_ids == out1.token_ids
@@ -348,7 +348,7 @@ async def test_concurrent_admit_churn_never_stale_rejects(bare_client):
                        FleetConfig(affinity=False, kv_share=True))
     prompt = ids("churny source: stable prefix page chain 07")
     out1 = await fleet.generate(prompt, sp())
-    stale0 = fleet._m_pull_stale.value
+    stale0 = fleet.stale_rejections()
     pulled_total = 0
     for round_idx in range(3):
         placement = await _pull_placement(fleet, prompt, tries=4)
@@ -373,7 +373,7 @@ async def test_concurrent_admit_churn_never_stale_rejects(bare_client):
         taken = dst_kv.allocator.alloc(dst_kv.allocator.free_pages)
         dst_kv.allocator.free(taken)
     assert pulled_total > 0
-    assert fleet._m_pull_stale.value == stale0  # ZERO stale rejections
+    assert fleet.stale_rejections() == stale0  # ZERO stale rejections
     # The pulled pages serve byte-identical streams.
     out2 = await fleet.generate(prompt, sp())
     assert out2.token_ids == out1.token_ids
@@ -394,10 +394,81 @@ async def test_mid_pull_preemption_degrades_to_recompute():
     taken = src_kv.allocator.alloc(src_kv.allocator.free_pages)
     src_kv.allocator.free(taken)
     assert src_kv.match_prefix(prompt) == 0
-    stale0 = fleet._m_pull_stale.value
+    stale0 = fleet.stale_rejections()
     pulled = await fleet._execute_pull(placement, prompt, 0)
     assert pulled == 0
-    assert fleet._m_pull_stale.value - stale0 == 1  # stale plan counted
+    assert fleet.stale_rejections() - stale0 == 1  # stale plan counted
+    # Reason attribution: the chain was GONE at export (epoch moved).
+    assert fleet._m_stale["epoch_moved"].value >= 1
+    out2 = await fleet.generate(prompt, sp())
+    assert out2.token_ids == out1.token_ids
+    await fleet.stop()
+
+
+async def test_partial_export_counts_mid_pull_preempt():
+    """Satellite (per-chain staleness attribution): an export that lands
+    SHORT of the planned deficit — the chain truncated between probe and
+    copy — books reason=mid_pull_preempt while its partial prefix still
+    installs (a partial pull is a byte-exact win, not a failure)."""
+    client = JaxTpuClient.for_testing(max_new_tokens=16, dp_replicas=2)
+    fleet = AsyncFleet(client.cores,
+                       FleetConfig(affinity=False, kv_share=True))
+    prompt = ids("partial pull: prefix chain truncates mid-copy 08")
+    out1 = await fleet.generate(prompt, sp())
+    placement = await _pull_placement(fleet, prompt)
+    assert placement.pull_pages >= 2  # the plan wants the whole chain
+    src_core = client.cores[placement.pull_src]
+    real_export = src_core.export_kv_pages
+
+    def truncated_export(prompt_ids, **kw):
+        # The chain "shrank" while the pull was in flight: export only
+        # one page of the planned deficit.
+        kw["max_pages"] = 1
+        return real_export(prompt_ids, **kw)
+
+    src_core.export_kv_pages = truncated_export
+    try:
+        stale0 = fleet._m_stale["mid_pull_preempt"].value
+        pulled = await fleet._execute_pull(placement, prompt, 0)
+    finally:
+        src_core.export_kv_pages = real_export
+    assert pulled == 1  # the partial prefix still landed
+    assert fleet._m_stale["mid_pull_preempt"].value - stale0 == 1
+    out2 = await fleet.generate(prompt, sp())
+    assert out2.token_ids == out1.token_ids
+    await fleet.stop()
+
+
+async def test_corrupt_payload_counts_digest_mismatch():
+    """Satellite (per-chain staleness attribution): a payload block
+    corrupted in transit is rejected by the import's digest check and
+    books reason=digest_mismatch — the request recomputes and streams
+    byte-identically."""
+    client = JaxTpuClient.for_testing(max_new_tokens=16, dp_replicas=2)
+    fleet = AsyncFleet(client.cores,
+                       FleetConfig(affinity=False, kv_share=True))
+    prompt = ids("corrupt pull: flipped bytes in transit 09")
+    out1 = await fleet.generate(prompt, sp())
+    placement = await _pull_placement(fleet, prompt)
+    src_core = client.cores[placement.pull_src]
+    real_export = src_core.export_kv_pages
+
+    def corrupting_export(prompt_ids, **kw):
+        exported = real_export(prompt_ids, **kw)
+        if exported is not None:
+            # Flip bytes AFTER the digests were computed (copy: fetched
+            # device arrays may be read-only views).
+            exported.leaves_k[0] = np.asarray(exported.leaves_k[0]) + 1.0
+        return exported
+
+    src_core.export_kv_pages = corrupting_export
+    try:
+        stale0 = fleet._m_stale["digest_mismatch"].value
+        pulled = await fleet._execute_pull(placement, prompt, 0)
+    finally:
+        src_core.export_kv_pages = real_export
+    assert pulled == 0  # nothing corrupted was installed
+    assert fleet._m_stale["digest_mismatch"].value - stale0 == 1
     out2 = await fleet.generate(prompt, sp())
     assert out2.token_ids == out1.token_ids
     await fleet.stop()
@@ -540,6 +611,11 @@ async def test_pull_span_traced_end_to_end(tmp_path):
     assert pulls, "no page-pull span traced"
     assert pulls[0]["meta"]["pages"] >= 1
     assert "src" in pulls[0]["meta"]
+    # Satellite: the span names the OWNING CHAIN (tail block hash of the
+    # pulled prefix — chained hashing makes it identify the whole chain),
+    # so repeated pulls of one hot conversation join across timelines.
+    assert len(pulls[0]["meta"]["chain"]) == 16
+    int(pulls[0]["meta"]["chain"], 16)
     tl = build_timeline(spans, pulls[0]["meta"]["trace_id"])
     assert any(e["name"] == "router.page_pull" and e.get("src") is not None
                for e in tl["events"])

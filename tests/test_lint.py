@@ -765,6 +765,23 @@ class TestTreeIsClean:
                                  root=ROOT)
         assert findings == [], "\n".join(f.format() for f in findings)
 
+    def test_obs_package_has_zero_noqa_sites(self):
+        """The workload-fingerprinting layer is pure host-side
+        observation: deque appends on the finish path, scrape-time
+        folds, JSON history. ZERO `runbook: noqa` markers — a
+        suppression appearing here means observation started syncing
+        devices or blocking under locks, which would put a read-only
+        layer on the serving critical path."""
+        obs_files = sorted(
+            (ROOT / "runbookai_tpu" / "obs").glob("*.py"))
+        assert obs_files, "obs package missing"
+        for path in obs_files:
+            assert "runbook: noqa" not in path.read_text(), (
+                f"unexpected noqa marker in {path}")
+        findings = analyze_paths([ROOT / "runbookai_tpu" / "obs"],
+                                 root=ROOT)
+        assert findings == [], "\n".join(f.format() for f in findings)
+
     def test_sched_package_has_zero_noqa_sites(self):
         """The scheduler/admission subsystem is pure host-side control
         code: no device syncs, no blocking I/O under locks, nothing to

@@ -1,0 +1,123 @@
+"""Metrics-catalog parity: every ``runbook_*`` series a live
+engine+server registers must be documented in docs/observability.md's
+catalog tables, and every cataloged name must still be registered by
+live code (removed metrics must leave the docs too). The doc IS the
+operator contract — dashboards and alerts are written against it — so
+drift in either direction fails tier-1 instead of a dashboard.
+"""
+
+import json
+import re
+import urllib.request
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+DOC = ROOT / "docs" / "observability.md"
+
+# Names whose registration is import-time or lazy (not constructed by the
+# live-surface build below); each is asserted against its real
+# registration site instead of the fresh registry.
+_IMPORT_TIME_PREFIXES = ("runbook_agent_",)
+
+
+def catalog_names() -> set[str]:
+    """Metric names from the doc's catalog tables (first cell of each
+    ``| `runbook_...` |`` row; a cell may carry two slash-joined names)."""
+    text = DOC.read_text()
+    start = text.index("## Metric catalog")
+    end = text.index("## Example PromQL")
+    names: set[str] = set()
+    for line in text[start:end].splitlines():
+        if not line.startswith("| `runbook_"):
+            continue
+        first_cell = line.split("|")[1]
+        names.update(re.findall(r"`(runbook_[a-z0-9_]+)`", first_cell))
+    assert names, "catalog tables not found / empty"
+    return names
+
+
+def test_live_registry_matches_doc_catalog(monkeypatch, tmp_path):
+    import runbookai_tpu.utils.metrics as metrics_mod
+
+    # Import-time registrations land in the PROCESS registry the moment
+    # the module loads — collect their names from there (importing after
+    # the monkeypatch would not re-run module bodies).
+    import runbookai_tpu.agent.agent  # noqa: F401 — registers llm counters
+    import runbookai_tpu.agent.parallel_executor  # noqa: F401 — tool metrics
+
+    process_registry = metrics_mod.get_registry()
+    import_time_names = {
+        m.name for m in process_registry
+        if m.name.startswith(_IMPORT_TIME_PREFIXES)}
+    assert import_time_names, "agent metrics not registered at import"
+
+    # A FRESH registry isolates this test from every metric other tests
+    # registered into the process-wide one (test-fixture names like
+    # runbook_test_* must not poison the parity check).
+    fresh = metrics_mod.MetricsRegistry()
+    monkeypatch.setattr(metrics_mod, "REGISTRY", fresh)
+
+    # --- the full live surface ------------------------------------------
+    from runbookai_tpu.engine.fleet import AsyncFleet
+    from runbookai_tpu.fleet.multimodel import ModelGroup, MultiModelFleet
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.obs import WorkloadFingerprinter, WorkloadMonitor
+    from runbookai_tpu.sched import TenantGovernor
+    from runbookai_tpu.sched.feedback import MixedBudgetController
+    from runbookai_tpu.server.openai_api import OpenAIServer
+    from runbookai_tpu.utils.config import TenantsConfig
+    from runbookai_tpu.utils.slo import SLOMonitor
+
+    # Engine + router + per-replica + fleet aggregates (dp=2).
+    client = JaxTpuClient.for_testing(dp_replicas=2, max_new_tokens=4)
+    # Multi-model rollups over the same cores (two one-replica groups).
+    c0, c1 = client.cores
+    MultiModelFleet([
+        ModelGroup(name="a", tokenizer=client.tokenizer,
+                   fleet=AsyncFleet([c0], model_label="a",
+                                    clear_labeled=False)),
+        ModelGroup(name="b", tokenizer=client.tokenizer,
+                   fleet=AsyncFleet([c1], model_label="b",
+                                    clear_labeled=False)),
+    ])
+    # SLO monitor + the feedback controller's adjustment metrics.
+    slo = SLOMonitor({"tpot_p95_ms": 40.0}, registry=fresh)
+    MixedBudgetController(slo, registry=fresh)
+    # Tenant admission governor.
+    TenantGovernor.from_config(TenantsConfig(
+        enabled=True, keys={"t1": {"rate_limit_rpm": 60}}))
+    # Workload monitor (fingerprints, drift, plan staleness, health).
+    fp = WorkloadFingerprinter(client.cores, model="a", window_s=300)
+    WorkloadMonitor({"a": fp}, {"a": ({}, "default")}, registry=fresh)
+    # Trace rotation counter registers lazily at the first rotation.
+    from runbookai_tpu.utils import trace as trace_mod
+
+    tracer = trace_mod.Tracer(tmp_path / "t.jsonl")
+    tracer.max_bytes = 1
+    tracer.event("a")
+    tracer.event("b")  # exceeds the cap -> rotation -> counter registers
+    tracer.close()
+    # HTTP server: per-route request metrics + a real scrape.
+    srv = OpenAIServer(client, "llama3-test", port=0)
+    srv.start_background()
+    try:
+        scraped = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=30
+        ).read().decode()
+    finally:
+        srv.shutdown()
+    assert "runbook_requests_total" in scraped
+
+    live = {m.name for m in fresh} | import_time_names
+    doc = catalog_names()
+
+    undocumented = sorted(live - doc)
+    assert not undocumented, (
+        "metrics registered by a live engine+server but missing from "
+        "docs/observability.md's catalog tables: "
+        f"{json.dumps(undocumented, indent=2)}")
+    unregistered = sorted(doc - live)
+    assert not unregistered, (
+        "metrics cataloged in docs/observability.md but no longer "
+        "registered by a live engine+server (remove the rows or restore "
+        f"the series): {json.dumps(unregistered, indent=2)}")

@@ -1,0 +1,521 @@
+"""Workload fingerprinting, plan-drift detection, fleet health scoring
+(runbookai_tpu/obs — the observation half of ROADMAP item 3).
+
+Pins: fingerprint determinism (identical flight-recorder fixtures ⇒
+byte-identical emitted Workload JSON and drift score), the absence
+contract (empty/warmup windows drop every series — never drift=0, the
+``runbook_slo_*`` contract), the descriptor round-trip into the
+autotuner's own ``Workload``, drift bounds and the stale threshold,
+reference resolution (plan provenance > llm.obs.workload > default),
+rotated on-disk history with provenance, replica-health composition,
+the live engine tap + /debug/workload + /healthz workload block, the
+`runbook workload` CLI (including --emit-descriptor feeding
+`runbook tune --workload` unchanged), and the read-only claim: streams
+are byte-identical with fingerprinting on vs off.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from runbookai_tpu.obs import (
+    DEFAULT_DRIFT_THRESHOLD,
+    FingerprintHistory,
+    RequestSample,
+    WorkloadFingerprinter,
+    WorkloadMonitor,
+    build_fingerprint,
+    descriptor_json,
+    drift_score,
+    reference_descriptor,
+    replica_health,
+)
+from runbookai_tpu.utils import metrics as metrics_mod
+
+
+def sample(ts, prompt=64, out=16, cached=0, guided=False,
+           forced_sync=False, aborted=False):
+    return RequestSample(ts=ts, prompt_tokens=prompt, output_tokens=out,
+                         cached_tokens=cached, guided=guided,
+                         forced_sync=forced_sync or guided,
+                         aborted=aborted)
+
+
+def step(ts, kind="decode", batch=2, queue=1, occ=0.5):
+    return {"ts": ts, "kind": kind, "batch": batch, "queue_depth": queue,
+            "occupancy": occ, "tokens": 4}
+
+
+FIXTURE_SAMPLES = [
+    sample(10.0, prompt=48, out=12),
+    sample(11.0, prompt=64, out=16, cached=16),
+    sample(12.0, prompt=80, out=20, guided=True),
+    sample(13.0, prompt=64, out=16, aborted=True),
+]
+FIXTURE_STEPS = [step(10.5), step(11.5, kind="mixed", batch=3, queue=2),
+                 step(12.5, kind="idle", batch=0, queue=0),
+                 step(13.5, kind="prefill", batch=1, queue=4)]
+FIXTURE_METRICS = {"spec_accepted": 6, "decode_dispatches": 12}
+WINDOW = (9.0, 14.0)
+
+
+# ----------------------------------------------------------- determinism
+
+
+def test_fingerprint_is_deterministic_byte_for_byte():
+    """Identical flight-recorder fixtures ⇒ byte-identical emitted
+    Workload JSON and drift score (the satellite contract)."""
+    a = build_fingerprint(FIXTURE_SAMPLES, FIXTURE_STEPS, FIXTURE_METRICS,
+                          model="m", window=WINDOW)
+    b = build_fingerprint(list(FIXTURE_SAMPLES), list(FIXTURE_STEPS),
+                          dict(FIXTURE_METRICS), model="m", window=WINDOW)
+    assert a is not None
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert descriptor_json(a) == descriptor_json(b)
+    ref = {"prompt_len": 512, "output_len": 128, "concurrency": 8,
+           "guided_share": 0.0, "spec_hit_rate": 0.0}
+    assert drift_score(a["workload"], ref) == drift_score(b["workload"], ref)
+
+
+def test_fingerprint_contents():
+    fp = build_fingerprint(FIXTURE_SAMPLES, FIXTURE_STEPS, FIXTURE_METRICS,
+                           model="m", window=WINDOW)
+    # The aborted request counts toward the mix, never the length stats.
+    assert fp["window"]["samples"] == 3
+    assert fp["window"]["aborted"] == 1
+    assert fp["prompt_tokens"]["p50"] == 64.0
+    assert fp["guided_share"] == round(1 / 3, 4)
+    assert fp["forced_sync_share"] == round(1 / 3, 4)
+    # 16 cached of 192 prompt tokens across the completed requests.
+    assert fp["prefix_cache_share"] == round(16 / (48 + 64 + 80), 4)
+    # spec hit rate = accepted per decode dispatch.
+    assert fp["spec_hit_rate"] == 0.5
+    # Idle steps are excluded from the concurrency fold: mean of
+    # (2+1, 3+2, 1+4) = 4.33 over the three non-idle records, ceiled.
+    assert fp["workload"]["concurrency"] == 5
+    assert fp["window"]["steps"] == 3
+
+
+def test_descriptor_round_trips_into_tuner_workload():
+    from runbookai_tpu.autotune.cost_model import Workload
+
+    fp = build_fingerprint(FIXTURE_SAMPLES, FIXTURE_STEPS, FIXTURE_METRICS,
+                           model="m", window=WINDOW)
+    payload = descriptor_json(fp)
+    wl = Workload.from_dict(json.loads(payload))
+    # The emitted keys are EXACTLY Workload.to_dict()'s — unchanged.
+    assert wl.to_dict() == fp["workload"]
+    with pytest.raises(ValueError, match="unknown workload descriptor"):
+        Workload.from_dict({"prompt_len": 8, "typo_key": 1})
+
+
+def test_empty_and_warmup_windows_fingerprint_as_none():
+    # No samples at all.
+    assert build_fingerprint([], FIXTURE_STEPS, {}, model="m",
+                             window=WINDOW) is None
+    # Samples exist but OUTSIDE the window (warmup traffic aged out).
+    old = [sample(1.0), sample(2.0)]
+    assert build_fingerprint(old, [], {}, model="m",
+                             window=(100.0, 200.0)) is None
+    # Only aborted requests: nothing completed, nothing to fingerprint.
+    assert build_fingerprint([sample(10.0, aborted=True)], [], {},
+                             model="m", window=WINDOW) is None
+
+
+# ----------------------------------------------------------------- drift
+
+
+def test_drift_score_bounds_and_direction():
+    base = {"prompt_len": 64, "output_len": 16, "concurrency": 4,
+            "guided_share": 0.0, "spec_hit_rate": 0.0}
+    assert drift_score(base, base) == 0.0
+    # The ROADMAP item 3 shift: short-chat -> long-context/guided.
+    shifted = dict(base, prompt_len=256, guided_share=1.0)
+    d = drift_score(shifted, base)
+    assert DEFAULT_DRIFT_THRESHOLD < d <= 1.0
+    # A mild change stays under the threshold.
+    mild = dict(base, prompt_len=80)
+    assert drift_score(mild, base) < DEFAULT_DRIFT_THRESHOLD
+    # Bounded even under absurd shifts.
+    extreme = {"prompt_len": 1_000_000, "output_len": 1, "concurrency": 1,
+               "guided_share": 1.0, "spec_hit_rate": 5.0}
+    assert drift_score(extreme, base) <= 1.0
+    # Symmetric in the scale dimensions.
+    assert drift_score(base, shifted) == drift_score(shifted, base)
+
+
+def test_no_step_evidence_excludes_concurrency_from_drift():
+    """With zero non-idle step records (recorder disabled / ring aged
+    out) the fingerprint has NO concurrency evidence: the descriptor
+    carries the floor (1), never the window's request count — a
+    200-request sequential window must not fabricate concurrency=200 —
+    and the monitor drops the dimension from the drift score entirely."""
+    many = [sample(10.0 + i * 0.01) for i in range(200)]
+    fp = build_fingerprint(many, [], {}, model="m", window=WINDOW)
+    assert fp["concurrency"] is None
+    assert fp["workload"]["concurrency"] == 1
+    # Scored via the monitor: references differing ONLY in concurrency
+    # produce the SAME drift when the dimension has no evidence.
+    base = {"prompt_len": 64, "output_len": 16, "concurrency": 1,
+            "guided_share": 0.0, "spec_hit_rate": 0.0}
+    high = dict(base, concurrency=64)
+    skip = ("concurrency",)
+    assert drift_score(fp["workload"], base, skip=skip) == \
+        drift_score(fp["workload"], high, skip=skip)
+    # And the remaining weights re-normalize: a pure-guided shift still
+    # reaches the same score it would with the dimension present+equal.
+    guided_ref = dict(base, guided_share=1.0)
+    with_dim = drift_score(fp["workload"], guided_ref)
+    without_dim = drift_score(fp["workload"], guided_ref, skip=skip)
+    assert without_dim >= with_dim > 0
+
+
+# ------------------------------------------------------------- reference
+
+
+def test_reference_resolution_order(tmp_path):
+    from runbookai_tpu.autotune.cost_model import Workload
+    from runbookai_tpu.utils.config import LLMConfig
+
+    # Default: the tuner's own defaults.
+    cfg = LLMConfig()
+    ref, src = reference_descriptor(cfg)
+    assert ref == Workload().to_dict() and src == "default"
+    # Configured descriptor beats the default.
+    cfg = LLMConfig(obs={"workload": {"prompt_len": 99}})
+    ref, src = reference_descriptor(cfg)
+    assert ref["prompt_len"] == 99
+    assert src == "config:llm.obs.workload"
+    # Plan provenance beats both.
+    from runbookai_tpu.autotune.plan import PlanArtifact, save_plan
+
+    plan = PlanArtifact(
+        model="llama3-test", topology={"tp": 1, "device_kind": "cpu"},
+        engine={"page_size": 4, "num_pages": 64},
+        workload={"prompt_len": 321, "output_len": 45, "concurrency": 6,
+                  "guided_share": 0.25, "spec_hit_rate": 0.1})
+    path = tmp_path / "p.json"
+    save_plan(plan, path)
+    ref, src = reference_descriptor(cfg, plan_path=str(path))
+    assert ref["prompt_len"] == 321 and ref["guided_share"] == 0.25
+    assert src == f"plan:{plan.plan_id}"
+
+
+# --------------------------------------------------------------- history
+
+
+def test_history_rotation_and_provenance(tmp_path):
+    hist = FingerprintHistory(tmp_path / "fp", max_files=3)
+    for i in range(5):
+        hist.record({"recorded_ts": float(i), "models": {
+            "m": {"fingerprint": {"window": {"samples": i}}}}})
+    entries = hist.entries()
+    assert len(entries) == 3  # oldest pruned past max_files
+    # Monotonic sequence survives pruning (newest kept).
+    assert [e["recorded_ts"] for e in entries] == [2.0, 3.0, 4.0]
+    # Provenance (window span / sample counts) rides in each entry.
+    assert entries[-1]["models"]["m"]["fingerprint"]["window"][
+        "samples"] == 4
+
+
+# ---------------------------------------------------------------- health
+
+
+def test_replica_health_composition():
+    class _KV:
+        def __init__(self, util):
+            self._u = util
+
+        def utilization(self):
+            return self._u
+
+    class _Core:
+        def __init__(self, util=0.0, queue=0):
+            class E:
+                max_batch_slots = 4
+            self.ecfg = E()
+            self.waiting = [None] * queue
+            self.prefilling = []
+            self.kv = _KV(util)
+
+    healthy = replica_health(_Core())
+    assert healthy == 1.0
+    # Each axis degrades the score; any exhausted axis dominates.
+    assert replica_health(_Core(queue=4)) == 0.5
+    assert replica_health(_Core(util=0.9)) == pytest.approx(0.1)
+    assert replica_health(_Core(), burn=2.0) == 0.5
+    assert replica_health(_Core(), drift=0.4) == 0.6
+    assert replica_health(_Core(util=1.0), burn=10.0) == 0.0
+    combined = replica_health(_Core(util=0.5, queue=4), burn=2.0,
+                              drift=0.5)
+    assert combined == pytest.approx(0.5 * 0.5 * 0.5 * 0.5)
+
+
+# ------------------------------------------------- monitor + metric layer
+
+
+def _mk_monitor(registry, fingerprinters, references=None, **kw):
+    refs = references or {name: ({"prompt_len": 64, "output_len": 16,
+                                  "concurrency": 4, "guided_share": 0.0,
+                                  "spec_hit_rate": 0.0}, "test")
+                          for name in fingerprinters}
+    return WorkloadMonitor(fingerprinters, refs, registry=registry, **kw)
+
+
+class _FakeReq:
+    """EngineRequest stand-in for tap-level tests."""
+
+    def __init__(self, prompt=64, out=16, guided=None, aborted=False,
+                 cached=0):
+        from runbookai_tpu.engine.request import (
+            FinishReason,
+            SamplingParams,
+        )
+
+        self.prompt_ids = [1] * prompt
+        self.num_generated = out
+        self.cached_tokens = cached
+        self.sampling = SamplingParams(guided=guided)
+        self.finish_reason = (FinishReason.ABORTED if aborted
+                              else FinishReason.MAX_TOKENS)
+
+
+def test_monitor_absence_then_presence_in_scrape():
+    """Empty windows scrape as series ABSENCE for every workload gauge
+    (never drift=0 / stale=0); the first completed request materializes
+    them. Same contract as runbook_slo_*."""
+    reg = metrics_mod.MetricsRegistry()
+    fp = WorkloadFingerprinter([], model="m", window_s=300)
+    monitor = _mk_monitor(reg, {"m": fp})
+    text = reg.render()
+    for name in ("runbook_workload_drift_score", "runbook_plan_stale",
+                 "runbook_workload_prompt_len_p50",
+                 "runbook_workload_window_requests"):
+        assert f"# TYPE {name} gauge" in text     # registered...
+        assert f'{name}{{model="m"}}' not in text  # ...but absent
+    fp.observe_request(_FakeReq(prompt=256, guided="json"))
+    monitor._memo.clear()  # the scrape memo holds ~1s; tests skip the wait
+    text = reg.render()
+    assert 'runbook_workload_drift_score{model="m"}' in text
+    assert 'runbook_plan_stale{model="m"} 1' in text
+    assert 'runbook_workload_window_requests{model="m"} 1' in text
+
+
+def test_monitor_drift_and_stale_threshold():
+    reg = metrics_mod.MetricsRegistry()
+    fp = WorkloadFingerprinter([], model="m", window_s=300)
+    monitor = _mk_monitor(reg, {"m": fp}, drift_threshold=0.9)
+    assert monitor.drift("m") is None
+    assert monitor.plan_stale("m") is None
+    # Traffic matching the reference: tiny drift, not stale.
+    for _ in range(4):
+        fp.observe_request(_FakeReq(prompt=64, out=16))
+    monitor._memo.clear()
+    assert monitor.drift("m") is not None
+    assert monitor.plan_stale("m") is False
+    snap = monitor.snapshot()
+    assert snap["models"]["m"]["plan_stale"] is False
+    assert snap["models"]["m"]["reference_source"] == "test"
+    assert snap["drift_score"] == snap["models"]["m"]["drift_score"]
+
+
+def test_monitor_multi_group_snapshot_and_merge():
+    reg = metrics_mod.MetricsRegistry()
+    fp_a = WorkloadFingerprinter([], model="a", window_s=300)
+    fp_b = WorkloadFingerprinter([], model="b", window_s=300)
+    monitor = _mk_monitor(reg, {"a": fp_a, "b": fp_b})
+    for _ in range(3):
+        fp_a.observe_request(_FakeReq(prompt=64, out=16))
+    # b stays empty: its row reports absence while a's fingerprints.
+    snap = monitor.snapshot()
+    assert snap["models"]["a"]["fingerprint"] is not None
+    assert snap["models"]["b"]["fingerprint"] is None
+    assert snap["models"]["b"]["drift_score"] is None
+    # Merged fleet view folds every group's samples (here: a's only).
+    assert snap["merged"]["model"] == "fleet"
+    assert snap["merged"]["window"]["samples"] == 3
+    # Fleet-wide staleness is the worst group's.
+    assert snap["drift_score"] == snap["models"]["a"]["drift_score"]
+
+
+def test_monitor_history_interval_gating(tmp_path):
+    reg = metrics_mod.MetricsRegistry()
+    fp = WorkloadFingerprinter([], model="m", window_s=300)
+    hist = FingerprintHistory(tmp_path / "h", max_files=8)
+    monitor = _mk_monitor(reg, {"m": fp}, history=hist,
+                          history_interval_s=3600.0)
+    fp.observe_request(_FakeReq())
+    monitor.snapshot()
+    monitor.snapshot()  # inside the interval: no second file
+    entries = hist.entries()
+    assert len(entries) == 1
+    assert entries[0]["models"]["m"]["fingerprint"]["window"]["samples"] == 1
+    assert "drift_score" in entries[0]["models"]["m"]
+
+
+# ------------------------------------------------------- live engine e2e
+
+
+async def test_engine_tap_and_byte_identity():
+    """The tap records real finished requests — and the read-only claim:
+    an engine WITH fingerprinting streams byte-identically to one
+    without (identical seeds, identical prompts)."""
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+
+    prompts = [[7] * 24, [9] * 40]
+    outs = {}
+    for tapped in (False, True):
+        client = JaxTpuClient.for_testing(max_new_tokens=8)
+        fp = None
+        if tapped:
+            fp = WorkloadFingerprinter([client.core], model="m",
+                                       window_s=600)
+            fp.install_taps()
+        got = []
+        for p in prompts:
+            out = await client.engine.generate(p, client._sampling())
+            got.append(out.token_ids)
+        outs[tapped] = got
+        if tapped:
+            assert fp.sample_count == 2
+            fprint = fp.fingerprint()
+            assert fprint["window"]["samples"] == 2
+            assert fprint["prompt_tokens"]["p50"] == 32.0
+        await client.engine.stop()
+    assert outs[False] == outs[True]  # fingerprinting never touches a stream
+
+
+async def test_guided_and_aborted_requests_fingerprint_correctly():
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+
+    client = JaxTpuClient.for_testing(max_new_tokens=8)
+    fp = WorkloadFingerprinter([client.core], model="m", window_s=600)
+    fp.install_taps()
+    await client.engine.generate([5] * 16, client._sampling())
+    await client.engine.generate([5] * 16, client._sampling(guided="json"))
+    fprint = fp.fingerprint()
+    assert fprint["guided_share"] == 0.5
+    assert fprint["forced_sync_share"] == 0.5
+    await client.engine.stop()
+
+
+def test_server_debug_workload_and_healthz_block():
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.server.openai_api import OpenAIServer
+    from runbookai_tpu.utils.config import LLMConfig
+
+    cfg = LLMConfig(provider="jax-tpu", model="llama3-test",
+                    dtype="float32", page_size=4, num_pages=256,
+                    max_batch_slots=4, prefill_chunk=32, max_seq_len=256,
+                    max_new_tokens=8)
+    client = JaxTpuClient.from_config(cfg)
+    assert client.workload_monitor is not None  # llm.obs defaults ON
+    srv = OpenAIServer(client, "llama3-test", port=0)
+    srv.start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        # Warmup window: enabled, but nothing fingerprinted yet.
+        snap = json.loads(urllib.request.urlopen(
+            base + "/debug/workload", timeout=30).read())
+        assert snap["enabled"] is True
+        assert snap["models"]["llama3-test"]["fingerprint"] is None
+        assert snap["drift_score"] is None and snap["plan_stale"] is None
+        req = urllib.request.Request(
+            base + "/v1/chat/completions",
+            data=json.dumps({"messages": [{"role": "user",
+                                           "content": "hi"}],
+                             "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=120).read()
+        client.workload_monitor._memo.clear()
+        snap = json.loads(urllib.request.urlopen(
+            base + "/debug/workload", timeout=30).read())
+        entry = snap["models"]["llama3-test"]
+        assert entry["fingerprint"]["window"]["samples"] == 1
+        assert entry["drift_score"] is not None
+        assert entry["reference_source"] == "default"
+        health = json.loads(urllib.request.urlopen(
+            base + "/healthz", timeout=30).read())
+        assert health["workload"]["models"]["llama3-test"][
+            "fingerprint"] is not None
+        # The health gauge scrapes per replica+model.
+        metrics = urllib.request.urlopen(
+            base + "/metrics", timeout=30).read().decode()
+        assert 'runbook_replica_health{replica="0",model="llama3-test"}' \
+            in metrics
+        assert 'runbook_workload_drift_score{model="llama3-test"}' \
+            in metrics
+    finally:
+        srv.shutdown()
+
+
+def test_workload_monitor_disabled_by_config():
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.utils.config import LLMConfig
+
+    cfg = LLMConfig(provider="jax-tpu", model="llama3-test",
+                    dtype="float32", page_size=4, num_pages=256,
+                    max_batch_slots=4, prefill_chunk=32, max_seq_len=256,
+                    obs={"enabled": False})
+    client = JaxTpuClient.from_config(cfg)
+    assert client.workload_monitor is None
+    assert client.core.workload_tap is None
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def test_cli_workload_render_and_descriptor_handoff(tmp_path):
+    """`runbook workload` renders a live server's fingerprints, and
+    --emit-descriptor writes JSON that feeds `runbook tune --smoke
+    --workload` WITHOUT edits (the acceptance hand-off)."""
+    from runbookai_tpu.cli.main import main as cli_main
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.server.openai_api import OpenAIServer
+    from runbookai_tpu.utils.config import LLMConfig
+
+    cfg = LLMConfig(provider="jax-tpu", model="llama3-test",
+                    dtype="float32", page_size=4, num_pages=256,
+                    max_batch_slots=4, prefill_chunk=32, max_seq_len=256,
+                    max_new_tokens=8)
+    client = JaxTpuClient.from_config(cfg)
+    srv = OpenAIServer(client, "llama3-test", port=0)
+    srv.start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    out = tmp_path / "descriptor.json"
+    try:
+        req = urllib.request.Request(
+            base + "/v1/chat/completions",
+            data=json.dumps({"messages": [{"role": "user",
+                                           "content": "hello"}],
+                             "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=120).read()
+        client.workload_monitor._memo.clear()
+        assert cli_main(["workload", "--url", base]) == 0
+        assert cli_main(["workload", "--url", base,
+                         "--emit-descriptor", str(out)]) == 0
+    finally:
+        srv.shutdown()
+    payload = json.loads(out.read_text())
+    assert set(payload) == {"prompt_len", "output_len", "concurrency",
+                            "guided_share", "spec_hit_rate"}
+    # The emitted file feeds the tuner unchanged.
+    plan_out = tmp_path / "plan.json"
+    rc = cli_main(["tune", "--smoke", "--no-measure",
+                   "--workload", str(out), "--out", str(plan_out)])
+    assert rc == 0
+    assert json.loads(plan_out.read_text())["plan_id"]
+
+
+def test_cli_workload_emit_refuses_empty_window(tmp_path, capsys):
+    from runbookai_tpu.cli.main import _render_workload
+
+    # Disabled surface renders a clear message, not a table.
+    assert "disabled" in _render_workload({"enabled": False})
+    # An enabled-but-empty snapshot renders absence rows.
+    text = _render_workload({"enabled": True, "drift_threshold": 0.35,
+                             "models": {"m": {"fingerprint": None,
+                                              "reference_source": "x"}}})
+    assert "m" in text and "-" in text
