@@ -391,6 +391,9 @@ class Agent:
             if tool.risk == RiskLevel.READ:
                 cached = self.cache.get(call.name, call.args)
                 if cached is not None:
+                    # runbook: noqa[RBK010] — tool label: call.name resolved
+                    # through self.tools above, so values are the registered
+                    # toolset (fixed at Agent construction).
                     _TOOL_CACHE_HITS.labels(tool=call.name).inc()
                     results[i] = ToolResult(call=call, result=cached, cached=True)
                     continue

@@ -68,7 +68,7 @@ class ParallelToolExecutor:
     ) -> ToolResult:
         start = time.perf_counter()
         timeout = self.mutation_timeout if is_mutation else self.timeout
-        _TOOL_CALLS.labels(tool=call.name).inc()
+        _TOOL_CALLS.labels(tool=call.name).inc()  # runbook: noqa[RBK010] — tool label: registered toolset, fixed at executor construction
         try:
             if timeout:
                 result = await asyncio.wait_for(execute(call), timeout=timeout)
@@ -77,15 +77,15 @@ class ParallelToolExecutor:
             return ToolResult(call=call, result=result,
                               duration_ms=(time.perf_counter() - start) * 1000)
         except asyncio.TimeoutError:
-            _TOOL_ERRORS.labels(tool=call.name).inc()
+            _TOOL_ERRORS.labels(tool=call.name).inc()  # runbook: noqa[RBK010] — tool label: registered toolset, fixed at executor construction
             return ToolResult(call=call, error=f"tool {call.name} timed out",
                               duration_ms=(time.perf_counter() - start) * 1000)
         except Exception as exc:  # noqa: BLE001 — tool errors surface as results
-            _TOOL_ERRORS.labels(tool=call.name).inc()
+            _TOOL_ERRORS.labels(tool=call.name).inc()  # runbook: noqa[RBK010] — tool label: registered toolset, fixed at executor construction
             return ToolResult(call=call, error=f"{type(exc).__name__}: {exc}",
                               duration_ms=(time.perf_counter() - start) * 1000)
         finally:
-            _TOOL_LATENCY.labels(tool=call.name).observe(
+            _TOOL_LATENCY.labels(tool=call.name).observe(  # runbook: noqa[RBK010] — tool label: registered toolset, fixed at executor construction
                 time.perf_counter() - start)
 
     async def execute_all(

@@ -17,22 +17,36 @@ in-tree analyzer that enforces that discipline on every commit:
   findings so the gate only fails on NEW ones, and ``--update-baseline``
   regenerates it deterministically.
 
+Since PR 13 the analyzer is **whole-program**: every run first builds a
+project-wide symbol table and call graph (``analysis/project.py`` — still
+stdlib ``ast`` only, deterministic output), then runs the per-file rules
+with cross-module jit-reachability seeds plus four cross-module rules
+(``analysis/xrules.py``).
+
 Rule set (see docs/lint.md for the catalog with bad/good examples):
 
 ========  ==================================================================
 RBK001    data-dependent Python branching / ``bool()``/``int()``/``float()``
           / ``.item()`` / ``.tolist()`` on traced values inside
-          ``@jax.jit``-reachable functions (recompile + host-sync hazards)
+          ``@jax.jit``-reachable functions — reachability and traced-ness
+          now propagate across module boundaries through the call graph
 RBK002    ``jax.block_until_ready`` / ``jax.device_get`` / implicit
           device→host transfer in the engine step/decode loop outside
           sanctioned sync points
 RBK003    blocking I/O (``time.sleep``, file/socket/subprocess) while
           holding a lock (``with self._lock:`` scope analysis)
 RBK004    shared attributes mutated both inside and outside a lock scope
-          (lock-discipline heuristic)
+          (same-module lock-discipline heuristic)
 RBK005    metric registrations violating the observability contract
           (``^runbook_[a-z0-9_]+$``; histograms need explicit buckets)
 RBK006    ``print`` / ``jax.debug.print`` left in engine/ops/model hot paths
+RBK007    lock-order cycles through the call graph, same-instance
+          re-acquisition, locks held across ``await``/thread handoffs
+RBK008    attributes of engine/fleet/sched/obs/server objects written from
+          ≥ 2 thread entry roles without one common lock
+RBK009    blocking calls inside ``async def`` bodies on the serving path
+RBK010    metric-label values not drawn from a statically bounded set
+          (the label-cardinality contract, checked)
 ========  ==================================================================
 """
 
@@ -49,6 +63,7 @@ from runbookai_tpu.analysis.core import (
     analyze_file,
     analyze_paths,
     analyze_source,
+    finding_fingerprints,
     iter_python_files,
 )
 from runbookai_tpu.analysis.rules import default_rules, rule_by_id
@@ -62,6 +77,7 @@ __all__ = [
     "analyze_source",
     "baseline_counts",
     "default_rules",
+    "finding_fingerprints",
     "iter_python_files",
     "load_baseline",
     "new_findings",

@@ -2,13 +2,28 @@
 ``python -m runbookai_tpu.analysis`` and ``scripts/lint.py``.
 
 Kept free of heavy imports (no jax, no engine): the lint gate is the
-fastest check in tier-1 and must stay that way.
+fastest check in tier-1 and must stay that way. Every run is two-phase
+(whole-program index, then per-file rules with cross-module seeds) and
+byte-deterministic for a given file set regardless of discovery order.
+
+Formats:
+
+- ``text`` (default) — one ``path:line:col: RULE [severity] message`` line;
+- ``json`` — findings carry ``severity``, ``symbol`` and a stable
+  ``fingerprint`` (rule+path+symbol hash, line-move tolerant) so CI can
+  diff finding SETS across commits without line-number churn;
+- ``sarif`` — minimal SARIF 2.1.0 for CI annotation UIs.
+
+``--changed`` keeps pre-commit fast without giving up the whole-program
+view: the full index is still built (cross-module rules need it), but
+reported findings are filtered to files modified per ``git status``.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
 from typing import Optional, Sequence
@@ -19,21 +34,40 @@ from runbookai_tpu.analysis.baseline import (
     write_baseline,
 )
 from runbookai_tpu.analysis.core import (
+    PARSE_RULE_ID,
+    Finding,
     Severity,
     _rel_path,
     analyze_paths,
+    finding_fingerprints,
     iter_python_files,
 )
 
 DEFAULT_BASELINE = "lint-baseline.json"
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemas/JSON/sarif-schema-2.1.0.json")
+
+
+def _rule_catalog() -> dict[str, str]:
+    """id → one-line description for every rule (per-file + project)."""
+    from runbookai_tpu.analysis.rules import default_rules
+    from runbookai_tpu.analysis.xrules import XRULE_DESCRIPTIONS
+
+    out = {PARSE_RULE_ID: "un-parseable module (file is never analyzed)"}
+    for rule in default_rules():
+        out[rule.rule_id] = rule.description
+    out.update(XRULE_DESCRIPTIONS)
+    return dict(sorted(out.items()))
 
 
 def add_lint_arguments(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("paths", nargs="*", default=None,
                         help="files/directories to analyze "
                              "(default: runbookai_tpu/)")
-    parser.add_argument("--format", choices=("text", "json"), default="text",
-                        dest="fmt", help="finding output format")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text", dest="fmt",
+                        help="finding output format")
     parser.add_argument("--baseline", default=None,
                         help=f"baseline JSON path (default: "
                              f"{DEFAULT_BASELINE} when it exists)")
@@ -42,6 +76,89 @@ def add_lint_arguments(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--update-baseline", action="store_true",
                         help="rewrite the baseline from the current tree "
                              "and exit 0")
+    parser.add_argument("--changed", action="store_true",
+                        help="report only findings in files git sees as "
+                             "modified/added/untracked (the whole-program "
+                             "index is still built over every path — "
+                             "cross-module rules keep their full view)")
+
+
+def _git_changed_paths(anchor: Path) -> Optional[set[str]]:
+    """Repo-relative paths of modified/staged/untracked files, normalized
+    like ``Finding.path`` (relative to ``anchor``). None when git is
+    unavailable or the anchor is not inside a work tree."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], cwd=anchor,
+            capture_output=True, text=True, timeout=30)
+        if top.returncode != 0:
+            return None
+        toplevel = Path(top.stdout.strip())
+        # -uall: without it a brand-new directory collapses to one
+        # "?? newpkg/" line and every file inside it would slip past the
+        # .py filter — the exact new-package case pre-commit must catch.
+        status = subprocess.run(
+            ["git", "status", "--porcelain", "-uall"], cwd=anchor,
+            capture_output=True, text=True, timeout=30)
+        if status.returncode != 0:
+            return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    out: set[str] = set()
+    for line in status.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:]
+        if " -> " in path:  # rename: keep the new side
+            path = path.split(" -> ", 1)[1]
+        path = path.strip().strip('"')
+        if not path.endswith(".py"):
+            continue
+        out.add(_rel_path(toplevel / path, anchor))
+    return out
+
+
+def _rows(findings: Sequence[Finding]) -> list[dict]:
+    rows = [f.to_json() for f in findings]
+    for row, fp in zip(rows, finding_fingerprints(findings)):
+        row["fingerprint"] = fp
+    return rows
+
+
+def _sarif(findings: Sequence[Finding]) -> dict:
+    catalog = _rule_catalog()
+    level = {Severity.ERROR: "error", Severity.WARNING: "warning"}
+    results = []
+    for f, fp in zip(findings, finding_fingerprints(findings)):
+        results.append({
+            "ruleId": f.rule,
+            "level": level.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "partialFingerprints": {"runbookLint/v1": fp},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": max(1, f.col + 1)},
+                },
+                "logicalLocations": ([{"fullyQualifiedName": f.symbol}]
+                                     if f.symbol else []),
+            }],
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "runbook-lint",
+                "informationUri": "docs/lint.md",
+                "rules": [{"id": rid,
+                           "shortDescription": {"text": desc}}
+                          for rid, desc in catalog.items()],
+            }},
+            "results": results,
+        }],
+    }
 
 
 def run_lint(args: argparse.Namespace,
@@ -81,11 +198,26 @@ def run_lint(args: argparse.Namespace,
         baseline = load_baseline(baseline_path)
     new = new_findings(findings, baseline)
 
-    if args.fmt == "json":
+    scope_note = ""
+    if args.changed:
+        changed = _git_changed_paths(root or Path.cwd())
+        if changed is None:
+            print("lint: --changed requires a git work tree", file=out)
+            return 2
+        before = len(new)
+        new = [f for f in new if f.path in changed]
+        scope_note = (f" (--changed: {len(new)} of {before} findings in "
+                      f"{len(changed)} changed files)")
+
+    if args.fmt == "sarif":
+        json.dump(_sarif(new), out, indent=2, sort_keys=True)
+        out.write("\n")
+    elif args.fmt == "json":
         json.dump({
-            "findings": [f.to_json() for f in new],
+            "findings": _rows(new),
             "total": len(findings),
-            "baselined": len(findings) - len(new),
+            "baselined": len(findings) - len(new) if not args.changed
+            else None,
             "new": len(new),
             "errors": sum(f.severity == Severity.ERROR for f in new),
         }, out, indent=2)
@@ -94,19 +226,21 @@ def run_lint(args: argparse.Namespace,
         for f in new:
             print(f.format(), file=out)
         baselined = len(findings) - len(new)
-        suffix = f" ({baselined} baselined)" if baselined else ""
+        suffix = f" ({baselined} baselined)" \
+            if baselined and not args.changed else ""
         if new:
-            print(f"lint: {len(new)} new finding(s){suffix}", file=out)
+            print(f"lint: {len(new)} new finding(s){suffix}{scope_note}",
+                  file=out)
         else:
-            print(f"lint: clean{suffix}", file=out)
+            print(f"lint: clean{suffix}{scope_note}", file=out)
     return 1 if new else 0
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="runbook-lint",
-        description="AST static analysis for JAX/TPU serving hazards "
-                    "(RBK001-RBK006; see docs/lint.md)")
+        description="whole-program AST static analysis for JAX/TPU serving "
+                    "hazards (RBK001-RBK010; see docs/lint.md)")
     add_lint_arguments(parser)
     return run_lint(parser.parse_args(argv))
 

@@ -56,6 +56,9 @@ class Finding:
     rule: str
     severity: str
     message: str
+    # Enclosing symbol ("Class.method", "func", "" at module level) — the
+    # line-move-tolerant anchor CI fingerprints key on.
+    symbol: str = ""
 
     @property
     def baseline_key(self) -> str:
@@ -71,7 +74,37 @@ class Finding:
     def to_json(self) -> dict:
         return {"path": self.path, "line": self.line, "col": self.col,
                 "rule": self.rule, "severity": self.severity,
-                "message": self.message}
+                "symbol": self.symbol, "message": self.message}
+
+
+def finding_fingerprints(findings: Sequence[Finding]) -> list[str]:
+    """Stable per-finding ids CI can diff across commits.
+
+    Hash of (rule, path, symbol, ordinal) — ordinal is the finding's rank
+    among same-keyed findings in line order, so moving a function around a
+    file (or adding unrelated lines above it) keeps the fingerprint, while
+    a SECOND violation of the same rule in the same symbol mints a new one.
+    Line and column deliberately excluded.
+    """
+    import hashlib
+
+    ordinals: dict[tuple[str, str, str], int] = {}
+    out: list[str] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        key = (f.rule, f.path, f.symbol)
+        n = ordinals.get(key, 0)
+        ordinals[key] = n + 1
+        out.append(hashlib.blake2b(
+            f"{f.rule}|{f.path}|{f.symbol}|{n}".encode(),
+            digest_size=8).hexdigest())
+    # Re-order to match the caller's finding order.
+    order = sorted(range(len(findings)),
+                   key=lambda i: (findings[i].path, findings[i].line,
+                                  findings[i].col, findings[i].rule))
+    by_input = [""] * len(findings)
+    for rank, idx in enumerate(order):
+        by_input[idx] = out[rank]
+    return by_input
 
 
 class Rule:
@@ -256,18 +289,43 @@ def _param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
     return [n for n in names if n not in ("self", "cls")]
 
 
-def _jit_table(tree: ast.Module) -> dict[ast.AST, _FuncInfo]:
+def iter_functions(tree: ast.Module) -> list[tuple[str, Optional[str], ast.AST]]:
+    """``(qualname, enclosing_class, node)`` for every function def, in
+    source order. Qualnames join enclosing class/function names with dots
+    (``Cls.meth``, ``outer.inner``) — the shared spelling the project
+    index, jit seeds, and finding fingerprints all key on."""
+    out: list[tuple[str, Optional[str], ast.AST]] = []
+
+    def _walk(node: ast.AST, stack: tuple[str, ...],
+              cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join((*stack, child.name))
+                out.append((qual, cls, child))
+                _walk(child, (*stack, child.name), cls)
+            elif isinstance(child, ast.ClassDef):
+                _walk(child, (*stack, child.name), child.name)
+            else:
+                _walk(child, stack, cls)
+
+    _walk(tree, (), None)
+    return out
+
+
+def _jit_table(tree: ast.Module,
+               seeds: Optional[dict[str, frozenset[str]]] = None,
+               ) -> dict[ast.AST, _FuncInfo]:
     """Every function def → jit info, with same-module closure propagation.
 
     "jit-reachable" is approximated statically as: directly jit-decorated,
-    or called by name from a jit-reachable function *in the same module*
-    (cross-module reachability would need imports + a project call graph;
-    the in-module closure already covers the helper-split idiom that loses
-    the decorator from view).
+    called by name from a jit-reachable function in the same module, or
+    seeded by the PROJECT pass (``seeds``: qualname → traced param names,
+    derived from cross-module call edges — the whole-program upgrade that
+    closed the documented "same module only" gap).
     """
     infos: dict[ast.AST, _FuncInfo] = {}
     by_name: dict[str, _FuncInfo] = {}
-    for node in ast.walk(tree):
+    for qual, _cls, node in iter_functions(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             statics = _jit_decorator_info(node)
             info = _FuncInfo(node=node, jit_decorated=statics is not None,
@@ -275,6 +333,10 @@ def _jit_table(tree: ast.Module) -> dict[ast.AST, _FuncInfo]:
                              jit_reachable=statics is not None)
             if info.jit_decorated:
                 info.traced_params = set(_param_names(node)) - set(statics)
+            seeded = (seeds or {}).get(qual)
+            if seeded is not None:
+                info.jit_reachable = True
+                info.traced_params |= set(seeded) - set(info.static_params)
             infos[node] = info
             # Last definition wins for duplicate names — matches runtime.
             by_name[node.name] = info
@@ -395,10 +457,12 @@ class _Walker(ast.NodeVisitor):
         self.scope = Scope()
         self.findings: list[Finding] = []
         self._func_stack: list[_FuncInfo] = []
+        self._qual: list[str] = []  # enclosing class/function name stack
 
     # ----------------------------------------------------------- plumbing
 
     def _emit(self, rule: Rule, results: Iterable[tuple[ast.AST, str]]) -> None:
+        symbol = ".".join(self._qual)
         for node, message in results:
             if self.ctx.suppressed(rule.rule_id, node):
                 continue
@@ -409,6 +473,7 @@ class _Walker(ast.NodeVisitor):
                 rule=rule.rule_id,
                 severity=rule.severity,
                 message=message,
+                symbol=symbol,
             ))
 
     def run(self) -> list[Finding]:
@@ -443,9 +508,11 @@ class _Walker(ast.NodeVisitor):
                            lock_depth=0,
                            class_name=prev.class_name, func_name=node.name)
         self._func_stack.append(info or _FuncInfo(node=node))
+        self._qual.append(node.name)
         try:
             self.generic_visit(node)
         finally:
+            self._qual.pop()
             self._func_stack.pop()
             self.scope = prev
 
@@ -457,9 +524,11 @@ class _Walker(ast.NodeVisitor):
         self.scope = Scope(in_jit=False, traced_params=frozenset(),
                            lock_depth=prev.lock_depth, class_name=node.name,
                            func_name=prev.func_name)
+        self._qual.append(node.name)
         try:
             self.generic_visit(node)
         finally:
+            self._qual.pop()
             self.scope = prev
 
     def _visit_with(self, node) -> None:
@@ -549,9 +618,37 @@ def _rel_path(path: Path, root: Optional[Path]) -> str:
         return path.as_posix()
 
 
+def _module_name_on_disk(path: Path) -> str:
+    """Dotted module name derived from the file's PACKAGE ROOT on disk:
+    walk parents up while ``__init__.py`` marks them as package dirs.
+
+    Import resolution in the project index must not depend on how the
+    display path was anchored — `runbook lint /abs/checkout/runbookai_tpu`
+    and an in-repo run link the same `runbookai_tpu.engine.fleet` names,
+    so cross-module rules never silently degrade to per-file analysis
+    because of the invocation cwd.
+    """
+    p = path.resolve()
+    top = p.parent
+    while (top / "__init__.py").is_file() and top.parent != top:
+        top = top.parent
+    parts = list(p.relative_to(top).parts)
+    parts[-1] = parts[-1][:-3] if parts[-1].endswith(".py") else parts[-1]
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
 def analyze_source(source: str, path: str,
-                   rules: Optional[Sequence[Rule]] = None) -> list[Finding]:
-    """Analyze one module's source under a display path (noqa applied)."""
+                   rules: Optional[Sequence[Rule]] = None,
+                   jit_seeds: Optional[dict[str, frozenset[str]]] = None,
+                   ) -> list[Finding]:
+    """Analyze one module's source under a display path (noqa applied).
+
+    ``jit_seeds`` (qualname → traced param names) marks functions
+    jit-reachable from OTHER modules — produced by the project pass; the
+    in-module closure then continues from the seeded state.
+    """
     if rules is None:
         # Fresh instances per call: RBK004 aggregates per-walk state, and a
         # shared module-level set would cross-attribute findings if callers
@@ -566,7 +663,7 @@ def analyze_source(source: str, path: str,
                         message=f"un-parseable module: {e.msg}")]
     ctx = ModuleContext(path=path, source=source, tree=tree,
                         tags=_path_tags(path), noqa=_noqa_lines(source),
-                        jit_info=_jit_table(tree))
+                        jit_info=_jit_table(tree, seeds=jit_seeds))
     return _Walker(ctx, list(rules)).run()
 
 
@@ -579,9 +676,39 @@ def analyze_file(path: str | Path, rules: Optional[Sequence[Rule]] = None,
 
 def analyze_paths(paths: Iterable[str | Path],
                   rules: Optional[Sequence[Rule]] = None,
-                  root: Optional[Path] = None) -> list[Finding]:
+                  root: Optional[Path] = None,
+                  project: bool = True) -> list[Finding]:
+    """Two-phase analysis over a file set.
+
+    Phase 1 (index): every file is parsed once into the whole-program
+    symbol table / call graph (``analysis/project.py``) — this yields the
+    cross-module rules RBK007–RBK010 and the jit-reachability seeds that
+    upgrade RBK001 past the module boundary. Phase 2 runs the per-file
+    rules with those seeds applied. ``project=False`` reverts to the
+    first-order per-file pass (used by targeted unit tests only — the CLI
+    always runs both phases).
+
+    Output is deterministic for a given file SET regardless of input
+    order: files are discovered sorted and findings sort on
+    (path, line, col, rule).
+    """
+    files = iter_python_files(paths)
+    entries = [(f, _rel_path(f, root), f.read_text(encoding="utf-8"))
+               for f in files]
     findings: list[Finding] = []
-    for f in iter_python_files(paths):
-        findings.extend(analyze_file(f, rules=rules, root=root))
+    seeds_by_path: dict[str, dict[str, frozenset[str]]] = {}
+    if project and entries:
+        from runbookai_tpu.analysis.project import build_index
+        from runbookai_tpu.analysis.xrules import run_cross_rules
+        # Module names come from each file's on-disk package root, NOT
+        # the display path — imports must resolve however the run was
+        # anchored (absolute paths, foreign cwd, --no-baseline).
+        index = build_index([(rel, text, _module_name_on_disk(f))
+                             for f, rel, text in entries])
+        seeds_by_path = index.jit_seeds()
+        findings.extend(run_cross_rules(index))
+    for _f, rel, text in entries:
+        findings.extend(analyze_source(text, rel, rules=rules,
+                                       jit_seeds=seeds_by_path.get(rel)))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -1,0 +1,942 @@
+"""Whole-program index: symbols, imports, call graph, per-function facts.
+
+PR 2's analyzer was one visitor pass per file, and its rule set inherited
+that horizon: RBK001 stopped at the module boundary and the lock rules saw
+only lexical ``with`` scopes. The platform the repo grew into (engine step
+thread, HTTP handler threads, router pull workers through
+``AsyncEngine.run_locked``, feedback controller, workload monitor) fails in
+*cross-module* ways — lock-order cycles, thread-shared state mutated from
+different entry points, unbounded metric-label cardinality. This module is
+the second phase that makes those failure classes statically visible:
+
+- parse every file once (stdlib ``ast`` only — the analyzer stays
+  dependency-free and jax-free);
+- build a project symbol table (modules → classes/functions, with a light
+  attribute-type inference: ``self.core = EngineCore(...)`` and annotated
+  params give method receivers types, so ``self.core.step()`` resolves);
+- resolve in-package imports (absolute and relative) into a deterministic
+  call graph;
+- classify **thread entry roles** (async defs = the event loop,
+  ``threading.Thread``/``asyncio.to_thread``/executor targets = worker
+  threads, ``do_*`` methods of ``*RequestHandler`` classes = HTTP handler
+  threads) and propagate them through the call graph;
+- run a guaranteed-held-locks dataflow (intersection over role-bearing
+  call paths), so a write in ``EngineCore.submit`` *knows* the caller holds
+  ``AsyncEngine._lock`` even though no ``with`` is lexically in sight;
+- compute transitive lock-acquisition sets for lock-order analysis;
+- propagate jit-reachability and traced params across modules, producing
+  the seeds ``core._jit_table`` consumes (the RBK001 upgrade that closes
+  docs/lint.md's documented "same module only" gap).
+
+Everything is deterministic: files are processed in sorted path order, all
+derived sets are emitted sorted, and no state survives between builds —
+``tests/test_lint.py`` shuffles input order and pins byte-identical JSON.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from runbookai_tpu.analysis.core import (
+    _LOCK_SEG_RE,
+    ModuleContext,
+    _jit_decorator_info,
+    _is_lock_ctx,
+    _noqa_lines,
+    _param_names,
+    _path_tags,
+    dotted_name,
+    iter_functions,
+    mentions_traced,
+)
+
+# Thread-handoff primitives: calling one of these hands a callable to a
+# DIFFERENT thread. The first positional arg (or ``target=`` keyword) is a
+# role root; calling one while holding a lock is an RBK007 hazard.
+_HANDOFF_CALLS = frozenset({
+    "asyncio.to_thread", "to_thread", "threading.Thread", "Thread",
+})
+_HANDOFF_METHODS = frozenset({"submit", "run_in_executor"})
+
+# HTTP-handler detection: do_* methods of classes whose base names end in
+# RequestHandler run on per-connection server threads.
+_HTTP_METHODS = frozenset({"do_GET", "do_POST", "do_PUT", "do_DELETE",
+                           "do_PATCH", "do_HEAD"})
+
+ROLE_EVENT_LOOP = "event-loop"
+ROLE_HTTP = "http-handler"
+
+
+def module_name_for(path: str) -> str:
+    """``a/b/c.py`` → ``a.b.c``; ``a/b/__init__.py`` → ``a.b``."""
+    parts = path[:-3].split("/") if path.endswith(".py") else path.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+# --------------------------------------------------------------------------- #
+# data model                                                                  #
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class CallSite:
+    callee: Optional[str]          # fully-qualified function id, or None
+    node: ast.AST
+    held: tuple[str, ...]          # lock ids lexically held at the call
+    same_instance: bool            # self.m() / local nested call — receiver
+    # is the same object as the caller's `self`
+
+
+@dataclass
+class LockAcq:
+    lock: str                      # lock id
+    node: ast.AST
+    held: tuple[str, ...]          # lock ids already held (lexically)
+    self_rooted: bool              # context expr starts at `self.`
+
+
+@dataclass
+class AttrWrite:
+    owner: str                     # fully-qualified class id
+    attr: str
+    node: ast.AST
+    held: tuple[str, ...]          # lexical locks at the write
+    ctor: bool                     # written in __init__-family method
+
+
+@dataclass
+class LabelSite:
+    node: ast.Call                 # the `.labels(...)` call
+    values: list[tuple[str, ast.AST]]  # (label display name, value expr)
+
+
+@dataclass
+class FuncNode:
+    fq: str                        # "<module>.<qual>"
+    qual: str                      # module-local qualname ("Cls.meth")
+    module: "ModuleInfo"
+    cls: Optional[str]             # enclosing class LOCAL name
+    node: ast.AST
+    is_async: bool
+    calls: list[CallSite] = field(default_factory=list)
+    lock_acqs: list[LockAcq] = field(default_factory=list)
+    awaits_under_lock: list[tuple[ast.AST, str]] = field(default_factory=list)
+    handoffs_under_lock: list[tuple[ast.AST, str, str]] = field(
+        default_factory=list)      # (node, primitive name, held lock id)
+    blocking: list[tuple[ast.AST, str, tuple[str, ...], bool]] = field(
+        default_factory=list)      # (node, what, held, in_async_body)
+    attr_writes: list[AttrWrite] = field(default_factory=list)
+    label_sites: list[LabelSite] = field(default_factory=list)
+    local_types: dict[str, str] = field(default_factory=dict)
+    local_assigns: dict[str, list[ast.AST]] = field(default_factory=dict)
+    for_targets: dict[str, tuple[ast.AST, int]] = field(default_factory=dict)
+    # name -> (iterable expr, index in tuple target or -1)
+    nested: dict[str, str] = field(default_factory=dict)  # local def name → fq
+    # computed in link phase:
+    roles: set[str] = field(default_factory=set)
+    entry_locks: Optional[frozenset[str]] = None   # None = no tracked caller
+    acquires: set[str] = field(default_factory=set)
+
+    @property
+    def params(self) -> list[str]:
+        return _param_names(self.node)
+
+
+@dataclass
+class ClassInfo:
+    fq: str
+    local: str
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    bases: list[str] = field(default_factory=list)   # unresolved dotted names
+    methods: dict[str, FuncNode] = field(default_factory=dict)
+    attr_types: dict[str, str] = field(default_factory=dict)  # attr → class fq
+    consts: dict[str, ast.AST] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    path: str
+    source: str
+    tree: ast.Module
+    tags: frozenset[str]
+    is_package: bool = False  # an __init__.py (module name == package name)
+    imports: dict[str, str] = field(default_factory=dict)  # local → fq target
+    classes: dict[str, ClassInfo] = field(default_factory=dict)
+    funcs: dict[str, FuncNode] = field(default_factory=dict)  # top-level only
+    all_funcs: dict[str, FuncNode] = field(default_factory=dict)  # qual → node
+    consts: dict[str, ast.AST] = field(default_factory=dict)
+    ctx: Optional[ModuleContext] = None   # for noqa suppression
+
+    def make_ctx(self) -> ModuleContext:
+        if self.ctx is None:
+            self.ctx = ModuleContext(
+                path=self.path, source=self.source, tree=self.tree,
+                tags=self.tags, noqa=_noqa_lines(self.source), jit_info={})
+        return self.ctx
+
+
+class ProjectIndex:
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}
+        self.funcs: dict[str, FuncNode] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.role_roots: list[tuple[str, str]] = []   # (func fq, role)
+        self.parse_failures: list[str] = []           # paths that won't parse
+
+    # ------------------------------------------------------------ resolution
+
+    def resolve(self, module: ModuleInfo, name: str) -> Optional[str]:
+        """A bare name in ``module`` → fully-qualified symbol/module id."""
+        if name in module.funcs:
+            return module.funcs[name].fq
+        if name in module.classes:
+            return module.classes[name].fq
+        return module.imports.get(name)
+
+    def class_of(self, fq: Optional[str]) -> Optional[ClassInfo]:
+        return self.classes.get(fq) if fq else None
+
+    def method(self, cls_fq: str, name: str,
+               _seen: Optional[set[str]] = None) -> Optional[FuncNode]:
+        """Resolve a method through statically-known project bases (MRO-ish,
+        left-to-right depth-first)."""
+        seen = _seen if _seen is not None else set()
+        if cls_fq in seen:
+            return None
+        seen.add(cls_fq)
+        cls = self.classes.get(cls_fq)
+        if cls is None:
+            return None
+        if name in cls.methods:
+            return cls.methods[name]
+        for base in cls.bases:
+            resolved = self.resolve(cls.module, base.split(".")[0])
+            if resolved is None:
+                continue
+            base_fq = resolved + base[len(base.split(".")[0]):] \
+                if "." in base else resolved
+            hit = self.method(base_fq, name, seen)
+            if hit is not None:
+                return hit
+        return None
+
+    def attr_type(self, cls_fq: str, attr: str) -> Optional[str]:
+        cls = self.classes.get(cls_fq)
+        while cls is not None:
+            if attr in cls.attr_types:
+                return cls.attr_types[attr]
+            nxt = None
+            for base in cls.bases:
+                resolved = self.resolve(cls.module, base.split(".")[0])
+                if resolved in self.classes:
+                    nxt = self.classes[resolved]
+                    break
+            cls = nxt
+        return None
+
+    # -------------------------------------------------------------- jit seeds
+
+    def jit_seeds(self) -> dict[str, dict[str, frozenset[str]]]:
+        """path → {module-local qualname → traced param names} for functions
+        made jit-reachable by CROSS-module edges.
+
+        Fixed point over the project call graph, mirroring the in-module
+        closure in ``core._jit_table``: a function becomes jit-reachable
+        when a jit-reachable caller anywhere in the project calls it, and a
+        param becomes traced only when some such call site feeds it an
+        expression that mentions a traced value.
+        """
+        reachable: dict[str, set[str]] = {}   # func fq → traced params
+        statics: dict[str, frozenset[str]] = {}
+        for fq in sorted(self.funcs):
+            fn = self.funcs[fq]
+            info = _jit_decorator_info(fn.node) if isinstance(
+                fn.node, (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+            statics[fq] = info if info is not None else frozenset()
+            if info is not None:
+                reachable[fq] = set(_param_names(fn.node)) - set(info)
+
+        def _positional(fn: FuncNode) -> list[str]:
+            a = fn.node.args
+            return [p.arg for p in (*a.posonlyargs, *a.args)
+                    if p.arg not in ("self", "cls")]
+
+        changed = True
+        while changed:
+            changed = False
+            for fq in sorted(reachable):
+                fn = self.funcs.get(fq)
+                if fn is None:
+                    continue
+                traced = frozenset(reachable[fq])
+                for call in fn.calls:
+                    callee = self.funcs.get(call.callee or "")
+                    if callee is None or callee.fq == fq:
+                        continue
+                    if not isinstance(call.node, ast.Call):
+                        continue
+                    params = _positional(callee)
+                    hits: set[str] = set()
+                    for idx, arg in enumerate(call.node.args):
+                        if idx < len(params) and mentions_traced(arg, traced):
+                            hits.add(params[idx])
+                    for kw in call.node.keywords:
+                        if kw.arg and mentions_traced(kw.value, traced):
+                            hits.add(kw.arg)
+                    hits -= set(statics.get(callee.fq, frozenset()))
+                    cur = reachable.get(callee.fq)
+                    if cur is None:
+                        reachable[callee.fq] = set(hits)
+                        changed = True
+                    elif not hits <= cur:
+                        cur |= hits
+                        changed = True
+        out: dict[str, dict[str, frozenset[str]]] = {}
+        for fq in sorted(reachable):
+            fn = self.funcs.get(fq)
+            if fn is None or _jit_decorator_info(fn.node) is not None:
+                continue  # directly decorated — the per-file table has it
+            out.setdefault(fn.module.path, {})[fn.qual] = frozenset(
+                reachable[fq])
+        return out
+
+
+# --------------------------------------------------------------------------- #
+# phase 1: per-module scan                                                    #
+# --------------------------------------------------------------------------- #
+
+
+def _const_collection(node: ast.AST) -> bool:
+    """A literal collection of constants (the "statically bounded set"
+    RBK010 accepts: fixed tuple/list/set/frozenset/dict-of-constant-keys,
+    possibly wrapped in frozenset()/tuple()/set()/list()/sorted())."""
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return all(isinstance(e, ast.Constant) for e in node.elts)
+    if isinstance(node, ast.Dict):
+        return all(isinstance(k, ast.Constant) for k in node.keys if k)
+    if isinstance(node, ast.Call) and not node.keywords:
+        name = dotted_name(node.func)
+        if name in ("frozenset", "tuple", "set", "list", "sorted") \
+                and len(node.args) == 1:
+            return _const_collection(node.args[0])
+    return False
+
+
+class _ModuleScanner(ast.NodeVisitor):
+    """Collect a module's symbols, imports and constants (pass 1a)."""
+
+    def __init__(self, info: ModuleInfo):
+        self.info = info
+
+    def scan(self) -> None:
+        mod = self.info
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    if alias.asname:
+                        mod.imports[alias.asname] = alias.name
+                    else:
+                        head = alias.name.split(".")[0]
+                        mod.imports[head] = head
+            elif isinstance(stmt, ast.ImportFrom):
+                base = stmt.module or ""
+                if stmt.level:  # relative import → anchor at this package
+                    pkg = mod.name.split(".")
+                    # A package __init__ IS its package: `from .b import x`
+                    # there drops level-1 components, a plain module drops
+                    # `level` (its own name first).
+                    drop = stmt.level - 1 if mod.is_package else stmt.level
+                    pkg = pkg[: len(pkg) - drop] if drop else pkg
+                    base = ".".join(pkg + ([stmt.module] if stmt.module else []))
+                for alias in stmt.names:
+                    if alias.name == "*":
+                        continue
+                    mod.imports[alias.asname or alias.name] = \
+                        f"{base}.{alias.name}" if base else alias.name
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                mod.consts[stmt.targets[0].id] = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                    and isinstance(stmt.target, ast.Name):
+                mod.consts[stmt.target.id] = stmt.value
+
+
+def _annotation_class(annotation: Optional[ast.AST]) -> Optional[str]:
+    """Dotted class name out of a (possibly string/Optional-wrapped)
+    annotation, or None."""
+    if annotation is None:
+        return None
+    if isinstance(annotation, ast.Constant) and isinstance(annotation.value, str):
+        try:
+            annotation = ast.parse(annotation.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(annotation, ast.Subscript):
+        name = dotted_name(annotation.value)
+        if name in ("Optional", "typing.Optional"):
+            return _annotation_class(annotation.slice)
+        return None
+    return dotted_name(annotation)
+
+
+class _FuncScanner:
+    """Collect one function's facts: calls, locks, writes, label sites.
+
+    Recursive statement walk that carries the lexical lock stack; nested
+    ``def``s get their OWN FuncNode (their bodies run later, with no lock
+    held), matching the per-file walker's scoping rules.
+    """
+
+    def __init__(self, index: ProjectIndex, fn: FuncNode):
+        self.index = index
+        self.fn = fn
+        self.held: list[str] = []
+        self.sync_held: list[str] = []  # subset of `held` from sync `with`
+
+    # ------------------------------------------------------ type inference
+
+    def _expr_type(self, expr: ast.AST) -> Optional[str]:
+        """Best-effort class id of an expression's value."""
+        if isinstance(expr, ast.Call):
+            target = self._callable_target(expr.func)
+            if target in self.index.classes:
+                return target
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and self.fn.cls is not None:
+                return f"{self.fn.module.name}.{self.fn.cls}"
+            return self.fn.local_types.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self._expr_type(expr.value)
+            if base is not None:
+                return self.index.attr_type(base, expr.attr)
+            return None
+        return None
+
+    def _callable_target(self, func: ast.AST) -> Optional[str]:
+        """Resolve a call's target expression to a function/class fq id."""
+        if isinstance(func, ast.Name):
+            if func.id in self.fn.nested:
+                return self.fn.nested[func.id]
+            return self.index.resolve(self.fn.module, func.id)
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            # self.m(...) / typed_receiver.m(...)
+            base_type = self._expr_type(base)
+            if base_type is not None:
+                hit = self.index.method(base_type, func.attr)
+                if hit is not None:
+                    return hit.fq
+                return None
+            # module_alias.f(...) or pkg.mod.f(...)
+            dotted = dotted_name(func)
+            if dotted is None:
+                return None
+            head, _, rest = dotted.partition(".")
+            target = self.index.resolve(self.fn.module, head)
+            if target is None:
+                return None
+            full = f"{target}.{rest}" if rest else target
+            if full in self.index.funcs or full in self.index.classes:
+                return full
+            return None
+        return None
+
+    # ------------------------------------------------------------- walking
+
+    def scan(self, body: list[ast.stmt]) -> None:
+        # Pre-pass: param annotation types.
+        args = self.fn.node.args
+        for a in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+            cls = _annotation_class(a.annotation)
+            if cls:
+                resolved = self.index.resolve(self.fn.module,
+                                              cls.split(".")[0])
+                if resolved:
+                    tail = cls[len(cls.split(".")[0]):]
+                    full = resolved + tail
+                    if full in self.index.classes:
+                        self.fn.local_types[a.arg] = full
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs scanned as their own FuncNode
+        if isinstance(stmt, ast.ClassDef):
+            return  # nested classes scanned separately
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            # `async with lock:` acquisitions are asyncio locks — holding
+            # one across an await is their normal operation, so they join
+            # the order/handoff analysis but not the sync-held set that
+            # feeds the await-under-lock check.
+            is_sync = isinstance(stmt, ast.With)
+            acquired: list[str] = []
+            for item in stmt.items:
+                self._exprs_in(item.context_expr)
+                if _is_lock_ctx(item):
+                    lock = self._lock_id(item.context_expr)
+                    if lock is not None:
+                        self.fn.lock_acqs.append(LockAcq(
+                            lock=lock, node=stmt,
+                            held=tuple(self.held),
+                            self_rooted=self._is_self_rooted(
+                                item.context_expr)))
+                        acquired.append(lock)
+            self.held.extend(acquired)
+            if is_sync:
+                self.sync_held.extend(acquired)
+            try:
+                for s in stmt.body:
+                    self._stmt(s)
+            finally:
+                for _ in acquired:
+                    self.held.pop()
+                    if is_sync:
+                        self.sync_held.pop()
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            value = stmt.value
+            if value is not None:
+                self._exprs_in(value)
+            for target in targets:
+                self._record_write(stmt, target, value)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            # Loop targets feed RBK010 boundedness (`for reason in REASONS:`).
+            self._comp_target(stmt.target, stmt.iter)
+        # Generic: visit child statements, collect expressions. Except
+        # handlers and match cases are NOT ast.stmt — their bodies must
+        # still be walked as statements or `with lock:` inside an except
+        # block silently loses lock tracking.
+        for _name, val in ast.iter_fields(stmt):
+            vals = val if isinstance(val, list) else [val]
+            for v in vals:
+                if isinstance(v, ast.stmt):
+                    self._stmt(v)
+                elif isinstance(v, ast.ExceptHandler):
+                    if v.type is not None:
+                        self._exprs_in(v.type)
+                    for s in v.body:
+                        self._stmt(s)
+                elif isinstance(v, getattr(ast, "match_case", ())):
+                    for s in v.body:
+                        self._stmt(s)
+                elif isinstance(v, ast.AST):
+                    self._exprs_in(v)
+
+    def _record_write(self, stmt: ast.stmt, target: ast.AST,
+                      value: Optional[ast.AST]) -> None:
+        if isinstance(target, ast.Tuple):
+            for el in target.elts:
+                self._record_write(stmt, el, None)
+            return
+        if isinstance(target, ast.Name):
+            if value is not None:
+                self.fn.local_assigns.setdefault(target.id, []).append(value)
+                t = self._expr_type(value)
+                if t is not None:
+                    self.fn.local_types.setdefault(target.id, t)
+            return
+        if not isinstance(target, ast.Attribute):
+            return
+        self._exprs_in(target.value)
+        owner = self._expr_type(target.value)
+        if owner is None:
+            return
+        is_ctor = self.fn.qual.split(".")[-1] in (
+            "__init__", "__new__", "__post_init__", "__init_subclass__") \
+            and isinstance(target.value, ast.Name) \
+            and target.value.id == "self"
+        self.fn.attr_writes.append(AttrWrite(
+            owner=owner, attr=target.attr, node=stmt,
+            held=tuple(self.held), ctor=is_ctor))
+        # Attribute-type inference for `self.x = <typed expr>` in ctors.
+        if value is not None and isinstance(target.value, ast.Name) \
+                and target.value.id == "self" and self.fn.cls is not None:
+            t = self._expr_type(value)
+            cls = self.index.classes.get(
+                f"{self.fn.module.name}.{self.fn.cls}")
+            if t is not None and cls is not None:
+                cls.attr_types.setdefault(target.attr, t)
+
+    def _exprs_in(self, node: ast.AST) -> None:
+        # Manual walk so lambda bodies can be PRUNED: a lambda runs later
+        # (often on another thread — `to_thread(lambda: ...)` is RBK009's
+        # own recommended remediation), so calls inside one must not be
+        # attributed to the enclosing function's lock/async context.
+        stack = [node]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, ast.Lambda):
+                continue
+            stack.extend(reversed(list(ast.iter_child_nodes(sub))))
+            if isinstance(sub, ast.Call):
+                self._call(sub)
+            elif isinstance(sub, ast.Await):
+                if self.sync_held and self.fn.is_async:
+                    self.fn.awaits_under_lock.append(
+                        (sub, self.sync_held[-1]))
+            elif isinstance(sub, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                  ast.GeneratorExp)):
+                for gen in sub.generators:
+                    self._comp_target(gen.target, gen.iter)
+
+    def _comp_target(self, target: ast.AST, iterable: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.fn.for_targets[target.id] = (iterable, -1)
+        elif isinstance(target, ast.Tuple):
+            for i, el in enumerate(target.elts):
+                if isinstance(el, ast.Name):
+                    self.fn.for_targets[el.id] = (iterable, i)
+
+    def _call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func)
+        # labels(...) sites → RBK010.
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "labels":
+            values: list[tuple[str, ast.AST]] = []
+            for i, arg in enumerate(node.args):
+                values.append((f"#{i}", arg))
+            for kw in node.keywords:
+                values.append((kw.arg or "**", kw.value))
+            self.fn.label_sites.append(LabelSite(node=node, values=values))
+        # Thread handoffs: role roots + RBK007 under-lock hazard.
+        handoff = None
+        target_expr: Optional[ast.AST] = None
+        if name in _HANDOFF_CALLS:
+            handoff = name
+            target_expr = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target_expr = kw.value
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _HANDOFF_METHODS:
+            # `.submit` only counts on executor-ish receivers — the engine
+            # has its own `submit(request)` that never changes threads.
+            recv = (dotted_name(node.func.value) or "").lower()
+            if node.func.attr == "run_in_executor" \
+                    or any(seg in recv for seg in ("executor", "pool", "tpe")):
+                handoff = node.func.attr
+                idx = 1 if node.func.attr == "run_in_executor" else 0
+                if len(node.args) > idx:
+                    target_expr = node.args[idx]
+        if handoff is not None:
+            if target_expr is not None:
+                target = self._func_ref(target_expr)
+                if target is not None:
+                    role = f"worker:{self.index.funcs[target].qual}" \
+                        if handoff in ("to_thread", "asyncio.to_thread",
+                                       "submit", "run_in_executor") \
+                        else f"thread:{self.index.funcs[target].qual}"
+                    self.index.role_roots.append((target, role))
+            if self.held:
+                self.fn.handoffs_under_lock.append(
+                    (node, handoff, self.held[-1]))
+        # run_locked is the engine's own handoff seam.
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "run_locked" and self.held:
+            self.fn.handoffs_under_lock.append(
+                (node, "run_locked", self.held[-1]))
+        # Blocking calls (for RBK009 and xrule context).
+        blocking = self._blocking_kind(node)
+        if blocking is not None:
+            self.fn.blocking.append(
+                (node, blocking, tuple(self.held), self.fn.is_async))
+        # Call-graph edge.
+        target = self._callable_target(node.func)
+        if target in self.index.classes:
+            ctor = self.index.method(target, "__init__")
+            target = ctor.fq if ctor is not None else None
+        same_instance = False
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in self.fn.nested:
+            same_instance = True
+        elif isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            same_instance = True
+        self.fn.calls.append(CallSite(
+            callee=target if target in self.index.funcs else None,
+            node=node, held=tuple(self.held), same_instance=same_instance))
+
+    _BLOCK_EXACT = frozenset({"time.sleep", "os.system", "os.popen",
+                              "sleep"})
+    _BLOCK_PREFIXES = ("subprocess.", "socket.", "requests.", "urllib.",
+                       "http.client.", "shutil.")
+    _BLOCK_METHODS = frozenset({"read_text", "write_text", "read_bytes",
+                                "write_bytes"})
+
+    def _blocking_kind(self, node: ast.Call) -> Optional[str]:
+        name = dotted_name(node.func)
+        if name in self._BLOCK_EXACT:
+            return name
+        if name and name.startswith(self._BLOCK_PREFIXES):
+            return name
+        if isinstance(node.func, ast.Name) and node.func.id == "open":
+            return "open"
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in self._BLOCK_METHODS:
+                return f".{node.func.attr}"
+            if node.func.attr == "acquire":
+                recv = dotted_name(node.func.value)
+                if recv is not None and _is_lock_name(recv) \
+                        and not any(kw.arg == "timeout" for kw in node.keywords) \
+                        and not node.args:
+                    return f"{recv}.acquire"
+        return None
+
+    def _func_ref(self, expr: ast.AST) -> Optional[str]:
+        """Resolve a function REFERENCE (not call) to a project function."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.fn.nested:
+                return self.fn.nested[expr.id]
+            target = self.index.resolve(self.fn.module, expr.id)
+            return target if target in self.index.funcs else None
+        if isinstance(expr, ast.Attribute):
+            base_type = self._expr_type(expr.value)
+            if base_type is not None:
+                hit = self.index.method(base_type, expr.attr)
+                return hit.fq if hit is not None else None
+        return None
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        if isinstance(expr, ast.Attribute):
+            owner = self._expr_type(expr.value)
+            if owner is not None:
+                return f"{owner}.{expr.attr}"
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        return f"{self.fn.module.name}:{name}"
+
+    @staticmethod
+    def _is_self_rooted(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        while isinstance(expr, ast.Attribute):
+            expr = expr.value
+        return isinstance(expr, ast.Name) and expr.id == "self"
+
+
+def _is_lock_name(dotted: str) -> bool:
+    return any(_LOCK_SEG_RE.search(seg) for seg in dotted.lower().split("."))
+
+
+def _module_pseudo_def(tree: ast.Module) -> ast.FunctionDef:
+    """Wrap a module's top-level statements in a synthetic zero-arg def so
+    the function scanner can walk them. Nested real defs/classes are
+    skipped by the scanner as usual (they have their own FuncNodes)."""
+    fn = ast.FunctionDef(
+        name="<module>",
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=list(tree.body) or [ast.Pass()],
+        decorator_list=[], returns=None, type_comment=None)
+    fn.lineno, fn.col_offset = 1, 0
+    fn.end_lineno, fn.end_col_offset = 1, 0
+    return fn
+
+
+# --------------------------------------------------------------------------- #
+# build + link                                                                #
+# --------------------------------------------------------------------------- #
+
+
+def build_index(files: Iterable[tuple]) -> ProjectIndex:
+    """``(display_path, source)`` or ``(display_path, source, module_name)``
+    entries → linked :class:`ProjectIndex`.
+
+    The optional explicit ``module_name`` decouples import resolution from
+    the DISPLAY path (which stays whatever the baseline/output anchor
+    produced): ``analyze_paths`` derives it from the file's on-disk
+    package root, so `runbook lint /abs/checkout/runbookai_tpu` links the
+    same call graph as an in-repo run. Files that fail to parse are
+    recorded in ``parse_failures`` and skipped (the per-file phase reports
+    them as RBK000).
+    """
+    index = ProjectIndex()
+    for entry in sorted(files):
+        path, source = entry[0], entry[1]
+        name = entry[2] if len(entry) > 2 and entry[2] else \
+            module_name_for(path)
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            index.parse_failures.append(path)
+            continue
+        mod = ModuleInfo(name=name, path=path,
+                         source=source, tree=tree, tags=_path_tags(path),
+                         is_package=path.endswith("__init__.py"))
+        if mod.name in index.modules:
+            continue  # duplicate module name (shadowed path) — first wins
+        index.modules[mod.name] = mod
+
+    # pass 1a: symbols, imports, constants, class skeletons.
+    for name in sorted(index.modules):
+        mod = index.modules[name]
+        _ModuleScanner(mod).scan()
+        for qual, cls_local, node in iter_functions(mod.tree):
+            fn = FuncNode(fq=f"{mod.name}.{qual}", qual=qual, module=mod,
+                          cls=cls_local,
+                          node=node,
+                          is_async=isinstance(node, ast.AsyncFunctionDef))
+            mod.all_funcs[qual] = fn
+            index.funcs[fn.fq] = fn
+            if "." not in qual:
+                mod.funcs[qual] = fn
+        # Module-level code gets a pseudo-function so import-time facts
+        # (a top-level `labels(...)` registration, a module-scope `with
+        # lock:`) are scanned like everything else — an unbounded label
+        # at import time must not land silently.
+        pseudo = FuncNode(fq=f"{mod.name}.<module>", qual="<module>",
+                          module=mod, cls=None,
+                          node=_module_pseudo_def(mod.tree), is_async=False)
+        mod.all_funcs["<module>"] = pseudo
+        index.funcs[pseudo.fq] = pseudo
+        for stmt in ast.walk(mod.tree):
+            if not isinstance(stmt, ast.ClassDef):
+                continue
+            # Only top-level and one-deep nested classes get ids; nested
+            # classes key on their bare name (collisions: first wins).
+            ci = ClassInfo(fq=f"{mod.name}.{stmt.name}", local=stmt.name,
+                           module=mod, node=stmt,
+                           bases=[b for b in
+                                  (dotted_name(x) for x in stmt.bases) if b])
+            index.classes.setdefault(ci.fq, ci)
+            mod.classes.setdefault(stmt.name, ci)
+            for item in stmt.body:
+                if isinstance(item, ast.Assign) and len(item.targets) == 1 \
+                        and isinstance(item.targets[0], ast.Name):
+                    ci.consts[item.targets[0].id] = item.value
+        # Attach methods to classes (one level of nesting).
+        for qual, fn in mod.all_funcs.items():
+            parts = qual.split(".")
+            if len(parts) >= 2 and parts[-2] in mod.classes \
+                    and fn.cls == parts[-2]:
+                mod.classes[parts[-2]].methods.setdefault(parts[-1], fn)
+
+    # pass 1b: nested-def visibility (local name → fq), then body scans.
+    for name in sorted(index.modules):
+        mod = index.modules[name]
+        for qual, fn in mod.all_funcs.items():
+            for other_qual in mod.all_funcs:
+                if other_qual.startswith(qual + ".") \
+                        and "." not in other_qual[len(qual) + 1:]:
+                    fn.nested[other_qual.rsplit(".", 1)[-1]] = \
+                        f"{mod.name}.{other_qual}"
+    # Two scan rounds: round 1 populates ctor attr types (self.core =
+    # EngineCore(...)) on the ClassInfos, round 2 re-scans with receiver
+    # types visible so `self.core.step()` resolves to EngineCore.step.
+    # Per-function facts are reset between rounds; attr_types persist.
+    for _round in (1, 2):
+        index.role_roots = []
+        for name in sorted(index.modules):
+            mod = index.modules[name]
+            for qual in sorted(mod.all_funcs):
+                fn = mod.all_funcs[qual]
+                fn.calls, fn.lock_acqs = [], []
+                fn.awaits_under_lock, fn.handoffs_under_lock = [], []
+                fn.blocking, fn.attr_writes, fn.label_sites = [], [], []
+                fn.local_types, fn.local_assigns, fn.for_targets = {}, {}, {}
+                _FuncScanner(index, fn).scan(fn.node.body)
+
+    # HTTP-handler and event-loop role roots.
+    for name in sorted(index.modules):
+        mod = index.modules[name]
+        for qual in sorted(mod.all_funcs):
+            fn = mod.all_funcs[qual]
+            if fn.is_async:
+                index.role_roots.append((fn.fq, ROLE_EVENT_LOOP))
+            leaf = qual.split(".")[-1]
+            if leaf in _HTTP_METHODS and fn.cls is not None:
+                cls = mod.classes.get(fn.cls)
+                if cls is not None and any(
+                        b.split(".")[-1].endswith("RequestHandler")
+                        for b in cls.bases):
+                    index.role_roots.append((fn.fq, ROLE_HTTP))
+
+    _link(index)
+    return index
+
+
+def _link(index: ProjectIndex) -> None:
+    """Role propagation, guaranteed-held-locks dataflow, transitive
+    acquisition sets — the fixed points the cross rules read."""
+    # Roles: BFS from roots along call edges. Worker-thread targets do NOT
+    # inherit the spawner's role (they run on their own thread).
+    worklist: list[str] = []
+    for fq, role in sorted(set(index.role_roots)):
+        fn = index.funcs.get(fq)
+        if fn is not None and role not in fn.roles:
+            fn.roles.add(role)
+            worklist.append(fq)
+    while worklist:
+        fq = worklist.pop()
+        fn = index.funcs[fq]
+        for call in fn.calls:
+            callee = index.funcs.get(call.callee or "")
+            if callee is None or callee.is_async:
+                # Async callees always run on the event loop regardless of
+                # the caller's thread (they are event-loop roots already).
+                continue
+            if not fn.roles <= callee.roles:
+                callee.roles |= fn.roles
+                worklist.append(callee.fq)
+
+    # Guaranteed-held locks: intersection over role-bearing call paths.
+    # entry_locks(root) = {}; edge f→g at site with H held refines
+    # entry(g) ∩= entry(f) ∪ H. Monotone decreasing → terminates.
+    for fq, _role in sorted(set(index.role_roots)):
+        fn = index.funcs.get(fq)
+        if fn is not None:
+            fn.entry_locks = frozenset() if fn.entry_locks is None \
+                else fn.entry_locks
+    changed = True
+    while changed:
+        changed = False
+        for fq in sorted(index.funcs):
+            fn = index.funcs[fq]
+            if fn.entry_locks is None or not fn.roles:
+                continue
+            base = fn.entry_locks
+            for call in fn.calls:
+                callee = index.funcs.get(call.callee or "")
+                if callee is None:
+                    continue
+                at_site = frozenset(base | set(call.held))
+                if callee.entry_locks is None:
+                    callee.entry_locks = at_site
+                    changed = True
+                else:
+                    refined = callee.entry_locks & at_site
+                    if refined != callee.entry_locks:
+                        callee.entry_locks = refined
+                        changed = True
+
+    # Transitive lock acquisitions (for lock-order edges through calls).
+    for fq in sorted(index.funcs):
+        fn = index.funcs[fq]
+        fn.acquires = {a.lock for a in fn.lock_acqs}
+    changed = True
+    while changed:
+        changed = False
+        for fq in sorted(index.funcs):
+            fn = index.funcs[fq]
+            for call in fn.calls:
+                callee = index.funcs.get(call.callee or "")
+                if callee is None:
+                    continue
+                if not callee.acquires <= fn.acquires:
+                    fn.acquires |= callee.acquires
+                    changed = True

@@ -1,0 +1,621 @@
+"""Whole-program rules RBK007–RBK010, run over :class:`ProjectIndex`.
+
+These are the cross-module failure classes the per-file rules cannot see
+(each rule's docstring names the runtime incident it prevents — the PR 2
+principle that a gate nobody understands gets noqa'd into irrelevance):
+
+RBK007  lock-order hazards: acquisition-order cycles between lock sites
+        (propagated through the call graph), a non-reentrant lock
+        re-acquired on the same instance, and locks held across
+        ``await`` points or thread handoffs (``run_locked`` /
+        ``asyncio.to_thread`` / executor submits).
+RBK008  thread-shared state: attributes of engine/fleet/sched/obs/server
+        objects written from ≥2 distinct thread entry roles (step loop,
+        HTTP handlers, router pull workers, event loop) without one lock
+        common to every writing path.
+RBK009  blocking calls (``time.sleep``, file/socket I/O, bare
+        ``Lock.acquire``) directly inside ``async def`` bodies on the
+        serving path — each one freezes every stream the event loop owns.
+RBK010  metric-label cardinality: every ``labels(...)`` value must come
+        from a statically bounded set (literal, fixed tuple/frozenset
+        constant, ``Literal[...]`` param, membership-guarded fallback,
+        or a bounded propagation of those) — the checked twin of the
+        bounded-``reason``-label convention docs/observability.md pins.
+
+Findings are suppressible with the standard ``# runbook: noqa[RBK00x]``
+marker at the flagged line (same lexical semantics as the per-file rules —
+each module's noqa map is consulted through its ``ModuleContext``).
+All output is deterministically ordered.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from runbookai_tpu.analysis.core import (
+    Finding,
+    Severity,
+    _param_names,
+    dotted_name,
+)
+from runbookai_tpu.analysis.project import (
+    FuncNode,
+    ProjectIndex,
+    _const_collection,
+)
+
+# id → one-line description (the SARIF/driver rule metadata; docs/lint.md
+# carries the full catalog with bad/good examples).
+XRULE_DESCRIPTIONS = {
+    "RBK007": ("lock-order hazard: acquisition-order cycle, same-instance "
+               "re-acquisition, or a lock held across an await/thread "
+               "handoff"),
+    "RBK008": ("thread-shared attribute written from >= 2 thread entry "
+               "roles without one lock common to every writing path"),
+    "RBK009": ("blocking call (sleep / file / socket / bare Lock.acquire) "
+               "inside an async def body on the serving path"),
+    "RBK010": ("metric label value not drawn from a statically bounded "
+               "set (label-cardinality contract)"),
+}
+
+# Packages whose objects RBK008 audits (thread-shared serving state).
+SHARED_STATE_TAGS = frozenset({"engine", "fleet", "sched", "obs", "server"})
+
+# Packages whose async bodies RBK009 audits (the serving event loops).
+ASYNC_PATH_TAGS = frozenset({"engine", "fleet", "server"})
+
+
+def _finding(fn: FuncNode, node: ast.AST, rule: str, severity: str,
+             message: str) -> Optional[Finding]:
+    ctx = fn.module.make_ctx()
+    if ctx.suppressed(rule, node):
+        return None
+    return Finding(path=fn.module.path,
+                   line=getattr(node, "lineno", 0),
+                   col=getattr(node, "col_offset", 0),
+                   rule=rule, severity=severity, message=message,
+                   symbol=fn.qual)
+
+
+def _short(lock: str) -> str:
+    """Human-readable lock id: drop the package prefix."""
+    return lock.split(".", 2)[-1] if lock.count(".") >= 2 else lock
+
+
+# --------------------------------------------------------------------------- #
+# RBK007 — lock-order analysis                                                #
+# --------------------------------------------------------------------------- #
+
+
+def check_lock_order(index: ProjectIndex) -> Iterator[Finding]:
+    # Edge set: (held A → acquired B) with a representative site each.
+    edges: dict[tuple[str, str], tuple[FuncNode, ast.AST]] = {}
+
+    def _add(a: str, b: str, fn: FuncNode, node: ast.AST) -> None:
+        edges.setdefault((a, b), (fn, node))
+
+    for fq in sorted(index.funcs):
+        fn = index.funcs[fq]
+        entry = fn.entry_locks or frozenset()
+        # Lexical nesting inside one function.
+        for acq in fn.lock_acqs:
+            for held in (*entry, *acq.held):
+                if held != acq.lock:
+                    _add(held, acq.lock, fn, acq.node)
+            # Same-instance re-acquisition: `with self.X:` nested under an
+            # already-held `self.X` (threading.Lock is NOT reentrant).
+            if acq.self_rooted and acq.lock in acq.held:
+                f = _finding(
+                    fn, acq.node, "RBK007", Severity.ERROR,
+                    f"`{_short(acq.lock)}` re-acquired while already held "
+                    f"on the same instance — threading.Lock is not "
+                    f"reentrant; this deadlocks the holder")
+                if f:
+                    yield f
+        # Call-mediated: calling g while holding A adds A → every lock g
+        # (transitively) acquires.
+        for call in fn.calls:
+            callee = index.funcs.get(call.callee or "")
+            if callee is None:
+                continue
+            held_here = tuple(dict.fromkeys((*entry, *call.held)))
+            if not held_here:
+                continue
+            for b in sorted(callee.acquires):
+                for a in held_here:
+                    if a != b:
+                        _add(a, b, fn, call.node)
+                    elif call.same_instance:
+                        f = _finding(
+                            fn, call.node, "RBK007", Severity.ERROR,
+                            f"call re-enters `{_short(a)}` on the same "
+                            f"instance ({callee.qual} acquires it) while "
+                            f"it is already held — non-reentrant deadlock")
+                        if f:
+                            yield f
+
+    # Cycles: strongly connected components of the edge graph with >1 lock.
+    order = sorted({n for e in edges for n in e})
+    adj: dict[str, list[str]] = {n: [] for n in order}
+    for (a, b) in sorted(edges):
+        adj[a].append(b)
+    sccs = _tarjan(order, adj)
+    cyclic = [sorted(s) for s in sccs if len(s) > 1]
+    for comp in sorted(cyclic):
+        members = set(comp)
+        for (a, b) in sorted(edges):
+            if a in members and b in members:
+                fn, node = edges[(a, b)]
+                f = _finding(
+                    fn, node, "RBK007", Severity.ERROR,
+                    f"lock-order cycle: `{_short(a)}` is held while "
+                    f"acquiring `{_short(b)}`, but elsewhere the order "
+                    f"reverses (cycle through "
+                    f"{', '.join(_short(c) for c in comp)}) — pick one "
+                    f"global order or drop to a snapshot-outside-lock "
+                    f"pattern")
+                if f:
+                    yield f
+
+    # Locks held across awaits / thread handoffs.
+    for fq in sorted(index.funcs):
+        fn = index.funcs[fq]
+        for node, lock in fn.awaits_under_lock:
+            f = _finding(
+                fn, node, "RBK007", Severity.ERROR,
+                f"`await` while holding `{_short(lock)}` — a sync lock "
+                f"held across a suspension point blocks EVERY other task "
+                f"(and thread) contending for it until this coroutine "
+                f"resumes; release before awaiting or use run_locked")
+            if f:
+                yield f
+        for node, what, lock in fn.handoffs_under_lock:
+            f = _finding(
+                fn, node, "RBK007", Severity.ERROR,
+                f"`{what}(...)` while holding `{_short(lock)}` hands work "
+                f"to another thread with the lock still held — if that "
+                f"work (or anything it awaits) needs the same lock, the "
+                f"handoff deadlocks; move it outside the `with` scope")
+            if f:
+                yield f
+
+
+def _tarjan(nodes: list[str], adj: dict[str, list[str]]) -> list[set[str]]:
+    """Iterative Tarjan SCC (deterministic: nodes/edges pre-sorted)."""
+    idx: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[set[str]] = []
+    counter = [0]
+
+    for root in nodes:
+        if root in idx:
+            continue
+        work: list[tuple[str, int]] = [(root, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                idx[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            advanced = False
+            for i in range(pi, len(adj[node])):
+                nxt = adj[node][i]
+                if nxt not in idx:
+                    work[-1] = (node, i + 1)
+                    work.append((nxt, 0))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], idx[nxt])
+            if advanced:
+                continue
+            if low[node] == idx[node]:
+                comp: set[str] = set()
+                while True:
+                    top = stack.pop()
+                    on_stack.discard(top)
+                    comp.add(top)
+                    if top == node:
+                        break
+                sccs.append(comp)
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    return sccs
+
+
+# --------------------------------------------------------------------------- #
+# RBK008 — cross-file thread-shared-state races                               #
+# --------------------------------------------------------------------------- #
+
+
+def check_shared_state(index: ProjectIndex) -> Iterator[Finding]:
+    # (class fq, attr) → [(fn, write)] for role-bearing non-ctor writers.
+    writes: dict[tuple[str, str], list] = {}
+    for fq in sorted(index.funcs):
+        fn = index.funcs[fq]
+        if not fn.roles:
+            continue
+        for w in fn.attr_writes:
+            if w.ctor:
+                continue
+            cls = index.classes.get(w.owner)
+            if cls is None or not (cls.module.tags & SHARED_STATE_TAGS):
+                continue
+            writes.setdefault((w.owner, w.attr), []).append((fn, w))
+
+    for (owner, attr) in sorted(writes):
+        writers = writes[(owner, attr)]
+        roles: set[str] = set()
+        for fn, _w in writers:
+            roles |= fn.roles
+        if len(roles) < 2:
+            continue
+        # One lock common to every writing path?
+        common: Optional[frozenset[str]] = None
+        for fn, w in writers:
+            held = frozenset((*(fn.entry_locks or frozenset()), *w.held))
+            common = held if common is None else (common & held)
+        if common:
+            continue
+        writers.sort(key=lambda p: (p[0].module.path,
+                                    getattr(p[1].node, "lineno", 0)))
+        # Anchor at the least-protected write (no lock at all beats a
+        # wrong lock for the "start here" signal).
+        anchor_fn, anchor_w = min(
+            writers,
+            key=lambda p: (len((*(p[0].entry_locks or frozenset()),
+                                *p[1].held)),
+                           p[0].module.path,
+                           getattr(p[1].node, "lineno", 0)))
+        others = sorted({f"{fn.module.path}:{getattr(w.node, 'lineno', 0)}"
+                         for fn, w in writers
+                         if (fn, w) != (anchor_fn, anchor_w)})
+        cls_short = owner.rsplit(".", 1)[-1]
+        f = _finding(
+            anchor_fn, anchor_w.node, "RBK008", Severity.WARNING,
+            f"`{cls_short}.{attr}` is written from {len(roles)} thread "
+            f"entry roles ({', '.join(sorted(roles))}) with no lock "
+            f"common to every writing path (also written at "
+            f"{', '.join(others[:3])}{', …' if len(others) > 3 else ''}) — "
+            f"take one consistent lock or confine the attribute to a "
+            f"single thread")
+        if f:
+            yield f
+
+
+# --------------------------------------------------------------------------- #
+# RBK009 — blocking calls in async bodies                                     #
+# --------------------------------------------------------------------------- #
+
+
+def check_async_blocking(index: ProjectIndex) -> Iterator[Finding]:
+    for fq in sorted(index.funcs):
+        fn = index.funcs[fq]
+        if not (fn.module.tags & ASYNC_PATH_TAGS):
+            continue
+        if fn.is_async:
+            for node, what, _held, _ in fn.blocking:
+                f = _finding(
+                    fn, node, "RBK009", Severity.ERROR,
+                    f"`{what}(...)` directly inside an `async def` body "
+                    f"freezes the event loop (every live stream stalls "
+                    f"for its duration) — use an async equivalent or "
+                    f"move it behind asyncio.to_thread")
+                if f:
+                    yield f
+            # One-hop cross-module view: awaitless sync helpers that block
+            # are still executed on the loop when called from async code.
+            for call in fn.calls:
+                callee = index.funcs.get(call.callee or "")
+                if callee is None or callee.is_async:
+                    continue
+                direct = [b for b in callee.blocking if not b[3]]
+                if direct:
+                    what = direct[0][1]
+                    f = _finding(
+                        fn, call.node, "RBK009", Severity.ERROR,
+                        f"call runs `{callee.qual}` on the event loop, and "
+                        f"its body blocks (`{what}(...)` at "
+                        f"{callee.module.path}:"
+                        f"{getattr(direct[0][0], 'lineno', 0)}) — wrap the "
+                        f"call in asyncio.to_thread or make the helper "
+                        f"async")
+                    if f:
+                        yield f
+
+
+# --------------------------------------------------------------------------- #
+# RBK010 — metric-label cardinality                                           #
+# --------------------------------------------------------------------------- #
+
+
+def _const_dict_values(node: ast.AST) -> bool:
+    """A dict literal whose VALUES are all constants (keys may be names:
+    ``{PRIORITY_BATCH: "batch"}`` still yields a bounded value set)."""
+    return isinstance(node, ast.Dict) \
+        and all(isinstance(v, ast.Constant) for v in node.values)
+
+
+def _return_exprs(node: ast.AST) -> list[ast.AST]:
+    """Return-statement values of a function body, excluding nested defs.
+    A bare ``return`` contributes a None constant."""
+    out: list[ast.AST] = []
+
+    def _walk(n: ast.AST) -> None:
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(child, ast.Return):
+                out.append(child.value if child.value is not None
+                           else ast.Constant(value=None))
+            _walk(child)
+
+    _walk(node)
+    return out
+
+
+class _Boundedness:
+    """Decide whether a label-value expression draws from a statically
+    bounded set. Conservative: unknown means unbounded."""
+
+    MAX_DEPTH = 8
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+
+    def _callee_of(self, call: ast.Call, fn: FuncNode) -> Optional[FuncNode]:
+        for site in fn.calls:
+            if site.node is call:
+                return self.index.funcs.get(site.callee or "")
+        return None
+
+    def bounded(self, expr: ast.AST, fn: FuncNode, depth: int = 0,
+                stack: Optional[frozenset] = None) -> bool:
+        if depth > self.MAX_DEPTH:
+            return False
+        if isinstance(expr, ast.Constant):
+            return True
+        if isinstance(expr, ast.JoinedStr):
+            return all(self.bounded(v.value, fn, depth + 1, stack)
+                       for v in expr.values
+                       if isinstance(v, ast.FormattedValue))
+        if isinstance(expr, ast.IfExp):
+            # `x if x in BOUNDED else "other"` — the membership guard IS
+            # the allowlist (the server's route-label idiom).
+            if self._membership_guarded(expr, fn, depth, stack):
+                return self.bounded(expr.orelse, fn, depth + 1, stack)
+            return (self.bounded(expr.body, fn, depth + 1, stack)
+                    and self.bounded(expr.orelse, fn, depth + 1, stack))
+        if isinstance(expr, ast.Call):
+            if dotted_name(expr.func) == "str" and len(expr.args) == 1:
+                return self.bounded(expr.args[0], fn, depth + 1, stack)
+            # D.get(x, default) on a constant-VALUED dict: the result set
+            # is the dict's values plus the default (the `class_label`
+            # idiom — arbitrary ints in, canonical names out).
+            if isinstance(expr.func, ast.Attribute) \
+                    and expr.func.attr == "get" \
+                    and len(expr.args) in (1, 2):
+                recv = expr.func.value
+                const = self._resolve_const(recv.id, fn) \
+                    if isinstance(recv, ast.Name) else None
+                if const is not None and _const_dict_values(const):
+                    default_ok = len(expr.args) == 1 or self.bounded(
+                        expr.args[1], fn, depth + 1, stack)
+                    return default_ok
+            # A project function whose every `return` value is bounded
+            # (in the callee's own context) returns a bounded value.
+            callee = self._callee_of(expr, fn)
+            if callee is not None:
+                key = (callee.fq, "<returns>")
+                if key in (stack or frozenset()):
+                    return False
+                rstack = (stack or frozenset()) | {key}
+                rets = _return_exprs(callee.node)
+                return bool(rets) and all(
+                    self.bounded(r, callee, depth + 1, rstack) for r in rets)
+            return False
+        if isinstance(expr, ast.Name):
+            return self._name_bounded(expr.id, fn, depth, stack)
+        if isinstance(expr, ast.Attribute):
+            # Class-level constant (`self.KIND` where KIND = "x" on the
+            # class) — anything else on an instance is runtime state.
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                    and fn.cls is not None:
+                cls = fn.module.classes.get(fn.cls)
+                if cls is not None and expr.attr in cls.consts:
+                    const = cls.consts[expr.attr]
+                    return isinstance(const, ast.Constant) \
+                        or _const_collection(const)
+            return False
+        return False
+
+    def _membership_guarded(self, expr: ast.IfExp, fn: FuncNode,
+                            depth: int, stack) -> bool:
+        test = expr.test
+        return (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.ops[0], ast.In)
+                and ast.dump(test.left) == ast.dump(expr.body)
+                and self._collection_bounded(test.comparators[0], fn,
+                                             depth + 1))
+
+    def _name_bounded(self, name: str, fn: FuncNode, depth: int,
+                      stack) -> bool:
+        stack = stack or frozenset()
+        key = (fn.fq, name)
+        if key in stack:
+            return False
+        stack = stack | {key}
+        # for-loop / comprehension target over a bounded collection.
+        if name in fn.for_targets:
+            iterable, tup_idx = fn.for_targets[name]
+            return self._collection_bounded(iterable, fn, depth + 1,
+                                            tuple_index=tup_idx)
+        # Local assignments: bounded iff every assignment is.
+        if name in fn.local_assigns:
+            return all(self.bounded(v, fn, depth + 1, stack)
+                       for v in fn.local_assigns[name])
+        # Module/class constant.
+        const = self._resolve_const(name, fn)
+        if const is not None:
+            return isinstance(const, ast.Constant) or _const_collection(const)
+        # Parameter: Literal[...] annotation, or every resolvable project
+        # call site passes a bounded value.
+        if name in _param_names(fn.node):
+            if self._literal_annotated(name, fn):
+                return True
+            return self._callsites_bounded(name, fn, depth, stack)
+        return False
+
+    def _resolve_const(self, name: str, fn: FuncNode) -> Optional[ast.AST]:
+        if fn.cls is not None:
+            cls = fn.module.classes.get(fn.cls)
+            if cls is not None and name in cls.consts:
+                return cls.consts[name]
+        if name in fn.module.consts:
+            return fn.module.consts[name]
+        target = fn.module.imports.get(name)
+        if target and "." in target:
+            mod_name, _, leaf = target.rpartition(".")
+            mod = self.index.modules.get(mod_name)
+            if mod is not None and leaf in mod.consts:
+                return mod.consts[leaf]
+        return None
+
+    def _collection_bounded(self, expr: ast.AST, fn: FuncNode, depth: int,
+                            tuple_index: int = -1) -> bool:
+        if depth > self.MAX_DEPTH:
+            return False
+        if isinstance(expr, ast.Call):
+            cname = dotted_name(expr.func)
+            if cname in ("sorted", "frozenset", "set", "tuple", "list") \
+                    and len(expr.args) == 1 and not expr.keywords:
+                return self._collection_bounded(expr.args[0], fn, depth + 1,
+                                                tuple_index)
+            if isinstance(expr.func, ast.Attribute) \
+                    and expr.func.attr in ("keys", "items") \
+                    and not expr.args:
+                # dict.keys()/.items() of a bounded-key dict: the label is
+                # bounded when it binds the KEY (items() index 0 or keys()).
+                inner = expr.func.value
+                if expr.func.attr == "items" and tuple_index not in (0, -1):
+                    return False
+                return self._collection_bounded(inner, fn, depth + 1)
+            return False
+        if _const_collection(expr):
+            return True
+        if isinstance(expr, ast.Name):
+            const = self._resolve_const(expr.id, fn)
+            if const is not None:
+                return _const_collection(const) or self._collection_bounded(
+                    const, fn, depth + 1, tuple_index)
+            return False
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self" \
+                and fn.cls is not None:
+            cls = fn.module.classes.get(fn.cls)
+            if cls is not None and expr.attr in cls.consts:
+                return _const_collection(cls.consts[expr.attr])
+        return False
+
+    def _literal_annotated(self, name: str, fn: FuncNode) -> bool:
+        args = fn.node.args
+        for a in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+            if a.arg != name or a.annotation is None:
+                continue
+            ann = a.annotation
+            if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                try:
+                    ann = ast.parse(ann.value, mode="eval").body
+                except SyntaxError:
+                    return False
+            if isinstance(ann, ast.Subscript):
+                base = dotted_name(ann.value)
+                if base in ("Literal", "typing.Literal"):
+                    return True
+        return False
+
+    def _callsites_bounded(self, param: str, fn: FuncNode, depth: int,
+                           stack) -> bool:
+        sites = []
+        for other_fq in sorted(self.index.funcs):
+            other = self.index.funcs[other_fq]
+            for call in other.calls:
+                if call.callee == fn.fq and isinstance(call.node, ast.Call):
+                    sites.append((other, call.node))
+        if not sites:
+            return False
+        a = fn.node.args
+        positional = [p.arg for p in (*a.posonlyargs, *a.args)
+                      if p.arg not in ("self", "cls")]
+        for other, call in sites:
+            exprs = []
+            for i, arg in enumerate(call.args):
+                if i < len(positional) and positional[i] == param:
+                    exprs.append(arg)
+            for kw in call.keywords:
+                if kw.arg == param:
+                    exprs.append(kw.value)
+                elif kw.arg is None:
+                    return False  # **kwargs forwarding — opaque
+            if not exprs:
+                # Param not supplied here: bounded only via its default.
+                default = self._param_default(param, fn)
+                if default is None or not self.bounded(default, fn,
+                                                       depth + 1, stack):
+                    return False
+                continue
+            for e in exprs:
+                if not self.bounded(e, other, depth + 1, stack):
+                    return False
+        return True
+
+    @staticmethod
+    def _param_default(param: str, fn: FuncNode) -> Optional[ast.AST]:
+        a = fn.node.args
+        pos = [*a.posonlyargs, *a.args]
+        defaults = list(a.defaults)
+        for arg, default in zip(reversed(pos), reversed(defaults)):
+            if arg.arg == param:
+                return default
+        for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+            if arg.arg == param and default is not None:
+                return default
+        return None
+
+
+def check_label_cardinality(index: ProjectIndex) -> Iterator[Finding]:
+    judge = _Boundedness(index)
+    for fq in sorted(index.funcs):
+        fn = index.funcs[fq]
+        for site in fn.label_sites:
+            bad = [name for name, expr in site.values
+                   if not judge.bounded(expr, fn)]
+            if not bad:
+                continue
+            f = _finding(
+                fn, site.node, "RBK010", Severity.ERROR,
+                f"label value(s) {', '.join(bad)} not drawn from a "
+                f"statically bounded set — unbounded label cardinality "
+                f"grows the scrape forever and kills the dashboards; use "
+                f"a Literal/enum/fixed-tuple allowlist with an 'other' "
+                f"fallback (docs/observability.md), or noqa with the "
+                f"reason the set is bounded at runtime")
+            if f:
+                yield f
+
+
+def run_cross_rules(index: ProjectIndex) -> list[Finding]:
+    out: list[Finding] = []
+    out.extend(check_lock_order(index))
+    out.extend(check_shared_state(index))
+    out.extend(check_async_blocking(index))
+    out.extend(check_label_cardinality(index))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule, f.message))
+    return out

@@ -1645,8 +1645,8 @@ def build_parser() -> argparse.ArgumentParser:
     met.set_defaults(fn=cmd_metrics)
 
     lint = sub.add_parser(
-        "lint", help="AST static analysis for TPU serving hazards "
-                     "(RBK001-RBK006; docs/lint.md)")
+        "lint", help="whole-program AST static analysis for TPU serving "
+                     "hazards (RBK001-RBK010; docs/lint.md)")
     from runbookai_tpu.analysis.cli import add_lint_arguments
 
     add_lint_arguments(lint)

@@ -275,6 +275,8 @@ class MultiModelFleet:
         for metric, fn in per_model:
             metric.clear_functions()
             for g in self.groups.values():
+                # runbook: noqa[RBK010] — model label: served-group
+                # catalog names, fixed at fleet build.
                 metric.labels(model=g.name).set_function(
                     lambda gg=g, f=fn: f(gg))
 

@@ -378,6 +378,8 @@ class WorkloadMonitor:
             metric = reg.gauge(name, help_text, labels=("model",))
             metric.clear_functions()
             for model in models:
+                # runbook: noqa[RBK010] — model label: served-group
+                # catalog names, fixed at monitor attach.
                 metric.labels(model=model).set_function(
                     lambda m=model, f=fn: fp_value(m, f))
 
@@ -402,8 +404,12 @@ class WorkloadMonitor:
         g_drift.clear_functions()
         g_stale.clear_functions()
         for model in models:
+            # runbook: noqa[RBK010] — model label: served-group
+            # catalog names, fixed at monitor attach.
             g_drift.labels(model=model).set_function(
                 lambda m=model: drift_or_raise(m))
+            # runbook: noqa[RBK010] — model label: served-group
+            # catalog names, fixed at monitor attach.
             g_stale.labels(model=model).set_function(
                 lambda m=model: float(
                     drift_or_raise(m) > self.drift_threshold))
@@ -417,6 +423,8 @@ class WorkloadMonitor:
         for model, fp in self.fingerprinters.items():
             for core in fp.cores:
                 rid = core.replica_idx if core.replica_idx is not None else 0
+                # runbook: noqa[RBK010] — replica/model labels: pinned
+                # replica ids x served-group names, fixed at attach.
                 g_health.labels(replica=str(rid), model=model).set_function(
                     lambda c=core, m=model: self.replica_health(c, m))
 

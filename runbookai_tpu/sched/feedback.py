@@ -153,6 +153,8 @@ class MixedBudgetController:
         if not self._levels:
             self._build_levels(core)
             replica = getattr(core, "replica_idx", None)
+            # runbook: noqa[RBK010] — replica label: one controller per
+            # replica, ids pinned at engine construction.
             self._g_share.labels(
                 replica=str(replica if replica is not None else 0)
             ).set_function(lambda: float(core._mix_pf_tokens))

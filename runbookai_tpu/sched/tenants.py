@@ -183,6 +183,8 @@ class TenantGovernor:
         g_budget.clear_functions()
         for name, state in self._states.items():
             if state.tokens is not None:
+                # runbook: noqa[RBK010] — tenant label: configured policy names;
+                # unknown API keys collapse to the shared 'default' bucket.
                 g_budget.labels(tenant=name).set_function(
                     lambda n=name: self._budget_level(n))
         g_pages = reg.gauge(
@@ -193,6 +195,8 @@ class TenantGovernor:
         g_pages.clear_functions()
         for name, state in self._states.items():
             if state.policy.kv_page_limit is not None:
+                # runbook: noqa[RBK010] — tenant label: configured policy names;
+                # unknown API keys collapse to the shared 'default' bucket.
                 g_pages.labels(tenant=name).set_function(
                     lambda n=name: self._pages_in_flight(n))
 
@@ -287,6 +291,8 @@ class TenantGovernor:
                     # alerts read (an operator would raise the limit
                     # for a request no headroom could ever admit).
                     state.refused_kv_oversized += 1
+                    # runbook: noqa[RBK010] — tenant label: configured policy names;
+                    # unknown API keys collapse to the shared 'default' bucket.
                     self._m_requests.labels(
                         tenant=tenant,
                         outcome="refused_kv_oversized").inc()
@@ -303,6 +309,8 @@ class TenantGovernor:
             else:
                 pages = 0.0  # nothing to release at settle
             state.admitted += 1
+        # runbook: noqa[RBK010] — tenant label: configured policy names;
+        # unknown API keys collapse to the shared 'default' bucket.
         self._m_requests.labels(tenant=tenant, outcome="admitted").inc()
         return Admission(True, tenant, priority=priority,
                          reserved_tokens=reserve, reserved_pages=pages)
@@ -310,6 +318,8 @@ class TenantGovernor:
     def _throttle_metrics(self, tenant: str, outcome: str) -> None:
         # Counter bumps are their own locks; called with self._lock held
         # only because the caller is mid-decision — no I/O, no blocking.
+        # runbook: noqa[RBK010] — tenant label: configured policy names;
+        # unknown API keys collapse to the shared 'default' bucket.
         self._m_requests.labels(tenant=tenant, outcome=outcome).inc()
         self._m_throttled.inc()
 
@@ -336,6 +346,8 @@ class TenantGovernor:
                     - admission.reserved_pages)
             state.tokens_charged += charged
         if charged:
+            # runbook: noqa[RBK010] — tenant label: configured policy names;
+            # unknown API keys collapse to the shared 'default' bucket.
             self._m_tokens.labels(tenant=admission.tenant).inc(charged)
 
     def snapshot(self) -> dict[str, Any]:

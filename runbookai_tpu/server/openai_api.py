@@ -88,6 +88,12 @@ _KNOWN_ROUTES = frozenset((
     "/debug/workload", "/tenants",
 ))
 
+# Every status this server emits; anything novel scrapes as "other" so the
+# status label stays a statically bounded set (RBK010 contract).
+_KNOWN_STATUSES = frozenset((
+    "200", "400", "403", "404", "429", "500", "503", "504",
+))
+
 # Retry-After for fleet sheds / engine pool-pressure 503s: the backlog
 # drains in engine-step time, so "about a second" is the honest hint (a
 # tenant throttle's Retry-After is computed from its bucket instead).
@@ -366,9 +372,11 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     fn()
             finally:
                 tracer.clear_context()
+                status = str(self._status or 500)
                 requests_total.labels(
                     route=route, method=method,
-                    status=str(self._status or 500)).inc()
+                    status=status if status in _KNOWN_STATUSES
+                    else "other").inc()
                 request_latency.labels(route=route, method=method).observe(
                     time.perf_counter() - t0)
 

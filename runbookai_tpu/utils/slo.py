@@ -98,16 +98,24 @@ class SLOMonitor:
             "Evaluations (scrapes + /healthz probes) that observed the "
             "objective breached", labels=("objective",))
         for key in self.objectives:
+            # runbook: noqa[RBK010] — objective label: regex-validated
+            # <ttft|tpot|e2e>_p<q>_ms spellings from llm.slo, fixed at load.
             self._g_target.labels(objective=key).set_function(
                 lambda k=key: self.objectives[k]["target_ms"])
             # Materialize the violation series at 0: "never breached" must
             # scrape as an explicit zero so rate() works from first breach.
+            # runbook: noqa[RBK010] — objective label: regex-validated
+            # <ttft|tpot|e2e>_p<q>_ms spellings from llm.slo, fixed at load.
             self._c_violations.labels(objective=key).inc(0.0)
             # current/burn raise (-> series dropped) while the histogram
             # is empty: "no data" must scrape as absence, not as 0 (a
             # burn_ratio of 0 would read as a comfortably-met SLO).
+            # runbook: noqa[RBK010] — objective label: regex-validated
+            # <ttft|tpot|e2e>_p<q>_ms spellings from llm.slo, fixed at load.
             self._g_current.labels(objective=key).set_function(
                 lambda k=key: self._current_ms_or_raise(k))
+            # runbook: noqa[RBK010] — objective label: regex-validated
+            # <ttft|tpot|e2e>_p<q>_ms spellings from llm.slo, fixed at load.
             self._g_burn.labels(objective=key).set_function(
                 lambda k=key: self._burn_or_raise(k))
 
@@ -141,6 +149,8 @@ class SLOMonitor:
     def _burn_or_raise(self, key: str) -> float:
         burn = self._current_ms_or_raise(key) / self.objectives[key]["target_ms"]
         if burn > 1.0:
+            # runbook: noqa[RBK010] — objective label: regex-validated
+            # <ttft|tpot|e2e>_p<q>_ms spellings from llm.slo, fixed at load.
             self._c_violations.labels(objective=key).inc()
         return burn
 
@@ -158,6 +168,8 @@ class SLOMonitor:
                     if current is not None else None)
             breached = burn is not None and burn > 1.0
             if breached:
+                # runbook: noqa[RBK010] — objective label: regex-validated
+                # <ttft|tpot|e2e>_p<q>_ms spellings from llm.slo, fixed at load.
                 self._c_violations.labels(objective=key).inc()
             out[key] = {
                 "target_ms": obj["target_ms"],

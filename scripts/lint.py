@@ -8,8 +8,16 @@ by the committed ``lint-baseline.json`` — no network, no TPU, no jax.
 
 Usage:
     python scripts/lint.py                 # gate: runbookai_tpu/ vs baseline
+    python scripts/lint.py --changed       # pre-commit: whole-program index,
+                                           # findings filtered to files git
+                                           # sees as modified/untracked
+    python scripts/lint.py --format sarif  # CI annotation (SARIF 2.1.0)
     python scripts/lint.py --update-baseline
     python scripts/lint.py path/to/file.py --no-baseline
+
+Pre-commit recipe (docs/lint.md): run ``python scripts/lint.py --changed``
+from any checkout dir — it exits 1 only when YOUR edits introduce a
+finding, while cross-module rules still see the whole tree.
 """
 
 import os

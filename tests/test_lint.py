@@ -656,6 +656,778 @@ class TestCLI:
         assert "clean" in capsys.readouterr().out
 
 
+# ------------------------------------------------- whole-program (PR 13)
+
+
+def write_tree(tmp_path, files):
+    """Write a fixture tree, creating ``__init__.py`` package markers in
+    every intermediate directory — module names resolve from the on-disk
+    package root, exactly like the real tree."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        d = p.parent
+        while d != tmp_path:
+            init = d / "__init__.py"
+            if not init.exists():
+                init.write_text("")
+            d = d.parent
+        p.write_text(textwrap.dedent(src))
+
+
+def lint_tree(tmp_path, files):
+    """Write a fixture tree and run the full two-phase analysis on it."""
+    write_tree(tmp_path, files)
+    return analyze_paths([tmp_path], root=tmp_path)
+
+
+class TestCrossModuleRBK001:
+    """The documented "same module only" gap is CLOSED: jit-reachability
+    and traced-ness ride the project call graph. If these fixtures stop
+    flagging, reachability regressed to per-file."""
+
+    A = """
+        import jax
+        from pkg.b import helper, shape_helper
+
+        @jax.jit
+        def f(x):
+            k = x.shape[0]
+            return helper(x) + shape_helper(k)
+    """
+    B = """
+        def helper(v):
+            if v > 0:
+                return v
+            return -v
+
+        def shape_helper(dim):
+            if dim % 128 == 0:
+                return dim
+            return None
+    """
+
+    def test_jit_in_a_flags_branching_helper_in_b(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/a.py": self.A, "pkg/b.py": self.B})
+        assert [(f.rule, f.path, f.symbol) for f in out] == \
+            [("RBK001", "pkg/b.py", "helper")]
+
+    def test_module_attribute_call_resolves(self, tmp_path):
+        out = lint_tree(tmp_path, {
+            "pkg/a.py": """
+                import jax
+                import pkg.b
+
+                @jax.jit
+                def f(x):
+                    return pkg.b.helper(x)
+            """,
+            "pkg/b.py": self.B})
+        assert [(f.rule, f.symbol) for f in out] == [("RBK001", "helper")]
+
+    def test_static_args_stay_clean_cross_module(self, tmp_path):
+        out = lint_tree(tmp_path, {
+            "pkg/a.py": """
+                import jax
+                from pkg.b import shape_helper
+
+                @jax.jit
+                def f(x):
+                    return x * shape_helper(x.shape[0])
+            """,
+            "pkg/b.py": self.B})
+        assert out == []
+
+    def test_per_file_pass_alone_misses_it(self, tmp_path):
+        # Control: project=False reverts to the first-order analyzer —
+        # proving the finding above comes from the call graph.
+        write_tree(tmp_path, {"pkg/a.py": self.A, "pkg/b.py": self.B})
+        assert analyze_paths([tmp_path], root=tmp_path, project=False) == []
+
+
+class TestRBK007:
+    def test_lock_order_cycle_flagged_both_sites(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/engine/locks.py": """
+            import threading
+
+            class A:
+                def __init__(self, b: "B"):
+                    self._lock = threading.Lock()
+                    self.b = b
+
+                def outer(self):
+                    with self._lock:
+                        self.b.poke()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+
+            class B:
+                def __init__(self, a: "A"):
+                    self._lock = threading.Lock()
+                    self.a = a
+
+                def poke(self):
+                    with self._lock:
+                        pass
+
+                def reverse(self):
+                    with self._lock:
+                        self.a.inner()
+        """})
+        assert [(f.rule, f.symbol) for f in out] == \
+            [("RBK007", "A.outer"), ("RBK007", "B.reverse")]
+        assert "lock-order cycle" in out[0].message
+
+    def test_consistent_order_clean(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/engine/locks.py": """
+            import threading
+
+            class A:
+                def __init__(self, b: "B"):
+                    self._lock = threading.Lock()
+                    self.b = b
+
+                def outer(self):
+                    with self._lock:
+                        self.b.poke()
+
+                def outer2(self):
+                    with self._lock:
+                        self.b.poke()
+
+            class B:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def poke(self):
+                    with self._lock:
+                        pass
+        """})
+        assert out == []
+
+    def test_await_under_sync_lock(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/engine/aw.py": """
+            import asyncio
+            import threading
+
+            class E:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                async def bad(self):
+                    with self._lock:
+                        await asyncio.sleep(0.1)
+
+                async def good(self):
+                    with self._lock:
+                        snap = 1
+                    await asyncio.sleep(snap)
+        """})
+        assert [(f.rule, f.symbol) for f in out] == [("RBK007", "E.bad")]
+        assert "await" in out[0].message
+
+    def test_async_with_lock_is_not_flagged(self, tmp_path):
+        # asyncio.Lock held across await is its normal operation.
+        out = lint_tree(tmp_path, {"pkg/engine/aw.py": """
+            import asyncio
+
+            class E:
+                def __init__(self):
+                    self._lock = asyncio.Lock()
+
+                async def ok(self):
+                    async with self._lock:
+                        await asyncio.sleep(0.1)
+        """})
+        assert out == []
+
+    def test_handoff_under_lock(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/engine/ho.py": """
+            import asyncio
+            import threading
+
+            class E:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                async def bad(self, fn):
+                    with self._lock:
+                        await asyncio.to_thread(fn)
+
+                async def good(self, fn):
+                    with self._lock:
+                        snap = fn
+                    await asyncio.to_thread(snap)
+        """})
+        rules = [(f.rule, f.symbol) for f in out]
+        assert ("RBK007", "E.bad") in rules
+        assert all(sym == "E.bad" for _r, sym in rules)
+        assert any("to_thread" in f.message for f in out)
+
+    def test_run_locked_under_lock(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/fleet/rl.py": """
+            import threading
+
+            class Router:
+                def __init__(self, eng):
+                    self._lock = threading.Lock()
+                    self.eng = eng
+
+                async def bad(self):
+                    with self._lock:
+                        await self.eng.run_locked(lambda: 1)
+        """})
+        assert any("run_locked" in f.message and f.rule == "RBK007"
+                   for f in out)
+
+    def test_same_instance_reacquisition(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/engine/re.py": """
+            import threading
+
+            class E:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def helper(self):
+                    with self._lock:
+                        pass
+
+                def reenter(self):
+                    with self._lock:
+                        self.helper()
+        """})
+        assert [(f.rule, f.symbol) for f in out] == \
+            [("RBK007", "E.reenter")]
+        assert "re-enters" in out[0].message
+
+    def test_cross_instance_same_class_clean(self, tmp_path):
+        # Two DIFFERENT instances of one class lock sequentially — the
+        # (class, attr) ids collide but no same-instance deadlock exists.
+        out = lint_tree(tmp_path, {"pkg/engine/xi.py": """
+            import threading
+
+            class E:
+                def __init__(self, peer: "E"):
+                    self._lock = threading.Lock()
+                    self.peer = peer
+
+                def helper(self):
+                    with self._lock:
+                        pass
+
+                def poke_peer(self):
+                    with self._lock:
+                        self.peer.helper()
+        """})
+        assert out == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/engine/nq.py": """
+            import threading
+
+            class E:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def helper(self):
+                    with self._lock:
+                        pass
+
+                def reenter(self):
+                    with self._lock:
+                        # runbook: noqa[RBK007] — RLock at runtime
+                        self.helper()
+        """})
+        assert out == []
+
+
+class TestRBK008:
+    RACE = """
+        import asyncio
+        import threading
+
+        class Core:
+            def __init__(self):
+                self.epoch = 0
+
+            def bump(self):
+                self.epoch += 1
+
+        class Front:
+            def __init__(self, core: Core):
+                self._lock = threading.Lock()
+                self.core = core
+
+            async def submit(self):
+                {submit_body}
+
+            async def run(self):
+                await asyncio.to_thread(self._step)
+
+            def _step(self):
+                with self._lock:
+                    self.core.bump()
+    """
+
+    def test_unlocked_cross_entry_write_flagged(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/engine/sh.py": self.RACE.format(
+            submit_body="self.core.bump()")})
+        assert [(f.rule, f.symbol) for f in out] == \
+            [("RBK008", "Core.bump")]
+        assert "Core.epoch" in out[0].message
+        assert "event-loop" in out[0].message
+
+    def test_common_lock_clean(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/engine/sh.py": self.RACE.format(
+            submit_body="""with self._lock:
+                    self.core.bump()""")})
+        assert out == []
+
+    def test_single_role_clean(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/engine/sh.py": """
+            import asyncio
+
+            class Core:
+                def __init__(self):
+                    self.epoch = 0
+
+                async def a(self):
+                    self.epoch += 1
+
+                async def b(self):
+                    self.epoch = 0
+        """})
+        assert out == []
+
+    def test_ctor_writes_exempt_and_non_audited_pkg_clean(self, tmp_path):
+        # Same race shape, but the class lives outside the audited
+        # engine/fleet/sched/obs/server packages.
+        out = lint_tree(tmp_path, {"pkg/agentx/sh.py": self.RACE.format(
+            submit_body="self.core.bump()")})
+        assert out == []
+
+
+class TestRBK009:
+    def test_direct_blocking_in_async_body(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/server/s.py": """
+            import time
+
+            async def handler():
+                time.sleep(0.5)
+                fh = open("/tmp/x")
+        """})
+        assert [f.rule for f in out] == ["RBK009", "RBK009"]
+
+    def test_one_hop_sync_helper_flagged_at_call_site(self, tmp_path):
+        out = lint_tree(tmp_path, {
+            "pkg/server/s.py": """
+                from pkg.server.util import slow_helper
+
+                async def handler():
+                    slow_helper()
+            """,
+            "pkg/server/util.py": """
+                import time
+
+                def slow_helper():
+                    time.sleep(1.0)
+            """})
+        flagged = [(f.rule, f.path, f.symbol) for f in out]
+        assert ("RBK009", "pkg/server/s.py", "handler") in flagged
+        assert any("slow_helper" in f.message for f in out)
+
+    def test_bare_lock_acquire(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/fleet/l.py": """
+            class R:
+                async def bad(self):
+                    self._lock.acquire()
+
+                async def ok(self):
+                    self._lock.acquire(timeout=0.5)
+        """})
+        assert [(f.rule, f.symbol) for f in out] == [("RBK009", "R.bad")]
+
+    def test_sync_def_and_other_packages_clean(self, tmp_path):
+        out = lint_tree(tmp_path, {
+            "pkg/server/s.py": """
+                import time
+
+                def sync_handler():
+                    time.sleep(0.5)
+            """,
+            "pkg/cli/c.py": """
+                import time
+
+                async def cli_cmd():
+                    time.sleep(0.5)
+            """})
+        assert out == []
+
+
+class TestRBK010:
+    def test_unbounded_label_flagged(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/obs/m.py": """
+            def install(reg, name):
+                m = reg.counter("runbook_x_total", "h", labels=("k",))
+                m.labels(k=name).inc()
+        """})
+        assert [f.rule for f in out] == ["RBK010"]
+        assert "k" in out[0].message
+
+    def test_bounded_forms_clean(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/obs/m.py": """
+            from pkg.obs.names import KINDS
+
+            LOCAL = ("x", "y")
+            NAMES = {1: "one", 2: "two"}
+
+
+            def canonical(n):
+                return NAMES.get(n, "other")
+
+
+            def install(reg, name, n):
+                m = reg.counter("runbook_x_total", "h", labels=("k",))
+                m.labels(k="const").inc()
+                for k in LOCAL:
+                    m.labels(k=k).inc()
+                for k in KINDS:
+                    m.labels(k=k).inc()
+                m.labels(k=name if name in KINDS else "other").inc()
+                m.labels(k=canonical(n)).inc()
+                m.labels(k=str(canonical(n))).inc()
+                pick = "a" if n else "b"
+                m.labels(k=pick).inc()
+        """, "pkg/obs/names.py": """
+            KINDS = frozenset({"a", "b", "c"})
+        """})
+        assert out == []
+
+    def test_literal_param_and_callsite_propagation(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/obs/m.py": """
+            from typing import Literal
+
+
+            def record(reg, kind: Literal["hit", "miss"]):
+                reg.counter("runbook_k_total", "h",
+                            labels=("kind",)).labels(kind=kind).inc()
+
+
+            def record2(reg, kind):
+                reg.counter("runbook_k2_total", "h",
+                            labels=("kind",)).labels(kind=kind).inc()
+
+
+            def caller(reg):
+                record2(reg, "hit")
+                record2(reg, "miss")
+        """})
+        assert out == []
+
+    def test_unbounded_callsite_breaks_propagation(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/obs/m.py": """
+            def record2(reg, kind):
+                reg.counter("runbook_k2_total", "h",
+                            labels=("kind",)).labels(kind=kind).inc()
+
+
+            def caller(reg, user_value):
+                record2(reg, "hit")
+                record2(reg, user_value)
+        """})
+        assert [f.rule for f in out] == ["RBK010"]
+
+    def test_instance_attr_unbounded_needs_noqa(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/fleet/m.py": """
+            class F:
+                def __init__(self, model):
+                    self.model = model
+
+                def install(self, reg):
+                    m = reg.counter("runbook_m_total", "h",
+                                    labels=("model",))
+                    m.labels(model=self.model).inc()
+
+                def install_ok(self, reg):
+                    m = reg.counter("runbook_m2_total", "h",
+                                    labels=("model",))
+                    # runbook: noqa[RBK010] — model fixed at build
+                    m.labels(model=self.model).inc()
+        """})
+        assert [(f.rule, f.symbol) for f in out] == \
+            [("RBK010", "F.install")]
+
+
+class TestDeterminism:
+    FILES = {
+        "pkg/engine/a.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def helper(self):
+                    with self._lock:
+                        pass
+
+                def reenter(self):
+                    with self._lock:
+                        self.helper()
+        """,
+        "pkg/server/s.py": """
+            import time
+
+            async def handler():
+                time.sleep(0.5)
+        """,
+        "pkg/obs/m.py": """
+            def install(reg, name):
+                reg.counter("runbook_x_total", "h",
+                            labels=("k",)).labels(k=name).inc()
+        """,
+        "pkg/b.py": """
+            def helper(v):
+                if v > 0:
+                    return v
+                return -v
+        """,
+        "pkg/a.py": """
+            import jax
+            from pkg.b import helper
+
+            @jax.jit
+            def f(x):
+                return helper(x)
+        """,
+    }
+
+    def _dump(self, findings):
+        from runbookai_tpu.analysis import finding_fingerprints
+
+        rows = [f.to_json() for f in findings]
+        for row, fp in zip(rows, finding_fingerprints(findings)):
+            row["fingerprint"] = fp
+        return json.dumps(rows, sort_keys=True)
+
+    def test_shuffled_input_order_is_byte_identical(self, tmp_path):
+        write_tree(tmp_path, self.FILES)
+        files = [tmp_path / rel for rel in self.FILES]
+        runs = []
+        for order in (files, list(reversed(files)),
+                      files[2:] + files[:2], [tmp_path]):
+            runs.append(self._dump(analyze_paths(order, root=tmp_path)))
+        assert len(set(runs)) == 1
+        assert json.loads(runs[0]), "fixture tree must produce findings"
+
+    def test_repeated_runs_identical(self, tmp_path):
+        write_tree(tmp_path, self.FILES)
+        a = self._dump(analyze_paths([tmp_path], root=tmp_path))
+        b = self._dump(analyze_paths([tmp_path], root=tmp_path))
+        assert a == b
+
+
+class TestFingerprints:
+    def test_line_move_tolerant(self):
+        from runbookai_tpu.analysis import finding_fingerprints
+
+        src = """
+            def f(x):
+                print(x)
+        """
+        moved = "\n\n\n# a comment\n" + textwrap.dedent(src)
+        a = lint(src)
+        b = analyze_source(moved, "runbookai_tpu/engine/mod.py")
+        assert a[0].line != b[0].line
+        assert finding_fingerprints(a) == finding_fingerprints(b)
+
+    def test_second_finding_in_symbol_gets_new_fingerprint(self):
+        from runbookai_tpu.analysis import finding_fingerprints
+
+        out = lint("""
+            def f(x):
+                print(x)
+                print(x)
+        """)
+        fps = finding_fingerprints(out)
+        assert len(fps) == 2 and fps[0] != fps[1]
+
+    def test_symbol_recorded(self):
+        out = lint("""
+            class C:
+                def f(self, x):
+                    print(x)
+        """)
+        assert out[0].symbol == "C.f"
+        assert out[0].to_json()["symbol"] == "C.f"
+
+
+class TestFormatsAndChanged:
+    def _tree(self, tmp_path):
+        pkg = tmp_path / "engine"
+        pkg.mkdir(parents=True, exist_ok=True)
+        (pkg / "mod.py").write_text("def f(x):\n    print(x)\n")
+        return tmp_path
+
+    def test_json_rows_carry_severity_symbol_fingerprint(self, tmp_path,
+                                                         capsys):
+        tree = self._tree(tmp_path)
+        assert lint_main([str(tree), "--no-baseline",
+                          "--format", "json"]) == 1
+        data = json.loads(capsys.readouterr().out)
+        row = data["findings"][0]
+        assert row["severity"] == "warning"
+        assert row["symbol"] == "f"
+        assert len(row["fingerprint"]) == 16
+        int(row["fingerprint"], 16)  # hex
+
+    def test_sarif_minimal_shape(self, tmp_path, capsys):
+        tree = self._tree(tmp_path)
+        assert lint_main([str(tree), "--no-baseline",
+                          "--format", "sarif"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+        assert ids == sorted(ids)
+        assert {"RBK000", "RBK001", "RBK006", "RBK007", "RBK008",
+                "RBK009", "RBK010"} <= set(ids)
+        res = run["results"][0]
+        assert res["ruleId"] == "RBK006"
+        assert res["level"] == "warning"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("engine/mod.py")
+        assert loc["region"]["startLine"] >= 1
+        assert res["partialFingerprints"]["runbookLint/v1"]
+
+    def test_changed_filters_to_git_modified_files(self, tmp_path,
+                                                   capsys, monkeypatch):
+        import subprocess
+
+        def git(*args):
+            r = subprocess.run(["git", *args], cwd=tmp_path,
+                               capture_output=True, text=True)
+            assert r.returncode == 0, r.stderr
+            return r
+
+        tree = self._tree(tmp_path)
+        monkeypatch.chdir(tmp_path)
+        git("init", "-q")
+        git("-c", "user.email=t@t", "-c", "user.name=t",
+            "add", ".")
+        git("-c", "user.email=t@t", "-c", "user.name=t",
+            "commit", "-qm", "seed")
+        # Clean work tree: the committed violation is NOT reported.
+        assert lint_main(["engine", "--no-baseline", "--changed"]) == 0
+        capsys.readouterr()
+        # A new violating file IS reported; the committed one stays out.
+        (tmp_path / "engine" / "new.py").write_text(
+            "def g(x):\n    print(x)\n")
+        assert lint_main(["engine", "--no-baseline", "--changed"]) == 1
+        out = capsys.readouterr().out
+        assert "new.py" in out and "mod.py" not in out
+
+    def test_changed_outside_git_is_usage_error(self, tmp_path, capsys,
+                                                monkeypatch):
+        import unittest.mock as mock
+
+        tree = self._tree(tmp_path)
+        monkeypatch.chdir(tmp_path)
+        with mock.patch("runbookai_tpu.analysis.cli._git_changed_paths",
+                        return_value=None):
+            assert lint_main(["engine", "--no-baseline", "--changed"]) == 2
+        assert "git" in capsys.readouterr().out
+
+    def test_changed_sees_files_in_untracked_directories(self, tmp_path,
+                                                         capsys,
+                                                         monkeypatch):
+        # `git status --porcelain` collapses a new directory to one
+        # "?? newpkg/" line; without -uall the files inside would slip
+        # past the .py filter — the exact new-package pre-commit case.
+        import subprocess
+
+        def git(*args):
+            r = subprocess.run(["git", *args], cwd=tmp_path,
+                               capture_output=True, text=True)
+            assert r.returncode == 0, r.stderr
+            return r
+
+        self._tree(tmp_path)
+        monkeypatch.chdir(tmp_path)
+        git("init", "-q")
+        git("-c", "user.email=t@t", "-c", "user.name=t", "add", ".")
+        git("-c", "user.email=t@t", "-c", "user.name=t",
+            "commit", "-qm", "seed")
+        newpkg = tmp_path / "newpkg" / "engine"
+        newpkg.mkdir(parents=True)
+        (newpkg / "mod.py").write_text("def h(x):\n    print(x)\n")
+        assert lint_main(["newpkg", "--no-baseline", "--changed"]) == 1
+        assert "newpkg/engine/mod.py" in capsys.readouterr().out
+
+
+class TestReviewRegressions:
+    """Pins for the scanner/driver defects the PR-13 review pass found."""
+
+    def test_lambda_body_is_not_the_enclosing_context(self, tmp_path):
+        # `to_thread(lambda: time.sleep(...))` is RBK009's own recommended
+        # remediation — the lambda runs on a worker thread, not the loop.
+        out = lint_tree(tmp_path, {"pkg/server/s.py": """
+            import asyncio
+            import time
+
+            async def handler():
+                await asyncio.to_thread(lambda: time.sleep(1.0))
+        """})
+        assert out == []
+
+    def test_relative_import_in_package_init_resolves(self, tmp_path):
+        # `from .b import helper` inside pkg/__init__.py anchors at pkg
+        # itself (the __init__ IS its package) — a dropped component here
+        # silently unlinked every call edge through a package __init__.
+        out = lint_tree(tmp_path, {
+            "pkg/__init__.py": """
+                import jax
+                from .b import helper
+
+                @jax.jit
+                def f(x):
+                    return helper(x)
+            """,
+            "pkg/b.py": """
+                def helper(v):
+                    if v > 0:
+                        return v
+                    return -v
+            """})
+        assert [(f.rule, f.path) for f in out] == [("RBK001", "pkg/b.py")]
+
+    def test_module_level_label_site_is_checked(self, tmp_path):
+        out = lint_tree(tmp_path, {"pkg/obs/m.py": """
+            import os
+
+            _M = REG.counter("runbook_x_total", "h", labels=("k",))
+            _M.labels(k=os.environ["USER"]).inc()
+            _M.labels(k="const").inc()
+        """})
+        assert [(f.rule, f.symbol) for f in out] == \
+            [("RBK010", "<module>")]
+
+    def test_absolute_path_invocation_still_links_cross_module(
+            self, tmp_path, capsys, monkeypatch):
+        # Module names come from the on-disk package root, not the display
+        # path: an absolute-path --no-baseline run from a foreign cwd must
+        # resolve the same import graph as an in-repo run — degrading to
+        # per-file analysis would print "clean" on code it never linked.
+        write_tree(tmp_path, {
+            "pkg/a.py": TestCrossModuleRBK001.A,
+            "pkg/b.py": TestCrossModuleRBK001.B})
+        monkeypatch.chdir("/")
+        assert lint_main([str(tmp_path), "--no-baseline"]) == 1
+        assert "RBK001" in capsys.readouterr().out
+
+
 # ---------------------------------------------------------------- integration
 
 
@@ -747,52 +1519,85 @@ class TestTreeIsClean:
             ROOT / "runbookai_tpu" / "engine" / "fleet.py")
         assert fleet == {}, fleet
 
-    def test_fleet_package_has_zero_noqa_sites(self):
-        """The multi-model fleet is pure host-side control code like the
-        scheduler: group resolution, config derivation, metric rollups.
-        Engine construction happens through the same builders the
-        single-model path uses (whose sanctioned syncs are pinned
-        above), so ZERO `runbook: noqa` markers here — a suppression
-        appearing means routing/built code started syncing devices or
-        blocking under locks."""
-        fleet_files = sorted(
-            (ROOT / "runbookai_tpu" / "fleet").glob("*.py"))
-        assert fleet_files, "fleet package missing"
-        for path in fleet_files:
-            assert "runbook: noqa" not in path.read_text(), (
-                f"unexpected noqa marker in {path}")
-        findings = analyze_paths([ROOT / "runbookai_tpu" / "fleet"],
-                                 root=ROOT)
+    @staticmethod
+    def _noqa_sites(path, rule):
+        """Map each noqa[RULE] annotation to its (nearest) enclosing def."""
+        import re
+
+        sites: dict = {}
+        fn = None
+        for line in path.read_text().splitlines():
+            m = re.match(r"\s*(?:async )?def (\w+)", line)
+            if m:
+                fn = m.group(1)
+            if f"noqa[{rule}]" in line:
+                sites[fn] = sites.get(fn, 0) + 1
+        return sites
+
+    def _package_noqa_is_rbk010_only(self, pkg):
+        """Control-path packages sanction NOTHING except the RBK010
+        label-identity sites pinned below: a noqa for any other rule
+        appearing means control code started doing data-path work
+        (device syncs, blocking under locks)."""
+        import re
+
+        files = sorted((ROOT / "runbookai_tpu" / pkg).glob("*.py"))
+        assert files, f"{pkg} package missing"
+        for path in files:
+            for m in re.finditer(r"noqa\[([A-Z0-9]+)\]", path.read_text()):
+                assert m.group(1) == "RBK010", (
+                    f"unexpected noqa[{m.group(1)}] in {path}")
+        findings = analyze_paths([ROOT / "runbookai_tpu" / pkg], root=ROOT)
         assert findings == [], "\n".join(f.format() for f in findings)
 
-    def test_obs_package_has_zero_noqa_sites(self):
-        """The workload-fingerprinting layer is pure host-side
-        observation: deque appends on the finish path, scrape-time
-        folds, JSON history. ZERO `runbook: noqa` markers — a
-        suppression appearing here means observation started syncing
-        devices or blocking under locks, which would put a read-only
-        layer on the serving critical path."""
-        obs_files = sorted(
-            (ROOT / "runbookai_tpu" / "obs").glob("*.py"))
-        assert obs_files, "obs package missing"
-        for path in obs_files:
-            assert "runbook: noqa" not in path.read_text(), (
-                f"unexpected noqa marker in {path}")
-        findings = analyze_paths([ROOT / "runbookai_tpu" / "obs"],
-                                 root=ROOT)
-        assert findings == [], "\n".join(f.format() for f in findings)
+    def test_fleet_package_noqa_is_rbk010_only(self):
+        self._package_noqa_is_rbk010_only("fleet")
 
-    def test_sched_package_has_zero_noqa_sites(self):
-        """The scheduler/admission subsystem is pure host-side control
-        code: no device syncs, no blocking I/O under locks, nothing to
-        sanction. ZERO `runbook: noqa` markers — a suppression appearing
-        here means control-path code started doing data-path work."""
-        sched_files = sorted(
-            (ROOT / "runbookai_tpu" / "sched").glob("*.py"))
-        assert sched_files, "sched package missing"
-        for path in sched_files:
-            assert "runbook: noqa" not in path.read_text(), (
-                f"unexpected noqa marker in {path}")
-        findings = analyze_paths([ROOT / "runbookai_tpu" / "sched"],
-                                 root=ROOT)
-        assert findings == [], "\n".join(f.format() for f in findings)
+    def test_obs_package_noqa_is_rbk010_only(self):
+        self._package_noqa_is_rbk010_only("obs")
+
+    def test_sched_package_noqa_is_rbk010_only(self):
+        self._package_noqa_is_rbk010_only("sched")
+
+    def test_rbk010_inventory_pinned(self):
+        """Every RBK010 suppression documents a label whose value set is
+        bounded at RUNTIME by config or registration (group names, replica
+        ids, tenant policies, SLO objectives, registered tools) — the
+        static analyzer cannot see that, so the noqa + reason IS the
+        pinned allowlist. A new annotation anywhere else means a metric
+        label started following request-derived values; fix the label
+        (membership-guarded fallback, `class_label` idiom) instead of
+        widening this pin."""
+        expected = {
+            "engine/fleet.py": {"_route": 2, "_disagg_warm": 1,
+                                "_install_metrics": 9},
+            "fleet/multimodel.py": {"_install_metrics": 1},
+            # Attribution is nearest-preceding-def: monitor's sites sit
+            # after the nested fp_value/drift_or_raise helpers.
+            "obs/monitor.py": {"fp_value": 1, "drift_or_raise": 3},
+            "sched/feedback.py": {"on_step": 1},
+            "sched/tenants.py": {"__init__": 2, "admit": 2,
+                                 "_throttle_metrics": 1, "settle": 1},
+            "utils/slo.py": {"__init__": 4, "_burn_or_raise": 1,
+                             "evaluate": 1},
+            "agent/agent.py": {"_execute_calls": 1},
+            "agent/parallel_executor.py": {"_execute_one": 4},
+            # The server's status label is FIXED in code (allowlist +
+            # "other" fallback), not suppressed.
+            "server/openai_api.py": {},
+        }
+        for rel, sites in expected.items():
+            got = self._noqa_sites(ROOT / "runbookai_tpu" / rel, "RBK010")
+            assert got == sites, (rel, got)
+
+    def test_rbk010_annotations_carry_reasons(self):
+        """Every RBK010 suppression says WHY the set is bounded."""
+        for rel in ("engine/fleet.py", "fleet/multimodel.py",
+                    "obs/monitor.py", "sched/feedback.py",
+                    "sched/tenants.py", "utils/slo.py", "agent/agent.py",
+                    "agent/parallel_executor.py"):
+            src = (ROOT / "runbookai_tpu" / rel).read_text()
+            for line in src.splitlines():
+                if "noqa[RBK010]" in line:
+                    comment = line.split("#", 1)[1]
+                    assert "—" in comment, (rel, line)
