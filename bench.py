@@ -73,7 +73,16 @@ carries the per-phase drift scores and whether the stale threshold was
 crossed, with digests byte-identical to a BENCH_OBS=0 run),
 BENCH_SOAK (`--soak [SECONDS]`: time-bounded closed-loop mixed traffic;
 compose with `--models A,B` to soak a two-group multi-model fleet —
-gates on zero lost requests and banks per-group fingerprints).
+gates on zero lost requests and banks per-group fingerprints),
+BENCH_SOAK_SCENARIOS (`--soak-scenarios [SECONDS]`: the chaos soak gate
+— the seeded scenario mix (simulate/traffic.py) through a dp>=2 fleet
+with fault injection + replica supervision, run twice (chaos-free
+baseline, then chaos) and gated on production invariants: zero lost
+requests outside fault windows, interactive p95 TTFT bound, tenant
+fairness, RSS/fd bounds, per-chain digest determinism, supervisor
+recovery — docs/robustness.md; knobs: BENCH_CHAOS=0 disables faults,
+BENCH_CHAOS_SEED, BENCH_SOAK_DP, BENCH_SOAK_RATE,
+BENCH_SOAK_TTFT_P95_MS, BENCH_WEDGE_TIMEOUT_S).
 Every artifact's `details.engine_config` records the core's fully
 resolved EngineConfig (post probe-gating), flags or no flags; every
 measured window also carries `details.flight_summary` (step-level
@@ -485,15 +494,34 @@ def run_bench(model_name: str, on_accel: bool, probe: dict) -> None:
 
     models_env = os.environ.get("BENCH_MODELS")
     soak_env = os.environ.get("BENCH_SOAK")
+    scenarios_env = os.environ.get("BENCH_SOAK_SCENARIOS")
     if os.environ.get("BENCH_SHIFT") and (
-            soak_env or models_env or os.environ.get("BENCH_CLASSES")):
+            soak_env or scenarios_env or models_env
+            or os.environ.get("BENCH_CLASSES")):
         # The soak/models/classes branches run first and would otherwise
         # silently win — the operator must never believe they measured
         # the traffic-shift scenario when a different arm was banked.
         raise ValueError(
             "BENCH_SHIFT measures the single-engine traffic-shift arm "
-            "and does not compose with --soak/--models/--classes (run "
-            "them as separate arms)")
+            "and does not compose with --soak/--soak-scenarios/--models/"
+            "--classes (run them as separate arms)")
+    if scenarios_env:
+        # Chaos soak gate (`--soak-scenarios [S]`): the seeded scenario
+        # mix through the full composed stack, chaos on, gated on
+        # production invariants (docs/robustness.md). Composes with
+        # --models like --soak; refuses the same arms --soak refuses,
+        # plus --soak itself (one soak spelling per run).
+        if plan is not None or os.environ.get("BENCH_DP") \
+                or os.environ.get("BENCH_CLASSES") or soak_env:
+            raise ValueError(
+                "BENCH_SOAK_SCENARIOS measures the chaos soak gate and "
+                "does not compose with --plan/--dp/--classes/--soak "
+                "(run them as separate arms)")
+        run_soak_scenarios_bench(
+            float(scenarios_env), models_env, model_name, probe,
+            prompt_len=prompt_len, new_tokens=new_tokens,
+            on_accel=on_accel)
+        return
     if soak_env:
         # Soak arm (`--soak [S]`): time-bounded mixed traffic through a
         # live fleet — optionally a TWO-GROUP fleet via `--models A,B`
@@ -1519,6 +1547,441 @@ def run_soak_bench(duration_s: float, models_spec: str | None,
          details)
 
 
+def _soak_scenarios_pass(fleet, mix, *, chaos_schedule=None,
+                         supervisor_kw=None, duration_s=0.0):
+    """Drive one scenario-mix pass through a live MultiModelFleet.
+
+    Open-loop arrivals: each chain sleeps to its scheduled offset, then
+    runs its turns causally (an agentic chain's turn carries the
+    previous turns' context). With ``chaos_schedule`` set, a
+    FleetSupervisor attaches to every group fleet and a ChaosInjector
+    walks the schedule against the FIRST group (the dp the schedule was
+    generated for); the pass returns per-chain records plus the
+    supervisor/chaos snapshots the invariant gate is computed from."""
+    import asyncio
+    import random as _random
+    import time as _time
+
+    from runbookai_tpu.chaos import ChaosInjector, FleetSupervisor
+    from runbookai_tpu.engine.request import (
+        FinishReason,
+        FleetSaturated,
+        SamplingParams,
+    )
+    from runbookai_tpu.sched import PRIORITY_BATCH, PRIORITY_INTERACTIVE
+
+    model_groups = list(fleet.groups.values())
+    supervisors = []
+    injector = None
+    records: dict[str, dict] = {}
+
+    async def run_turn(chain, turn, prompt, rec):
+        sampling = SamplingParams(
+            temperature=chain.temperature,
+            max_new_tokens=turn.max_new_tokens, stop_token_ids=(),
+            seed=(chain.seed if chain.temperature > 0 else None))
+        priority = (PRIORITY_BATCH if chain.priority == "batch"
+                    else PRIORITY_INTERACTIVE)
+        t0 = _time.monotonic() - rec["_t_origin"]
+        toks: list[int] = []
+        ttft_ms = None
+        aborted = False
+        if turn.stream:
+            sink: list = []
+            try:
+                t_start = _time.perf_counter()
+                agen = fleet.generate_stream(
+                    prompt, sampling, priority=priority,
+                    model=chain.model, request_sink=sink,
+                    request_id=chain.chain_id)
+                async for tok in agen:
+                    if ttft_ms is None:
+                        ttft_ms = (_time.perf_counter() - t_start) * 1e3
+                    toks.append(tok)
+            except FleetSaturated:
+                aborted = True
+            req = sink[-1] if sink else None
+            if req is not None and req.finish_reason is FinishReason.ABORTED:
+                aborted = True
+        else:
+            out = await fleet.generate(
+                prompt, sampling, priority=priority, model=chain.model,
+                request_id=chain.chain_id)
+            toks = list(out.token_ids)
+            ttft_ms = out.ttft_ms
+            aborted = out.finish_reason is FinishReason.ABORTED
+        rec["turns"].append({
+            "t_start_s": round(t0, 4),
+            "t_end_s": round(_time.monotonic() - rec["_t_origin"], 4),
+            "ttft_ms": (round(ttft_ms, 3) if ttft_ms is not None
+                        else None),
+            "tokens": len(toks),
+            "aborted": aborted,
+        })
+        return toks, aborted
+
+    async def run_chain(chain, t_origin):
+        rec = {"cls": chain.cls, "tenant": chain.tenant,
+               "model": chain.model, "interactive":
+               chain.priority == "interactive",
+               "turns": [], "aborted": False, "_t_origin": t_origin,
+               "streams": []}
+        records[chain.chain_id] = rec
+        await asyncio.sleep(max(0.0, chain.at_s
+                                - (_time.monotonic() - t_origin)))
+        context: list[int] = []
+        for turn in chain.turns:
+            if turn.gap_s:
+                await asyncio.sleep(turn.gap_s)
+            prompt = (context + list(turn.prompt_ids)
+                      if chain.carry_context else list(turn.prompt_ids))
+            # Keep causal chains inside the engine's sequence budget.
+            max_prompt = 2048 - turn.max_new_tokens - 16
+            prompt = prompt[-max_prompt:]
+            toks, aborted = await run_turn(chain, turn, prompt, rec)
+            rec["streams"].append(toks)
+            if aborted:
+                rec["aborted"] = True
+                break  # a dead turn kills the causal chain
+            context = prompt + toks
+        rec["t_start_s"] = rec["turns"][0]["t_start_s"] if rec["turns"] \
+            else chain.at_s
+        rec["t_end_s"] = rec["turns"][-1]["t_end_s"] if rec["turns"] \
+            else chain.at_s
+        rec["digest"] = token_streams_digest(rec.pop("streams"))
+        rec.pop("_t_origin")
+
+    async def _run():
+        nonlocal injector
+        loop = asyncio.get_running_loop()
+        t_origin = _time.monotonic()
+        wall_origin = _time.time()
+        if chaos_schedule is not None:
+            for g in model_groups:
+                sup = FleetSupervisor(g.fleet, **(supervisor_kw or {}))
+                sup.start()
+                supervisors.append(sup)
+
+            def flood_fn(event):
+                # Synthetic tenant-flood burst: fire-and-forget batch
+                # requests through the event loop — chaos traffic, not
+                # gated traffic.
+                rng = _random.Random(event.at_s)
+                sp = SamplingParams(temperature=0.0, max_new_tokens=4,
+                                    stop_token_ids=())
+
+                async def _flood():
+                    await asyncio.gather(*[
+                        fleet.generate(
+                            [rng.randrange(0, 256) for _ in range(24)],
+                            sp, priority=PRIORITY_BATCH,
+                            model=model_groups[0].name)
+                        for _ in range(event.params.get("requests", 4))],
+                        return_exceptions=True)
+
+                asyncio.run_coroutine_threadsafe(_flood(), loop)
+
+            injector = ChaosInjector(model_groups[0].fleet,
+                                     chaos_schedule, flood_fn=flood_fn)
+            injector.start()
+        await asyncio.gather(*[run_chain(c, t_origin)
+                               for c in mix.chains])
+        if injector is not None:
+            # Recovery phase: keep light probe traffic flowing until an
+            # applied crash has been detected AND every replica is back
+            # to healthy (or the budget runs out) — a crash whose hook
+            # fires on the run's last step still gets its full
+            # detect→rebuild→rejoin arc before the supervisors stop.
+            # Probes are chaos plumbing, never gated traffic.
+            deadline = _time.monotonic() + min(
+                15.0, max(3.0, duration_s))
+            probe_sp = SamplingParams(temperature=0.0, max_new_tokens=2,
+                                      stop_token_ids=())
+
+            def needs_recovery() -> bool:
+                crash_applied = any(
+                    w["kind"] == "replica_crash"
+                    and w["status"] == "applied"
+                    for w in injector.snapshot()["windows"])
+                trans = [t for s in supervisors for t in s.transitions]
+                if crash_applied and not any(t["to"] == "failed"
+                                             for t in trans):
+                    return True  # hook or detection still pending
+                return any(s.state_of(i) != "healthy"
+                           for s in supervisors
+                           for i in range(s.fleet.dp))
+
+            while needs_recovery() and _time.monotonic() < deadline:
+                await asyncio.gather(*[
+                    fleet.generate(list(range(65, 81)), probe_sp,
+                                   model=g.name)
+                    for g in model_groups], return_exceptions=True)
+                await asyncio.sleep(0.05)
+            injector.stop()
+        for sup in supervisors:
+            sup.stop()
+        await fleet.stop()
+        return t_origin, wall_origin
+
+    t0 = _time.perf_counter()
+    _t_origin, wall_origin = asyncio.run(_run())
+    wall = _time.perf_counter() - t0
+    return {
+        "records": records,
+        "wall_s": round(wall, 3),
+        "wall_origin": wall_origin,
+        "chaos": injector.snapshot() if injector is not None else None,
+        "supervisors": [s.snapshot() for s in supervisors],
+    }
+
+
+def _soak_effective_windows(passed: dict) -> list[tuple[float, float]]:
+    """Fault windows in run-offset seconds, extended to RECOVERY: a
+    crash/wedge window stays open until the target replica's next
+    rejoin-to-healthy transition (a chain failing between the crash and
+    the rebuild is inside the fault, not a lost request). Every
+    supervisor failure→rejoin arc counts as a window too — a failover
+    the supervisor initiated IS fault handling, injected or not (excess
+    arcs stay visible as details.supervisor.rebuilds_total churn)."""
+    chaos = passed.get("chaos")
+    if not chaos:
+        return []
+    wall_origin = passed["wall_origin"]
+    transitions = [t for s in passed["supervisors"]
+                   for t in s["transitions"]]
+
+    def rejoin_after(replica, start):
+        rejoins = [t["ts"] - wall_origin for t in transitions
+                   if t["replica"] == replica and t["to"] == "healthy"
+                   and t["ts"] - wall_origin >= start]
+        return min(rejoins) if rejoins else float("inf")
+
+    windows = []
+    for w in chaos["windows"]:
+        start, end = w["applied_at_s"], w["ends_at_s"]
+        if w["kind"] in ("replica_crash", "replica_wedge"):
+            end = rejoin_after(w["replica"], start)
+        windows.append((start - 0.1, end + 0.1))
+    for t in transitions:
+        if t["to"] == "failed":
+            start = t["ts"] - wall_origin
+            windows.append((start - 0.1,
+                            rejoin_after(t["replica"], start) + 0.1))
+    return windows
+
+
+def _overlaps(rec: dict, windows) -> bool:
+    s, e = rec.get("t_start_s", 0.0), rec.get("t_end_s", 0.0)
+    return any(s < we and e > ws for ws, we in windows)
+
+
+def run_soak_scenarios_bench(duration_s: float, models_spec: str | None,
+                             model_name: str, probe: dict, *,
+                             prompt_len, new_tokens, on_accel) -> None:
+    """The ``--soak-scenarios [S]`` arm: the production-invariant soak
+    gate (ROADMAP item 5; docs/robustness.md).
+
+    A seeded scenario mix (simulate/traffic.py: short chat, agentic
+    chains, batch floods, shared-prefix sessions, spiky tenants) runs
+    TWICE through identically-built fleets: a chaos-free baseline pass,
+    then a chaos pass with the seeded fault schedule (chaos/inject.py)
+    and a fleet supervisor on every group (chaos/supervisor.py). The
+    gate is production shape, not throughput:
+
+    - zero lost requests outside (recovery-extended) fault windows;
+    - interactive p95 TTFT within ``BENCH_SOAK_TTFT_P95_MS``;
+    - per-tenant completion fairness;
+    - bounded RSS growth and fd delta across the chaos pass;
+    - per-chain digest determinism: every chain completed in both
+      passes outside fault windows is byte-identical to the baseline;
+    - supervisor recovery: an injected crash is detected, failed over,
+      rebuilt and rejoined (the transition record proves it).
+
+    Every verdict lands in ``details["invariants"]`` with its measured
+    figures; the headline stays the chaos pass's decode rate."""
+    import jax
+
+    from runbookai_tpu.chaos import FaultSchedule
+    from runbookai_tpu.engine.flight_recorder import FlightRecorder
+    from runbookai_tpu.models.llama import CONFIGS, init_params
+    from runbookai_tpu.simulate.traffic import generate_traffic
+    from runbookai_tpu.utils.tokens import ByteTokenizer
+
+    dp_default = int(os.environ.get("BENCH_SOAK_DP", 2))
+    groups = (parse_models_spec(models_spec) if models_spec
+              else [(model_name, max(2, dp_default))])
+    ecfg = bench_group_engine_config(on_accel)
+    tok = ByteTokenizer()
+    params = {name: init_params(jax.random.PRNGKey(1000 + gi),
+                                CONFIGS[name], dtype=ecfg.kv_dtype)
+              for gi, (name, _) in enumerate(groups)}
+    names = [name for name, _ in groups]
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", 14))
+    chaos_on = os.environ.get("BENCH_CHAOS", "1") != "0"
+    mix = generate_traffic(
+        seed, duration_s,
+        chains_per_minute=float(os.environ.get("BENCH_SOAK_RATE", 120)),
+        prompt_scale=prompt_len / 128.0,
+        max_new_scale=new_tokens / 64.0,
+        models=(names if len(names) > 1 else None))
+    schedule = (FaultSchedule.generate(
+        seed, duration_s, groups[0][1], ensure_crash=True)
+        if chaos_on else None)
+    supervisor_kw = {
+        "poll_interval_s": 0.02,
+        # The floor must exceed a rebuilt core's first-dispatch compile
+        # (the docs/robustness.md wedge_timeout_s contract) — an
+        # aggressive value fails over replicas that are merely
+        # compiling, and a dp=1 group then flaps rebuild→compile→
+        # false-wedge forever.
+        "wedge_timeout_s": float(os.environ.get(
+            "BENCH_WEDGE_TIMEOUT_S",
+            max(3.0, min(8.0, duration_s * 0.1)))),
+        "rejoin_hysteresis_s": min(0.5, max(0.05, duration_s * 0.02)),
+    }
+
+    def build():
+        return build_bench_model_groups(
+            groups, params, tok, ecfg, warm_prompt_len=prompt_len,
+            warm_new_tokens=new_tokens, warm_seed=20_011)
+
+    import resource
+
+    # Baseline pass: same mix, no chaos — the digest reference.
+    baseline = _soak_scenarios_pass(build(), mix, duration_s=duration_s)
+
+    fd_dir = "/proc/self/fd"
+    fds_before = (len(os.listdir(fd_dir)) if os.path.isdir(fd_dir)
+                  else None)
+    rss_before_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+    fleet = build()
+    chaotic = _soak_scenarios_pass(
+        fleet, mix, chaos_schedule=schedule,
+        supervisor_kw=supervisor_kw, duration_s=duration_s)
+    # Read AFTER the pass: a rebuild swapped the crashed replica's core,
+    # and the throughput/flight summaries must cover the live fleet.
+    all_cores = fleet.cores
+
+    rss_after_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    fds_after = (len(os.listdir(fd_dir)) if os.path.isdir(fd_dir)
+                 else None)
+
+    windows = _soak_effective_windows(chaotic)
+    recs = chaotic["records"]
+    base_recs = baseline["records"]
+    lost = [cid for cid, r in recs.items() if r["aborted"]]
+    lost_outside = [cid for cid in lost
+                    if not _overlaps(recs[cid], windows)]
+    ttfts = sorted(
+        t["ttft_ms"] for r in recs.values() if r["interactive"]
+        for t in r["turns"] if t["ttft_ms"] is not None)
+    p95_ttft = (ttfts[min(len(ttfts) - 1,
+                          int(0.95 * len(ttfts)))] if ttfts else None)
+    ttft_bound = float(os.environ.get("BENCH_SOAK_TTFT_P95_MS", 30_000))
+    per_tenant: dict[str, dict] = {}
+    for r in recs.values():
+        t = per_tenant.setdefault(r["tenant"],
+                                  {"chains": 0, "completed": 0})
+        t["chains"] += 1
+        t["completed"] += 0 if r["aborted"] else 1
+    fairness_floor = float(os.environ.get("BENCH_SOAK_FAIRNESS", 0.5))
+    fairness_min = min((t["completed"] / t["chains"]
+                        for t in per_tenant.values()), default=1.0)
+    mismatched = [
+        cid for cid, r in recs.items()
+        if not r["aborted"] and not _overlaps(r, windows)
+        and cid in base_recs and not base_recs[cid]["aborted"]
+        and r["digest"] != base_recs[cid]["digest"]]
+    rss_growth_mb = (rss_after_kb - rss_before_kb) / 1024.0
+    rss_bound_mb = float(os.environ.get("BENCH_SOAK_RSS_MB", 8192))
+    fd_delta = (fds_after - fds_before
+                if fds_before is not None and fds_after is not None
+                else None)
+    crash_applied = bool(chaotic["chaos"]) and any(
+        w["kind"] == "replica_crash" and w["status"] == "applied"
+        for w in chaotic["chaos"]["windows"])
+    transitions = [t for s in chaotic["supervisors"]
+                   for t in s["transitions"]]
+    recovered = (not crash_applied) or all(
+        any(t["replica"] == w["replica"] and t["to"] == state
+            for t in transitions)
+        for w in chaotic["chaos"]["windows"]
+        if w["kind"] == "replica_crash" and w["status"] == "applied"
+        for state in ("failed", "rebuilding", "rejoining", "healthy"))
+    invariants = {
+        "zero_lost_outside_fault_windows": {
+            "passed": not lost_outside,
+            "lost_total": len(lost),
+            "lost_outside_windows": lost_outside},
+        "interactive_ttft_p95": {
+            "passed": p95_ttft is None or p95_ttft <= ttft_bound,
+            "p95_ms": (round(p95_ttft, 2) if p95_ttft is not None
+                       else None),
+            "bound_ms": ttft_bound},
+        "tenant_fairness": {
+            "passed": fairness_min >= fairness_floor,
+            "min_completion_ratio": round(fairness_min, 4),
+            "floor": fairness_floor,
+            "per_tenant": per_tenant},
+        "rss_bound": {
+            "passed": rss_growth_mb <= rss_bound_mb,
+            "growth_mb": round(rss_growth_mb, 1),
+            "bound_mb": rss_bound_mb},
+        "fd_bound": {
+            "passed": fd_delta is None or fd_delta <= 64,
+            "delta": fd_delta},
+        "digest_determinism": {
+            "passed": not mismatched,
+            "compared": sum(
+                1 for cid, r in recs.items()
+                if not r["aborted"] and not _overlaps(r, windows)
+                and cid in base_recs and not base_recs[cid]["aborted"]),
+            "mismatched": mismatched},
+        "supervisor_recovered": {
+            "passed": recovered,
+            "crash_applied": crash_applied},
+    }
+    total_decode = sum(c.metrics["decode_tokens"] for c in all_cores)
+    max_decode_t = max(c.metrics["decode_time_s"] for c in all_cores)
+    from runbookai_tpu.autotune.plan import engine_config_dict
+
+    details = {
+        "arm": "soak_scenarios",
+        "engine_config": engine_config_dict(all_cores[0].ecfg),
+        "models": names,
+        "multi_model": len(names) > 1,
+        "dp": fleet.dp,
+        "duration_s": duration_s,
+        "wall_s": chaotic["wall_s"],
+        "baseline_wall_s": baseline["wall_s"],
+        "platform": probe.get("platform"),
+        "device_kind": probe.get("kind"),
+        "chaos_enabled": chaos_on,
+        "chaos_seed": seed,
+        "chains": len(recs),
+        "turns": sum(len(r["turns"]) for r in recs.values()),
+        "classes": mix.by_class(),
+        "fault_windows": [[round(s, 3),
+                           (round(e, 3) if e != float("inf") else None)]
+                          for s, e in windows],
+        "invariants": invariants,
+        "invariants_passed": all(v["passed"]
+                                 for v in invariants.values()),
+        "chaos": chaotic["chaos"],
+        "supervisor": ({"rebuilds_total": sum(
+            s["rebuilds_total"] for s in chaotic["supervisors"]),
+            "failovers_total": sum(
+                s["failovers_total"] for s in chaotic["supervisors"]),
+            "transitions": transitions}
+            if chaotic["supervisors"] else None),
+        "flight_summary": FlightRecorder.merge_summaries(
+            [c.flight.summary() for c in all_cores]),
+    }
+    emit(round(total_decode / max(max_decode_t, 1e-9), 2), "tok/s",
+         details)
+
+
 def run_fleet_bench(cfg, params, tok, ecfg, masker, dp, probe, *,
                     n_requests, prompt_len, new_tokens, make_prompt,
                     outputs_digest, on_accel, quantized, weights_path,
@@ -1919,6 +2382,18 @@ def main() -> None:
         # BENCH_OBS=0 run (runbookai_tpu/obs).
         sys.argv.remove("--shift")
         os.environ["BENCH_SHIFT"] = "1"
+    if "--soak-scenarios" in sys.argv:
+        # Chaos soak gate: `--soak-scenarios [SECONDS]` (default 30) of
+        # the seeded scenario mix with fault injection + supervision,
+        # gated on production invariants (docs/robustness.md). Compose
+        # with `--models A,B`; BENCH_CHAOS=0 runs the mix chaos-free.
+        i = sys.argv.index("--soak-scenarios")
+        sys.argv.pop(i)
+        if i < len(sys.argv) and not sys.argv[i].startswith("-") \
+                and sys.argv[i].replace(".", "", 1).isdigit():
+            os.environ["BENCH_SOAK_SCENARIOS"] = sys.argv.pop(i)
+        else:
+            os.environ["BENCH_SOAK_SCENARIOS"] = "30"
     if "--soak" in sys.argv:
         # Soak arm: `--soak [SECONDS]` (default 30) of closed-loop mixed
         # traffic; compose with `--models A,B` for a two-group fleet.
@@ -1973,7 +2448,7 @@ def main() -> None:
     # The sanity line is the round-over-round single-engine series; a --dp
     # or --plan run must not perturb it (env restored right after).
     arm_vars = ("BENCH_DP", "BENCH_PLAN", "BENCH_CLASSES", "BENCH_MODELS",
-                "BENCH_SOAK", "BENCH_SHIFT")
+                "BENCH_SOAK", "BENCH_SOAK_SCENARIOS", "BENCH_SHIFT")
     saved_arms = {var: os.environ.pop(var, None) for var in arm_vars}
     try:
         cpu_sanity = _spawn_inner(
@@ -2014,6 +2489,7 @@ def main() -> None:
             "BENCH_CLASSES" not in os.environ and \
             "BENCH_MODELS" not in os.environ and \
             "BENCH_SOAK" not in os.environ and \
+            "BENCH_SOAK_SCENARIOS" not in os.environ and \
             "BENCH_SHIFT" not in os.environ and \
             os.environ.get("BENCH_CPU_MODEL", "llama3-test") == model_name:
         # The fallback headline IS the cpu-sanity config — don't run it

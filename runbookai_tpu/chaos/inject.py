@@ -1,0 +1,382 @@
+"""Deterministic, seeded fault injection against a live engine fleet.
+
+The serving platform now composes multi-model fleets, disaggregated
+tiers, fleet-wide KV sharing, SLO scheduling and live workload
+observation — but nothing ever *broke* it on purpose. This module is the
+breaking half of the chaos story (AIBrix makes fault-tolerant replica
+management a first-class serving-infrastructure concern; FlashInfer-
+Bench's repeatable-harness discipline is why the schedule is seeded):
+
+- :class:`FaultSchedule` is a pure function of ``(seed, duration, dp,
+  kinds)``: the same seed produces a byte-identical schedule JSON
+  (pinned by ``tests/test_chaos.py``), so a chaos soak is re-runnable
+  evidence, not a flake generator.
+- :class:`ChaosInjector` walks a schedule against a live
+  :class:`~runbookai_tpu.engine.fleet.AsyncFleet`, applying each fault
+  through documented seams — the ``EngineCore.chaos_hook`` step seam
+  (crash / wedge / spill pressure run under the engine lock, before any
+  pool mutation), the ``AsyncFleet.chaos_pull_hook`` page-transfer seam
+  (d2d delay / corruption on the in-transit payload), and a caller-
+  supplied flood handler — and records every applied window with
+  provenance (``/healthz`` ``chaos`` block, ``runbook chaos status``).
+
+Fault model (docs/robustness.md):
+
+``replica_crash``
+    The replica's next step raises: the AsyncEngine loop fails its live
+    requests and dies — the supervisor's crash signal. One-shot.
+``replica_wedge``
+    The replica's step thread stalls inside step() (under the engine
+    lock) for the window: heartbeats stop advancing while work queues —
+    the supervisor's wedge signal.
+``kv_pull_delay`` / ``kv_pull_corrupt``
+    Cross-replica page pulls slow down in transit / arrive with a
+    flipped byte. Corruption MUST be rejected by the import digest check
+    and degrade to recompute (``runbook_router_xreplica_stale_total``
+    ``{reason="digest_mismatch"}``) — the payload never installs.
+``spill_pressure``
+    The host-RAM spill tier collapses (entries evicted, capacity zero)
+    for the window, then recovers: readmit paths must degrade to
+    recompute, never corrupt.
+``tenant_flood``
+    A burst of synthetic tenant traffic, submitted by the driver's
+    registered flood handler (the injector itself never owns an event
+    loop).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from runbookai_tpu.utils import metrics as metrics_mod
+
+# The closed fault vocabulary. Metric children are pre-created over this
+# tuple (bounded label contract, RBK010) and the schedule generator
+# validates requested kinds against it.
+FAULT_KINDS = ("replica_crash", "replica_wedge", "kv_pull_delay",
+               "kv_pull_corrupt", "spill_pressure", "tenant_flood")
+
+# Fault kinds that target one replica (the others act fleet-wide).
+_REPLICA_KINDS = ("replica_crash", "replica_wedge", "spill_pressure")
+
+
+class ChaosReplicaCrash(RuntimeError):
+    """The injected step failure — distinguishable in logs from a real
+    device error, identical in effect (the engine loop's crash path)."""
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault: what, when (offset seconds from injector
+    start), for how long, and against which replica (fleet-local
+    position; ``None`` for fleet-wide kinds)."""
+
+    kind: str
+    at_s: float
+    duration_s: float
+    replica: Optional[int] = None
+    params: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "at_s": self.at_s,
+                "duration_s": self.duration_s, "replica": self.replica,
+                "params": dict(sorted(self.params.items()))}
+
+
+@dataclass
+class FaultSchedule:
+    """A deterministic fault plan: same ``(seed, duration_s, dp, kinds,
+    events_per_minute)`` ⇒ byte-identical :meth:`to_json` output."""
+
+    seed: int
+    duration_s: float
+    dp: int
+    events: list[FaultEvent]
+
+    def to_json(self) -> str:
+        doc = {"seed": self.seed, "duration_s": self.duration_s,
+               "dp": self.dp,
+               "events": [e.to_dict() for e in self.events]}
+        return json.dumps(doc, indent=2, sort_keys=True)
+
+    @classmethod
+    def generate(cls, seed: int, duration_s: float, dp: int,
+                 kinds: tuple = FAULT_KINDS,
+                 events_per_minute: float = 12.0,
+                 ensure_crash: bool = False) -> "FaultSchedule":
+        """Sample a schedule from ``random.Random(seed)``.
+
+        Event times land in the middle 80% of the run (a fault in the
+        first instant would race fleet warmup; one in the final instant
+        would outlive the measurement). Durations are bounded so every
+        window closes inside the run. ``ensure_crash`` rewrites the
+        first event into a ``replica_crash`` when none was sampled —
+        the soak gate's acceptance scenario requires one."""
+        unknown = set(kinds) - set(FAULT_KINDS)
+        if unknown:
+            raise ValueError(f"unknown fault kinds {sorted(unknown)}; "
+                             f"valid: {FAULT_KINDS}")
+        if not kinds:
+            raise ValueError("at least one fault kind is required")
+        rng = random.Random(seed)
+        n = max(1, int(duration_s * events_per_minute / 60.0))
+        events: list[FaultEvent] = []
+        for _ in range(n):
+            kind = kinds[rng.randrange(len(kinds))]
+            at = round(duration_s * (0.1 + 0.8 * rng.random()), 3)
+            max_dur = max(0.05, min(duration_s * 0.25,
+                                    duration_s - at, 10.0))
+            duration = (0.0 if kind == "replica_crash"
+                        else round(max_dur * (0.3 + 0.7 * rng.random()),
+                                   3))
+            replica = (rng.randrange(max(1, dp))
+                       if kind in _REPLICA_KINDS else None)
+            params: dict = {}
+            if kind == "kv_pull_delay":
+                params["delay_ms"] = rng.choice((10, 25, 50, 100))
+            elif kind == "tenant_flood":
+                params["requests"] = rng.choice((4, 8, 16))
+                params["tenant"] = "spiky"
+            events.append(FaultEvent(kind=kind, at_s=at,
+                                     duration_s=duration,
+                                     replica=replica, params=params))
+        if ensure_crash and not any(e.kind == "replica_crash"
+                                    for e in events):
+            # The acceptance scenario's crash lands MID-run (35% in):
+            # traffic is still flowing when the step thread dies, and
+            # the tail of the run exercises detect→rebuild→rejoin.
+            events.append(FaultEvent(
+                kind="replica_crash", at_s=round(0.35 * duration_s, 3),
+                duration_s=0.0, replica=rng.randrange(max(1, dp))))
+        events.sort(key=lambda e: (e.at_s, e.kind))
+        return cls(seed=seed, duration_s=duration_s, dp=dp, events=events)
+
+
+class ChaosInjector:
+    """Apply a :class:`FaultSchedule` to a live fleet, one daemon thread
+    walking the events in time order. Every application is recorded as a
+    window with provenance (planned vs applied offset, wall timestamp,
+    status) and counted on ``runbook_chaos_faults_total{kind}``. The
+    injector attaches itself as ``fleet.chaos`` so ``/healthz`` carries
+    its snapshot."""
+
+    def __init__(self, fleet, schedule: FaultSchedule, *,
+                 flood_fn: Optional[Callable[[FaultEvent], None]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None):
+        self.fleet = fleet
+        self.schedule = schedule
+        self.flood_fn = flood_fn
+        self._clock = clock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t0: Optional[float] = None
+        self._lock = threading.Lock()
+        self.windows: list[dict[str, Any]] = []
+        # Step hooks THIS injector armed, by fleet-local replica index
+        # -> the window record: stop() disarms any that never fired (an
+        # idle replica's crash hook must not detonate minutes after the
+        # chaos run ended) and rewrites their provenance.
+        self._armed: dict[int, dict[str, Any]] = {}
+        reg = registry or metrics_mod.get_registry()
+        counter = reg.counter(
+            "runbook_chaos_faults_total",
+            "Fault events applied by the chaos injector, by kind",
+            labels=("kind",))
+        self._m_faults = {kind: counter.labels(kind=kind)
+                          for kind in FAULT_KINDS}
+        fleet.chaos = self
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "ChaosInjector":
+        self._t0 = self._clock()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="chaos-injector")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        # Deactivate the transfer seam, and disarm any of OUR step
+        # hooks that never fired (fired hooks clear themselves; a
+        # rebuilt core carries none): an armed crash hook on a replica
+        # that stayed idle through the run must not detonate on the
+        # first real request minutes later. The window's provenance is
+        # rewritten so nobody reads an unfired fault as applied.
+        self.fleet.chaos_pull_hook = None
+        for idx, window in self._armed.items():
+            if idx >= len(self.fleet.cores):
+                continue
+            core = self.fleet.cores[idx]
+            hook = core.chaos_hook
+            if hook is not None and getattr(hook, "_chaos_injector",
+                                            None) is self:
+                core.chaos_hook = None
+                with self._lock:
+                    window["status"] = "disarmed (never fired)"
+
+    def _elapsed(self) -> float:
+        return self._clock() - (self._t0 or 0.0)
+
+    def _run(self) -> None:
+        for event in self.schedule.events:
+            while not self._stop.is_set() \
+                    and self._elapsed() < event.at_s:
+                self._stop.wait(min(0.02,
+                                    event.at_s - self._elapsed()))
+            if self._stop.is_set():
+                return
+            self._apply(event)
+
+    # ----------------------------------------------------------- appliers
+
+    def _apply(self, event: FaultEvent) -> None:
+        window = {
+            "kind": event.kind,
+            "replica": (self.fleet.replica_ids[event.replica]
+                        if event.replica is not None else None),
+            "planned_at_s": event.at_s,
+            "applied_at_s": round(self._elapsed(), 4),
+            "duration_s": event.duration_s,
+            "ends_at_s": round(self._elapsed() + event.duration_s, 4),
+            "wall_ts": time.time(),
+            "params": dict(event.params),
+            "status": "applied",
+        }
+        try:
+            getattr(self, f"_apply_{event.kind}")(event, window)
+        except Exception as exc:  # noqa: BLE001 — one bad fault must not
+            # stop the schedule; the window records the failure.
+            window["status"] = f"error: {exc}"
+        with self._lock:
+            self.windows.append(window)
+        if window["status"] == "applied":
+            # Errored faults never count as applied — the counter and
+            # snapshot()["events_applied"] mean what they say.
+            self._m_faults[event.kind].inc()
+
+    def _arm(self, event: FaultEvent, window: dict, hook) -> None:
+        """Install a step hook tagged as ours and remember its window,
+        so stop() can disarm it (and fix the provenance) if it never
+        fires."""
+        hook._chaos_injector = self
+        self._armed[event.replica] = window
+        self.fleet.cores[event.replica].chaos_hook = hook
+
+    def _apply_replica_crash(self, event: FaultEvent,
+                             window: dict) -> None:
+        def crash_hook(c) -> None:
+            # One-shot: the rebuilt (or restarted) engine must serve.
+            c.chaos_hook = None
+            raise ChaosReplicaCrash(
+                f"chaos: injected crash on replica {c.replica_idx}")
+
+        self._arm(event, window, crash_hook)
+
+    def _apply_replica_wedge(self, event: FaultEvent,
+                             window: dict) -> None:
+        end = self._clock() + event.duration_s
+        stop = self._stop
+        clock = self._clock
+
+        def wedge_hook(c) -> None:
+            # Stall the step thread (engine lock held — exactly what a
+            # wedged dispatch looks like) until the window closes.
+            while clock() < end and not stop.is_set():
+                time.sleep(0.01)
+            c.chaos_hook = None
+
+        self._arm(event, window, wedge_hook)
+
+    def _apply_kv_pull_delay(self, event: FaultEvent,
+                             window: dict) -> None:
+        end = self._clock() + event.duration_s
+        delay_s = event.params.get("delay_ms", 25) / 1e3
+        clock = self._clock
+
+        def delay_hook(exported):
+            # Runs in the pull's worker thread (no locks held): only the
+            # pulling request pays the latency.
+            if clock() < end:
+                time.sleep(delay_s)
+            return exported
+
+        self.fleet.chaos_pull_hook = delay_hook
+
+    def _apply_kv_pull_corrupt(self, event: FaultEvent,
+                               window: dict) -> None:
+        end = self._clock() + event.duration_s
+        clock = self._clock
+
+        def corrupt_hook(exported):
+            if clock() < end and exported.leaves_k:
+                # Flip one byte of the first exported page: the import's
+                # per-block digest check must reject it (the pull
+                # degrades to recompute; byte-identity survives).
+                page = np.array(exported.leaves_k[0], copy=True)
+                flat = page.view(np.uint8).reshape(-1)
+                flat[0] ^= 0xFF
+                exported.leaves_k[0] = page
+            return exported
+
+        self.fleet.chaos_pull_hook = corrupt_hook
+
+    def _apply_spill_pressure(self, event: FaultEvent,
+                              window: dict) -> None:
+        end = self._clock() + event.duration_s
+        clock = self._clock
+        state: dict = {}
+
+        def spill_hook(c) -> None:
+            # Runs at step top under the engine lock — the only safe
+            # place to mutate the spill tier from outside the step
+            # thread's own paths.
+            spill = c.kv.spill
+            if spill is None:
+                c.chaos_hook = None
+                return
+            if "saved" not in state:
+                state["saved"] = spill.max_pages
+                spill.evict_all()
+                spill.max_pages = 0
+            if clock() >= end:
+                spill.max_pages = state["saved"]
+                c.chaos_hook = None
+
+        self._arm(event, window, spill_hook)
+
+    def _apply_tenant_flood(self, event: FaultEvent,
+                            window: dict) -> None:
+        if self.flood_fn is None:
+            raise RuntimeError("no flood handler registered")
+        self.flood_fn(event)
+
+    # -------------------------------------------------------- observability
+
+    def snapshot(self) -> dict:
+        """The ``/healthz`` ``chaos`` block: schedule identity, applied
+        windows with provenance, and which are active right now."""
+        now = self._elapsed() if self._t0 is not None else 0.0
+        with self._lock:
+            windows = [dict(w) for w in self.windows]
+        return {
+            "seed": self.schedule.seed,
+            "events_planned": len(self.schedule.events),
+            "events_applied": sum(1 for w in windows
+                                  if w["status"] == "applied"),
+            "elapsed_s": round(now, 3),
+            "active": [w["kind"] for w in windows
+                       if w["status"] == "applied"
+                       and w["applied_at_s"] <= now < w["ends_at_s"]],
+            "windows": windows,
+        }

@@ -1,0 +1,369 @@
+"""Fleet supervision: detect dead/wedged replicas, fail over their
+in-flight requests, rebuild the engine online, rejoin with hysteresis.
+
+A replica that dies or wedges mid-stream used to take its in-flight
+chains down silently: callers hung on done events, the router kept
+placing new requests onto the corpse, and nothing rebuilt it. The
+:class:`FleetSupervisor` closes that loop (AIBrix-style self-healing
+replica management) with a per-replica state machine:
+
+::
+
+    healthy ──(step stalls with work queued)──▶ suspect
+    healthy/suspect ──(loop crashed | stall > 2×timeout)──▶ failed
+    failed ──(quarantine + fail over in-flight)──▶ rebuilding
+    rebuilding ──(AsyncFleet.rebuild_replica)──▶ rejoining
+    rejoining ──(hysteresis elapsed, no relapse)──▶ healthy
+    suspect ──(step advances)──▶ healthy
+
+Detection reads the flight recorder's step cursor as a heartbeat
+(``total_steps`` advancing = alive), ``AsyncEngine.loop_crashed`` as the
+crash signal, and a non-blocking engine-lock probe as the wedge
+corroborator — the same signal ``health_snapshot`` reports as
+``"unresponsive"`` when its lock budget runs out.
+
+Failover: every live request on the failed core is force-finished as
+ABORTED under a bounded lock attempt — the fleet's ``generate`` retry
+loop (bounded exponential backoff, seeded jitter) re-places each one on
+a sibling, and ``generate_stream`` fails over any stream that had not
+yet yielded. Tokens already streamed cannot be unsaid; those streams end
+in the ABORTED state the HTTP layer turns into a clean SSE error event.
+
+Rebuild: ``AsyncFleet.rebuild_replica`` — engine teardown and
+reconstruction on the replica's device slice as a first-class runtime
+operation (the architectural unlock ROADMAP item 2's autoscaler also
+needs). Rejoin hysteresis doubles per consecutive failure (capped), and
+a replica that keeps dying past ``max_consecutive_rebuilds`` stays
+quarantined (state ``failed``) rather than flapping the fleet.
+
+Metric labels stay statically bounded (zero ``noqa`` sites — pinned by
+``tests/test_lint.py``): per-state series are pre-created over the
+:data:`SUPERVISOR_STATES` literal; per-replica detail lives in the
+``/healthz`` ``supervisor`` block, not in label values.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from runbookai_tpu.engine.request import FinishReason, RequestState
+from runbookai_tpu.utils import metrics as metrics_mod
+from runbookai_tpu.utils.trace import get_tracer
+
+SUPERVISOR_STATES = ("healthy", "suspect", "failed", "rebuilding",
+                     "rejoining")
+
+# Bounded transition history surfaced by snapshot() (the timeline a
+# `runbook chaos status` renders; old entries age out).
+_TRANSITIONS_MAX = 256
+
+# Every live supervisor in the process: the runbook_supervisor_replicas
+# gauge sums states across ALL of them (a multi-model deployment runs
+# one supervisor per group; a callback bound to just the last-built one
+# would silently stop reporting its siblings' failed replicas). Weak so
+# a torn-down fleet's supervisor drops out of the scrape.
+_SUPERVISORS: "weakref.WeakSet[FleetSupervisor]" = weakref.WeakSet()
+
+
+@dataclass
+class _ReplicaState:
+    state: str = "healthy"
+    since: float = 0.0
+    reason: str = ""
+    last_steps: int = 0
+    last_advance: float = 0.0
+    last_crash_count: int = 0
+    rebuilds: int = 0
+    consecutive_failures: int = 0
+    rejoin_at: float = 0.0
+
+
+class FleetSupervisor:
+    """Poll-loop supervisor over one :class:`AsyncFleet`'s replicas."""
+
+    def __init__(self, fleet, *, poll_interval_s: float = 0.05,
+                 wedge_timeout_s: float = 60.0,
+                 rejoin_hysteresis_s: float = 0.25,
+                 rejoin_hysteresis_max_s: float = 30.0,
+                 max_consecutive_rebuilds: int = 3,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None):
+        self.fleet = fleet
+        self.poll_interval_s = poll_interval_s
+        self.wedge_timeout_s = wedge_timeout_s
+        self.rejoin_hysteresis_s = rejoin_hysteresis_s
+        self.rejoin_hysteresis_max_s = rejoin_hysteresis_max_s
+        self.max_consecutive_rebuilds = max_consecutive_rebuilds
+        self._clock = clock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Guards _states + transitions against snapshot() readers (HTTP
+        # threads). Never held across fleet calls or blocking work —
+        # transitions mutate state briefly, detection/rebuild run
+        # outside it.
+        self._lock = threading.Lock()
+        now = self._clock()
+        self._states = [
+            _ReplicaState(since=now, last_advance=now,
+                          last_steps=core.flight.total_steps)
+            for core in fleet.cores]
+        self.transitions: deque = deque(maxlen=_TRANSITIONS_MAX)
+        # Per-supervisor totals (snapshot()): the runbook_supervisor_*
+        # counters are process-wide across every fleet's supervisor.
+        self._rebuilds = 0
+        self._failovers = 0
+        reg = registry or metrics_mod.get_registry()
+        transitions = reg.counter(
+            "runbook_supervisor_transitions_total",
+            "Replica state-machine transitions, by state entered",
+            labels=("state",))
+        self._m_transitions = {state: transitions.labels(state=state)
+                               for state in SUPERVISOR_STATES}
+        replicas = reg.gauge(
+            "runbook_supervisor_replicas",
+            "Replicas currently in each supervision state",
+            labels=("state",))
+        _SUPERVISORS.add(self)
+        for state in SUPERVISOR_STATES:
+            # Sums over EVERY live supervisor (racy state reads — the
+            # scrape-gauge staleness contract), so per-group
+            # supervisors don't overwrite each other's callback.
+            replicas.labels(state=state).set_function(
+                lambda s=state: float(sum(
+                    1 for sup in list(_SUPERVISORS)
+                    for st in sup._states if st.state == s)))
+        self._m_rebuilds = reg.counter(
+            "runbook_supervisor_rebuilds_total",
+            "Online replica rebuilds (engine teardown + reconstruction "
+            "on the replica's device slice)")
+        self._m_failovers = reg.counter(
+            "runbook_supervisor_failovers_total",
+            "In-flight requests force-finished off a failed replica for "
+            "router-level retry")
+        fleet.supervisor = self
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "FleetSupervisor":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="fleet-supervisor")
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — supervision must survive
+                import logging  # a poll hiccup; the next tick retries
+
+                logging.getLogger(__name__).exception(
+                    "supervisor poll failed")
+            self._stop.wait(self.poll_interval_s)
+
+    # ----------------------------------------------------------- detection
+
+    def poll_once(self) -> None:
+        """One detection sweep over every replica (public so tests and
+        deterministic drivers can step the machine without the thread)."""
+        now = self._clock()
+        for i in range(self.fleet.dp):
+            self._check(i, now)
+
+    def _lock_busy(self, i: int) -> bool:
+        """Non-blocking engine-lock probe: True when the step thread is
+        holding the lock right now — the corroborating wedge signal
+        health_snapshot reports as "unresponsive"."""
+        lock = self.fleet.replicas[i]._lock
+        acquired = lock.acquire(blocking=False)
+        if acquired:
+            lock.release()
+        return not acquired
+
+    def _crashed(self, i: int) -> bool:
+        """Sticky crash detection: the loop's monotonic crash count
+        catches a crash even when a caller's start() already restarted
+        the loop before this poll; ``loop_crashed`` covers a dead loop
+        nobody restarted."""
+        st = self._states[i]
+        replica = self.fleet.replicas[i]
+        count = replica.crash_count
+        if count > st.last_crash_count:
+            st.last_crash_count = count
+            return True
+        return replica.loop_crashed
+
+    def _check(self, i: int, now: float) -> None:
+        st = self._states[i]
+        core = self.fleet.cores[i]
+        steps = core.flight.total_steps
+        if steps != st.last_steps:
+            st.last_steps = steps
+            st.last_advance = now
+            if st.state == "suspect":
+                self._transition(i, "healthy", "step cursor advanced")
+        if st.state == "healthy" and st.consecutive_failures \
+                and now - st.since > 10 * self.wedge_timeout_s:
+            # Sustained health clears the flap counter — the next
+            # failure starts hysteresis from the base again.
+            st.consecutive_failures = 0
+        if st.state in ("healthy", "suspect"):
+            if self._crashed(i):
+                self._fail(i, now, "engine loop crashed")
+                return
+            stalled_for = now - st.last_advance
+            if core.has_work and stalled_for > self.wedge_timeout_s:
+                if st.state == "healthy":
+                    self._transition(
+                        i, "suspect",
+                        f"no step in {stalled_for:.2f}s with work "
+                        f"queued (lock "
+                        f"{'held' if self._lock_busy(i) else 'free'})")
+                elif stalled_for > 2 * self.wedge_timeout_s:
+                    self._fail(i, now,
+                               f"wedged: no step in {stalled_for:.2f}s")
+        elif st.state == "rejoining":
+            if self._crashed(i):
+                self._fail(i, now, "crashed during rejoin hysteresis")
+            elif now >= st.rejoin_at:
+                self.fleet.unquarantine(i)
+                self._transition(i, "healthy", "rejoined routing")
+
+    # ------------------------------------------------- failover + rebuild
+
+    def _fail(self, i: int, now: float, reason: str) -> None:
+        self._transition(i, "failed", reason)
+        self.fleet.quarantine(i)
+        failed_over = self._failover(i)
+        if failed_over:
+            self._failovers += failed_over
+            self._m_failovers.inc(failed_over)
+        st = self._states[i]
+        if st.consecutive_failures >= self.max_consecutive_rebuilds:
+            # Flapping: stop burning rebuilds on a replica that dies
+            # every time it comes back — it stays quarantined until an
+            # operator intervenes (state sticky at "failed").
+            self._transition(
+                i, "failed",
+                f"left quarantined after "
+                f"{st.consecutive_failures} consecutive failures",
+                force=True)
+            return
+        self._transition(i, "rebuilding",
+                         f"failed over {failed_over} in-flight requests")
+        try:
+            new_core = self.fleet.rebuild_replica(i)
+        except Exception as exc:  # noqa: BLE001 — a rebuild that raises
+            # leaves the replica quarantined, never half-swapped.
+            self._transition(i, "failed", f"rebuild error: {exc}",
+                             force=True)
+            return
+        st.rebuilds += 1
+        st.consecutive_failures += 1
+        self._rebuilds += 1
+        self._m_rebuilds.inc()
+        hysteresis = min(
+            self.rejoin_hysteresis_max_s,
+            self.rejoin_hysteresis_s
+            * (2 ** (st.consecutive_failures - 1)))
+        st.rejoin_at = self._clock() + hysteresis
+        st.last_steps = new_core.flight.total_steps
+        st.last_advance = self._clock()
+        st.last_crash_count = 0  # the fresh AsyncEngine counts from 0
+        self._transition(i, "rejoining",
+                         f"hysteresis {hysteresis:.2f}s")
+
+    def _failover(self, i: int) -> int:
+        """Unblock every live request on the failed core NOW so the
+        router's retry loop re-places them. With the engine lock (a
+        crashed core's lock is free) the full ``force_finish`` cleanup
+        runs. When the lock cannot be had — a wedged step thread holds
+        it — pools are NEVER touched (mutating them under a live step
+        corrupts the core): only the request's finish state and done
+        event are set, which is all the awaiters need; the pools belong
+        to an abandoned core a fresh engine is about to replace."""
+        core = self.fleet.cores[i]
+        replica = self.fleet.replicas[i]
+        locked = replica._lock.acquire(timeout=0.2)
+        try:
+            live = (list(core.waiting) + list(core.prefilling)
+                    + list(core.decoding))
+            for req in live:
+                try:
+                    if locked:
+                        core.force_finish(req)
+                    else:
+                        req.finish_reason = (req.finish_reason
+                                             or FinishReason.ABORTED)
+                        req.state = RequestState.FINISHED
+                        if req.done_event is not None:
+                            req.done_event.set()
+                except Exception:  # noqa: BLE001 — a poisoned core must
+                    pass           # not strand the remaining awaiters
+        finally:
+            if locked:
+                replica._lock.release()
+        return len(live)
+
+    def _transition(self, i: int, to: str, reason: str,
+                    force: bool = False) -> None:
+        st = self._states[i]
+        if st.state == to and not force:
+            return
+        frm = st.state
+        now = self._clock()
+        with self._lock:
+            st.state = to
+            st.since = now
+            st.reason = reason
+            self.transitions.append({
+                "ts": round(time.time(), 6),
+                "replica": self.fleet.replica_ids[i],
+                "from": frm,
+                "to": to,
+                "reason": reason,
+            })
+        self._m_transitions[to].inc()
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.event("supervisor.transition",
+                         replica=self.fleet.replica_ids[i],
+                         frm=frm, to=to, reason=reason)
+
+    # -------------------------------------------------------- observability
+
+    def state_of(self, i: int) -> str:
+        """Current state of fleet-local replica position ``i``."""
+        return self._states[i].state
+
+    def snapshot(self) -> dict:
+        """The ``/healthz`` ``supervisor`` block."""
+        with self._lock:
+            replicas = [{
+                "replica": self.fleet.replica_ids[i],
+                "state": st.state,
+                "reason": st.reason,
+                "rebuilds": st.rebuilds,
+                "consecutive_failures": st.consecutive_failures,
+            } for i, st in enumerate(self._states)]
+            transitions = list(self.transitions)
+        return {
+            "wedge_timeout_s": self.wedge_timeout_s,
+            "rejoin_hysteresis_s": self.rejoin_hysteresis_s,
+            "replicas": replicas,
+            "rebuilds_total": self._rebuilds,
+            "failovers_total": self._failovers,
+            "transitions": transitions,
+        }

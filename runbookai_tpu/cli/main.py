@@ -850,6 +850,83 @@ def cmd_tenants(args) -> int:
     return 0
 
 
+def _chaos_blocks(health: dict) -> dict:
+    """Extract {scope: {supervisor, chaos}} from a /healthz body —
+    top-level for a single fleet, per served model group otherwise."""
+    blocks: dict[str, dict] = {}
+    if "supervisor" in health or "chaos" in health:
+        blocks["(fleet)"] = {"supervisor": health.get("supervisor"),
+                             "chaos": health.get("chaos")}
+    for name, g in (health.get("models") or {}).items():
+        if isinstance(g, dict) and ("supervisor" in g or "chaos" in g):
+            blocks[name] = {"supervisor": g.get("supervisor"),
+                            "chaos": g.get("chaos")}
+    return blocks
+
+
+def _render_chaos(blocks: dict) -> str:
+    out: list[str] = []
+    for scope, b in blocks.items():
+        sup = b.get("supervisor")
+        out.append(f"## {scope}")
+        if sup:
+            out.append(f"supervision: wedge_timeout={sup['wedge_timeout_s']}s "
+                       f"rebuilds={sup['rebuilds_total']} "
+                       f"failovers={sup['failovers_total']}")
+            for r in sup["replicas"]:
+                line = (f"  r{r['replica']}: {r['state']}"
+                        f" (rebuilds={r['rebuilds']})")
+                if r.get("reason"):
+                    line += f" — {r['reason']}"
+                out.append(line)
+            tail = sup["transitions"][-8:]
+            if tail:
+                out.append("  recent transitions:")
+                out.extend(f"    r{t['replica']}: {t['from']} -> "
+                           f"{t['to']} ({t['reason']})" for t in tail)
+        else:
+            out.append("supervision: not attached "
+                       "(llm.fleet.supervisor.enabled)")
+        chaos = b.get("chaos")
+        if chaos:
+            out.append(f"chaos: seed={chaos['seed']} applied="
+                       f"{chaos['events_applied']}/"
+                       f"{chaos['events_planned']} "
+                       f"active={chaos['active'] or '-'}")
+            for w in chaos["windows"][-8:]:
+                tgt = (f" r{w['replica']}"
+                       if w.get("replica") is not None else "")
+                out.append(f"  {w['kind']}{tgt} at {w['applied_at_s']}s "
+                           f"for {w['duration_s']}s [{w['status']}]")
+        else:
+            out.append("chaos: no injector attached")
+    return "\n".join(out)
+
+
+def cmd_chaos(args) -> int:
+    """``runbook chaos status`` — replica supervision + fault-injection
+    state from a running server's ``/healthz`` (the ``supervisor`` and
+    ``chaos`` blocks each fleet's health snapshot carries when a
+    FleetSupervisor / ChaosInjector is attached)."""
+    url = args.url.rstrip("/") + "/healthz"
+    try:
+        health = _fetch_json(url, args.timeout)
+    except (OSError, TimeoutError, ValueError) as e:
+        print(f"no server reachable at {args.url} ({e})")
+        return 1
+    blocks = _chaos_blocks(health)
+    if args.json:
+        print(json.dumps(blocks, indent=2))
+        return 0
+    print(f"# {url}")
+    if not blocks:
+        print("no supervisor or chaos injector attached "
+              "(single engine, or llm.fleet.supervisor disabled)")
+        return 0
+    print(_render_chaos(blocks))
+    return 0
+
+
 def _render_workload(snap: dict) -> str:
     """Table view of a /debug/workload snapshot."""
     if not snap.get("enabled"):
@@ -1629,6 +1706,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="raw JSON instead of the table")
     tn.add_argument("--timeout", type=float, default=10.0)
     tn.set_defaults(fn=cmd_tenants)
+
+    ch = sub.add_parser(
+        "chaos", help="chaos-hardening state: replica supervision + "
+                      "fault-injection windows from a running server")
+    ch_sub = ch.add_subparsers(dest="chaos_cmd", required=True)
+    ch_status = ch_sub.add_parser(
+        "status", help="supervisor replica states, rebuild/failover "
+                       "counters, recent transitions and applied fault "
+                       "windows (GET <url>/healthz)")
+    ch_status.add_argument("--url", default="http://127.0.0.1:8000",
+                           help="server base URL (GET <url>/healthz)")
+    ch_status.add_argument("--json", action="store_true",
+                           help="raw JSON instead of the table")
+    ch_status.add_argument("--timeout", type=float, default=10.0)
+    ch.set_defaults(fn=cmd_chaos)
 
     met = sub.add_parser(
         "metrics", help="scrape a server's /metrics or summarize a trace")

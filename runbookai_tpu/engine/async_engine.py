@@ -32,6 +32,11 @@ class AsyncEngine:
         self._wake: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
+        # Monotonic count of engine-loop crashes (step exceptions). The
+        # fleet supervisor reads this as its STICKY crash signal: a
+        # caller's start() may restart a crashed loop before the
+        # supervisor's next poll, but the count never un-bumps.
+        self.crash_count = 0
 
     async def start(self) -> None:
         # A done task means the loop that owned it was torn down (e.g. a
@@ -92,6 +97,7 @@ class AsyncEngine:
                 # would leave their done_events unset and every pending
                 # generate()/generate_stream() awaiting forever. The next
                 # caller's start() clears the done task and restarts.
+                self.crash_count += 1
                 self._fail_live_requests()
                 raise
 
@@ -118,6 +124,20 @@ class AsyncEngine:
     def _locked_step(self) -> None:
         with self._lock:
             self.core.step()
+
+    @property
+    def loop_crashed(self) -> bool:
+        """True when the engine-loop task died on an exception (a step
+        blew up) and no stop() was requested — the fleet supervisor's
+        replica-crash signal. Reading ``Task.done()`` from a foreign
+        thread is safe (it's a plain state check); the exception itself
+        stays unretrieved until start() clears the task."""
+        task = self._task
+        if self._stopped or task is None or not task.done():
+            return False
+        if task.cancelled():
+            return False
+        return task.exception() is not None
 
     def debug_steps(self, last_n: Optional[int] = None,
                     lock_timeout: float = 0.5) -> dict:

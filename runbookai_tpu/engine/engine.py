@@ -924,6 +924,7 @@ class EngineCore:
         )
         self._kv_k = self.kv.pool.kv_k
         self._kv_v = self.kv.pool.kv_v
+        self.seed = seed  # recorded so an online rebuild replays it
         self._key = jax.random.PRNGKey(seed)
 
         # OpenAI repetition penalties: device-resident per-slot token
@@ -1005,6 +1006,13 @@ class EngineCore:
         # None = no observer; the callee appends to a bounded deque — one
         # O(1) call off the dispatch path, never inside a dispatch.
         self.workload_tap = None
+        # Fault-injection seam (runbookai_tpu/chaos): called at the TOP
+        # of step(), under the AsyncEngine lock, before any pool
+        # mutation. A hook may raise (replica crash — the loop's
+        # _fail_live_requests path runs) or stall (replica wedge); hooks
+        # are one-shot and clear themselves. None (the default) costs
+        # one attribute check per step.
+        self.chaos_hook = None
         self.registry = metrics_mod.get_registry()
         # Flight recorder: one bounded record per step (what was the
         # engine DOING on the slow steps?). The step thread is the only
@@ -2638,6 +2646,11 @@ class EngineCore:
         the step runs as ONE unified ragged dispatch; otherwise — or when
         mixing bails during reconciliation — the classic split
         prefill-then-decode pair runs, at most one dispatch each."""
+        if self.chaos_hook is not None:
+            # Fault-injection seam (runbookai_tpu/chaos): runs before any
+            # pool mutation so an injected crash leaves a consistent core
+            # for the supervisor's failover sweep.
+            self.chaos_hook(self)
         if len(self.finished) > self._FINISHED_HIGH_WATER:
             del self.finished[: -self._FINISHED_KEEP]
         before = len(self.finished)

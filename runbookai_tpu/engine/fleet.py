@@ -62,6 +62,7 @@ standalone engine would.
 from __future__ import annotations
 
 import asyncio
+import random
 import threading
 import time as _time
 from contextvars import ContextVar
@@ -134,6 +135,15 @@ class FleetConfig:
     # Prompts below this many full pages skip the prefill tier (the warm
     # round-trip would cost more than the tail prefill it saves).
     disagg_min_prompt_pages: int = 1
+    # Cross-replica retry backoff: attempt k (1-based) waits
+    # min(max, base * 2**(k-1)) scaled by seeded jitter in [0.5, 1.0)
+    # before re-placing — an aborting replica's siblings see a spread-out
+    # retry wave, not a synchronized stampede. 0 disables (the historical
+    # immediate re-place). The jitter stream is seeded per fleet so a
+    # soak's retry schedule is reproducible run over run.
+    retry_backoff_base: float = 0.05
+    retry_backoff_max: float = 2.0
+    retry_jitter_seed: int = 0
 
 
 @dataclass
@@ -362,7 +372,9 @@ class AsyncFleet:
     def __init__(self, cores: Sequence[EngineCore],
                  fleet_cfg: Optional[FleetConfig] = None,
                  model_label: Optional[str] = None,
-                 clear_labeled: bool = True):
+                 clear_labeled: bool = True,
+                 replica_factory: Optional[Callable[[int], EngineCore]]
+                 = None):
         if not cores:
             raise ValueError("a fleet needs at least one EngineCore")
         self.cores = list(cores)
@@ -408,6 +420,32 @@ class AsyncFleet:
         self._rr = 0
         self._affinity_hits = 0
         self._case_routes: dict[str, dict[int, int]] = {}
+        # Supervision (runbookai_tpu/chaos): quarantined LOCAL replica
+        # positions are excluded from routing (placement AND pull
+        # sources) until the supervisor rejoins them. Replaced as a
+        # whole frozenset under self._lock; racy reads see either the
+        # old or new set — the same one-step-stale contract as the load
+        # reads.
+        self._quarantined: frozenset[int] = frozenset()
+        # Online rebuild: a caller-supplied factory (global replica id ->
+        # fresh EngineCore on that replica's device slice); None falls
+        # back to cloning the dead core's construction inputs.
+        self.replica_factory = replica_factory
+        # Hook re-running any wrapper's metric bindings after a rebuild
+        # swaps a core (fleet/multimodel re-unions its rollups here).
+        self._rebuild_listener: Optional[Callable[[], None]] = None
+        # Attach points read by /healthz and `runbook chaos status`:
+        # the fleet supervisor (chaos/supervisor.py) and the fault
+        # injector (chaos/inject.py) publish their snapshots through
+        # health_snapshot when present.
+        self.supervisor = None
+        self.chaos = None
+        # Fault-injection seam on the page-pull path: applied to the
+        # ExportedPages payload INSIDE the export worker thread (a delay
+        # or corruption must never block the event loop).
+        self.chaos_pull_hook = None
+        # Seeded jitter stream for retry backoff (drawn under _lock).
+        self._retry_rng = random.Random(self.cfg.retry_jitter_seed)
         self._install_metrics(clear=clear_labeled)
 
     # ------------------------------------------------------------- routing
@@ -461,8 +499,12 @@ class AsyncFleet:
         # decoding and the other 8 queued behind a long prefill).
         candidates: list[tuple[int, int, int, int]] = []
         sources: list[tuple[int, int]] = []  # (idx, matched)
+        quarantined = self._quarantined  # one racy read per decision
         for i, core in enumerate(self.cores):
-            if i in exclude:
+            if i in exclude or i in quarantined:
+                # Quarantined replicas (supervisor failover) serve
+                # nothing: not placement, not pull sources — a dead
+                # core's pages cannot be trusted mid-rebuild.
                 continue
             matched = (core.kv.match_prefix(prompt_ids, hashes=hashes,
                                             hash_seed=hash_seed)
@@ -589,6 +631,13 @@ class AsyncFleet:
         if exported is None:
             self._m_stale["epoch_moved"].inc()
             return 0
+        hook = self.chaos_pull_hook
+        if hook is not None:
+            # Fault injection on the in-transit payload (chaos/inject.py:
+            # d2d delay / corruption). Runs in a worker thread with NO
+            # engine lock held — a delayed pull stalls only this
+            # request, never a step loop or the event loop.
+            exported = await asyncio.to_thread(hook, exported)
 
         def _import() -> tuple[int, bool]:
             core = self.cores[dst]
@@ -727,6 +776,14 @@ class AsyncFleet:
         tried: set[int] = set()  # decode-tier picks that aborted
         out: Optional[EngineOutput] = None
         for attempt in range(retries + 1):
+            if attempt:
+                # Bounded exponential backoff with seeded jitter BEFORE
+                # re-placing: the sibling that absorbs a failed-over
+                # request gets a beat to drain, and concurrent retries
+                # de-synchronize instead of stampeding one replica.
+                # Sleeping cannot change tokens — retry byte-identity is
+                # regression-pinned in tests/test_fleet.py.
+                await self._retry_backoff(attempt)
             placement = self._route(prompt_ids, hash_seed,
                                     exclude=frozenset(tried),
                                     trace_id=request_id)
@@ -756,36 +813,179 @@ class AsyncFleet:
         request_sink: Optional[list] = None,
         request_id: Optional[str] = None,
     ):
-        """Route once, then yield the replica's token stream unchanged
-        (no cross-replica retry mid-stream: tokens already yielded cannot
-        be unsaid). Shedding raises :class:`FleetSaturated`."""
+        """Route, then yield the chosen replica's token stream.
+
+        Failover happens only BEFORE the first token: a replica that
+        aborts the request without yielding anything (pool pressure, a
+        crash's failover sweep) is retried on its siblings with the same
+        backoff as :meth:`generate` — the caller's stream just starts a
+        beat later, byte-identical. Once a token has been yielded it
+        cannot be unsaid, so a mid-stream abort ends the stream with the
+        request's ABORTED state (the HTTP layer turns that into a clean
+        SSE error event) instead of hanging or silently truncating.
+        Shedding raises :class:`FleetSaturated`."""
         t_arrival = _time.perf_counter()  # TTFT includes warm + pull
         hash_seed = self._hash_seed(adapter)
         if self._prefill_tier and not self.is_saturated():
             await self._disagg_warm(prompt_ids, hash_seed, adapter,
                                     request_id)
-        placement = self._route(prompt_ids, hash_seed,
-                                trace_id=request_id)
-        idx = placement.idx
-        if idx is None:
-            raise FleetSaturated(
-                f"all {self.dp} replicas over shed_queue_depth="
-                f"{self.cfg.shed_queue_depth}")
-        if placement.pull_src is not None:
-            await self._execute_pull(placement, prompt_ids, hash_seed,
-                                     trace_id=request_id)
-        agen = self.replicas[idx].generate_stream(
-            prompt_ids, sampling, priority=priority, adapter=adapter,
-            request_sink=request_sink, request_id=request_id,
-            arrival_time=t_arrival)
-        try:
-            async for tok in agen:
-                yield tok
-        finally:
-            # `async for` abandons (never closes) its iterator on early
-            # exit; close explicitly so the replica's early-exit abort
-            # (slot + KV pages freed) runs NOW, not at GC time.
-            await agen.aclose()
+        retries = (self.cfg.max_retries if self.cfg.max_retries is not None
+                   else self.dp - 1)
+        tried: set[int] = set()
+        for attempt in range(retries + 1):
+            if attempt:
+                await self._retry_backoff(attempt)
+            placement = self._route(prompt_ids, hash_seed,
+                                    exclude=frozenset(tried),
+                                    trace_id=request_id)
+            idx = placement.idx
+            if idx is None:
+                raise FleetSaturated(
+                    f"all {self.dp} replicas over shed_queue_depth="
+                    f"{self.cfg.shed_queue_depth} or quarantined")
+            if attempt:
+                self._m_retries.inc()
+            if placement.pull_src is not None:
+                await self._execute_pull(placement, prompt_ids, hash_seed,
+                                         trace_id=request_id)
+            # The replica appends its EngineRequest to the sink when the
+            # stream starts; a private sink keeps failed-over attempts'
+            # entries out of the caller's view until they actually serve.
+            sink: list = []
+
+            def mirror() -> None:
+                if request_sink is not None and sink \
+                        and (not request_sink
+                             or request_sink[-1] is not sink[0]):
+                    request_sink.append(sink[0])
+
+            agen = self.replicas[idx].generate_stream(
+                prompt_ids, sampling, priority=priority, adapter=adapter,
+                request_sink=sink, request_id=request_id,
+                arrival_time=t_arrival)
+            yielded = False
+            try:
+                async for tok in agen:
+                    mirror()
+                    yielded = True
+                    yield tok
+            finally:
+                # `async for` abandons (never closes) its iterator on
+                # early exit; close explicitly so the replica's
+                # early-exit abort (slot + KV pages freed) runs NOW,
+                # not at GC time.
+                await agen.aclose()
+            mirror()
+            req = sink[0] if sink else None
+            if (not yielded and req is not None
+                    and req.finish_reason is FinishReason.ABORTED
+                    and attempt < retries):
+                # Nothing reached the caller: fail over to a sibling —
+                # the stream the caller finally sees is byte-identical
+                # to an untroubled placement. The serving attempt's
+                # request (not this aborted one) is what lands in the
+                # caller's request_sink.
+                if request_sink is not None and request_sink \
+                        and request_sink[-1] is req:
+                    request_sink.pop()
+                tried.add(idx)
+                continue
+            return
+
+    # ------------------------------------------------- retry backoff
+
+    async def _retry_backoff(self, attempt: int) -> None:
+        """Sleep the bounded-exponential, seeded-jitter backoff for retry
+        ``attempt`` (1-based) and observe it into
+        ``runbook_router_retry_backoff_seconds``. 0-base disables."""
+        base = self.cfg.retry_backoff_base
+        if base <= 0:
+            return
+        raw = min(self.cfg.retry_backoff_max,
+                  base * (2 ** (attempt - 1)))
+        with self._lock:
+            jitter = self._retry_rng.random()
+        delay = raw * (0.5 + 0.5 * jitter)
+        self._m_backoff.observe(delay)
+        await asyncio.sleep(delay)
+
+    # ------------------------------------------- supervision / rebuild
+
+    def quarantine(self, idx: int) -> None:
+        """Remove LOCAL replica position ``idx`` from routing (placement
+        and pull sources). Idempotent; the supervisor calls this the
+        moment a replica is declared failed."""
+        with self._lock:
+            self._quarantined = self._quarantined | {idx}
+
+    def unquarantine(self, idx: int) -> None:
+        with self._lock:
+            self._quarantined = self._quarantined - {idx}
+
+    def quarantined_replicas(self) -> list[int]:
+        """GLOBAL replica ids currently out of routing."""
+        return sorted(self.replica_ids[i] for i in self._quarantined)
+
+    def available_replicas(self) -> int:
+        """Decode-tier replicas currently accepting placements."""
+        quarantined = self._quarantined
+        return sum(1 for i in self._decode_tier if i not in quarantined)
+
+    def failing_over(self) -> bool:
+        """True while NO decode-tier replica accepts placements (every
+        one quarantined mid-failover): the HTTP layer answers 503 with
+        Retry-After instead of burning a shed on a request that cannot
+        be placed."""
+        return self.available_replicas() == 0
+
+    def _default_replica_factory(self, old: EngineCore) -> EngineCore:
+        """Rebuild an EngineCore from the dead core's own construction
+        inputs: same model/engine config, the SAME param tree (already
+        resident on the replica's device slice — nothing re-uploads),
+        same mesh, guided hooks, LoRA registry, tracer, seed and replica
+        index. The draft worker is NOT rebuilt (its slot state died with
+        the core; speculation resumes only through an explicit
+        ``replica_factory``)."""
+        params = old.params
+        if old.lora is not None:
+            # EngineCore re-stacks the registry's adapters itself; the
+            # dead core's params carry its stale stacked copy.
+            params = {k: v for k, v in params.items() if k != "lora"}
+        return EngineCore(
+            old.cfg, params, old.tokenizer, old.ecfg,
+            mask_fn=old.mask_fn, advance_fn=old.advance_fn,
+            seed=old.seed, tracer=old.tracer, mesh=old.mesh,
+            lora_registry=old.lora, replica_idx=old.replica_idx)
+
+    def rebuild_replica(self, idx: int) -> EngineCore:
+        """Online replica rebuild: tear down LOCAL position ``idx``'s
+        engine and construct a fresh one on the same device slice, as a
+        first-class runtime operation. The caller (the supervisor) has
+        already quarantined the replica and failed over its in-flight
+        requests; this swaps the core + AsyncEngine pair under the
+        router lock, re-binds the per-replica metric callbacks to the
+        new core, and notifies any wrapping fleet (multi-model rollups)
+        so no scrape keeps reading the dead engine. The replica remains
+        quarantined — rejoining is the supervisor's hysteresis call."""
+        old_replica = self.replicas[idx]
+        old_core = self.cores[idx]
+        # The old loop must exit when (if) it ever wakes: a wedged step
+        # thread finishing hours later must find a stopped engine, not
+        # re-enter scheduling on an abandoned core.
+        old_replica._stopped = True
+        factory = self.replica_factory or (
+            lambda _gid: self._default_replica_factory(old_core))
+        new_core = factory(self.replica_ids[idx])
+        with self._lock:
+            self.cores[idx] = new_core
+            self.replicas[idx] = AsyncEngine(new_core)
+        # Re-point every per-replica labeled callback and the unlabeled
+        # aggregates at the live core list (the previous bindings hold
+        # the dead core). clear=False: sibling labelsets stay bound.
+        self._install_metrics(clear=False)
+        if self._rebuild_listener is not None:
+            self._rebuild_listener()
+        return new_core
 
     # -------------------------------------------------- eval attribution
 
@@ -848,6 +1048,15 @@ class AsyncFleet:
             "runbook_router_retries_total",
             "Cross-replica retries after a replica aborted on pool "
             "pressure", labels=("model",)).labels(model=model)
+        # runbook: noqa[RBK010] — model label: configured group
+        # name, fixed at fleet build.
+        self._m_backoff = reg.histogram(
+            "runbook_router_retry_backoff_seconds",
+            "Seeded-jitter exponential backoff slept before each "
+            "cross-replica retry re-place",
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                     2.0, 5.0),
+            labels=("model",)).labels(model=model)
         # runbook: noqa[RBK010] — model label: configured group
         # name, fixed at fleet build.
         self._m_shed = reg.counter(
@@ -976,8 +1185,14 @@ class AsyncFleet:
         stream gets a real 503; the inevitable check-then-route race
         falls back to the in-stream error event."""
         depth = self.cfg.shed_queue_depth
-        return depth is not None and all(
-            len(self.cores[i].waiting) >= depth for i in self._decode_tier)
+        if depth is None:
+            return False
+        quarantined = self._quarantined
+        live = [i for i in self._decode_tier if i not in quarantined]
+        # No live replica at all is failover, not saturation — the HTTP
+        # layer checks failing_over() first and answers a distinct 503.
+        return bool(live) and all(
+            len(self.cores[i].waiting) >= depth for i in live)
 
     def debug_steps(self, last_n: Optional[int] = None,
                     lock_timeout: float = 0.5) -> dict:
@@ -1019,17 +1234,35 @@ class AsyncFleet:
 
         agg: dict = {}
         replicas = []
+        unresponsive: list[int] = []
+        quarantined = self._quarantined
         kv_total = kv_used = kv_cached = 0
         deadline = time.monotonic() + lock_timeout
         for i, (engine, core) in enumerate(zip(self.replicas, self.cores)):
             budget = max(0.0, deadline - time.monotonic())
-            locked = engine._lock.acquire(timeout=budget) if budget \
-                else engine._lock.acquire(blocking=False)
+            # Floor of 20 ms even after the shared budget is spent: one
+            # genuinely wedged replica must not make every LATER replica
+            # (probed with what would be a blocking=False attempt that
+            # any normal in-flight dispatch fails) read as a phantom
+            # fleet-wide outage. Worst case stays bounded:
+            # lock_timeout + dp × 20 ms.
+            locked = engine._lock.acquire(timeout=max(budget, 0.02))
             try:
                 m = dict(core.metrics)
             finally:
                 if locked:
                     engine._lock.release()
+            # A replica that exhausts its lock budget is NOT silently
+            # reported thin: its step thread is holding the lock past a
+            # liveness probe's patience — the cheapest wedge signal the
+            # supervisor has. (Its metrics row is the torn lock-free
+            # read, explicitly labeled.)
+            status = "ok"
+            if not locked:
+                status = "unresponsive"
+                unresponsive.append(self.replica_ids[i])
+            elif i in quarantined:
+                status = "quarantined"
             for k, v in m.items():
                 agg[k] = agg.get(k, 0) + v
             kv = core.kv
@@ -1040,6 +1273,7 @@ class AsyncFleet:
                 "replica": self.replica_ids[i],
                 "tier": ("prefill" if i in self._prefill_tier
                          else "decode" if self._prefill_tier else "mixed"),
+                "status": status,
                 "running": len(core.decoding),
                 "waiting": len(core.waiting) + len(core.prefilling),
                 "kv": {"pages_total": kv.allocator.num_pages,
@@ -1065,6 +1299,19 @@ class AsyncFleet:
                 "imbalance_ratio": round(self._imbalance(), 4),
             },
         }
+        if unresponsive:
+            body["unresponsive_replicas"] = unresponsive
+        if quarantined:
+            body["router"]["quarantined"] = self.quarantined_replicas()
+        if self.supervisor is not None:
+            # Replica supervision (chaos/supervisor.py): per-replica
+            # state machine, rebuild/failover counters, recent
+            # transitions — the `runbook chaos status` body.
+            body["supervisor"] = self.supervisor.snapshot()
+        if self.chaos is not None:
+            # Live fault injection (chaos/inject.py): the seeded
+            # schedule and every applied fault window with provenance.
+            body["chaos"] = self.chaos.snapshot()
         if self._kv_share:
             body["router"]["kv_share"] = {
                 "xreplica_hits": int(self._m_xreplica_hits.value),

@@ -245,6 +245,17 @@ class HostSpillTier:
             self._store.move_to_end(block_hash)
         return entry
 
+    def evict_all(self) -> int:
+        """Drop every resident entry (counted as evictions) — the
+        spill-pressure fault in chaos/inject.py simulates the host-RAM
+        envelope collapsing under an external consumer. Returns the
+        number of pages dropped. Call under the owning engine's lock
+        (the tier is otherwise only touched from the step thread)."""
+        dropped = len(self._store)
+        self._store.clear()
+        self.evictions += dropped
+        return dropped
+
 
 class PageAllocator:
     """Host-side allocator over physical page ids with a prefix-cache index.

@@ -233,7 +233,11 @@ def build_group(llm_cfg, *,
                 kv_share_min_pages=getattr(router, "kv_share_min_pages", 1),
                 disagg_prefill_replicas=disagg_n,
                 disagg_min_prompt_pages=(disagg.min_prompt_pages
-                                         if disagg_n else 1))
+                                         if disagg_n else 1),
+                retry_backoff_base=getattr(router, "retry_backoff_base",
+                                           0.05),
+                retry_backoff_max=getattr(router, "retry_backoff_max",
+                                          2.0))
     if replica_indices is not None:
         # Multi-model group build: cores always come from
         # build_engine_fleet so each carries its GLOBAL replica index

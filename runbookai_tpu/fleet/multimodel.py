@@ -113,6 +113,16 @@ class MultiModelFleet:
         # this final rebind wins).
         install_fleet_aggregates(self.cores)
         self._install_metrics()
+        # Online replica rebuild (chaos/supervisor.py): when a group
+        # fleet swaps a core, refresh the union core list and re-bind
+        # the process-wide aggregates so no scrape keeps pinning (or
+        # reading) the dead engine.
+        for g in groups:
+            g.fleet._rebuild_listener = self._on_group_rebuild
+
+    def _on_group_rebuild(self) -> None:
+        self.cores = [c for g in self.groups.values() for c in g.cores]
+        install_fleet_aggregates(self.cores)
 
     # ------------------------------------------------------------ resolution
 
@@ -308,6 +318,11 @@ class MultiModelFleet:
                 "router": snap["router"],
                 "decode_tokens": snap["metrics"].get("decode_tokens", 0),
             }
+            # Supervision / chaos surfaces ride per group (each group
+            # fleet has its own supervisor + injector when enabled).
+            for key in ("supervisor", "chaos", "unresponsive_replicas"):
+                if key in snap:
+                    models[name][key] = snap[key]
         usable = sum(c.kv.allocator.num_pages - 1 for c in self.cores)
         return {
             "dp_replicas": self.dp,

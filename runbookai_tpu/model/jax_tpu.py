@@ -67,6 +67,31 @@ async def stream_text(engine, tokenizer, prompt_ids, sampling,
         yield tail
 
 
+def _wire_supervisors(client, llm_cfg, fleets) -> None:
+    """Attach + start one FleetSupervisor per AsyncFleet when
+    ``llm.fleet.supervisor.enabled`` (chaos/supervisor.py): dead/wedged
+    replicas are quarantined, their in-flight requests failed over
+    through the router's retry path, the engine rebuilt online and
+    rejoined with hysteresis. ``client.supervisors`` holds the running
+    supervisors (daemon threads; ``/healthz`` reads their snapshots
+    through each fleet's ``supervisor`` attach point)."""
+    client.supervisors = []
+    sup_cfg = getattr(getattr(llm_cfg, "fleet", None), "supervisor",
+                      None)
+    if sup_cfg is None or not getattr(sup_cfg, "enabled", False):
+        return
+    from runbookai_tpu.chaos import FleetSupervisor
+
+    for fleet in fleets:
+        client.supervisors.append(FleetSupervisor(
+            fleet,
+            poll_interval_s=sup_cfg.poll_interval_s,
+            wedge_timeout_s=sup_cfg.wedge_timeout_s,
+            rejoin_hysteresis_s=sup_cfg.rejoin_hysteresis_s,
+            max_consecutive_rebuilds=sup_cfg.max_consecutive_rebuilds,
+        ).start())
+
+
 class JaxTpuClient(BaseLLMClient):
     def __init__(
         self,
@@ -219,7 +244,7 @@ class JaxTpuClient(BaseLLMClient):
             engine = build_multi_model_fleet(llm_cfg,
                                              slo_monitor=slo_monitor)
             default = engine.groups[engine.default]
-            return cls(
+            client = cls(
                 engine.cores, default.tokenizer,
                 temperature=llm_cfg.temperature, top_p=llm_cfg.top_p,
                 top_k=llm_cfg.top_k,
@@ -228,9 +253,12 @@ class JaxTpuClient(BaseLLMClient):
                 chat_format=default.chat_format,
                 slo_monitor=slo_monitor, tenants=tenants, engine=engine,
                 workload_monitor=build_workload_monitor(multi_model=engine))
+            _wire_supervisors(client, llm_cfg,
+                              [g.fleet for g in engine.groups.values()])
+            return client
         built = build_group(llm_cfg)
         wire_feedback(built.cores, built.llm_cfg, slo_monitor)
-        return cls(
+        client = cls(
             built.cores if len(built.cores) > 1 else built.cores[0],
             built.tokenizer,
             temperature=llm_cfg.temperature, top_p=llm_cfg.top_p,
@@ -243,6 +271,11 @@ class JaxTpuClient(BaseLLMClient):
             tenants=tenants,
             workload_monitor=build_workload_monitor(cores=built.cores),
         )
+        from runbookai_tpu.engine.fleet import AsyncFleet
+
+        if isinstance(client.engine, AsyncFleet):
+            _wire_supervisors(client, llm_cfg, [client.engine])
+        return client
 
     @classmethod
     def for_testing(cls, model_name: str = "llama3-test",

@@ -72,7 +72,7 @@ from concurrent.futures import TimeoutError as _FutTimeout  # builtin alias 3.11
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
-from runbookai_tpu.engine.request import FleetSaturated
+from runbookai_tpu.engine.request import FinishReason, FleetSaturated
 from runbookai_tpu.sched import (
     CLASS_NAMES,
     PRIORITY_INTERACTIVE,
@@ -770,6 +770,19 @@ def make_handler(bridge: _EngineBridge, model_name: str,
             if priority is None:
                 return  # throttled; 429 already sent
 
+            # Replica failover: while EVERY replica of the resolved
+            # group is quarantined (supervisor mid-rebuild), nothing can
+            # be placed — answer a real 503 with Retry-After now instead
+            # of burning a shed/abort on a request that cannot be
+            # served. Healthy siblings of a multi-model fleet are
+            # unaffected (the check is per resolved group).
+            failover = getattr(eng, "failing_over", None)
+            if failover is not None and failover():
+                self._settle_tenant(admission, 0)
+                self._error(503, "replica failover in progress (no "
+                                 "replica available; retry shortly)",
+                            retry_after=_SHED_RETRY_AFTER_S)
+                return
             try:
                 if body.get("stream"):
                     if n != 1:
@@ -1239,6 +1252,20 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     self._settle_tenant(
                         admission,
                         (len(ids) + n_streamed) if n_streamed else 0)
+                # Mid-stream abort (a replica died after tokens were
+                # already streamed, past the fleet's pre-token failover;
+                # or a shed landed mid-flight): end the SSE body with an
+                # explicit error event — a clean signal, never a silent
+                # "stop" truncation and never a hang. The fleet path
+                # appends the SERVING attempt's request last.
+                live_req = req_sink[-1] if req_sink else None
+                if live_req is not None and live_req.finish_reason \
+                        is FinishReason.ABORTED:
+                    send_terminator(
+                        b'data: {"error": {"message": "stream aborted '
+                        b'by the engine (replica failure or shed)"}}'
+                        b'\n\n')
+                    return
                 # max_tokens truncation reports "length", like non-stream.
                 finish = ("length"
                           if not state.get("saw_stop")

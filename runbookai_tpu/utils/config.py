@@ -74,6 +74,25 @@ class DisaggConfig(BaseModel):
     min_prompt_pages: int = Field(1, ge=1)
 
 
+class SupervisorConfig(BaseModel):
+    """Replica supervision (``llm.fleet.supervisor`` →
+    chaos/supervisor.FleetSupervisor): heartbeat-driven detection of
+    dead/wedged replicas, in-flight failover through the router retry
+    path, online replica rebuild and hysteresis-guarded rejoin. Off by
+    default. ``wedge_timeout_s`` MUST exceed the worst-case compile a
+    step can legitimately hold the engine lock for — a too-small value
+    fails over replicas that are merely compiling. See
+    docs/robustness.md."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    enabled: bool = False
+    poll_interval_s: float = Field(0.25, gt=0)
+    wedge_timeout_s: float = Field(60.0, gt=0)
+    rejoin_hysteresis_s: float = Field(1.0, gt=0)
+    max_consecutive_rebuilds: int = Field(3, ge=1)
+
+
 class FleetRouterConfig(BaseModel):
     """Engine-fleet router policy (engine/fleet.FleetConfig; only read
     when ``dp_replicas > 1``). See docs/SERVING.md."""
@@ -95,8 +114,14 @@ class FleetRouterConfig(BaseModel):
     kv_share: bool = False
     # Minimum full-page deficit worth a pull.
     kv_share_min_pages: int = Field(1, ge=1)
+    # Cross-replica retry backoff (docs/SERVING.md "Failure handling"):
+    # attempt k waits min(max, base * 2**(k-1)) with seeded jitter.
+    retry_backoff_base: float = Field(0.05, ge=0)
+    retry_backoff_max: float = Field(2.0, gt=0)
     # Prefill/decode tier split (docs/SERVING.md "Disaggregated tiers").
     disagg: DisaggConfig = Field(default_factory=DisaggConfig)
+    # Replica supervision (docs/robustness.md).
+    supervisor: SupervisorConfig = Field(default_factory=SupervisorConfig)
 
 
 class TenantPolicyConfig(BaseModel):

@@ -416,6 +416,56 @@ def test_bench_soak_smoke_two_group_fleet(monkeypatch, capsys):
     monkeypatch.delenv("BENCH_DP")
 
 
+def test_bench_soak_scenarios_smoke_chaos_gate(monkeypatch, capsys):
+    """The --soak-scenarios chaos gate must RUN on CPU in tier-1: a
+    dp=2 fleet serves the seeded scenario mix twice (chaos-free
+    baseline, then with an injected mid-run replica crash), the
+    supervisor detects/rebuilds/rejoins, and EVERY production invariant
+    verdict passes — zero lost outside fault windows, TTFT bound,
+    fairness, RSS/fd bounds, digest determinism, supervisor recovery."""
+    import bench as bench_mod
+
+    for var, val in (("BENCH_PROMPT", "32"), ("BENCH_NEW", "8"),
+                     ("BENCH_SLOTS", "2"), ("BENCH_PAGES", "128"),
+                     ("BENCH_SOAK_SCENARIOS", "2"),
+                     ("BENCH_BGE", "0"), ("BENCH_GUIDED", "0")):
+        monkeypatch.setenv(var, val)
+    probe = {"ok": True, "platform": "cpu", "kind": "cpu", "n": 1}
+    bench_mod.run_inner("llama3-test", False, probe)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    d = out["details"]
+    assert "error" not in d, d
+    assert d["arm"] == "soak_scenarios" and d["dp"] == 2
+    assert d["chaos_enabled"] is True
+    assert d["chains"] > 0 and d["turns"] >= d["chains"]
+    # Every scenario class was exercised.
+    assert set(d["classes"]) == {
+        "short_chat", "agentic_chain", "batch_flood",
+        "shared_prefix_session", "spiky_tenant"}
+    # The injected crash was applied and fully recovered from.
+    assert any(w["kind"] == "replica_crash"
+               and w["status"] == "applied"
+               for w in d["chaos"]["windows"])
+    tos = [t["to"] for t in d["supervisor"]["transitions"]]
+    for state in ("failed", "rebuilding", "rejoining", "healthy"):
+        assert state in tos, tos
+    assert d["supervisor"]["rebuilds_total"] >= 1
+    # The production-invariant gate: every verdict must hold.
+    assert d["invariants_passed"] is True, d["invariants"]
+    assert d["invariants"]["digest_determinism"]["compared"] > 0
+    # Same refusal posture as the other fleet arms.
+    monkeypatch.setenv("BENCH_DP", "2")
+    import pytest
+
+    with pytest.raises(ValueError, match="does not compose"):
+        bench_mod.run_bench("llama3-test", False, probe)
+    monkeypatch.delenv("BENCH_DP")
+    monkeypatch.setenv("BENCH_SOAK", "2")
+    with pytest.raises(ValueError, match="does not compose"):
+        bench_mod.run_bench("llama3-test", False, probe)
+    monkeypatch.delenv("BENCH_SOAK")
+
+
 def test_eval_artifacts_carry_quality_marker(tmp_path, monkeypatch):
     # Every eval artifact must state whether quality was measured with
     # real weights (VERDICT r4 #3).

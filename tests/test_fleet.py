@@ -295,6 +295,244 @@ async def test_request_id_namespacing_and_tracer_replica_label(tmp_path,
     assert len({e["request"] for e in finishes}) == 2  # no collision
 
 
+# ------------------------------------- failure handling (chaos/robustness)
+
+
+async def test_retry_backoff_observed_and_byte_identical(monkeypatch):
+    """Cross-replica retry waits a bounded, seeded backoff (histogram
+    observed) and the retried request's stream is byte-identical to a
+    direct placement — backoff reorders time, never tokens."""
+    client = JaxTpuClient.for_testing(max_new_tokens=8, dp_replicas=2)
+    fleet = client.engine
+    prompt = ids("retried request byte identity")
+    aborted = EngineOutput(
+        request_id="r0-req-dead", token_ids=[], text="",
+        finish_reason=FinishReason.ABORTED, ttft_ms=None,
+        decode_tokens=0, elapsed_s=0.0)
+
+    async def abort_gen(*a, **kw):
+        return aborted
+
+    # Fresh fleet: round-robin's first pick is r0 (no prefix published
+    # anywhere yet — the reference serve must come AFTER, or affinity
+    # would route straight to it and no retry happens).
+    monkeypatch.setattr(fleet.replicas[0], "generate", abort_gen)
+    hist = get_registry().get("runbook_router_retry_backoff_seconds")
+    observed_before = hist._state(("llama3-test",))[1]
+    import time as _t
+
+    t0 = _t.monotonic()
+    out = await fleet.generate(prompt, sp(8))
+    elapsed = _t.monotonic() - t0
+    want = await fleet.replicas[1].generate(prompt, sp(8))
+    assert out.request_id.startswith("r1-")
+    assert out.token_ids == want.token_ids
+    assert out.text == want.text
+    assert hist._state(("llama3-test",))[1] == observed_before + 1
+    # Bounded: base/2 <= sleep <= base (attempt 1), well under max.
+    assert elapsed >= fleet.cfg.retry_backoff_base * 0.5 * 0.9
+    await fleet.stop()
+
+
+async def test_retry_backoff_jitter_is_seeded():
+    """Two fleets with the same jitter seed draw the same backoff
+    sequence — a soak's retry schedule reproduces run over run."""
+    from runbookai_tpu.engine.fleet import FleetConfig
+
+    client = JaxTpuClient.for_testing(max_new_tokens=4, dp_replicas=2)
+    a = AsyncFleet(client.cores, FleetConfig(retry_jitter_seed=7))
+    b = AsyncFleet(client.cores, FleetConfig(retry_jitter_seed=7))
+    draws_a = [a._retry_rng.random() for _ in range(4)]
+    draws_b = [b._retry_rng.random() for _ in range(4)]
+    assert draws_a == draws_b
+
+
+async def test_stream_fails_over_before_first_token_byte_identical():
+    """A replica whose step crashes before any token was yielded is
+    retried on a sibling transparently: the caller's stream is
+    byte-identical to an untroubled run and the serving request lands
+    in the sink (never the aborted attempt)."""
+    from runbookai_tpu.chaos import ChaosReplicaCrash
+
+    client = JaxTpuClient.for_testing(max_new_tokens=8, dp_replicas=2)
+    fleet = client.engine
+    prompt = ids("failover stream prompt")
+    want = await _stream_tokens(fleet, prompt, sp(8))
+
+    def crash(core):
+        core.chaos_hook = None
+        raise ChaosReplicaCrash("pre-token crash")
+
+    # Route deterministically: next round-robin pick gets the hook.
+    with fleet._lock:
+        nxt = fleet._rr
+    fleet.cores[nxt].chaos_hook = crash
+    sink: list = []
+    toks = []
+    agen = fleet.generate_stream(prompt, sp(8), request_sink=sink)
+    async for tok in agen:
+        toks.append(tok)
+    await agen.aclose()
+    assert toks == want
+    assert len(sink) == 1
+    assert sink[0].finish_reason != FinishReason.ABORTED
+    await fleet.stop()
+
+
+async def test_crash_mid_stream_terminates_cleanly_never_hangs():
+    """Tokens already yielded cannot be unsaid: a crash AFTER the first
+    token ends the stream promptly with the request in ABORTED state
+    (the HTTP layer's SSE-error signal) — never a hang, never a silent
+    full-length stream."""
+    import asyncio as _asyncio
+
+    from runbookai_tpu.chaos import ChaosReplicaCrash
+
+    client = JaxTpuClient.for_testing(max_new_tokens=64, dp_replicas=2)
+    fleet = client.engine
+    sink: list = []
+    seen = []
+
+    async def consume():
+        agen = fleet.generate_stream(ids("mid stream crash"), sp(64),
+                                     request_sink=sink)
+        async for tok in agen:
+            seen.append(tok)
+            if len(seen) == 1:
+                # Arm the crash on the SERVING replica after the first
+                # token reached us.
+                serving = int(sink[-1].request_id[1])
+
+                def crash(core):
+                    core.chaos_hook = None
+                    raise ChaosReplicaCrash("mid-stream crash")
+
+                fleet.cores[serving].chaos_hook = crash
+        await agen.aclose()
+
+    await _asyncio.wait_for(consume(), timeout=60.0)
+    assert seen, "no tokens before the crash"
+    assert len(seen) < 64, "crash did not interrupt the stream"
+    assert sink[-1].finish_reason == FinishReason.ABORTED
+    await fleet.stop()
+
+
+def test_server_sse_stream_surfaces_abort_error_event():
+    """E2E over HTTP: a stream whose replica dies mid-flight ends with
+    an explicit SSE error event (clean signal), not a silent stop."""
+    from runbookai_tpu.chaos import ChaosReplicaCrash
+    from runbookai_tpu.server.openai_api import OpenAIServer
+
+    client = JaxTpuClient.for_testing(max_new_tokens=64, dp_replicas=2)
+    srv = OpenAIServer(client, model_name="llama3-test", port=0)
+    srv.start_background()
+    try:
+        steps = [0]
+
+        def crash_soon(core):
+            # A few steps in: the first token is out (emitted by the
+            # first prefill step), the stream is live, then the step
+            # thread dies.
+            steps[0] += 1
+            if steps[0] >= 3:
+                core.chaos_hook = None
+                raise ChaosReplicaCrash("sse mid-stream crash")
+
+        for core in client.cores:
+            core.chaos_hook = crash_soon
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/chat/completions",
+            data=json.dumps({
+                "messages": [{"role": "user", "content": "stream me"}],
+                "max_tokens": 64, "stream": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=120) as r:
+            body = r.read().decode()
+        assert '"error"' in body and "aborted" in body
+        assert "data: [DONE]" in body  # body stays well-formed SSE
+    finally:
+        for core in client.cores:
+            core.chaos_hook = None
+        srv.shutdown()
+
+
+def test_health_snapshot_marks_unresponsive_replica():
+    """A replica whose step thread holds the engine lock past the
+    snapshot's budget is reported ``unresponsive`` (the supervisor's
+    cheapest wedge signal), not silently thin."""
+    import threading
+
+    client = JaxTpuClient.for_testing(max_new_tokens=4, dp_replicas=2)
+    fleet = client.engine
+    hold = threading.Event()
+    held = threading.Event()
+
+    def holder():
+        with fleet.replicas[0]._lock:
+            held.set()
+            hold.wait(timeout=30.0)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert held.wait(timeout=10.0)
+    try:
+        snap = fleet.health_snapshot(lock_timeout=0.05)
+        by_replica = {r["replica"]: r for r in snap["replicas"]}
+        assert by_replica[0]["status"] == "unresponsive"
+        assert by_replica[1]["status"] == "ok"
+        assert snap["unresponsive_replicas"] == [0]
+    finally:
+        hold.set()
+        t.join(timeout=10.0)
+    snap = fleet.health_snapshot()
+    assert all(r["status"] == "ok" for r in snap["replicas"])
+    assert "unresponsive_replicas" not in snap
+
+
+def test_health_snapshot_marks_quarantined_replica():
+    client = JaxTpuClient.for_testing(max_new_tokens=4, dp_replicas=2)
+    fleet = client.engine
+    fleet.quarantine(0)
+    snap = fleet.health_snapshot()
+    by_replica = {r["replica"]: r for r in snap["replicas"]}
+    assert by_replica[0]["status"] == "quarantined"
+    assert snap["router"]["quarantined"] == [0]
+    assert fleet.available_replicas() == 1 and not fleet.failing_over()
+    fleet.quarantine(1)
+    assert fleet.failing_over()
+    fleet.unquarantine(0)
+    fleet.unquarantine(1)
+
+
+async def test_rebuild_replica_swaps_core_and_rebinds_metrics():
+    """Online rebuild as a first-class operation: the replica position
+    gets a fresh EngineCore (same replica id, same device slice), the
+    per-replica metric callbacks read the NEW core, and the fleet
+    serves byte-identically afterwards."""
+    client = JaxTpuClient.for_testing(max_new_tokens=8, dp_replicas=2)
+    fleet = client.engine
+    base = await fleet.generate(ids("pre rebuild probe"), sp(8))
+    old_core = fleet.cores[0]
+    old_replica = fleet.replicas[0]
+    new_core = fleet.rebuild_replica(0)
+    assert new_core is not old_core
+    assert fleet.cores[0] is new_core
+    assert fleet.replicas[0] is not old_replica
+    assert old_replica._stopped  # the abandoned loop exits on wake
+    assert new_core.replica_idx == 0
+    # Same device slice: the params tree was reused in place.
+    assert new_core.mesh is old_core.mesh
+    out = await fleet.generate(ids("pre rebuild probe"), sp(8))
+    assert out.token_ids == base.token_ids
+    # Scrape reads the NEW core (its decode counter, not the corpse's).
+    fleet._install_metrics()
+    text = get_registry().render()
+    assert ('runbook_replica_decode_tokens_total'
+            '{model="llama3-test",replica="0"}') in text
+    await fleet.stop()
+
+
 # ------------------------------------------------------------ observability
 
 

@@ -1559,6 +1559,24 @@ class TestTreeIsClean:
     def test_sched_package_noqa_is_rbk010_only(self):
         self._package_noqa_is_rbk010_only("sched")
 
+    def test_chaos_package_has_zero_noqa_sites(self):
+        """chaos/ sanctions NOTHING — zero runbook-noqa markers of any
+        rule: its supervisor/injector threading is exactly what the
+        RBK007–010 concurrency rules exist to check, and its metric
+        labels are designed statically bounded (state/kind literal
+        tuples; per-replica detail lives in the /healthz supervisor
+        block, not in label values)."""
+        import re
+
+        files = sorted((ROOT / "runbookai_tpu" / "chaos").glob("*.py"))
+        assert files, "chaos package missing"
+        for path in files:
+            assert not re.search(r"noqa\[[A-Z0-9]+\]", path.read_text()), (
+                f"unexpected runbook noqa in {path}")
+        findings = analyze_paths([ROOT / "runbookai_tpu" / "chaos"],
+                                 root=ROOT)
+        assert findings == [], "\n".join(f.format() for f in findings)
+
     def test_rbk010_inventory_pinned(self):
         """Every RBK010 suppression documents a label whose value set is
         bounded at RUNTIME by config or registration (group names, replica
@@ -1570,7 +1588,7 @@ class TestTreeIsClean:
         widening this pin."""
         expected = {
             "engine/fleet.py": {"_route": 2, "_disagg_warm": 1,
-                                "_install_metrics": 9},
+                                "_install_metrics": 10},
             "fleet/multimodel.py": {"_install_metrics": 1},
             # Attribution is nearest-preceding-def: monitor's sites sit
             # after the nested fp_value/drift_or_raise helpers.

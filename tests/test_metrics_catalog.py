@@ -89,6 +89,20 @@ def test_live_registry_matches_doc_catalog(monkeypatch, tmp_path):
     # Workload monitor (fingerprints, drift, plan staleness, health).
     fp = WorkloadFingerprinter(client.cores, model="a", window_s=300)
     WorkloadMonitor({"a": fp}, {"a": ({}, "default")}, registry=fresh)
+    # Chaos supervision + fault injection (runbookai_tpu/chaos):
+    # supervisor state/transition/rebuild/failover series and the
+    # per-kind fault counter (the retry-backoff histogram registers
+    # with the fleet build above). Neither is started — registration
+    # is construction-time.
+    from runbookai_tpu.chaos import (
+        ChaosInjector,
+        FaultSchedule,
+        FleetSupervisor,
+    )
+
+    FleetSupervisor(client.engine, registry=fresh)
+    ChaosInjector(client.engine, FaultSchedule.generate(1, 5.0, 2),
+                  registry=fresh)
     # Trace rotation counter registers lazily at the first rotation.
     from runbookai_tpu.utils import trace as trace_mod
 
