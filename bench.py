@@ -1548,7 +1548,8 @@ def run_soak_bench(duration_s: float, models_spec: str | None,
 
 
 def _soak_scenarios_pass(fleet, mix, *, chaos_schedule=None,
-                         supervisor_kw=None, duration_s=0.0):
+                         supervisor_kw=None, duration_s=0.0,
+                         incident_dir=None):
     """Drive one scenario-mix pass through a live MultiModelFleet.
 
     Open-loop arrivals: each chain sleeps to its scheduled offset, then
@@ -1557,7 +1558,14 @@ def _soak_scenarios_pass(fleet, mix, *, chaos_schedule=None,
     FleetSupervisor attaches to every group fleet and a ChaosInjector
     walks the schedule against the FIRST group (the dp the schedule was
     generated for); the pass returns per-chain records plus the
-    supervisor/chaos snapshots the invariant gate is computed from."""
+    supervisor/chaos snapshots the invariant gate is computed from.
+
+    EVERY pass (chaos or baseline) runs an IncidentMonitor over the
+    group fleets — the detection-coverage invariant needs both sides:
+    injected fault windows must overlap detected incidents of matching
+    signal classes, and the chaos-free baseline must open ZERO (the
+    false-positive gate). Hysteresis scales with the run so a 2 s CPU
+    smoke and the 1800 s protocol exercise the same lifecycle."""
     import asyncio
     import random as _random
     import time as _time
@@ -1568,12 +1576,24 @@ def _soak_scenarios_pass(fleet, mix, *, chaos_schedule=None,
         FleetSaturated,
         SamplingParams,
     )
+    from runbookai_tpu.obs import (
+        IncidentDetector,
+        IncidentMonitor,
+        default_policies,
+    )
     from runbookai_tpu.sched import PRIORITY_BATCH, PRIORITY_INTERACTIVE
 
     model_groups = list(fleet.groups.values())
     supervisors = []
     injector = None
     records: dict[str, dict] = {}
+    incident_monitor = IncidentMonitor(
+        [g.fleet for g in model_groups],
+        detector=IncidentDetector(default_policies(
+            open_after_s=min(5.0, max(0.2, duration_s * 0.1)),
+            resolve_after_s=min(10.0, max(0.4, duration_s * 0.2)))),
+        bundle_dir=incident_dir, max_bundles=64,
+        poll_interval_s=0.02)
 
     async def run_turn(chain, turn, prompt, rec):
         sampling = SamplingParams(
@@ -1656,6 +1676,7 @@ def _soak_scenarios_pass(fleet, mix, *, chaos_schedule=None,
         loop = asyncio.get_running_loop()
         t_origin = _time.monotonic()
         wall_origin = _time.time()
+        incident_monitor.start()
         if chaos_schedule is not None:
             for g in model_groups:
                 sup = FleetSupervisor(g.fleet, **(supervisor_kw or {}))
@@ -1720,6 +1741,7 @@ def _soak_scenarios_pass(fleet, mix, *, chaos_schedule=None,
             injector.stop()
         for sup in supervisors:
             sup.stop()
+        incident_monitor.stop()
         await fleet.stop()
         return t_origin, wall_origin
 
@@ -1732,6 +1754,7 @@ def _soak_scenarios_pass(fleet, mix, *, chaos_schedule=None,
         "wall_origin": wall_origin,
         "chaos": injector.snapshot() if injector is not None else None,
         "supervisors": [s.snapshot() for s in supervisors],
+        "incidents": incident_monitor.incidents(),
     }
 
 
@@ -1768,6 +1791,69 @@ def _soak_effective_windows(passed: dict) -> list[tuple[float, float]]:
             windows.append((start - 0.1,
                             rejoin_after(t["replica"], start) + 0.1))
     return windows
+
+
+def _incident_coverage(chaotic: dict) -> tuple[list[dict], bool]:
+    """Detection-coverage table: one row per APPLIED fault window —
+    which signal class detected it and how long detection took (MTTD).
+    Crash/wedge windows extend to the target replica's rejoin (same
+    recovery extension as the lost-request gate). Returns ``(rows,
+    required_ok)``: kinds in ``COVERAGE_REQUIRED_KINDS`` (their
+    detection path — supervisor transitions — is deterministic) MUST
+    overlap a detected incident; other kinds are reported but a miss
+    does not fail the gate (a 10 ms kv_pull_delay legitimately detects
+    as nothing)."""
+    from runbookai_tpu.obs import (
+        COVERAGE_REQUIRED_KINDS,
+        FAULT_SIGNAL_CLASSES,
+    )
+
+    chaos = chaotic.get("chaos")
+    if not chaos:
+        return [], True
+    wall_origin = chaotic["wall_origin"]
+    transitions = [t for s in chaotic["supervisors"]
+                   for t in s["transitions"]]
+
+    def rejoin_after(replica, start):
+        rejoins = [t["ts"] - wall_origin for t in transitions
+                   if t["replica"] == replica and t["to"] == "healthy"
+                   and t["ts"] - wall_origin >= start]
+        return min(rejoins) if rejoins else float("inf")
+
+    spans = [(inc, inc["opened_ts"] - wall_origin,
+              (inc["resolved_ts"] - wall_origin)
+              if inc.get("resolved_ts") is not None else float("inf"))
+             for inc in chaotic.get("incidents", ())]
+    rows: list[dict] = []
+    required_ok = True
+    for w in chaos["windows"]:
+        if w["status"] != "applied":
+            continue
+        start, end = w["applied_at_s"], w["ends_at_s"]
+        if w["kind"] in ("replica_crash", "replica_wedge"):
+            end = rejoin_after(w["replica"], start)
+        expected = FAULT_SIGNAL_CLASSES.get(w["kind"], ())
+        hits = [(inc, opened) for inc, opened, resolved in spans
+                if inc["signal"] in expected
+                and opened <= end + 0.25 and resolved >= start - 0.25]
+        hit = min(hits, key=lambda p: p[1]) if hits else None
+        required = w["kind"] in COVERAGE_REQUIRED_KINDS
+        if required and hit is None:
+            required_ok = False
+        rows.append({
+            "kind": w["kind"],
+            "replica": w["replica"],
+            "window_s": [round(start, 3),
+                         round(end, 3) if end != float("inf") else None],
+            "expected_signals": list(expected),
+            "detected_signal": hit[0]["signal"] if hit else None,
+            "incident": hit[0]["id"] if hit else None,
+            "mttd_s": (round(max(0.0, hit[1] - start), 3)
+                       if hit else None),
+            "required": required,
+        })
+    return rows, required_ok
 
 
 def _overlaps(rec: dict, windows) -> bool:
@@ -1846,8 +1932,12 @@ def run_soak_scenarios_bench(duration_s: float, models_spec: str | None,
             warm_new_tokens=new_tokens, warm_seed=20_011)
 
     import resource
+    import shutil
+    import tempfile
 
-    # Baseline pass: same mix, no chaos — the digest reference.
+    # Baseline pass: same mix, no chaos — the digest reference AND the
+    # detection false-positive gate (its incident monitor must open
+    # zero incidents against fault-free traffic).
     baseline = _soak_scenarios_pass(build(), mix, duration_s=duration_s)
 
     fd_dir = "/proc/self/fd"
@@ -1855,10 +1945,19 @@ def run_soak_scenarios_bench(duration_s: float, models_spec: str | None,
                   else None)
     rss_before_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
 
+    # Black-box capture target for the chaos pass: keep the bundles when
+    # the operator names a directory, else a temp dir verified + pruned
+    # after the gate reads it.
+    incident_dir = os.environ.get("BENCH_INCIDENT_DIR")
+    keep_bundles = bool(incident_dir)
+    if not incident_dir:
+        incident_dir = tempfile.mkdtemp(prefix="bench-incidents-")
+
     fleet = build()
     chaotic = _soak_scenarios_pass(
         fleet, mix, chaos_schedule=schedule,
-        supervisor_kw=supervisor_kw, duration_s=duration_s)
+        supervisor_kw=supervisor_kw, duration_s=duration_s,
+        incident_dir=incident_dir)
     # Read AFTER the pass: a rebuild swapped the crashed replica's core,
     # and the throughput/flight summaries must cover the live fleet.
     all_cores = fleet.cores
@@ -1909,6 +2008,44 @@ def run_soak_scenarios_bench(duration_s: float, models_spec: str | None,
         for w in chaotic["chaos"]["windows"]
         if w["kind"] == "replica_crash" and w["status"] == "applied"
         for state in ("failed", "rebuilding", "rejoining", "healthy"))
+    # Detection coverage (obs/detect.py, obs/incident.py): every
+    # REQUIRED injected fault window overlaps a detected incident of a
+    # matching signal class; the chaos-free baseline opened zero
+    # incidents; every captured bundle is schema-valid and its content
+    # hash verifies.
+    coverage_rows, coverage_required_ok = _incident_coverage(chaotic)
+    baseline_opens = len(baseline.get("incidents", ()))
+    from pathlib import Path as _Path
+
+    from runbookai_tpu.obs import BUNDLE_SCHEMA_VERSION
+    from runbookai_tpu.obs.incident import bundle_hash, load_bundle
+
+    # Verify THIS run's bundles only (each incident records the bundle
+    # it captured): a shared BENCH_INCIDENT_DIR may hold bundles from
+    # earlier runs, and neither a stale corrupt file nor a stale valid
+    # one may decide this run's verdict. An incident with NO recorded
+    # bundle is itself a failure — the black box went dark exactly when
+    # it mattered. One load per bundle; the hash check is inline.
+    bundle_rows = []
+    for inc in chaotic.get("incidents", ()):
+        name = inc.get("bundle")
+        row = {"incident": inc["id"], "name": name,
+               "hash_verified": False, "schema_valid": False}
+        if name:
+            try:
+                doc = load_bundle(_Path(incident_dir) / name)
+            except (OSError, json.JSONDecodeError):
+                doc = None
+            if doc is not None:
+                row["hash_verified"] = (doc.get("content_hash")
+                                        == bundle_hash(doc))
+                row["schema_valid"] = (doc.get("schema_version")
+                                       == BUNDLE_SCHEMA_VERSION)
+        bundle_rows.append(row)
+    if not keep_bundles:
+        shutil.rmtree(incident_dir, ignore_errors=True)
+    bundles_ok = all(b["hash_verified"] and b["schema_valid"]
+                     for b in bundle_rows)
     invariants = {
         "zero_lost_outside_fault_windows": {
             "passed": not lost_outside,
@@ -1941,6 +2078,13 @@ def run_soak_scenarios_bench(duration_s: float, models_spec: str | None,
         "supervisor_recovered": {
             "passed": recovered,
             "crash_applied": crash_applied},
+        "detection_coverage": {
+            "passed": (coverage_required_ok and baseline_opens == 0
+                       and bundles_ok),
+            "required_covered": coverage_required_ok,
+            "baseline_opens": baseline_opens,
+            "chaos_incidents": len(chaotic.get("incidents", ())),
+            "bundles": bundle_rows},
     }
     total_decode = sum(c.metrics["decode_tokens"] for c in all_cores)
     max_decode_t = max(c.metrics["decode_time_s"] for c in all_cores)
@@ -1965,6 +2109,11 @@ def run_soak_scenarios_bench(duration_s: float, models_spec: str | None,
         "fault_windows": [[round(s, 3),
                            (round(e, 3) if e != float("inf") else None)]
                           for s, e in windows],
+        # Fault kind → detected signal + MTTD, one row per applied
+        # window — the banked detection-coverage table (obs/detect.py's
+        # FAULT_SIGNAL_CLASSES mapping).
+        "incident_coverage": coverage_rows,
+        "incidents": chaotic.get("incidents", []),
         "invariants": invariants,
         "invariants_passed": all(v["passed"]
                                  for v in invariants.values()),

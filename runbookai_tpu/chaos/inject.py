@@ -363,6 +363,16 @@ class ChaosInjector:
 
     # -------------------------------------------------------- observability
 
+    def active_windows(self) -> list[dict]:
+        """Fault windows active RIGHT NOW, with provenance — the chaos
+        block an opened incident's context carries (obs/incident.py): an
+        incident during an injected fault says which fault."""
+        now = self._elapsed() if self._t0 is not None else 0.0
+        with self._lock:
+            return [dict(w) for w in self.windows
+                    if w["status"] == "applied"
+                    and w["applied_at_s"] <= now < w["ends_at_s"]]
+
     def snapshot(self) -> dict:
         """The ``/healthz`` ``chaos`` block: schedule identity, applied
         windows with provenance, and which are active right now."""

@@ -927,6 +927,133 @@ def cmd_chaos(args) -> int:
     return 0
 
 
+def _incident_feed(args) -> tuple[list[dict], str | None, str]:
+    """Incident docs + bundle-dir for ``runbook incident``: a running
+    server's ``GET /debug/incidents`` when reachable, else the incident
+    headers read straight off the on-disk bundle directory (``--dir`` /
+    ``llm.obs.incident_dir``) — a dead server's black box is exactly
+    when this command matters most."""
+    from runbookai_tpu.obs.incident import list_bundles, load_bundle
+
+    url = args.url.rstrip("/") + "/debug/incidents"
+    try:
+        snap = _fetch_json(url, args.timeout)
+    except (OSError, TimeoutError, ValueError):
+        snap = None
+    if snap is not None and snap.get("enabled"):
+        incidents = list(snap.get("open", [])) + list(snap.get("recent", []))
+        return incidents, snap.get("bundle_dir"), url
+    directory = args.dir
+    if directory is None:
+        config = _load(args)
+        directory = config.llm.obs.incident_dir
+    if not directory:
+        source = ("incident detection is disabled on this server"
+                  if snap is not None else f"no server at {args.url}")
+        return [], None, source + " and no bundle dir configured (--dir)"
+    incidents = []
+    for path in list_bundles(directory):
+        try:
+            incidents.append(load_bundle(path).get("incident") or {})
+        except (OSError, json.JSONDecodeError):
+            continue
+    return incidents, str(directory), f"bundles in {directory}"
+
+
+def _render_incidents(incidents: list[dict]) -> str:
+    if not incidents:
+        return "no incidents"
+    cols = ("id", "signal", "severity", "status", "opened", "duration",
+            "peak", "bundle")
+    rows = []
+    for inc in sorted(incidents, key=lambda i: i.get("id", "")):
+        dur = inc.get("duration_s")
+        rows.append((
+            str(inc.get("id", "?")), str(inc.get("signal", "?")),
+            str(inc.get("severity", "?")), str(inc.get("status", "?")),
+            str(inc.get("opened_ts", "?")),
+            "-" if dur is None else f"{dur:.1f}s",
+            str(inc.get("peak", "-")), inc.get("bundle") or "-"))
+    widths = [max(len(c), *(len(r[i]) for r in rows))
+              for i, c in enumerate(cols)]
+    out = ["  ".join(c.ljust(w) for c, w in zip(cols, widths))]
+    out += ["  ".join(v.ljust(w) for v, w in zip(r, widths)) for r in rows]
+    return "\n".join(out)
+
+
+def cmd_incident(args) -> int:
+    """``runbook incident list|show [--bundle]`` — the fleet's incident
+    feed (obs/incident.py): detected incidents with their lifecycle
+    state, and the captured black-box bundles. ``show <id> --bundle``
+    loads the incident's bundle, VERIFIES its content hash, and prints
+    the evidence inventory — a bundle that fails verification is not
+    evidence."""
+    incidents, bundle_dir, source = _incident_feed(args)
+    if args.incident_cmd == "list":
+        if args.json:
+            print(json.dumps(incidents, indent=2))
+        else:
+            print(f"# {source}")
+            print(_render_incidents(incidents))
+        return 0
+    # show <id>
+    inc = next((i for i in incidents if i.get("id") == args.id), None)
+    if inc is None:
+        print(f"no incident {args.id!r} ({source}); known: "
+              f"{sorted(i.get('id', '?') for i in incidents)}",
+              file=sys.stderr)
+        return 1
+    if not args.bundle:
+        print(json.dumps(inc, indent=2, sort_keys=True))
+        return 0
+    from runbookai_tpu.obs.incident import (
+        bundle_hash,
+        list_bundles,
+        load_bundle,
+    )
+
+    if not bundle_dir:
+        print("no bundle directory (server has no llm.obs.incident_dir; "
+              "pass --dir)", file=sys.stderr)
+        return 1
+    # Bundle names are <captured-ms>-<id>-<signal>.json; ids restart
+    # per process, so prefer the NEWEST match for this id.
+    matches = [p for p in list_bundles(bundle_dir)
+               if f"-{args.id}-" in p.name]
+    if inc.get("bundle"):
+        matches = [p for p in matches if p.name == inc["bundle"]] or matches
+    if not matches:
+        print(f"no bundle for {args.id!r} in {bundle_dir}",
+              file=sys.stderr)
+        return 1
+    path = matches[-1]
+    # One load serves the hash check AND the rendering below.
+    doc = load_bundle(path)
+    expected = str(doc.get("content_hash", ""))
+    actual = bundle_hash(doc)
+    ok = expected == actual
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0 if ok else 1
+    evidence = doc.get("evidence", {})
+    print(f"# {path}")
+    print(f"schema_version: {doc.get('schema_version')}")
+    print(f"content_hash: {expected} "
+          f"[{'verified' if ok else 'MISMATCH — got ' + actual}]")
+    print(f"captured_ts: {doc.get('captured_ts')}")
+    print("incident:")
+    print(json.dumps(doc.get("incident"), indent=2, sort_keys=True))
+    print("evidence:")
+    for key in sorted(evidence):
+        val = evidence[key]
+        size = (len(val) if isinstance(val, (list, str))
+                else len(json.dumps(val)))
+        unit = ("records" if isinstance(val, list)
+                else "bytes" if isinstance(val, str) else "json bytes")
+        print(f"  {key}: {size} {unit}")
+    return 0 if ok else 1
+
+
 def _render_workload(snap: dict) -> str:
     """Table view of a /debug/workload snapshot."""
     if not snap.get("enabled"):
@@ -1721,6 +1848,38 @@ def build_parser() -> argparse.ArgumentParser:
                            help="raw JSON instead of the table")
     ch_status.add_argument("--timeout", type=float, default=10.0)
     ch.set_defaults(fn=cmd_chaos)
+
+    inc = sub.add_parser(
+        "incident", help="fleet incident feed + captured black-box "
+                         "bundles (obs/incident.py): live from "
+                         "GET /debug/incidents, else from the bundle "
+                         "directory")
+    inc_sub = inc.add_subparsers(dest="incident_cmd", required=True)
+
+    def _incident_args(p) -> None:
+        p.add_argument("--url", default="http://127.0.0.1:8000",
+                       help="server base URL (GET <url>/debug/incidents)")
+        p.add_argument("--dir", default=None,
+                       help="bundle directory fallback (default: "
+                            "llm.obs.incident_dir)")
+        p.add_argument("--json", action="store_true",
+                       help="raw JSON instead of the table")
+        p.add_argument("--timeout", type=float, default=10.0)
+
+    inc_list = inc_sub.add_parser(
+        "list", help="detected incidents: lifecycle state, severity, "
+                     "peak, captured bundle")
+    _incident_args(inc_list)
+    inc_show = inc_sub.add_parser(
+        "show", help="one incident in full; --bundle loads + "
+                     "hash-verifies its black-box bundle")
+    inc_show.add_argument("id", help="incident id (inc-0001)")
+    inc_show.add_argument("--bundle", action="store_true",
+                          help="load the incident's bundle, verify its "
+                               "content hash, print the evidence "
+                               "inventory")
+    _incident_args(inc_show)
+    inc.set_defaults(fn=cmd_incident)
 
     met = sub.add_parser(
         "metrics", help="scrape a server's /metrics or summarize a trace")

@@ -679,6 +679,14 @@ class AsyncFleet:
             tracer.event("router.page_pull", **meta)
         return pulled
 
+    def shed_total(self) -> int:
+        """Requests this fleet shed (every replica over
+        ``shed_queue_depth``) — the public accessor the incident
+        detector's ``router_shed`` delta signal reads
+        (obs/incident.py), so detection never touches the private
+        metric child."""
+        return int(self._m_shed.value)
+
     def stale_rejections(self) -> int:
         """Total stale-pull count across reasons for THIS fleet's model
         label (the /healthz ``kv_share.stale_rejections`` figure): pulls

@@ -92,6 +92,28 @@ def _wire_supervisors(client, llm_cfg, fleets) -> None:
         ).start())
 
 
+def _wire_incidents(client, llm_cfg) -> None:
+    """Attach + start the incident monitor (obs/incident.py) over every
+    fleet the client serves through: it folds the exported signals (SLO
+    burn, workload drift, replica health, supervisor states, router
+    sheds/stale pulls, queue-wait percentiles) into an incident
+    lifecycle and captures a content-hashed evidence bundle on every
+    open (``llm.obs.incident_dir``). ``GET /debug/incidents``, the
+    ``/healthz`` ``incidents`` block and ``runbook incident`` all read
+    it; None when ``llm.obs`` (or ``incidents_enabled``) is off."""
+    from runbookai_tpu.obs.incident import IncidentMonitor
+
+    mm = client.multi_model
+    fleets = ([g.fleet for g in mm.groups.values()] if mm is not None
+              else [client.engine])
+    monitor = IncidentMonitor.from_config(
+        llm_cfg, fleets=fleets, cores=client.cores,
+        slo_monitor=client.slo_monitor,
+        workload_monitor=client.workload_monitor)
+    if monitor is not None:
+        client.incident_monitor = monitor.start()
+
+
 class JaxTpuClient(BaseLLMClient):
     def __init__(
         self,
@@ -154,6 +176,10 @@ class JaxTpuClient(BaseLLMClient):
         # /debug/workload, the /healthz workload block and the `runbook
         # workload` CLI all read it; None = zero workload surface.
         self.workload_monitor = workload_monitor
+        # Incident monitor (obs/incident.py, wired by _wire_incidents in
+        # from_config): detection + black-box capture. None = zero
+        # incident surface (/debug/incidents reports itself disabled).
+        self.incident_monitor = None
 
     # --------------------------------------------------------- model groups
 
@@ -255,6 +281,7 @@ class JaxTpuClient(BaseLLMClient):
                 workload_monitor=build_workload_monitor(multi_model=engine))
             _wire_supervisors(client, llm_cfg,
                               [g.fleet for g in engine.groups.values()])
+            _wire_incidents(client, llm_cfg)
             return client
         built = build_group(llm_cfg)
         wire_feedback(built.cores, built.llm_cfg, slo_monitor)
@@ -275,6 +302,7 @@ class JaxTpuClient(BaseLLMClient):
 
         if isinstance(client.engine, AsyncFleet):
             _wire_supervisors(client, llm_cfg, [client.engine])
+        _wire_incidents(client, llm_cfg)
         return client
 
     @classmethod

@@ -7,8 +7,23 @@ against the serving plan's provenance workload, and export a composite
 per-replica health signal. Read-only by design — nothing here changes a
 plan or moves traffic, so byte-identity with an unmonitored engine is
 structural (pinned by tests/test_obs.py).
+
+Incident detection rides on top (``detect.py`` pure, ``incident.py``
+live): the exported signals fold into an incident lifecycle with
+hysteresis, and every open preserves a content-hashed black-box bundle
+— the fleet writes its own postmortems (tests/test_incident.py).
 """
 
+from runbookai_tpu.obs.detect import (
+    COVERAGE_REQUIRED_KINDS,
+    FAULT_SIGNAL_CLASSES,
+    INCIDENT_SCHEMA_VERSION,
+    INCIDENT_SIGNALS,
+    IncidentDetector,
+    SignalPolicy,
+    default_policies,
+    incidents_json,
+)
 from runbookai_tpu.obs.fingerprint import (
     DEFAULT_DRIFT_THRESHOLD,
     DESCRIPTOR_KEYS,
@@ -18,6 +33,15 @@ from runbookai_tpu.obs.fingerprint import (
     descriptor_json,
     drift_score,
 )
+from runbookai_tpu.obs.incident import (
+    BUNDLE_SCHEMA_VERSION,
+    IncidentMonitor,
+    bundle_hash,
+    list_bundles,
+    load_bundle,
+    verify_bundle,
+    write_bundle,
+)
 from runbookai_tpu.obs.monitor import (
     FingerprintHistory,
     WorkloadMonitor,
@@ -26,15 +50,30 @@ from runbookai_tpu.obs.monitor import (
 )
 
 __all__ = [
+    "BUNDLE_SCHEMA_VERSION",
+    "COVERAGE_REQUIRED_KINDS",
     "DEFAULT_DRIFT_THRESHOLD",
     "DESCRIPTOR_KEYS",
+    "FAULT_SIGNAL_CLASSES",
     "FingerprintHistory",
+    "INCIDENT_SCHEMA_VERSION",
+    "INCIDENT_SIGNALS",
+    "IncidentDetector",
+    "IncidentMonitor",
     "RequestSample",
+    "SignalPolicy",
     "WorkloadFingerprinter",
     "WorkloadMonitor",
     "build_fingerprint",
+    "bundle_hash",
+    "default_policies",
     "descriptor_json",
     "drift_score",
+    "incidents_json",
+    "list_bundles",
+    "load_bundle",
     "reference_descriptor",
     "replica_health",
+    "verify_bundle",
+    "write_bundle",
 ]

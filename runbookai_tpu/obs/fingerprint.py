@@ -42,7 +42,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Any, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 from runbookai_tpu.utils.trace import _percentile
 
@@ -293,10 +293,15 @@ class WorkloadFingerprinter:
 
     def __init__(self, cores: Sequence[Any] = (), *,
                  model: str = "default", window_s: float = 300.0,
-                 max_samples: int = 4096):
+                 max_samples: int = 4096,
+                 clock: Callable[[], float] = time.time):
         self.cores = list(cores)
         self.model = model
         self.window_s = float(window_s)
+        # Injected clock seam (the supervisor's flap-damping pattern):
+        # window math is a pure function of it, so interval/rotation
+        # tests drive a fake clock instead of sleeping wall time.
+        self._clock = clock
         self._samples: deque[RequestSample] = deque(maxlen=max(16,
                                                                max_samples))
         self._lock = threading.Lock()
@@ -312,7 +317,7 @@ class WorkloadFingerprinter:
 
         sampling = req.sampling
         sample = RequestSample(
-            ts=time.time(),
+            ts=self._clock(),
             prompt_tokens=len(req.prompt_ids),
             output_tokens=req.num_generated,
             cached_tokens=req.cached_tokens,
@@ -357,7 +362,7 @@ class WorkloadFingerprinter:
     def fingerprint(self, now: Optional[float] = None
                     ) -> Optional[dict[str, Any]]:
         """The window's fingerprint, or None while it is empty."""
-        now = time.time() if now is None else float(now)
+        now = self._clock() if now is None else float(now)
         t0 = now - self.window_s
         return build_fingerprint(
             self.samples(), self._step_records(t0), self._metrics(),

@@ -35,9 +35,8 @@ from __future__ import annotations
 
 import json
 import threading
-import time
 from pathlib import Path
-from typing import Any, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 from runbookai_tpu.obs.fingerprint import (
     DEFAULT_DRIFT_THRESHOLD,
@@ -157,10 +156,18 @@ class WorkloadMonitor:
                  slo_monitor: Any = None, tenants: Any = None,
                  history: Optional[FingerprintHistory] = None,
                  history_interval_s: float = 60.0,
+                 clock: Optional[Callable[[], float]] = None,
                  registry: Optional[metrics_mod.MetricsRegistry] = None):
         if not fingerprinters:
             raise ValueError("a workload monitor needs >= 1 fingerprinter")
         self.fingerprinters = dict(fingerprinters)
+        # Injected clock seam (the supervisor's flap-damping pattern):
+        # history-rotation intervals and the scrape memo are pure
+        # functions of it, so interval tests drive a fake clock instead
+        # of wall-clock sleeps. Defaults to the first fingerprinter's
+        # clock so window math and rotation timing cannot disagree.
+        self._clock = clock if clock is not None else \
+            next(iter(self.fingerprinters.values()))._clock
         self.references = {name: references.get(name, ({}, "default"))
                            for name in fingerprinters}
         self.drift_threshold = float(drift_threshold)
@@ -179,7 +186,7 @@ class WorkloadMonitor:
 
     def _fp(self, model: str) -> Optional[dict[str, Any]]:
         """Memoized fingerprint (one fold serves a whole scrape pass)."""
-        now = time.time()
+        now = self._clock()
         with self._memo_lock:
             cached = self._memo.get(model)
             if cached is not None and now - cached[0] < _FINGERPRINT_MEMO_S:
@@ -223,7 +230,7 @@ class WorkloadMonitor:
         if len(self.fingerprinters) == 1:
             fp = self._fp(next(iter(self.fingerprinters)))
             return None if fp is None else {**fp, "model": "fleet"}
-        now = time.time() if now is None else float(now)
+        now = self._clock() if now is None else float(now)
         with self._memo_lock:
             cached = self._memo.get(self._MERGED_KEY)
             if cached is not None and now - cached[0] < _FINGERPRINT_MEMO_S:
@@ -299,7 +306,7 @@ class WorkloadMonitor:
     def _maybe_record(self, body: dict[str, Any]) -> None:
         if self.history is None:
             return
-        now = time.time()
+        now = self._clock()
         if now - self._history_last < self.history_interval_s:
             return
         self._history_last = now

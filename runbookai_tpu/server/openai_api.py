@@ -85,7 +85,7 @@ from runbookai_tpu.utils.trace import get_tracer
 _KNOWN_ROUTES = frozenset((
     "/v1/chat/completions", "/v1/completions", "/v1/embeddings",
     "/v1/adapters", "/v1/models", "/healthz", "/metrics", "/debug/steps",
-    "/debug/workload", "/tenants",
+    "/debug/workload", "/debug/incidents", "/tenants",
 ))
 
 # Every status this server emits; anything novel scrapes as "other" so the
@@ -585,6 +585,17 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 self._json(200, monitor.snapshot() if monitor is not None
                            else {"enabled": False, "models": {}})
                 return
+            if path == "/debug/incidents":
+                # Live incident feed + captured-bundle listing
+                # (obs/incident.py). Without a monitor the surface
+                # reports itself disabled (not 404 — the CLI
+                # distinguishes "off" from "no server"), matching
+                # /debug/workload and /tenants.
+                monitor = getattr(client, "incident_monitor", None)
+                self._json(200, monitor.snapshot(full=True)
+                           if monitor is not None
+                           else {"enabled": False, "open": []})
+                return
             if path == "/v1/models":
                 mm = getattr(client, "multi_model", None)
                 if mm is not None:
@@ -647,6 +658,14 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     # per-group for multi-model fleets, merged
                     # fleet-wide like debug_steps.
                     body["workload"] = monitor.snapshot()
+                incidents = getattr(client, "incident_monitor", None)
+                if incidents is not None:
+                    # Incident feed (obs/incident.py): open incidents +
+                    # per-signal totals. Block present only when a
+                    # monitor is attached, and totals carry only
+                    # signals that HAVE incidents — absence-not-zero,
+                    # the runbook_slo_* contract.
+                    body["incidents"] = incidents.snapshot()
                 self._json(200, body)
             elif path == "/tenants":
                 # Tenant accounting state (sched/tenants.py): configured

@@ -276,6 +276,26 @@ class ObsConfig(BaseModel):
     # Drift reference when no serving plan is pinned (plan provenance
     # wins when llm.plan / llm.models[].plan is set).
     workload: Optional[WorkloadDescriptorConfig] = None
+    # Incident detection + black-box capture (obs/detect.py,
+    # obs/incident.py): fold the exported signals (SLO burn, drift,
+    # replica health, supervisor states, router sheds/stale pulls,
+    # queue-wait percentiles) into an incident lifecycle with hysteresis
+    # and capture a content-hashed evidence bundle on every open.
+    # Surfaced on GET /debug/incidents, the /healthz `incidents` block,
+    # `runbook incident list|show` and runbook_incident_*{signal}.
+    incidents_enabled: bool = True
+    # Bundle directory (None = detect + surface, but capture nothing).
+    incident_dir: Optional[str] = None
+    # Rotation bound: oldest bundles pruned past this count.
+    incident_max_bundles: int = Field(16, ge=1)
+    incident_poll_interval_s: float = Field(1.0, gt=0)
+    # Hysteresis (both directions) for the level-shaped signals: a
+    # breach must persist incident_open_s before an incident opens, and
+    # an open incident must stay clear for incident_resolve_s before it
+    # resolves. Event-shaped signals (replica_failure, router_stale)
+    # keep their own constants — see obs/detect.default_policies.
+    incident_open_s: float = Field(5.0, ge=0)
+    incident_resolve_s: float = Field(10.0, ge=0)
 
 
 # Keys a model-group entry owns (or that cannot nest): a group's

@@ -29,6 +29,11 @@ from typing import Any, Optional
 # Dispatch spans that carry a meta.requests attribution list.
 DISPATCH_SPANS = ("engine.prefill", "engine.decode", "engine.decode_spec",
                   "engine.mixed")
+
+# Fleet-wide incident markers (obs/incident.py): not owned by any one
+# request, but stitched into every timeline they overlap — a dp retry
+# during an incident must be visible in one view.
+INCIDENT_EVENTS = ("incident.open", "incident.resolve")
 _DISPATCH_LABEL = {
     "engine.prefill": "prefill chunk",
     "engine.decode": "decode window",
@@ -154,10 +159,46 @@ def build_timeline(spans: list[dict[str, Any]],
             ev["label"] = name
         events.append(ev)
     last = max(ev["rel_ms"] + ev["ms"] for ev in events)
+    # Incident span band: fleet-wide incident.open/resolve markers
+    # overlapping this request's window ride into the timeline (with a
+    # small slack so an open that preceded the request by a beat still
+    # shows), labeled so the operator sees the request's dispatches AND
+    # the incident they ran inside in one view.
+    incidents: set[str] = set()
+    t_end = t0 + last / 1e3
+    for rec in spans:
+        name = str(rec.get("name", ""))
+        if name not in INCIDENT_EVENTS:
+            continue
+        ts = float(rec.get("ts", 0.0))
+        if not (t0 - 1.0 <= ts <= t_end + 1.0):
+            continue
+        meta = _meta(rec)
+        inc_id = str(meta.get("incident", "?"))
+        incidents.add(inc_id)
+        ev = {
+            "name": name,
+            "rel_ms": round((ts - t0) * 1e3, 3),
+            "ms": 0.0,
+            "incident": inc_id,
+            "signal": meta.get("signal"),
+        }
+        if name == "incident.open":
+            ev["label"] = (f"⚠ incident open: {meta.get('signal')} "
+                           f"({inc_id}, {meta.get('severity', '?')})")
+        else:
+            dur = meta.get("duration_s")
+            ev["label"] = (f"✓ incident resolve: {meta.get('signal')} "
+                           f"({inc_id}"
+                           + (f", {dur}s" if dur is not None else "")
+                           + ")")
+        events.append(ev)
+    events.sort(key=lambda e: e["rel_ms"])
     return {
         "request_id": request_id,
         "engine_requests": sorted(rids - {request_id}),
         "replicas": sorted(replicas),
+        "incidents": sorted(incidents),
         "total_ms": round(last, 3),
         "finish": ({"reason": finish.get("reason"),
                     "generated": finish.get("generated"),
@@ -180,6 +221,8 @@ def render_timeline(tl: dict[str, Any], max_events: int = 60) -> str:
     if tl["replicas"]:
         head.append("  replicas: "
                     + ", ".join(str(r) for r in tl["replicas"]))
+    if tl.get("incidents"):
+        head.append("  incidents: " + ", ".join(tl["incidents"]))
     events = tl["events"]
     shown: list[Any] = list(events)
     if len(events) > max_events:

@@ -453,6 +453,22 @@ def test_bench_soak_scenarios_smoke_chaos_gate(monkeypatch, capsys):
     # The production-invariant gate: every verdict must hold.
     assert d["invariants_passed"] is True, d["invariants"]
     assert d["invariants"]["digest_determinism"]["compared"] > 0
+    # Detection coverage (PR 15): the injected crash window overlaps a
+    # detected replica_failure incident with a banked MTTD, a captured
+    # bundle verifies (schema + content hash), and the chaos-free
+    # baseline pass opened ZERO incidents (false-positive gate).
+    cov = d["invariants"]["detection_coverage"]
+    assert cov["passed"] is True, cov
+    assert cov["baseline_opens"] == 0
+    assert cov["bundles"] and all(
+        b["hash_verified"] and b["schema_valid"] for b in cov["bundles"])
+    crash_rows = [r for r in d["incident_coverage"]
+                  if r["kind"] == "replica_crash"]
+    assert crash_rows, d["incident_coverage"]
+    for row in crash_rows:
+        assert row["detected_signal"] == "replica_failure"
+        assert row["incident"] and row["mttd_s"] is not None
+    assert any(i["signal"] == "replica_failure" for i in d["incidents"])
     # Same refusal posture as the other fleet arms.
     monkeypatch.setenv("BENCH_DP", "2")
     import pytest

@@ -1,0 +1,570 @@
+"""Incident detection + black-box capture (runbookai_tpu/obs/detect.py,
+obs/incident.py).
+
+Pins: detector determinism (seeded fixture readings ⇒ byte-identical
+incident JSON), hysteresis in BOTH directions (a blip never opens, a
+band reading never resolves), the absence contract
+(``runbook_incident_open`` absent with nothing open — never 0 — while
+``runbook_incident_total`` materializes at 0 for rate()), bundle
+schema/hash/rotation (a tampered bundle fails verification), the
+fault-kind → signal-class coverage mapping, the server surfaces
+(``/debug/incidents``, the ``/healthz`` ``incidents`` block), the
+``runbook incident`` CLI against a bundle directory, the timeline
+incident span band, and the e2e arc on a dp=2 CPU fleet: chaos crash →
+supervisor failover → incident open (with chaos provenance + a
+hash-verified bundle) → resolve.
+"""
+
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from runbookai_tpu.obs import (
+    BUNDLE_SCHEMA_VERSION,
+    COVERAGE_REQUIRED_KINDS,
+    FAULT_SIGNAL_CLASSES,
+    INCIDENT_SIGNALS,
+    IncidentDetector,
+    IncidentMonitor,
+    SignalPolicy,
+    default_policies,
+    incidents_json,
+    list_bundles,
+    load_bundle,
+    verify_bundle,
+    write_bundle,
+)
+from runbookai_tpu.utils import metrics as metrics_mod
+
+# Seeded fixture: a burn ramp that blips (no incident), sustains (open),
+# dips into the hysteresis band (stays open), then clears (resolve).
+FIXTURE_READINGS = [
+    (0.0, {"slo_burn": 1.0}),
+    (1.0, {"slo_burn": 2.0}),   # blip...
+    (2.0, {"slo_burn": 1.0}),   # ...gone before open_after_s
+    (3.0, {"slo_burn": 2.0}),   # sustained breach starts
+    (4.0, {"slo_burn": 2.5}),
+    (5.0, {"slo_burn": 3.0}),   # >= open_after_s=2 → opens here
+    (6.0, {"slo_burn": 1.3}),   # hysteresis band (1.1..1.5): stays open
+    (7.0, {"slo_burn": 1.0}),   # clear starts
+    (8.0, {"slo_burn": 1.0}),
+    (10.0, {"slo_burn": 1.0}),  # >= resolve_after_s=3 → resolves
+]
+FIXTURE_POLICIES = (SignalPolicy("slo_burn", 1.5, 1.1, open_after_s=2.0,
+                                 resolve_after_s=3.0, severity="major"),)
+
+
+def run_fixture():
+    det = IncidentDetector(FIXTURE_POLICIES)
+    events = []
+    for ts, reading in FIXTURE_READINGS:
+        events += [(kind, inc["id"]) for kind, inc
+                   in det.observe(ts, dict(reading))]
+    return det, events
+
+
+# ----------------------------------------------------------- determinism
+
+
+def test_detector_deterministic_byte_identical():
+    """Seeded fixtures ⇒ byte-identical incident JSON (the tentpole
+    contract: decisions are pure functions of window inputs)."""
+    a, events_a = run_fixture()
+    b, events_b = run_fixture()
+    assert events_a == events_b
+    assert incidents_json(a.incidents()) == incidents_json(b.incidents())
+    # And the lifecycle is exactly what the fixture spells (the breach
+    # peaks at open, so no update events fire).
+    assert events_a == [("open", "inc-0001"), ("resolve", "inc-0001")]
+    (inc,) = a.incidents()
+    assert inc["status"] == "resolved"
+    assert inc["opened_ts"] == 5.0        # sustained 2 s after t=3
+    assert inc["breach_started_ts"] == 3.0
+    assert inc["resolved_ts"] == 10.0     # clear held 3 s after t=7
+    assert inc["duration_s"] == 5.0
+    assert inc["peak"] == 3.0
+    assert inc["value_at_open"] == 3.0
+
+
+def test_hysteresis_both_directions():
+    det = IncidentDetector(FIXTURE_POLICIES)
+    # A blip shorter than open_after_s never opens.
+    assert det.observe(0.0, {"slo_burn": 9.9}) == []
+    assert det.observe(1.0, {"slo_burn": 0.5}) == []
+    assert det.observe(3.5, {"slo_burn": 9.9}) == []  # fresh breach clock
+    assert det.open_incidents() == []
+    # Sustained breach opens.
+    events = det.observe(5.5, {"slo_burn": 9.9})
+    assert [k for k, _ in events] == ["open"]
+    # Band readings (between resolve_at and open_at) hold it open
+    # forever — the resolve clock restarts on every band reading.
+    for ts in (6.0, 20.0, 40.0):
+        det.observe(ts, {"slo_burn": 1.3})
+        assert len(det.open_incidents()) == 1
+    # Clearing must PERSIST: a clear reading then a band reading resets.
+    det.observe(41.0, {"slo_burn": 0.5})
+    det.observe(42.0, {"slo_burn": 1.3})   # resets the resolve clock
+    det.observe(43.0, {"slo_burn": 0.5})
+    det.observe(44.0, {"slo_burn": 0.5})
+    assert len(det.open_incidents()) == 1  # only 1 s clear so far
+    events = det.observe(46.1, {"slo_burn": 0.5})
+    assert [k for k, _ in events] == ["resolve"]
+    assert det.open_incidents() == []
+
+
+def test_absent_reading_is_never_a_breach_and_resolves():
+    """The absence contract: a signal with no evidence neither opens an
+    incident nor holds one open (the thing being measured went away)."""
+    policy = SignalPolicy("router_stale", 1.0, 1.0, open_after_s=0.0,
+                          resolve_after_s=2.0)
+    det = IncidentDetector((policy,))
+    assert det.observe(0.0, {}) == []
+    det.observe(1.0, {"router_stale": 3.0})
+    assert len(det.open_incidents()) == 1
+    det.observe(2.0, {})                    # absence counts toward clear
+    events = det.observe(4.5, {})
+    assert [k for k, _ in events] == ["resolve"]
+
+
+def test_lte_mode_and_policy_validation():
+    # replica_health: low is bad.
+    policy = SignalPolicy("replica_health", 0.1, 0.25, mode="lte",
+                          open_after_s=0.0, resolve_after_s=1.0)
+    det = IncidentDetector((policy,))
+    det.observe(0.0, {"replica_health": 0.05})
+    assert len(det.open_incidents()) == 1
+    det.observe(1.0, {"replica_health": 0.15})  # band: stays open
+    assert len(det.open_incidents()) == 1
+    det.observe(2.0, {"replica_health": 0.9})
+    events = det.observe(3.5, {"replica_health": 0.9})
+    assert [k for k, _ in events] == ["resolve"]
+    with pytest.raises(ValueError, match="unknown incident signal"):
+        SignalPolicy("nope", 1.0, 1.0)
+    with pytest.raises(ValueError, match="clear side"):
+        SignalPolicy("slo_burn", 1.0, 2.0)  # inverted band
+    with pytest.raises(ValueError, match="mode"):
+        SignalPolicy("slo_burn", 1.0, 1.0, mode="eq")
+    with pytest.raises(ValueError, match="duplicate"):
+        IncidentDetector((policy, policy))
+
+
+def test_signal_inventory_and_fault_mapping():
+    """The signal vocabulary is a wire contract (metric labels,
+    /healthz, docs) and every chaos fault kind maps into it."""
+    from runbookai_tpu.chaos.inject import FAULT_KINDS
+
+    assert INCIDENT_SIGNALS == (
+        "slo_burn", "workload_drift", "replica_health", "replica_failure",
+        "router_shed", "router_stale", "queue_wait")
+    assert set(FAULT_SIGNAL_CLASSES) == set(FAULT_KINDS)
+    for kind, signals in FAULT_SIGNAL_CLASSES.items():
+        assert signals, kind
+        assert set(signals) <= set(INCIDENT_SIGNALS), kind
+    assert set(COVERAGE_REQUIRED_KINDS) <= set(FAULT_KINDS)
+    # Every signal has a default policy; drift tracks the threshold.
+    assert {p.signal for p in default_policies()} == set(INCIDENT_SIGNALS)
+    drift = next(p for p in default_policies(drift_threshold=0.7)
+                 if p.signal == "workload_drift")
+    assert drift.open_at == 0.7 and drift.resolve_at < 0.7
+
+
+# --------------------------------------------------------------- bundles
+
+
+def test_bundle_schema_hash_and_rotation(tmp_path):
+    d = tmp_path / "bundles"
+    paths = []
+    for i in range(5):
+        paths.append(write_bundle(d, {
+            "captured_ts": 1000.0 + i,
+            "incident": {"id": f"inc-{i + 1:04d}", "signal": "slo_burn"},
+            "evidence": {"metrics": "x" * i},
+        }, max_bundles=3))
+    names = [p.name for p in list_bundles(d)]
+    # Timestamp-prefixed names (capture ms, zero-padded): chronological
+    # even across process restarts, oldest pruned.
+    assert names == ["0000001002000-inc-0003-slo_burn.json",
+                     "0000001003000-inc-0004-slo_burn.json",
+                     "0000001004000-inc-0005-slo_burn.json"]
+    # A RESTARTED process re-issuing id inc-0003 at a later capture time
+    # must not overwrite the earlier run's postmortem.
+    write_bundle(d, {"captured_ts": 2000.0,
+                     "incident": {"id": "inc-0003",
+                                  "signal": "slo_burn"},
+                     "evidence": {}}, max_bundles=3)
+    names = [p.name for p in list_bundles(d)]
+    assert names[-1] == "0000002000000-inc-0003-slo_burn.json"
+    assert len(names) == 3  # pruned the true oldest, not the id-oldest
+    doc = load_bundle(paths[-1])
+    assert doc["schema_version"] == BUNDLE_SCHEMA_VERSION
+    assert doc["content_hash"].startswith("sha256:")
+    ok, expected, actual = verify_bundle(paths[-1])
+    assert ok and expected == actual
+    # Tampered evidence MUST fail verification — a hand-edited bundle
+    # is not evidence.
+    tampered = load_bundle(paths[-1])
+    tampered["evidence"]["metrics"] = "forged"
+    paths[-1].write_text(json.dumps(tampered))
+    ok, expected, actual = verify_bundle(paths[-1])
+    assert not ok and expected != actual
+
+
+# ------------------------------------------------- absence-not-zero scrape
+
+
+def test_metrics_absence_then_presence():
+    """No open incident ⇒ runbook_incident_open scrapes as ABSENCE (the
+    runbook_slo_* contract); runbook_incident_total materializes at 0
+    (rate() needs the zero). An open materializes the open series; a
+    resolve drops it again and lands a duration observation."""
+    reg = metrics_mod.MetricsRegistry()
+    policy = SignalPolicy("replica_failure", 1.0, 1.0, open_after_s=0.0,
+                          resolve_after_s=0.5)
+    clock = [0.0]
+    monitor = IncidentMonitor(
+        [], detector=IncidentDetector((policy,)),
+        clock=lambda: clock[0], registry=reg)
+    text = reg.render()
+    assert "# TYPE runbook_incident_open gauge" in text
+    assert 'runbook_incident_open{' not in text          # absence
+    for signal in INCIDENT_SIGNALS:
+        assert f'runbook_incident_total{{signal="{signal}"}} 0' in text
+    # Drive an open through the detector (no live sources attached).
+    with monitor._lock:
+        opened = monitor._detector.observe(0.0, {"replica_failure": 2.0})
+    for kind, inc in opened:
+        monitor._emit(kind, dict(inc))
+    text = reg.render()
+    assert 'runbook_incident_open{signal="replica_failure"} 1' in text
+    # Resolve needs the clear to PERSIST past resolve_after_s.
+    events = []
+    for ts in (10.0, 10.6):
+        clock[0] = ts
+        with monitor._lock:
+            events += monitor._detector.observe(
+                ts, {"replica_failure": 0.0})
+    for kind, inc in events:
+        monitor._emit(kind, dict(inc))
+    assert [k for k, _ in events] == ["resolve"]
+    text = reg.render()
+    assert 'runbook_incident_open{' not in text          # absent again
+    assert 'runbook_incident_total{signal="replica_failure"} 1' in text
+    assert ('runbook_incident_duration_seconds_count'
+            '{signal="replica_failure"} 1') in text
+
+
+def test_snapshot_totals_absence_and_feed(tmp_path):
+    reg = metrics_mod.MetricsRegistry()
+    monitor = IncidentMonitor([], bundle_dir=tmp_path / "b", registry=reg)
+    snap = monitor.snapshot(full=True)
+    assert snap["enabled"] is True
+    assert snap["open"] == [] and snap["open_count"] == 0
+    assert snap["totals"] == {}          # absence, not a zero per signal
+    assert snap["recent"] == [] and snap["bundles"] == []
+
+
+# ----------------------------------------------------------- e2e dp=2 arc
+
+
+async def test_e2e_crash_incident_resolve_arc(tmp_path):
+    """The acceptance arc at unit scale: a chaos crash on a dp=2 CPU
+    fleet is failed over by the supervisor, the incident monitor opens a
+    replica_failure incident carrying the unhealthy replica + chaos
+    provenance in its context, captures a schema-valid bundle whose hash
+    verifies, and resolves once the fleet is whole again."""
+    from runbookai_tpu.chaos import ChaosReplicaCrash, FleetSupervisor
+    from runbookai_tpu.engine.request import FinishReason, SamplingParams
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+
+    client = JaxTpuClient.for_testing(max_new_tokens=8, dp_replicas=2)
+    fleet = client.engine
+    sup = FleetSupervisor(fleet, poll_interval_s=0.02,
+                          wedge_timeout_s=30.0,
+                          rejoin_hysteresis_s=0.05).start()
+    detector = IncidentDetector((
+        SignalPolicy("replica_failure", 1.0, 1.0, open_after_s=0.0,
+                     resolve_after_s=0.1, severity="critical"),))
+    monitor = IncidentMonitor(
+        [fleet], detector=detector, bundle_dir=tmp_path / "bundles",
+        max_bundles=4, poll_interval_s=0.02).start()
+
+    def crash_hook(core) -> None:
+        core.chaos_hook = None
+        raise ChaosReplicaCrash("test crash")
+
+    def sp():
+        return SamplingParams(temperature=0.0, max_new_tokens=8,
+                              stop_token_ids=())
+
+    try:
+        fleet.cores[0].chaos_hook = crash_hook
+        outs = await asyncio.gather(*[
+            fleet.generate([66 + i] * 12, sp()) for i in range(6)])
+        assert all(o.finish_reason != FinishReason.ABORTED for o in outs)
+        for _ in range(400):
+            if sup.state_of(0) == "healthy" and not fleet._quarantined \
+                    and not monitor.snapshot()["open"]:
+                break
+            await asyncio.sleep(0.025)
+        await fleet.stop()
+    finally:
+        monitor.stop()
+        sup.stop()
+    incidents = monitor.incidents()
+    assert [i["signal"] for i in incidents] == ["replica_failure"]
+    (inc,) = incidents
+    assert inc["status"] == "resolved" and inc["severity"] == "critical"
+    assert inc["duration_s"] > 0
+    # Context captured AT OPEN: the failed replica was named.
+    assert inc["context"]["replicas"] == [0]
+    assert inc["context"]["reading"]["replica_failure"] == 1.0
+    # The bundle was captured while the incident was happening, is
+    # schema-valid, and its content hash verifies.
+    (bundle_path,) = list_bundles(tmp_path / "bundles")
+    assert bundle_path.name.endswith(f"-{inc['id']}-replica_failure.json")
+    assert inc["bundle"] == bundle_path.name
+    ok, _, _ = verify_bundle(bundle_path)
+    assert ok
+    doc = load_bundle(bundle_path)
+    assert doc["schema_version"] == BUNDLE_SCHEMA_VERSION
+    assert doc["incident"]["id"] == inc["id"]
+    evidence = doc["evidence"]
+    # Per-replica flight tails, the healthz body (supervisor block
+    # included), and a full metrics scrape all rode along.
+    assert set(evidence["flight"]) == {"0", "1"}
+    (health,) = evidence["healthz"].values()
+    # The supervisor block rode along with the failure arc on record
+    # (the replica may already be mid-rebuild by capture time).
+    assert any(t["to"] == "failed"
+               for t in health["supervisor"]["transitions"])
+    assert "runbook_decode_tokens_total" in evidence["metrics"]
+
+
+async def test_monitor_collect_reads_live_sources():
+    """collect() folds the live sources: supervisor states, shed/stale
+    deltas, and the workload monitor's drift/health when attached."""
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.obs import WorkloadFingerprinter, WorkloadMonitor
+
+    reg = metrics_mod.MetricsRegistry()
+    client = JaxTpuClient.for_testing(max_new_tokens=4, dp_replicas=2)
+    fleet = client.engine
+    fp = WorkloadFingerprinter(fleet.cores, model="m", window_s=600)
+    wm = WorkloadMonitor({"m": fp}, {"m": ({}, "default")}, registry=reg)
+    monitor = IncidentMonitor([fleet], workload_monitor=wm, registry=reg)
+    readings = monitor.collect()
+    # No supervisor attached → replica_failure absent (not zero).
+    assert "replica_failure" not in readings
+    # Fleet counters present as deltas (first poll = 0 against its own
+    # baseline), health computable before the first fingerprint.
+    assert readings["router_shed"] == 0.0
+    assert 0.0 <= readings["replica_health"] <= 1.0
+    assert "workload_drift" not in readings  # empty window → absence
+    from runbookai_tpu.chaos import FleetSupervisor
+
+    sup = FleetSupervisor(fleet, registry=reg)
+    readings = monitor.collect()
+    assert readings["replica_failure"] == 0.0
+    await fleet.stop()
+    sup.stop()
+
+
+# ------------------------------------------------------------- surfaces
+
+
+def test_server_debug_incidents_and_healthz_block(tmp_path):
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.server.openai_api import OpenAIServer
+    from runbookai_tpu.utils.config import LLMConfig
+
+    cfg = LLMConfig(provider="jax-tpu", model="llama3-test",
+                    dtype="float32", page_size=4, num_pages=256,
+                    max_batch_slots=4, prefill_chunk=32, max_seq_len=256,
+                    max_new_tokens=8,
+                    obs={"incident_dir": str(tmp_path / "inc"),
+                         "incident_poll_interval_s": 0.05})
+    client = JaxTpuClient.from_config(cfg)
+    try:
+        assert client.incident_monitor is not None  # llm.obs defaults ON
+        assert str(client.incident_monitor.bundle_dir) \
+            == str(tmp_path / "inc")
+        srv = OpenAIServer(client, "llama3-test", port=0)
+        srv.start_background()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            snap = json.loads(urllib.request.urlopen(
+                base + "/debug/incidents", timeout=30).read())
+            assert snap["enabled"] is True
+            assert snap["open"] == [] and snap["bundles"] == []
+            health = json.loads(urllib.request.urlopen(
+                base + "/healthz", timeout=30).read())
+            # Absence-not-zero healthz: block present (monitor attached),
+            # totals empty rather than zero per signal.
+            assert health["incidents"]["open"] == []
+            assert health["incidents"]["totals"] == {}
+            metrics = urllib.request.urlopen(
+                base + "/metrics", timeout=30).read().decode()
+            assert "runbook_incident_open{" not in metrics
+            # Materialized (possibly bumped by earlier tests sharing
+            # the process registry) — the series EXISTS from startup.
+            assert 'runbook_incident_total{signal="replica_failure"} ' \
+                in metrics
+        finally:
+            srv.shutdown()
+    finally:
+        if client.incident_monitor is not None:
+            client.incident_monitor.stop()
+
+
+def test_server_without_monitor_reports_disabled():
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.server.openai_api import OpenAIServer
+
+    client = JaxTpuClient.for_testing(max_new_tokens=4)
+    srv = OpenAIServer(client, "llama3-test", port=0)
+    srv.start_background()
+    try:
+        snap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/incidents",
+            timeout=30).read())
+        assert snap == {"enabled": False, "open": []}
+    finally:
+        srv.shutdown()
+
+
+def test_from_config_gating():
+    from runbookai_tpu.utils.config import LLMConfig
+
+    off = LLMConfig(obs={"enabled": False})
+    assert IncidentMonitor.from_config(off) is None
+    no_inc = LLMConfig(obs={"incidents_enabled": False})
+    assert IncidentMonitor.from_config(no_inc) is None
+    on = LLMConfig(obs={"incident_max_bundles": 3,
+                        "incident_open_s": 0.5})
+    monitor = IncidentMonitor.from_config(on)
+    assert monitor is not None and monitor.max_bundles == 3
+    assert monitor.bundle_dir is None  # detect-only without a dir
+
+
+def test_cli_incident_list_and_show_bundle(tmp_path, capsys):
+    from runbookai_tpu.cli.main import main as cli_main
+
+    d = tmp_path / "bundles"
+    write_bundle(d, {
+        "incident": {"id": "inc-0001", "signal": "replica_failure",
+                     "severity": "critical", "status": "resolved",
+                     "opened_ts": 100.0, "duration_s": 2.5, "peak": 1.0,
+                     "bundle": "inc-0001-replica_failure.json"},
+        "evidence": {"metrics": "runbook_x 1\n", "flight": {"0": []},
+                     "trace_tail": []},
+    })
+    # list: no server at the bogus URL → falls back to the bundle dir.
+    rc = cli_main(["incident", "list", "--url", "http://127.0.0.1:9",
+                   "--dir", str(d)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "inc-0001" in out and "replica_failure" in out
+    # show --bundle verifies the hash and prints the evidence inventory.
+    rc = cli_main(["incident", "show", "inc-0001", "--bundle",
+                   "--url", "http://127.0.0.1:9", "--dir", str(d)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "verified" in out and "evidence:" in out
+    # Unknown id is a clean error.
+    rc = cli_main(["incident", "show", "inc-9999",
+                   "--url", "http://127.0.0.1:9", "--dir", str(d)])
+    assert rc == 1
+
+
+# ------------------------------------------------------------- timeline
+
+
+def test_timeline_renders_incident_span_band():
+    """A dp retry during an incident is visible in ONE view: the
+    request's own spans plus the overlapping incident.open/resolve
+    band (satellite contract)."""
+    from runbookai_tpu.utils.timeline import build_timeline, render_timeline
+
+    spans = [
+        {"ts": 100.0, "name": "engine.enqueue", "ms": 0.0,
+         "meta": {"request": "r0-1", "trace_id": "req-x",
+                  "prompt_tokens": 8}},
+        {"ts": 100.3, "name": "incident.open", "ms": 0.0,
+         "meta": {"incident": "inc-0001", "signal": "replica_failure",
+                  "severity": "critical", "replicas": [0]}},
+        {"ts": 100.4, "name": "engine.request", "ms": 0.0,
+         "meta": {"request": "r1-1", "trace_id": "req-x",
+                  "reason": "stop", "generated": 4}},
+        {"ts": 100.45, "name": "incident.resolve", "ms": 0.0,
+         "meta": {"incident": "inc-0001", "signal": "replica_failure",
+                  "duration_s": 0.15}},
+        # An unrelated incident far outside the window stays out.
+        {"ts": 500.0, "name": "incident.open", "ms": 0.0,
+         "meta": {"incident": "inc-0002", "signal": "slo_burn"}},
+    ]
+    tl = build_timeline(spans, "req-x")
+    assert tl["incidents"] == ["inc-0001"]
+    names = [e["name"] for e in tl["events"]]
+    assert "incident.open" in names and "incident.resolve" in names
+    # Ordered into the request's own event stream.
+    assert names.index("incident.open") < names.index("engine.request")
+    text = render_timeline(tl)
+    assert "incident open: replica_failure (inc-0001, critical)" in text
+    assert "incident resolve: replica_failure" in text
+    assert "incidents: inc-0001" in text
+    assert "inc-0002" not in text
+
+
+async def test_e2e_tracer_events_stitch_into_timeline(tmp_path):
+    """Live arc → trace JSONL → `runbook timeline` sees the band."""
+    from runbookai_tpu.chaos import ChaosReplicaCrash, FleetSupervisor
+    from runbookai_tpu.engine.request import SamplingParams
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.utils.timeline import build_timeline
+    from runbookai_tpu.utils.trace import Tracer, read_spans, set_tracer
+
+    trace_path = tmp_path / "trace.jsonl"
+    set_tracer(Tracer(trace_path))
+    client = JaxTpuClient.for_testing(max_new_tokens=4, dp_replicas=2)
+    fleet = client.engine
+    sup = FleetSupervisor(fleet, poll_interval_s=0.02,
+                          wedge_timeout_s=30.0,
+                          rejoin_hysteresis_s=0.05).start()
+    monitor = IncidentMonitor(
+        [fleet], detector=IncidentDetector((
+            SignalPolicy("replica_failure", 1.0, 1.0, open_after_s=0.0,
+                         resolve_after_s=0.1),)),
+        poll_interval_s=0.02).start()
+
+    def crash_hook(core) -> None:
+        core.chaos_hook = None
+        raise ChaosReplicaCrash("test crash")
+
+    try:
+        fleet.cores[0].chaos_hook = crash_hook
+        sp = SamplingParams(temperature=0.0, max_new_tokens=4,
+                            stop_token_ids=())
+        outs = await asyncio.gather(*[
+            fleet.generate([70 + i] * 8, sp, request_id="req-incident")
+            for i in range(4)])
+        assert outs
+        for _ in range(400):
+            if not monitor.snapshot()["open"] \
+                    and monitor.incidents():
+                break
+            await asyncio.sleep(0.025)
+        await fleet.stop()
+    finally:
+        monitor.stop()
+        sup.stop()
+        from runbookai_tpu.utils.trace import get_tracer
+
+        get_tracer().close()
+        set_tracer(None)
+    spans = read_spans(trace_path)
+    assert any(r.get("name") == "incident.open" for r in spans)
+    tl = build_timeline(spans, "req-incident")
+    assert tl is not None
+    assert tl["incidents"], "incident band missing from the timeline"

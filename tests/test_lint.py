@@ -1593,6 +1593,11 @@ class TestTreeIsClean:
             # Attribution is nearest-preceding-def: monitor's sites sit
             # after the nested fp_value/drift_or_raise helpers.
             "obs/monitor.py": {"fp_value": 1, "drift_or_raise": 3},
+            # Incident detection sanctions NOTHING: the signal label is
+            # the INCIDENT_SIGNALS literal tuple (bounded statically,
+            # like the supervisor's state label).
+            "obs/detect.py": {},
+            "obs/incident.py": {},
             "sched/feedback.py": {"on_step": 1},
             "sched/tenants.py": {"__init__": 2, "admit": 2,
                                  "_throttle_metrics": 1, "settle": 1},

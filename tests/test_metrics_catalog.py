@@ -89,6 +89,12 @@ def test_live_registry_matches_doc_catalog(monkeypatch, tmp_path):
     # Workload monitor (fingerprints, drift, plan staleness, health).
     fp = WorkloadFingerprinter(client.cores, model="a", window_s=300)
     WorkloadMonitor({"a": fp}, {"a": ({}, "default")}, registry=fresh)
+    # Incident detection (obs/incident.py): open/total/duration series
+    # over the INCIDENT_SIGNALS label tuple. Not started — registration
+    # is construction-time.
+    from runbookai_tpu.obs import IncidentMonitor
+
+    IncidentMonitor([client.engine], registry=fresh)
     # Chaos supervision + fault injection (runbookai_tpu/chaos):
     # supervisor state/transition/rebuild/failover series and the
     # per-kind fault counter (the retry-backoff histogram registers

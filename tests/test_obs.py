@@ -354,6 +354,41 @@ def test_monitor_history_interval_gating(tmp_path):
     assert "drift_score" in entries[0]["models"]["m"]
 
 
+def test_monitor_history_rotation_via_injected_clock(tmp_path):
+    """History-interval timing is a pure function of the injected clock
+    (the supervisor's flap-damping seam): a fake clock drives rotation
+    across intervals with ZERO wall-clock sleeps, and the fingerprint
+    window ages out on the same clock — window math and rotation timing
+    cannot disagree."""
+    now = [1000.0]
+    reg = metrics_mod.MetricsRegistry()
+    fp = WorkloadFingerprinter([], model="m", window_s=300,
+                               clock=lambda: now[0])
+    hist = FingerprintHistory(tmp_path / "h", max_files=2)
+    monitor = _mk_monitor(reg, {"m": fp}, history=hist,
+                          history_interval_s=60.0, clock=lambda: now[0])
+    fp.observe_request(_FakeReq())
+    monitor.snapshot()
+    assert len(hist.entries()) == 1
+    # Same interval: gated. The scrape memo rides the same clock, so no
+    # manual _memo.clear() between folds either.
+    monitor.snapshot()
+    assert len(hist.entries()) == 1
+    # Advance past the interval twice; max_files=2 prunes the oldest.
+    for _ in range(3):
+        now[0] += 61.0
+        fp.observe_request(_FakeReq())
+        monitor.snapshot()
+    entries = hist.entries()
+    assert len(entries) == 2  # rotation bound held
+    assert entries[-1]["recorded_ts"] == round(now[0], 3)
+    # The sample tap stamped the fake clock: aging the clock past the
+    # window empties the fingerprint (absence), same seam end to end.
+    now[0] += 10_000.0
+    monitor.snapshot()
+    assert monitor.drift("m") is None
+
+
 # ------------------------------------------------------- live engine e2e
 
 
