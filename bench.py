@@ -93,6 +93,7 @@ engine flight recorder).
 from __future__ import annotations
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -1565,7 +1566,15 @@ def _soak_scenarios_pass(fleet, mix, *, chaos_schedule=None,
     injected fault windows must overlap detected incidents of matching
     signal classes, and the chaos-free baseline must open ZERO (the
     false-positive gate). Hysteresis scales with the run so a 2 s CPU
-    smoke and the 1800 s protocol exercise the same lifecycle."""
+    smoke and the 1800 s protocol exercise the same lifecycle.
+
+    Each pass also carries its own :class:`MetricsTSDB` (obs/tsdb.py),
+    monitor-driven so a registry sweep lands at every detector poll.
+    The per-pass store is what isolates the gate's query-expressed
+    invariants: registry counters are process-global and cumulative
+    across both passes, but ``increase()`` over one pass's window diffs
+    only what that pass contributed. The store is returned so the gate
+    can evaluate invariants through obs/query.py."""
     import asyncio
     import random as _random
     import time as _time
@@ -1579,6 +1588,7 @@ def _soak_scenarios_pass(fleet, mix, *, chaos_schedule=None,
     from runbookai_tpu.obs import (
         IncidentDetector,
         IncidentMonitor,
+        MetricsTSDB,
         default_policies,
     )
     from runbookai_tpu.sched import PRIORITY_BATCH, PRIORITY_INTERACTIVE
@@ -1587,13 +1597,20 @@ def _soak_scenarios_pass(fleet, mix, *, chaos_schedule=None,
     supervisors = []
     injector = None
     records: dict[str, dict] = {}
+    # Retention must hold the WHOLE pass (plus the recovery tail) or the
+    # gate's closing queries would prune away the early fault windows.
+    tsdb = MetricsTSDB(
+        interval_s=max(0.02, duration_s / 100.0),
+        retention_s=max(120.0, duration_s * 4.0 + 60.0),
+        max_series=4096)
     incident_monitor = IncidentMonitor(
         [g.fleet for g in model_groups],
         detector=IncidentDetector(default_policies(
             open_after_s=min(5.0, max(0.2, duration_s * 0.1)),
             resolve_after_s=min(10.0, max(0.4, duration_s * 0.2)))),
         bundle_dir=incident_dir, max_bundles=64,
-        poll_interval_s=0.02)
+        poll_interval_s=0.02, tsdb=tsdb,
+        history_lookback_s=max(2.0, min(60.0, duration_s)))
 
     async def run_turn(chain, turn, prompt, rec):
         sampling = SamplingParams(
@@ -1755,7 +1772,25 @@ def _soak_scenarios_pass(fleet, mix, *, chaos_schedule=None,
         "chaos": injector.snapshot() if injector is not None else None,
         "supervisors": [s.snapshot() for s in supervisors],
         "incidents": incident_monitor.incidents(),
+        "tsdb": tsdb,
     }
+
+
+def _soak_query(store, expr: str) -> dict:
+    """Evaluate one gate condition through the embedded history
+    (obs/tsdb.py + obs/query.py) instead of the pass's in-process
+    measurements. The verdict coming out the query path proves the
+    store actually carried the signal end to end — sampling, retention,
+    and evaluator semantics (counter resets, absence-not-zero) all sit
+    between the fleet and the number the gate reads."""
+    from runbookai_tpu.obs import evaluate
+
+    newest = store.snapshot()["newest_ts"]
+    if newest is None:
+        return {"expr": expr, "values": []}
+    doc = evaluate(store, expr, now=newest)
+    return {"expr": expr,
+            "values": [r["value"] for r in doc["result"]]}
 
 
 def _soak_effective_windows(passed: dict) -> list[tuple[float, float]]:
@@ -2030,7 +2065,8 @@ def run_soak_scenarios_bench(duration_s: float, models_spec: str | None,
     for inc in chaotic.get("incidents", ()):
         name = inc.get("bundle")
         row = {"incident": inc["id"], "name": name,
-               "hash_verified": False, "schema_valid": False}
+               "hash_verified": False, "schema_valid": False,
+               "has_history": False}
         if name:
             try:
                 doc = load_bundle(_Path(incident_dir) / name)
@@ -2041,11 +2077,18 @@ def run_soak_scenarios_bench(duration_s: float, models_spec: str | None,
                                         == bundle_hash(doc))
                 row["schema_valid"] = (doc.get("schema_version")
                                        == BUNDLE_SCHEMA_VERSION)
+                # The pre-open lookback window (obs/tsdb.py) sits
+                # INSIDE the hash envelope — hash_verified above
+                # already proves it arrived untampered.
+                row["has_history"] = doc.get("history") is not None
         bundle_rows.append(row)
     if not keep_bundles:
         shutil.rmtree(incident_dir, ignore_errors=True)
+    # has_history gates too: every soak monitor carries a store, so a
+    # bundle without its lookback section means the black box dropped
+    # the trend exactly when it mattered.
     bundles_ok = all(b["hash_verified"] and b["schema_valid"]
-                     for b in bundle_rows)
+                     and b["has_history"] for b in bundle_rows)
     invariants = {
         "zero_lost_outside_fault_windows": {
             "passed": not lost_outside,
@@ -2086,6 +2129,48 @@ def run_soak_scenarios_bench(duration_s: float, models_spec: str | None,
             "chaos_incidents": len(chaotic.get("incidents", ())),
             "bundles": bundle_rows},
     }
+    # Query-expressed invariants: the same gate conditions re-derived
+    # through each pass's embedded time-series store (obs/tsdb.py) and
+    # the PromQL-lite evaluator (obs/query.py). Each pass carries its
+    # OWN store, so increase()/max_over_time() over its window isolate
+    # that pass's contribution even though registry counters are
+    # process-global. These merge into ``invariants`` and therefore
+    # gate ``invariants_passed`` like every direct measurement above.
+    q_win = f"{int(math.ceil(chaotic['tsdb'].retention_s))}s"
+    q_base_inc = _soak_query(
+        baseline["tsdb"], f"increase(runbook_incident_total[{q_win}])")
+    q_base_shed = _soak_query(
+        baseline["tsdb"],
+        f"increase(runbook_router_shed_total[{q_win}])")
+    q_open = _soak_query(
+        chaotic["tsdb"], f"max_over_time(runbook_incident_open[{q_win}])")
+    q_ttft = _soak_query(
+        chaotic["tsdb"],
+        f"histogram_quantile(0.95, runbook_ttft_seconds_bucket[{q_win}])")
+    q_ttft_worst = max(q_ttft["values"], default=None)
+    invariants["query_baseline_zero_incidents"] = {
+        # False-positive gate through the store: the chaos-free pass's
+        # incident counters must not have moved. An empty result also
+        # passes — absence is "never sampled", not a hidden increment.
+        "passed": all(v == 0 for v in q_base_inc["values"]), **q_base_inc}
+    invariants["query_baseline_zero_lost"] = {
+        "passed": all(v == 0 for v in q_base_shed["values"]),
+        **q_base_shed}
+    invariants["query_detection_coverage"] = {
+        # runbook_incident_open is ABSENT while nothing is open, so a
+        # sampled value >= 1 proves the store caught the incident's
+        # open window in flight.
+        "passed": ((not crash_applied)
+                   or any(v >= 1 for v in q_open["values"])),
+        "crash_applied": crash_applied, **q_open}
+    invariants["query_interactive_ttft_p95"] = {
+        # Bucket-interpolated p95 of the worst series (per-replica
+        # grouping) against the same bound the direct measurement uses.
+        "passed": (q_ttft_worst is None
+                   or q_ttft_worst * 1e3 <= ttft_bound),
+        "p95_ms": (round(q_ttft_worst * 1e3, 2)
+                   if q_ttft_worst is not None else None),
+        "bound_ms": ttft_bound, **q_ttft}
     total_decode = sum(c.metrics["decode_tokens"] for c in all_cores)
     max_decode_t = max(c.metrics["decode_time_s"] for c in all_cores)
     from runbookai_tpu.autotune.plan import engine_config_dict
@@ -2114,6 +2199,9 @@ def run_soak_scenarios_bench(duration_s: float, models_spec: str | None,
         # FAULT_SIGNAL_CLASSES mapping).
         "incident_coverage": coverage_rows,
         "incidents": chaotic.get("incidents", []),
+        # Chaos pass store accounting (series/sample/memory bounds) —
+        # the query invariants above were evaluated against this store.
+        "tsdb": chaotic["tsdb"].snapshot(),
         "invariants": invariants,
         "invariants_passed": all(v["passed"]
                                  for v in invariants.values()),

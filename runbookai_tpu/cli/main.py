@@ -1051,7 +1051,43 @@ def cmd_incident(args) -> int:
         unit = ("records" if isinstance(val, list)
                 else "bytes" if isinstance(val, str) else "json bytes")
         print(f"  {key}: {size} {unit}")
+    history = doc.get("history")
+    if history is not None:
+        # Pre-open lookback from the embedded tsdb (obs/tsdb.py): what
+        # each detector input signal was doing BEFORE this opened.
+        print(f"history (lookback {history.get('lookback_s')}s, "
+              f"schema v{history.get('schema_version')}):")
+        signals = history.get("signals") or {}
+        if not signals:
+            print("  (no signal samples in the lookback window)")
+        for signal in sorted(signals):
+            points = signals[signal]
+            values = [p[1] for p in points]
+            print(f"  {signal:16s} {_spark(values)}  "
+                  f"{values[0]:.4g} -> {values[-1]:.4g}  "
+                  f"({len(values)} samples)")
     return 0 if ok else 1
+
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values: list, width: int = 40) -> str:
+    """Unicode sparkline of a signal's lookback trend, downsampled to
+    ``width`` evenly spaced points. Flat series render mid-block."""
+    if not values:
+        return ""
+    if len(values) > width:
+        step = len(values) / width
+        values = [values[int(i * step)] for i in range(width)]
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_BLOCKS[3] * len(values)
+    return "".join(
+        _SPARK_BLOCKS[min(len(_SPARK_BLOCKS) - 1,
+                          int((v - lo) / span * (len(_SPARK_BLOCKS) - 1)))]
+        for v in values)
 
 
 def _render_workload(snap: dict) -> str:
@@ -1166,6 +1202,76 @@ def cmd_workload(args) -> int:
         snap = scrape()
         if snap is None:
             return 1
+
+
+def _render_query_result(doc: dict) -> str:
+    """Table view of a /debug/query result: one row per series,
+    canonical selector -> value. An empty result prints as absence —
+    the store never materializes zeros for missing series."""
+    rows = []
+    for entry in doc.get("result", []):
+        labels = dict(entry.get("metric", {}))
+        name = labels.pop("__name__", "")
+        body = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        sel = f"{name}{{{body}}}" if body else (name or "{}")
+        rows.append((sel, entry.get("value")))
+    if not rows:
+        return "(empty result — absent series stay absent, never zero)"
+    width = max(len(sel) for sel, _ in rows)
+    return "\n".join(f"{sel.ljust(width)}  {value}" for sel, value in rows)
+
+
+def cmd_query(args) -> int:
+    """``runbook query EXPR [--range 5m] [--watch]`` — PromQL-lite over
+    a running server's embedded metric history (``GET /debug/query``;
+    obs/tsdb.py + obs/query.py). The grammar and the mapping to real
+    Prometheus are in docs/observability.md "Metric history & query"."""
+    import time as _time
+    import urllib.parse
+
+    qs = urllib.parse.urlencode({"expr": args.expr, "range": args.range})
+    url = f"{args.url.rstrip('/')}/debug/query?{qs}"
+
+    def scrape() -> dict | None:
+        import urllib.error
+
+        try:
+            return _fetch_json(url, args.timeout)
+        except urllib.error.HTTPError as e:
+            # A 400 carries the evaluator's parse error — surface it
+            # instead of a bare HTTP status.
+            try:
+                detail = json.loads(e.read()).get("error", {}).get(
+                    "message", "")
+            except (ValueError, OSError):
+                detail = ""
+            print(f"query rejected ({e.code}): {detail or e.reason}",
+                  file=sys.stderr)
+            return None
+        except (OSError, TimeoutError, ValueError) as e:
+            print(f"could not scrape {url}: {e}", file=sys.stderr)
+            return None
+
+    while True:
+        doc = scrape()
+        if doc is None:
+            return 1
+        if not doc.get("enabled", True):
+            print("metric history is disabled (llm.obs.tsdb.enabled)",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(doc, indent=2, sort_keys=True))
+        else:
+            print(f"# {args.expr}  (range {args.range}, "
+                  f"now {doc.get('now')})")
+            print(_render_query_result(doc))
+        if not args.watch:
+            return 0
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
 
 
 def cmd_timeline(args) -> int:
@@ -1880,6 +1986,27 @@ def build_parser() -> argparse.ArgumentParser:
                                "inventory")
     _incident_args(inc_show)
     inc.set_defaults(fn=cmd_incident)
+
+    qy = sub.add_parser(
+        "query", help="PromQL-lite over the server's embedded metric "
+                      "history (GET /debug/query; obs/query.py grammar)")
+    qy.add_argument("expr",
+                    help="query expression, e.g. "
+                         "'rate(runbook_requests_total[1m])' or "
+                         "'histogram_quantile(0.95, "
+                         "runbook_ttft_seconds_bucket[5m])'")
+    qy.add_argument("--url", default="http://127.0.0.1:8000",
+                    help="server base URL (GET <url>/debug/query)")
+    qy.add_argument("--range", default="5m",
+                    help="default window for selectors without an "
+                         "explicit [range] (duration: 30s, 5m, 1h)")
+    qy.add_argument("--watch", action="store_true",
+                    help="re-evaluate every --interval seconds")
+    qy.add_argument("--interval", type=float, default=2.0)
+    qy.add_argument("--json", action="store_true",
+                    help="raw result JSON instead of the table")
+    qy.add_argument("--timeout", type=float, default=10.0)
+    qy.set_defaults(fn=cmd_query)
 
     met = sub.add_parser(
         "metrics", help="scrape a server's /metrics or summarize a trace")

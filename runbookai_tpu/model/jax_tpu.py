@@ -92,6 +92,22 @@ def _wire_supervisors(client, llm_cfg, fleets) -> None:
         ).start())
 
 
+def _wire_tsdb(client, llm_cfg) -> None:
+    """Attach + start the embedded time-series store (obs/tsdb.py) when
+    ``llm.obs.tsdb.enabled``: a bounded ring over every exported
+    ``runbook_*`` series, sampled from the live registry.
+    ``GET /debug/query``, the ``/healthz`` ``history`` block and
+    ``runbook query`` read it; the incident monitor (wired after this)
+    derives its trend readings and bundle lookback from it. None when
+    the obs layer or the store is disabled — zero ``runbook_tsdb_*``
+    series and every surface on top reports itself absent."""
+    from runbookai_tpu.obs.tsdb import MetricsTSDB
+
+    store = MetricsTSDB.from_config(llm_cfg)
+    if store is not None:
+        client.tsdb = store.start()
+
+
 def _wire_incidents(client, llm_cfg) -> None:
     """Attach + start the incident monitor (obs/incident.py) over every
     fleet the client serves through: it folds the exported signals (SLO
@@ -109,7 +125,8 @@ def _wire_incidents(client, llm_cfg) -> None:
     monitor = IncidentMonitor.from_config(
         llm_cfg, fleets=fleets, cores=client.cores,
         slo_monitor=client.slo_monitor,
-        workload_monitor=client.workload_monitor)
+        workload_monitor=client.workload_monitor,
+        tsdb=getattr(client, "tsdb", None))
     if monitor is not None:
         client.incident_monitor = monitor.start()
 
@@ -180,6 +197,11 @@ class JaxTpuClient(BaseLLMClient):
         # from_config): detection + black-box capture. None = zero
         # incident surface (/debug/incidents reports itself disabled).
         self.incident_monitor = None
+        # Embedded time-series store (obs/tsdb.py, wired by _wire_tsdb
+        # in from_config): metric history + PromQL-lite queries. None =
+        # zero history surface (/debug/query reports itself disabled,
+        # /healthz has no history block, bundles no lookback).
+        self.tsdb = None
 
     # --------------------------------------------------------- model groups
 
@@ -281,6 +303,7 @@ class JaxTpuClient(BaseLLMClient):
                 workload_monitor=build_workload_monitor(multi_model=engine))
             _wire_supervisors(client, llm_cfg,
                               [g.fleet for g in engine.groups.values()])
+            _wire_tsdb(client, llm_cfg)
             _wire_incidents(client, llm_cfg)
             return client
         built = build_group(llm_cfg)
@@ -302,6 +325,7 @@ class JaxTpuClient(BaseLLMClient):
 
         if isinstance(client.engine, AsyncFleet):
             _wire_supervisors(client, llm_cfg, [client.engine])
+        _wire_tsdb(client, llm_cfg)
         _wire_incidents(client, llm_cfg)
         return client
 
@@ -432,4 +456,7 @@ class JaxTpuClient(BaseLLMClient):
             yield piece
 
     async def shutdown(self) -> None:
+        tsdb = getattr(self, "tsdb", None)
+        if tsdb is not None:
+            tsdb.stop()
         await self.engine.stop()

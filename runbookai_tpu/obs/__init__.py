@@ -12,6 +12,12 @@ Incident detection rides on top (``detect.py`` pure, ``incident.py``
 live): the exported signals fold into an incident lifecycle with
 hysteresis, and every open preserves a content-hashed black-box bundle
 — the fleet writes its own postmortems (tests/test_incident.py).
+
+Every signal also has a history (``tsdb.py`` store, ``query.py``
+PromQL-lite): a bounded injected-clock ring samples the live metrics
+registry, queries evaluate as pure functions of (store, expr, now),
+incident bundles embed a pre-open lookback window, and the soak gate
+asserts its invariants as queries (tests/test_tsdb.py).
 """
 
 from runbookai_tpu.obs.detect import (
@@ -35,6 +41,8 @@ from runbookai_tpu.obs.fingerprint import (
 )
 from runbookai_tpu.obs.incident import (
     BUNDLE_SCHEMA_VERSION,
+    HISTORY_SCHEMA_VERSION,
+    SIGNAL_SERIES,
     IncidentMonitor,
     bundle_hash,
     list_bundles,
@@ -42,6 +50,13 @@ from runbookai_tpu.obs.incident import (
     verify_bundle,
     write_bundle,
 )
+from runbookai_tpu.obs.query import (
+    QueryError,
+    evaluate,
+    evaluate_json,
+    result_json,
+)
+from runbookai_tpu.obs.tsdb import MetricsTSDB
 from runbookai_tpu.obs.monitor import (
     FingerprintHistory,
     WorkloadMonitor,
@@ -56,10 +71,14 @@ __all__ = [
     "DESCRIPTOR_KEYS",
     "FAULT_SIGNAL_CLASSES",
     "FingerprintHistory",
+    "HISTORY_SCHEMA_VERSION",
     "INCIDENT_SCHEMA_VERSION",
     "INCIDENT_SIGNALS",
     "IncidentDetector",
     "IncidentMonitor",
+    "MetricsTSDB",
+    "QueryError",
+    "SIGNAL_SERIES",
     "RequestSample",
     "SignalPolicy",
     "WorkloadFingerprinter",
@@ -69,11 +88,14 @@ __all__ = [
     "default_policies",
     "descriptor_json",
     "drift_score",
+    "evaluate",
+    "evaluate_json",
     "incidents_json",
     "list_bundles",
     "load_bundle",
     "reference_descriptor",
     "replica_health",
+    "result_json",
     "verify_bundle",
     "write_bundle",
 ]

@@ -60,10 +60,22 @@ from runbookai_tpu.obs.detect import (
     IncidentDetector,
     default_policies,
 )
+from runbookai_tpu.obs.query import bucket_quantile, counter_increase
 from runbookai_tpu.utils import metrics as metrics_mod
 from runbookai_tpu.utils.trace import get_tracer
 
 BUNDLE_SCHEMA_VERSION = 1
+
+# The bundle `history` section's own version: lookback payload shape
+# may evolve independently of the bundle envelope.
+HISTORY_SCHEMA_VERSION = 1
+
+# The store series the monitor writes each poll: the detector's input
+# readings, one labelset per INCIDENT_SIGNALS entry. Store-only (never
+# registered in the registry) — registering it as a gauge would make
+# absent signals linger at their last stored value, breaking the
+# absence contract the readings carry.
+SIGNAL_SERIES = "runbook_incident_signal"
 
 # Resolved-incident durations: seconds from open to resolve.
 INCIDENT_DURATION_BUCKETS = (1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
@@ -152,6 +164,7 @@ class IncidentMonitor:
                  max_bundles: int = 16,
                  poll_interval_s: float = 1.0,
                  flight_tail: int = 32, trace_tail: int = 64,
+                 tsdb: Any = None, history_lookback_s: float = 60.0,
                  clock: Callable[[], float] = time.time,
                  registry: Optional[metrics_mod.MetricsRegistry] = None):
         self.fleets = list(fleets)
@@ -162,6 +175,15 @@ class IncidentMonitor:
                           for c in getattr(fleet, "cores", ())]
         self.slo_monitor = slo_monitor
         self.workload_monitor = workload_monitor
+        # Embedded time-series store (obs/tsdb.py). When attached, the
+        # derivative-shaped readings (router sheds / stale pulls /
+        # queue-wait p95) come from the STORE's samples instead of
+        # hand-rolled snapshot diffs, every poll's readings are
+        # ingested as the SIGNAL_SERIES history, and bundles embed a
+        # pre-open lookback window. None = the PR-15 snapshot-diff
+        # paths, unchanged.
+        self.tsdb = tsdb
+        self.history_lookback_s = float(history_lookback_s)
         self.bundle_dir = Path(bundle_dir) if bundle_dir else None
         self.max_bundles = max(1, int(max_bundles))
         self.poll_interval_s = float(poll_interval_s)
@@ -178,9 +200,14 @@ class IncidentMonitor:
             else IncidentDetector()
         self._recent: list[dict[str, Any]] = []
         # Counter baselines for delta-shaped signals (sheds, stale
-        # pulls) and the queue-wait histogram's bucket snapshot.
+        # pulls) and the queue-wait bucket-snapshot window (the shared
+        # utils/metrics.HistogramWindow) — the tsdb-off fallback paths.
         self._prev_counts: dict[str, float] = {}
-        self._queue_baseline: Optional[list[float]] = None
+        self._queue_window: Optional[metrics_mod.HistogramWindow] = None
+        # End of the previous poll's store window (tsdb path): each
+        # poll's derivative readings diff the store samples over
+        # [previous poll, this poll].
+        self._last_poll_now: Optional[float] = None
         reg = registry or metrics_mod.get_registry()
         g_open = reg.gauge(
             "runbook_incident_open",
@@ -295,19 +322,44 @@ class IncidentMonitor:
 
     def _queue_wait_p95(self) -> Optional[float]:
         """p95 of the queue-wait observations since the LAST poll
-        (bucket-snapshot diff, the sched/feedback windowing idiom) —
-        None when no request was admitted this window (absence)."""
+        (bucket-snapshot diff via the shared
+        utils/metrics.HistogramWindow — the sched/feedback windowing
+        idiom) — None when no request was admitted this window
+        (absence)."""
         hist = metrics_mod.get_registry().get("runbook_queue_wait_seconds")
         if not isinstance(hist, metrics_mod.Histogram):
             return None
-        counts = hist.bucket_counts()
-        baseline = self._queue_baseline
-        self._queue_baseline = counts
-        if baseline is None:
-            return None
-        return hist.percentile_since(95, baseline)
+        if self._queue_window is None or self._queue_window.hist is not hist:
+            self._queue_window = metrics_mod.HistogramWindow(hist)
+        return self._queue_window.percentile(95)
 
-    def collect(self) -> dict[str, Any]:
+    def _trend_readings_from_store(self, readings: dict[str, Any],
+                                   now: float) -> None:
+        """The derivative-shaped signals from the STORE's samples over
+        [previous poll, now] — sheds / stale pulls as reset-aware
+        counter increases, queue-wait p95 as a bucket-snapshot quantile
+        (obs/query math, so detection and ``/debug/query`` cannot
+        disagree). First poll (no window yet) and windows with no
+        samples stay absent."""
+        start = self._last_poll_now
+        self._last_poll_now = now
+        if start is None or start >= now:
+            return
+        for signal, metric in (
+                ("router_shed", "runbook_router_shed_total"),
+                ("router_stale", "runbook_router_xreplica_stale_total")):
+            increases = [inc for _, pts in self.tsdb.select(
+                             metric, start, now)
+                         if (inc := counter_increase(pts)) is not None]
+            if increases:
+                readings[signal] = float(sum(increases))
+        rows = bucket_quantile(
+            self.tsdb.select("runbook_queue_wait_seconds_bucket",
+                             start, now), 0.95)
+        if rows:
+            readings["queue_wait"] = max(v for _, v in rows)
+
+    def collect(self, now: Optional[float] = None) -> dict[str, Any]:
         """One reading for the detector: every signal with live evidence
         (missing keys are the absence contract). Runs WITHOUT the
         monitor lock — every source has its own synchronization story
@@ -326,6 +378,10 @@ class IncidentMonitor:
                for f in self.fleets):
             readings["replica_failure"] = float(
                 len(self._unhealthy_replicas()))
+        if self.tsdb is not None:
+            self._trend_readings_from_store(
+                readings, float(self._clock() if now is None else now))
+            return readings
         sheds = [f.shed_total() for f in self.fleets
                  if hasattr(f, "shed_total")]
         if sheds:
@@ -349,7 +405,20 @@ class IncidentMonitor:
         bundle capture, tracer events, metric bumps — run outside the
         state lock."""
         now = self._clock() if now is None else float(now)
-        readings = self.collect()
+        if self.tsdb is not None:
+            # Aligned sweep: the derivative readings diff the store's
+            # samples at consecutive polls, so every poll contributes
+            # exactly one window endpoint (the sampler thread's own
+            # cadence only adds resolution in between).
+            self.tsdb.sample_once(now)
+        readings = self.collect(now)
+        if self.tsdb is not None:
+            # The detector's input readings become first-class history:
+            # what the bundle lookback and `runbook incident show`
+            # render. Absent signals ingest nothing.
+            for signal, value in sorted(readings.items()):
+                self.tsdb.ingest(now, SIGNAL_SERIES,
+                                 (("signal", signal),), float(value))
         with self._lock:
             events = self._detector.observe(now, readings)
             for kind, inc in events:
@@ -447,16 +516,46 @@ class IncidentMonitor:
         body["metrics"] = metrics_mod.get_registry().render()
         return body
 
+    def history_section(self,
+                        now: Optional[float] = None,
+                        ) -> Optional[dict[str, Any]]:
+        """The bundle's pre-open lookback: every INCIDENT_SIGNALS entry
+        with stored samples inside ``history_lookback_s`` of ``now``,
+        as ``[ts, value]`` pairs from the SIGNAL_SERIES history the
+        poll loop ingests. None when no store is attached (the bundle
+        then carries no ``history`` key at all); a signal that was
+        absent over the whole window is absent here too."""
+        if self.tsdb is None:
+            return None
+        now = float(self._clock() if now is None else now)
+        signals: dict[str, list[list[float]]] = {}
+        for labels, pts in self.tsdb.select(
+                SIGNAL_SERIES, now - self.history_lookback_s, now):
+            name = labels.get("signal")
+            if name in INCIDENT_SIGNALS:
+                signals[name] = [[round(ts, 3), round(v, 6)]
+                                 for ts, v in pts]
+        return {"schema_version": HISTORY_SCHEMA_VERSION,
+                "lookback_s": round(self.history_lookback_s, 3),
+                "signals": dict(sorted(signals.items()))}
+
     def capture_bundle(self, inc: dict[str, Any]) -> Optional[Path]:
         """Write one incident's bundle (schema-versioned, content-hashed,
         rotation-pruned). Failures never propagate into the poll loop —
         a full disk must not stop detection."""
+        doc: dict[str, Any] = {
+            "captured_ts": round(self._clock(), 3),
+            "incident": dict(inc),
+            "evidence": self.evidence(),
+        }
+        history = self.history_section()
+        if history is not None:
+            # Inside the content-hash envelope: verify_bundle covers
+            # the lookback exactly like every other evidence section.
+            doc["history"] = history
         try:
-            path = write_bundle(self.bundle_dir, {
-                "captured_ts": round(self._clock(), 3),
-                "incident": dict(inc),
-                "evidence": self.evidence(),
-            }, max_bundles=self.max_bundles)
+            path = write_bundle(self.bundle_dir, doc,
+                                max_bundles=self.max_bundles)
         except (OSError, TypeError, ValueError):
             # Full disk, or an evidence source emitting something even
             # default=str cannot serialize — detection keeps running.
@@ -507,6 +606,7 @@ class IncidentMonitor:
     def from_config(cls, llm_cfg: Any, *, fleets: Sequence[Any] = (),
                     cores: Optional[Sequence[Any]] = None,
                     slo_monitor: Any = None, workload_monitor: Any = None,
+                    tsdb: Any = None,
                     ) -> Optional["IncidentMonitor"]:
         """Build from ``llm.obs`` (None when the obs layer or incident
         detection is disabled). The drift policy's open threshold tracks
@@ -521,17 +621,22 @@ class IncidentMonitor:
                                           0.35)),
             open_after_s=getattr(obs_cfg, "incident_open_s", 5.0),
             resolve_after_s=getattr(obs_cfg, "incident_resolve_s", 10.0)))
+        tsdb_cfg = getattr(obs_cfg, "tsdb", None)
         return cls(
             fleets, cores=cores, slo_monitor=slo_monitor,
             workload_monitor=workload_monitor, detector=detector,
             bundle_dir=getattr(obs_cfg, "incident_dir", None),
             max_bundles=getattr(obs_cfg, "incident_max_bundles", 16),
             poll_interval_s=getattr(obs_cfg, "incident_poll_interval_s",
-                                    1.0))
+                                    1.0),
+            tsdb=tsdb,
+            history_lookback_s=getattr(tsdb_cfg, "lookback_s", 60.0)
+            if tsdb_cfg is not None else 60.0)
 
 
 __all__ = [
-    "BUNDLE_SCHEMA_VERSION", "INCIDENT_DURATION_BUCKETS",
+    "BUNDLE_SCHEMA_VERSION", "HISTORY_SCHEMA_VERSION",
+    "INCIDENT_DURATION_BUCKETS", "SIGNAL_SERIES",
     "IncidentMonitor", "bundle_hash", "list_bundles", "load_bundle",
     "verify_bundle", "write_bundle",
 ]

@@ -1,0 +1,301 @@
+"""PromQL-lite over the embedded time-series store (obs/tsdb.py).
+
+A deliberately small query language so the soak gate, the incident
+monitor, ``GET /debug/query`` and ``runbook query`` all speak ONE
+dialect that transfers to real Prometheus (docs/observability.md
+"Metric history & query" has the grammar and the mapping table):
+
+- instant selector          ``runbook_kv_pages_in_use{replica="0"}``
+- label matchers            ``=``, ``!=``, ``=~``, ``!~`` (full-match)
+- ``rate(sel[5m])``         per-second increase, counter-reset aware
+- ``increase(sel[5m])``     total increase, counter-reset aware
+- ``avg/min/max_over_time(sel[5m])``
+- ``histogram_quantile(0.95, runbook_ttft_seconds_bucket[5m])``
+  over bucket-snapshot increases (the shared
+  :func:`~runbookai_tpu.utils.metrics.percentile_from_counts`
+  interpolation — the same math as the feedback controller's burn
+  windows and the incident monitor's queue-wait reading).
+
+Evaluation is a **pure function of (store contents, query, now)**: no
+wall clock, no randomness, values rounded at emission, results sorted
+by canonical labels — the same fixture, query and ``now`` produce
+byte-identical :func:`result_json` output (pinned by
+tests/test_tsdb.py). Windows are CLOSED ``[now - range, now]``;
+``rate``/``increase`` need at least two samples in the window and a
+window with too little data yields an EMPTY result — absence, never
+zero (the ``runbook_slo_*`` contract, carried through the store).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Optional, Sequence
+
+from runbookai_tpu.utils.metrics import percentile_from_counts
+
+# Default window when a range function's selector carries no explicit
+# [d] (the server's ?range= and the CLI's --range override it).
+DEFAULT_RANGE_S = 300.0
+
+_RANGE_FUNCS = ("rate", "increase", "avg_over_time", "min_over_time",
+                "max_over_time")
+
+_DURATION_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*(ms|s|m|h|d)\s*$")
+_DURATION_UNITS = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0,
+                   "d": 86400.0}
+
+_SELECTOR_RE = re.compile(
+    r"^\s*(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)"
+    r"(?:\{(?P<matchers>[^}]*)\})?"
+    r"(?:\[(?P<range>[^\]]+)\])?\s*$")
+
+_MATCHER_RE = re.compile(
+    r'\s*([a-zA-Z_][a-zA-Z0-9_]*)\s*(=~|!~|!=|=)\s*"((?:[^"\\]|\\.)*)"\s*')
+
+
+class QueryError(ValueError):
+    """Unparseable expression / bad operand — surfaces as HTTP 400."""
+
+
+def parse_duration(text: str) -> float:
+    m = _DURATION_RE.match(str(text))
+    if m is None:
+        raise QueryError(f"bad duration {text!r} (want e.g. 30s, 5m, 1h)")
+    return float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+
+
+def _parse_matchers(body: str) -> list[tuple[str, str, str]]:
+    matchers: list[tuple[str, str, str]] = []
+    pos = 0
+    body = body.strip()
+    while pos < len(body):
+        m = _MATCHER_RE.match(body, pos)
+        if m is None:
+            raise QueryError(f"bad label matcher near {body[pos:]!r}")
+        label, op, value = m.group(1), m.group(2), m.group(3)
+        value = value.replace('\\"', '"').replace("\\\\", "\\")
+        if op in ("=~", "!~"):
+            try:
+                re.compile(value)
+            except re.error as e:
+                raise QueryError(
+                    f"bad regex {value!r} for {label}: {e}") from e
+        matchers.append((label, op, value))
+        pos = m.end()
+        if pos < len(body):
+            if body[pos] != ",":
+                raise QueryError(f"bad label matcher near {body[pos:]!r}")
+            pos += 1
+    return matchers
+
+
+def _parse_selector(text: str) -> dict[str, Any]:
+    m = _SELECTOR_RE.match(text)
+    if m is None:
+        raise QueryError(f"bad selector {text!r}")
+    range_s = (parse_duration(m.group("range"))
+               if m.group("range") is not None else None)
+    matchers = (_parse_matchers(m.group("matchers"))
+                if m.group("matchers") else [])
+    return {"name": m.group("name"), "matchers": matchers,
+            "range_s": range_s}
+
+
+def parse(expr: str) -> dict[str, Any]:
+    """Expression AST: ``{"fn", "q", "selector"}`` — ``fn`` is None for
+    a bare (instant) selector, ``q`` only for histogram_quantile."""
+    expr = str(expr).strip()
+    if not expr:
+        raise QueryError("empty expression")
+    m = re.match(r"^([a-z_]+)\s*\((.*)\)\s*$", expr, re.DOTALL)
+    if m is None:
+        return {"fn": None, "q": None, "selector": _parse_selector(expr)}
+    fn, args = m.group(1), m.group(2).strip()
+    if fn == "histogram_quantile":
+        head, sep, rest = args.partition(",")
+        if not sep:
+            raise QueryError(
+                "histogram_quantile wants (q, name_bucket[range])")
+        try:
+            q = float(head.strip())
+        except ValueError as e:
+            raise QueryError(f"bad quantile {head.strip()!r}") from e
+        if not 0.0 < q <= 1.0:
+            raise QueryError(f"quantile must be in (0, 1], got {q}")
+        selector = _parse_selector(rest.strip())
+        if not selector["name"].endswith("_bucket"):
+            raise QueryError(
+                "histogram_quantile wants a _bucket selector, got "
+                f"{selector['name']!r}")
+        return {"fn": fn, "q": q, "selector": selector}
+    if fn not in _RANGE_FUNCS:
+        raise QueryError(
+            f"unknown function {fn!r}; supported: "
+            f"{', '.join((*_RANGE_FUNCS, 'histogram_quantile'))}")
+    return {"fn": fn, "q": None, "selector": _parse_selector(args)}
+
+
+# ---------------------------------------------------------------- matching
+
+
+def _label_match(labels: dict[str, str],
+                 matchers: Sequence[tuple[str, str, str]]) -> bool:
+    for label, op, value in matchers:
+        have = labels.get(label, "")
+        if op == "=":
+            ok = have == value
+        elif op == "!=":
+            ok = have != value
+        elif op == "=~":
+            ok = re.fullmatch(value, have) is not None
+        else:  # !~
+            ok = re.fullmatch(value, have) is None
+        if not ok:
+            return False
+    return True
+
+
+def match_series(series: Sequence[tuple[dict[str, str], list]],
+                 matchers: Sequence[tuple[str, str, str]],
+                 ) -> list[tuple[dict[str, str], list]]:
+    """Filter ``store.select`` rows by label matchers."""
+    return [(labels, pts) for labels, pts in series
+            if _label_match(labels, matchers)]
+
+
+# -------------------------------------------------------------- evaluation
+
+
+def counter_increase(samples: Sequence[tuple[float, float]],
+                     ) -> Optional[float]:
+    """Total increase across ``samples``, counter-reset aware: a value
+    going backwards means the counter restarted from zero, so the
+    post-reset value itself is the contribution (the Prometheus
+    ``increase`` reset rule, without its window extrapolation). None
+    below two samples — one point carries no derivative."""
+    if len(samples) < 2:
+        return None
+    inc = 0.0
+    prev = samples[0][1]
+    for _, value in samples[1:]:
+        inc += (value - prev) if value >= prev else value
+        prev = value
+    return inc
+
+
+def bucket_quantile(series: Sequence[tuple[dict[str, str], list]],
+                    q: float) -> list[tuple[dict[str, str], float]]:
+    """``histogram_quantile`` core over ``_bucket`` series rows: group
+    by labels minus ``le``, diff each bucket's cumulative count across
+    its window (reset-aware), convert to per-bucket counts and
+    interpolate with the shared ``percentile_from_counts``. ``q`` is a
+    ratio in (0, 1]. Groups whose window carries no observation are
+    omitted (absence)."""
+    groups: dict[tuple[tuple[str, str], ...],
+                 list[tuple[float, Optional[float]]]] = {}
+    for labels, pts in series:
+        if "le" not in labels:
+            continue
+        le_raw = labels["le"]
+        le = float("inf") if le_raw == "+Inf" else float(le_raw)
+        key = tuple(sorted((k, v) for k, v in labels.items()
+                           if k != "le"))
+        groups.setdefault(key, []).append((le, counter_increase(pts)))
+    out: list[tuple[dict[str, str], float]] = []
+    for key, rows in sorted(groups.items()):
+        rows = [(le, inc) for le, inc in rows if inc is not None]
+        if not rows:
+            continue
+        rows.sort()
+        cumulative = [max(0.0, inc) for _, inc in rows]
+        counts = [cumulative[0]]
+        counts += [max(0.0, b - a)
+                   for a, b in zip(cumulative, cumulative[1:])]
+        bounds = [le for le, _ in rows if le != float("inf")]
+        if not bounds:
+            continue
+        if rows[-1][0] != float("inf"):
+            counts.append(0.0)  # no +Inf series sampled: empty overflow
+        value = percentile_from_counts(bounds, counts, q * 100.0)
+        if value is not None:
+            out.append((dict(key), value))
+    return out
+
+
+def _over_time(fn: str, values: Sequence[float]) -> float:
+    if fn == "avg_over_time":
+        return sum(values) / len(values)
+    if fn == "min_over_time":
+        return min(values)
+    return max(values)
+
+
+def evaluate(store: Any, expr: str, *, now: Optional[float] = None,
+             default_range_s: float = DEFAULT_RANGE_S) -> dict[str, Any]:
+    """Evaluate ``expr`` against ``store`` at ``now`` (store clock when
+    None). Pure: same store contents + expr + now ⇒ the same document,
+    and :func:`result_json` makes that byte-identical. Instant
+    selectors return each series' LATEST sample inside the window
+    (staleness bound = the window)."""
+    ast = parse(expr)
+    now = float(store.clock() if now is None else now)
+    selector = ast["selector"]
+    range_s = (selector["range_s"] if selector["range_s"] is not None
+               else float(default_range_s))
+    if range_s <= 0:
+        raise QueryError(f"range must be > 0, got {range_s}")
+    series = match_series(
+        store.select(selector["name"], now - range_s, now),
+        selector["matchers"])
+    fn = ast["fn"]
+    rows: list[tuple[dict[str, str], float]] = []
+    if fn is None:
+        for labels, pts in series:
+            rows.append(({"__name__": selector["name"], **labels},
+                         pts[-1][1]))
+    elif fn in ("rate", "increase"):
+        for labels, pts in series:
+            inc = counter_increase(pts)
+            if inc is None:
+                continue
+            if fn == "rate":
+                span = pts[-1][0] - pts[0][0]
+                if span <= 0:
+                    continue
+                rows.append((labels, inc / span))
+            else:
+                rows.append((labels, inc))
+    elif fn in ("avg_over_time", "min_over_time", "max_over_time"):
+        for labels, pts in series:
+            rows.append((labels, _over_time(fn, [v for _, v in pts])))
+    else:  # histogram_quantile
+        rows = bucket_quantile(series, ast["q"])
+    rows.sort(key=lambda row: sorted(row[0].items()))
+    return {
+        "expr": expr,
+        "now": round(now, 3),
+        "range_s": round(range_s, 3),
+        "result": [{"metric": dict(sorted(labels.items())),
+                    "value": round(float(value), 9)}
+                   for labels, value in rows],
+    }
+
+
+def result_json(doc: dict[str, Any]) -> str:
+    """Canonical bytes of an :func:`evaluate` document — THE form the
+    determinism pin compares and ``GET /debug/query`` serves."""
+    return json.dumps(doc, sort_keys=True, separators=(",", ":"))
+
+
+def evaluate_json(store: Any, expr: str, *, now: Optional[float] = None,
+                  default_range_s: float = DEFAULT_RANGE_S) -> str:
+    return result_json(evaluate(store, expr, now=now,
+                                default_range_s=default_range_s))
+
+
+__all__ = [
+    "DEFAULT_RANGE_S", "QueryError", "bucket_quantile",
+    "counter_increase", "evaluate", "evaluate_json", "match_series",
+    "parse", "parse_duration", "result_json",
+]

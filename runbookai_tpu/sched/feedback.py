@@ -78,12 +78,14 @@ class MixedBudgetController:
         self._steps = 0
         self._levels: list[int] = []
         self._level = 0  # index into _levels; 0 = the configured budget
-        # Bucket-count snapshot at the last consumed decision window:
-        # burn is computed over the observations SINCE it, never the
-        # process-lifetime histogram (whose percentile would take hours
-        # of bad samples to move after a day of good ones — inert
-        # exactly when the controller must act).
-        self._window_mark: Optional[list[float]] = None
+        # Bucket-snapshot window over the TPOT histogram (the shared
+        # utils/metrics.HistogramWindow): burn is computed over the
+        # observations SINCE the last consumed decision window, never
+        # the process-lifetime histogram (whose percentile would take
+        # hours of bad samples to move after a day of good ones — inert
+        # exactly when the controller must act). Built lazily: the
+        # monitor's histogram may register after the controller.
+        self._window: Optional[metrics_mod.HistogramWindow] = None
         reg = registry or metrics_mod.get_registry()
         self._m_adjust = reg.counter(
             "runbook_sched_feedback_adjustments_total",
@@ -123,24 +125,19 @@ class MixedBudgetController:
         hist = self.monitor.histogram(TPOT_OBJECTIVE)
         if hist is None:
             return None
-        counts = hist.bucket_counts()
-        if self._window_mark is None:
-            # First window reads everything observed so far (a synthetic
-            # over-SLO fixture must register on the first decision).
-            self._window_mark = [0.0] * len(counts)
-        if any(now < then for now, then in zip(counts, self._window_mark)):
-            # The histogram was reset under us (bench warmup, tests):
-            # resync rather than serving a garbage negative window.
-            self._window_mark = counts
+        if self._window is None:
+            # prime_zero: the first window reads everything observed so
+            # far (a synthetic over-SLO fixture must register on the
+            # first decision). Reset-resync and the mark-advances-only-
+            # when-consumed accumulation live in the shared helper.
+            self._window = metrics_mod.HistogramWindow(hist,
+                                                       prime_zero=True)
+        window = self._window.advance(self.min_window_obs)
+        if window is None:
             return None
-        window = sum(now - then
-                     for now, then in zip(counts, self._window_mark))
-        if window < self.min_window_obs:
-            return None
-        current_s = hist.percentile_since(
-            self.monitor.objectives[TPOT_OBJECTIVE]["q"],
-            self._window_mark)
-        self._window_mark = counts
+        current_s = metrics_mod.percentile_from_counts(
+            hist.buckets, window,
+            self.monitor.objectives[TPOT_OBJECTIVE]["q"])
         if current_s is None:
             return None
         return (current_s * 1e3

@@ -85,7 +85,7 @@ from runbookai_tpu.utils.trace import get_tracer
 _KNOWN_ROUTES = frozenset((
     "/v1/chat/completions", "/v1/completions", "/v1/embeddings",
     "/v1/adapters", "/v1/models", "/healthz", "/metrics", "/debug/steps",
-    "/debug/workload", "/debug/incidents", "/tenants",
+    "/debug/workload", "/debug/incidents", "/debug/query", "/tenants",
 ))
 
 # Every status this server emits; anything novel scrapes as "other" so the
@@ -585,6 +585,9 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 self._json(200, monitor.snapshot() if monitor is not None
                            else {"enabled": False, "models": {}})
                 return
+            if path == "/debug/query":
+                self._debug_query(query)
+                return
             if path == "/debug/incidents":
                 # Live incident feed + captured-bundle listing
                 # (obs/incident.py). Without a monitor the surface
@@ -658,6 +661,13 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                     # per-group for multi-model fleets, merged
                     # fleet-wide like debug_steps.
                     body["workload"] = monitor.snapshot()
+                store = getattr(client, "tsdb", None)
+                if store is not None:
+                    # Metric-history accounting (obs/tsdb.py): series /
+                    # sample / memory bounds of the embedded store that
+                    # /debug/query evaluates against. Block present
+                    # only when a store is attached (llm.obs.tsdb).
+                    body["history"] = store.snapshot()
                 incidents = getattr(client, "incident_monitor", None)
                 if incidents is not None:
                     # Incident feed (obs/incident.py): open incidents +
@@ -706,6 +716,52 @@ def make_handler(bridge: _EngineBridge, model_name: str,
                 self._error(404, "engine has no flight recorder")
                 return
             self._json(200, snap_fn(n))
+
+        def _debug_query(self, query: str) -> None:
+            """``GET /debug/query?expr=EXPR[&range=5m]`` — PromQL-lite
+            over the embedded time-series store (obs/tsdb.py +
+            obs/query.py). The body is the evaluator's CANONICAL bytes
+            (sorted keys, compact separators), so the query-determinism
+            pin covers the HTTP surface too. Without a store the
+            surface reports itself disabled (not 404 — the CLI
+            distinguishes "off" from "no server"), matching
+            /debug/workload."""
+            import urllib.parse
+
+            from runbookai_tpu.obs.query import (
+                QueryError,
+                evaluate,
+                parse_duration,
+                result_json,
+            )
+
+            store = getattr(client, "tsdb", None)
+            params = urllib.parse.parse_qs(query)
+            expr = (params.get("expr") or [""])[0]
+            if store is None:
+                self._json(200, {"enabled": False, "expr": expr,
+                                 "result": []})
+                return
+            if not expr:
+                self._error(400, "expr parameter is required")
+                return
+            range_s = None
+            raw_range = (params.get("range") or [None])[0]
+            try:
+                if raw_range:
+                    range_s = parse_duration(raw_range)
+                doc = evaluate(store, expr,
+                               **({"default_range_s": range_s}
+                                  if range_s is not None else {}))
+            except QueryError as e:
+                self._error(400, str(e))
+                return
+            body = result_json(doc).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def _route_post(self) -> None:
             if self.path == "/v1/adapters":

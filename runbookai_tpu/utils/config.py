@@ -248,6 +248,31 @@ class WorkloadDescriptorConfig(BaseModel):
         return self.model_dump()
 
 
+class TsdbConfig(BaseModel):
+    """Embedded telemetry time-series store (``llm.obs.tsdb`` →
+    ``runbookai_tpu/obs/tsdb.py``): a bounded ring-buffer history over
+    every exported ``runbook_*`` series, sampled from the live metrics
+    registry every ``interval_s``. Powers ``GET /debug/query`` /
+    ``runbook query`` (PromQL-lite), the ``/healthz`` ``history``
+    block, incident-bundle lookback windows and the soak gate's
+    query-expressed invariants. ``enabled: false`` removes every
+    ``runbook_tsdb_*`` series and every surface on top."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    enabled: bool = True
+    # Registry sweep cadence (seconds).
+    interval_s: float = Field(1.0, gt=0)
+    # Per-series ring horizon: samples older than this are pruned.
+    retention_s: float = Field(600.0, gt=0)
+    # Cap on distinct stored series; new series past it are dropped
+    # (and counted in the /healthz history block).
+    max_series: int = Field(2048, ge=16)
+    # Pre-open lookback window embedded in incident bundles' `history`
+    # section (seconds of detector-input signals before the open).
+    lookback_s: float = Field(60.0, gt=0)
+
+
 class ObsConfig(BaseModel):
     """Continuous workload fingerprinting + drift detection
     (``llm.obs`` → ``runbookai_tpu/obs``). On by default: the layer is
@@ -296,6 +321,9 @@ class ObsConfig(BaseModel):
     # keep their own constants — see obs/detect.default_policies.
     incident_open_s: float = Field(5.0, ge=0)
     incident_resolve_s: float = Field(10.0, ge=0)
+    # Embedded metric history + PromQL-lite query surface
+    # (obs/tsdb.py, obs/query.py).
+    tsdb: TsdbConfig = Field(default_factory=TsdbConfig)
 
 
 # Keys a model-group entry owns (or that cannot nest): a group's

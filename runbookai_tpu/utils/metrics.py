@@ -54,6 +54,97 @@ MIXED_TOKENS_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
                         512.0, 1024.0, 2048.0, 4096.0)
 
 
+def percentile_from_counts(bounds: Sequence[float],
+                           counts: Sequence[float],
+                           q: float) -> Optional[float]:
+    """q-th percentile (0–100) from per-bucket observation counts — the
+    ONE bucket-interpolation implementation. ``bounds`` are a
+    histogram's finite upper bounds; ``counts`` carries one entry per
+    finite bucket plus the trailing ``+Inf`` overflow (the
+    :meth:`Histogram.bucket_counts` layout). Linear interpolation inside
+    the winning bucket; the overflow clamps to the last finite bound.
+    None when the window is empty.
+
+    Every windowed-percentile consumer goes through here: lifetime and
+    windowed :class:`Histogram` percentiles, the sched/feedback burn
+    windows and obs/incident queue-wait readings (via
+    :class:`HistogramWindow`), and obs/query's ``histogram_quantile()``
+    over stored bucket snapshots — pinned by the parity test in
+    tests/test_tsdb.py so the implementations cannot re-diverge.
+    """
+    counts = list(counts)
+    total = sum(counts)
+    if total == 0:
+        return None
+    target = max(1.0, math.ceil(q / 100.0 * total))
+    cum = 0.0
+    lower = 0.0
+    for i, upper in enumerate(bounds):
+        c = counts[i]
+        if cum + c >= target:
+            return lower + (upper - lower) * ((target - cum) / c)
+        cum += c
+        lower = upper
+    return float(bounds[-1])
+
+
+class HistogramWindow:
+    """Bucket-snapshot-diff windowing over one :class:`Histogram`
+    labelset — the shared spelling of "percentile of the observations
+    since the last decision point" (previously hand-rolled in parallel
+    by ``sched/feedback.MixedBudgetController.burn`` and
+    ``obs/incident.IncidentMonitor``).
+
+    Semantics, chosen so both call sites keep their behavior:
+
+    - the mark advances only when a window is CONSUMED (``advance``
+      returned counts), so sparse traffic accumulates until it carries
+      at least ``min_obs`` observations instead of being dropped;
+    - a histogram reset under us (any bucket count going backwards —
+      bench warmup, tests) resyncs the mark and yields None rather than
+      a garbage negative window;
+    - ``prime_zero=True`` makes the first window read everything
+      observed so far (the feedback controller's first decision);
+      the default primes at the current counts, so the first call only
+      sets the mark (the incident monitor's first poll is absent).
+    """
+
+    def __init__(self, hist: "Histogram", key: tuple[str, ...] = (), *,
+                 prime_zero: bool = False):
+        self.hist = hist
+        self.key = tuple(key)
+        self._mark: Optional[list[float]] = None
+        self._prime_zero = bool(prime_zero)
+
+    def advance(self, min_obs: int = 1) -> Optional[list[float]]:
+        """Per-bucket counts of the observations since the last consumed
+        window, or None (too few, reset, or an unprimed first call)."""
+        counts = self.hist.bucket_counts(self.key)
+        if self._mark is None:
+            if self._prime_zero:
+                self._mark = [0.0] * len(counts)
+            else:
+                self._mark = counts
+                return None
+        if any(now < then for now, then in zip(counts, self._mark)):
+            self._mark = counts
+            return None
+        window = [now - then for now, then in zip(counts, self._mark)]
+        if sum(window) < max(1, int(min_obs)):
+            return None
+        self._mark = counts
+        return window
+
+    def percentile(self, q: float,
+                   min_obs: int = 1) -> Optional[float]:
+        """``advance()`` + interpolate in one call (the incident
+        monitor's queue-wait reading)."""
+        window = self.advance(min_obs)
+        if window is None:
+            return None
+        return percentile_from_counts(self.hist.buckets, window, q)
+
+
 def _escape_label_value(value: str) -> str:
     return (value.replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
@@ -369,19 +460,7 @@ class Histogram(_Metric):
         return self._interpolate(self._state(key)[0], q)
 
     def _interpolate(self, counts: list[float], q: float) -> Optional[float]:
-        total = sum(counts)
-        if total == 0:
-            return None
-        target = max(1.0, math.ceil(q / 100.0 * total))
-        cum = 0.0
-        lower = 0.0
-        for i, upper in enumerate(self.buckets):
-            c = counts[i]
-            if cum + c >= target:
-                return lower + (upper - lower) * ((target - cum) / c)
-            cum += c
-            lower = upper
-        return self.buckets[-1]
+        return percentile_from_counts(self.buckets, counts, q)
 
     def bucket_counts(self, key: tuple[str, ...] = ()) -> list[float]:
         """Per-bucket observation counts (finite buckets + the ``+Inf``

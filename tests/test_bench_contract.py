@@ -462,6 +462,26 @@ def test_bench_soak_scenarios_smoke_chaos_gate(monkeypatch, capsys):
     assert cov["baseline_opens"] == 0
     assert cov["bundles"] and all(
         b["hash_verified"] and b["schema_valid"] for b in cov["bundles"])
+    # Embedded-history gate (obs/tsdb.py + obs/query.py): every bundle
+    # carries its hash-verified pre-open lookback window, the chaos
+    # pass's store actually held series, and the query-expressed
+    # invariants — the same gate conditions re-derived through the
+    # PromQL-lite evaluator — all hold. query_detection_coverage in
+    # particular must have SAMPLED runbook_incident_open >= 1: that
+    # gauge is absent while nothing is open, so a stored value proves
+    # the ring caught the incident in flight.
+    assert all(b["has_history"] for b in cov["bundles"]), cov["bundles"]
+    assert d["tsdb"]["series"] > 0 and d["tsdb"]["samples"] > 0
+    assert d["tsdb"]["dropped_series"] == 0
+    for name in ("query_baseline_zero_incidents",
+                 "query_baseline_zero_lost",
+                 "query_detection_coverage",
+                 "query_interactive_ttft_p95"):
+        assert d["invariants"][name]["passed"] is True, \
+            d["invariants"][name]
+    qcov = d["invariants"]["query_detection_coverage"]
+    assert qcov["crash_applied"] is True
+    assert any(v >= 1 for v in qcov["values"]), qcov
     crash_rows = [r for r in d["incident_coverage"]
                   if r["kind"] == "replica_crash"]
     assert crash_rows, d["incident_coverage"]

@@ -95,6 +95,11 @@ def test_live_registry_matches_doc_catalog(monkeypatch, tmp_path):
     from runbookai_tpu.obs import IncidentMonitor
 
     IncidentMonitor([client.engine], registry=fresh)
+    # Embedded time-series store (obs/tsdb.py): series/samples/memory
+    # self-accounting. Not started — registration is construction-time.
+    from runbookai_tpu.obs import MetricsTSDB
+
+    MetricsTSDB(registry=fresh)
     # Chaos supervision + fault injection (runbookai_tpu/chaos):
     # supervisor state/transition/rebuild/failover series and the
     # per-kind fault counter (the retry-backoff histogram registers

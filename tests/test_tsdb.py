@@ -1,0 +1,587 @@
+"""Embedded metric history + PromQL-lite (runbookai_tpu/obs/tsdb.py,
+obs/query.py) and the shared windowed-percentile helper
+(utils/metrics.percentile_from_counts / HistogramWindow).
+
+Pins: ring bounds (retention pruning, count cap, max_series drop
+accounting), absence-not-zero carried through the sampler (a dropped
+registry series stores NOTHING for that tick), query determinism on a
+seeded fixture (byte-identical canonical JSON, pinned literally),
+``rate()``/``increase()`` counter-reset handling, the percentile-parity
+regression between the ONE shared interpolation and the previously
+hand-rolled feedback algorithm, config gating (``llm.obs.tsdb.enabled:
+false`` ⇒ no store, no surfaces, no bundle history), the e2e dp=2
+surfaces (``GET /debug/query``, the ``/healthz`` ``history`` block,
+``runbook query``), bundle lookback history under the content hash,
+and the read-only claim: generated tokens are byte-identical with the
+store on vs off.
+"""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from runbookai_tpu.obs import (
+    HISTORY_SCHEMA_VERSION,
+    SIGNAL_SERIES,
+    IncidentDetector,
+    IncidentMonitor,
+    MetricsTSDB,
+    QueryError,
+    SignalPolicy,
+    evaluate,
+    evaluate_json,
+    result_json,
+    verify_bundle,
+)
+from runbookai_tpu.obs.query import (
+    bucket_quantile,
+    counter_increase,
+    parse,
+    parse_duration,
+)
+from runbookai_tpu.utils import metrics as metrics_mod
+from runbookai_tpu.utils.metrics import (
+    HistogramWindow,
+    percentile_from_counts,
+)
+
+
+def _fixture_store(now: float = 150.0) -> MetricsTSDB:
+    """The seeded query fixture: two counter series (one with a reset),
+    two gauge series, one histogram bucket family. Deterministic —
+    injected clock, explicit ingest timestamps."""
+    store = MetricsTSDB(interval_s=1.0, retention_s=3600.0, max_series=64,
+                        registry=metrics_mod.MetricsRegistry(),
+                        clock=lambda: now)
+    for ts, v in ((100, 0), (110, 5), (120, 7), (130, 2), (140, 4)):
+        store.ingest(ts, "runbook_demo_total", {"replica": "0"}, v)
+    for ts, v in ((100, 1), (140, 3)):
+        store.ingest(ts, "runbook_demo_total", {"replica": "1"}, v)
+    for ts, v in ((100, 1.5), (120, 2.5)):
+        store.ingest(ts, "runbook_gauge", {"zone": "a"}, v)
+    store.ingest(110, "runbook_gauge", {"zone": "b"}, 7.0)
+    for le, t0, t1 in (("0.1", 0, 4), ("1.0", 0, 9), ("+Inf", 0, 10)):
+        store.ingest(100, "runbook_lat_seconds_bucket", {"le": le}, t0)
+        store.ingest(140, "runbook_lat_seconds_bucket", {"le": le}, t1)
+    return store
+
+
+# ------------------------------------------------------------ ring bounds
+
+
+def test_retention_pruning_and_count_cap():
+    # retention 1000 / interval 100 → ring cap max(64, 10*4) = 64.
+    store = MetricsTSDB(interval_s=100.0, retention_s=1000.0,
+                        max_series=8,
+                        registry=metrics_mod.MetricsRegistry())
+    for ts in range(200):
+        store.ingest(float(ts), "runbook_x", (), float(ts))
+    [(labels, pts)] = store.select("runbook_x")
+    assert labels == {}
+    assert len(pts) == 64  # count cap, not the 200 appended
+    assert pts[-1] == (199.0, 199.0)
+    # Time pruning: a sample far in the future evicts everything older
+    # than retention_s behind it.
+    store.ingest(5000.0, "runbook_x", (), 1.0)
+    [(_, pts)] = store.select("runbook_x")
+    assert all(ts >= 4000.0 for ts, _ in pts)
+    assert pts[-1] == (5000.0, 1.0)
+
+
+def test_max_series_cap_drops_and_accounts():
+    store = MetricsTSDB(interval_s=1.0, retention_s=60.0, max_series=4,
+                        registry=metrics_mod.MetricsRegistry())
+    for i in range(10):
+        store.ingest(1.0, "runbook_x", {"i": str(i)}, float(i))
+    snap = store.snapshot()
+    assert snap["series"] == 4
+    assert snap["dropped_series"] == 6
+    # Existing series keep accepting samples past the cap.
+    assert store.ingest(2.0, "runbook_x", {"i": "0"}, 9.0) is True
+    assert store.ingest(2.0, "runbook_x", {"i": "9"}, 9.0) is False
+    assert snap["memory_bytes"] > 0 and snap["samples"] == 4
+    assert snap["oldest_ts"] == 1.0
+
+
+def test_self_metrics_registered():
+    reg = metrics_mod.MetricsRegistry()
+    store = MetricsTSDB(registry=reg)
+    store.ingest(1.0, "runbook_x", (), 1.0)
+    rendered = reg.render()
+    assert "runbook_tsdb_series 1" in rendered
+    assert "runbook_tsdb_samples_total 1" in rendered
+    assert "runbook_tsdb_memory_bytes" in rendered
+
+
+# ------------------------------------------------------- absence-not-zero
+
+
+def test_sampler_preserves_absence_not_zero():
+    reg = metrics_mod.MetricsRegistry()
+    g = reg.gauge("runbook_flaky", "test", labels=("replica",))
+    alive = {"ok": False}
+
+    def read():
+        if not alive["ok"]:
+            raise RuntimeError("engine dead")  # registry DROPS the series
+        return 42.0
+
+    g.labels("0").set_function(read)
+    store = MetricsTSDB(interval_s=1.0, retention_s=600.0, registry=reg)
+    store.sample_once(10.0)  # absent tick: nothing stored
+    assert store.select("runbook_flaky") == []
+    alive["ok"] = True
+    store.sample_once(11.0)
+    alive["ok"] = False
+    store.sample_once(12.0)  # absent again
+    [(labels, pts)] = store.select("runbook_flaky")
+    assert labels == {"replica": "0"}
+    assert pts == [(11.0, 42.0)]  # ONE sample — never zeros for 10/12
+    # And a query over a window with no samples is empty, not zero
+    # (the closed window [11.5, 12] misses the lone 11.0 sample).
+    doc = evaluate(store, "runbook_flaky[500ms]", now=12.0)
+    assert doc["result"] == []
+
+
+# ----------------------------------------------------------- determinism
+
+
+# The canonical bytes the fixture must produce — literal pins, so any
+# drift in rounding, ordering, serialization, or evaluator semantics
+# breaks loudly. /debug/query serves exactly these bytes.
+_PINNED = {
+    "increase(runbook_demo_total[60s])":
+        '{"expr":"increase(runbook_demo_total[60s])","now":150.0,'
+        '"range_s":60.0,"result":[{"metric":{"replica":"0"},'
+        '"value":11.0},{"metric":{"replica":"1"},"value":2.0}]}',
+    "rate(runbook_demo_total[60s])":
+        '{"expr":"rate(runbook_demo_total[60s])","now":150.0,'
+        '"range_s":60.0,"result":[{"metric":{"replica":"0"},'
+        '"value":0.275},{"metric":{"replica":"1"},"value":0.05}]}',
+    "runbook_gauge":
+        '{"expr":"runbook_gauge","now":150.0,"range_s":300.0,'
+        '"result":[{"metric":{"__name__":"runbook_gauge","zone":"a"},'
+        '"value":2.5},{"metric":{"__name__":"runbook_gauge",'
+        '"zone":"b"},"value":7.0}]}',
+    "avg_over_time(runbook_gauge[60s])":
+        '{"expr":"avg_over_time(runbook_gauge[60s])","now":150.0,'
+        '"range_s":60.0,"result":[{"metric":{"zone":"a"},"value":2.0},'
+        '{"metric":{"zone":"b"},"value":7.0}]}',
+    'rate(runbook_demo_total{replica="0"}[60s])':
+        '{"expr":"rate(runbook_demo_total{replica=\\"0\\"}[60s])",'
+        '"now":150.0,"range_s":60.0,"result":[{"metric":'
+        '{"replica":"0"},"value":0.275}]}',
+    'runbook_gauge{zone=~"a|c"}':
+        '{"expr":"runbook_gauge{zone=~\\"a|c\\"}","now":150.0,'
+        '"range_s":300.0,"result":[{"metric":{"__name__":'
+        '"runbook_gauge","zone":"a"},"value":2.5}]}',
+    "histogram_quantile(0.95, runbook_lat_seconds_bucket[60s])":
+        '{"expr":"histogram_quantile(0.95, '
+        'runbook_lat_seconds_bucket[60s])","now":150.0,"range_s":60.0,'
+        '"result":[{"metric":{},"value":1.0}]}',
+    "histogram_quantile(0.5, runbook_lat_seconds_bucket[60s])":
+        '{"expr":"histogram_quantile(0.5, '
+        'runbook_lat_seconds_bucket[60s])","now":150.0,"range_s":60.0,'
+        '"result":[{"metric":{},"value":0.28}]}',
+    "max_over_time(runbook_demo_total[25s])":
+        '{"expr":"max_over_time(runbook_demo_total[25s])","now":150.0,'
+        '"range_s":25.0,"result":[{"metric":{"replica":"0"},'
+        '"value":4.0},{"metric":{"replica":"1"},"value":3.0}]}',
+    "increase(runbook_absent_total[60s])":
+        '{"expr":"increase(runbook_absent_total[60s])","now":150.0,'
+        '"range_s":60.0,"result":[]}',
+}
+
+
+def test_query_determinism_byte_identical_pinned():
+    store = _fixture_store()
+    for expr, want in _PINNED.items():
+        got = evaluate_json(store, expr, now=150.0)
+        assert got == want, expr
+        # Pure function: a second evaluation (and one through the
+        # store's own clock) is byte-identical.
+        assert evaluate_json(store, expr, now=150.0) == got
+        assert evaluate_json(store, expr) == got  # clock() → 150.0
+
+
+def test_result_json_is_canonical():
+    doc = evaluate(_fixture_store(), "runbook_gauge", now=150.0)
+    s = result_json(doc)
+    assert s == json.dumps(doc, sort_keys=True, separators=(",", ":"))
+
+
+# -------------------------------------------------------- evaluator rules
+
+
+def test_counter_reset_rule():
+    # 0→5 (+5), 5→7 (+2), 7→2 reset (post-reset value IS the
+    # contribution: +2), 2→4 (+2) = 11 over 40s.
+    pts = [(100, 0.0), (110, 5.0), (120, 7.0), (130, 2.0), (140, 4.0)]
+    assert counter_increase(pts) == 11.0
+    assert counter_increase(pts[:1]) is None  # one point: no derivative
+    assert counter_increase([]) is None
+
+
+def test_rate_needs_two_samples_and_positive_span():
+    store = MetricsTSDB(registry=metrics_mod.MetricsRegistry())
+    store.ingest(100.0, "runbook_one_total", (), 5.0)
+    assert evaluate(store, "rate(runbook_one_total[60s])",
+                    now=150.0)["result"] == []
+
+
+def test_parse_rejections():
+    for bad in ("", "no such thing(", "frobnicate(runbook_x[5m])",
+                "histogram_quantile(2.0, runbook_x_bucket[5m])",
+                "histogram_quantile(0.5, runbook_x[5m])",
+                "histogram_quantile(0.5)",
+                'runbook_x{bad matcher}', 'runbook_x{l=~"(unclosed"}',
+                "runbook_x[5 parsecs]"):
+        with pytest.raises(QueryError):
+            parse(bad)
+    with pytest.raises(QueryError):
+        parse_duration("five minutes")
+    assert parse_duration("90s") == 90.0
+    assert parse_duration("1.5m") == 90.0
+    assert parse_duration("250ms") == 0.25
+    ast = parse('rate(runbook_x{a="1",b!~"x.*"}[2m])')
+    assert ast["fn"] == "rate" and ast["selector"]["range_s"] == 120.0
+    assert ast["selector"]["matchers"] == [("a", "=", "1"),
+                                           ("b", "!~", "x.*")]
+
+
+def test_bucket_quantile_without_inf_series():
+    # A window where +Inf was never sampled gets an empty overflow
+    # bucket, not a crash.
+    series = [({"le": "0.1"}, [(0, 0.0), (10, 4.0)]),
+              ({"le": "1.0"}, [(0, 0.0), (10, 8.0)])]
+    [(labels, value)] = bucket_quantile(series, 0.5)
+    assert labels == {}
+    assert value == pytest.approx(0.1)
+
+
+# ------------------------------------------------------ percentile parity
+
+
+def _legacy_feedback_interpolate(hist_buckets, window, q):
+    """The algorithm sched/feedback.py carried before the extraction —
+    kept verbatim HERE as the regression reference, so the shared
+    helper can never silently diverge from what the burn controller
+    shipped with."""
+    import math
+
+    total = sum(window)
+    if total == 0:
+        return None
+    target = max(1.0, math.ceil(q / 100.0 * total))
+    cum = 0.0
+    lower = 0.0
+    for i, upper in enumerate(hist_buckets):
+        c = window[i]
+        if cum + c >= target:
+            return lower + (upper - lower) * ((target - cum) / c)
+        cum += c
+        lower = upper
+    return float(hist_buckets[-1])
+
+
+def test_percentile_parity_shared_helper_vs_legacy():
+    bounds = list(metrics_mod.TPOT_BUCKETS)
+    cases = [
+        [0.0] * len(bounds) + [0.0],
+        [5.0, 3.0, 2.0] + [0.0] * (len(bounds) - 3) + [0.0],
+        [0.0] * (len(bounds) - 1) + [4.0, 7.0],  # overflow-heavy
+        [1.0] * (len(bounds) + 1),
+    ]
+    for window in cases:
+        for q in (50.0, 90.0, 95.0, 99.0):
+            assert percentile_from_counts(bounds, window, q) \
+                == _legacy_feedback_interpolate(bounds, window, q)
+    # And the lifetime Histogram.percentile rides the same helper.
+    hist = metrics_mod.Histogram("runbook_t_seconds", "t", (0.1, 1.0))
+    for v in (0.05, 0.2, 0.3, 5.0):
+        hist.observe(v)
+    assert hist.percentile(50) == percentile_from_counts(
+        (0.1, 1.0), hist.bucket_counts(), 50)
+
+
+def test_histogram_window_semantics():
+    hist = metrics_mod.Histogram("runbook_w_seconds", "t", (0.1, 1.0))
+    hist.observe(0.05)
+    # Default priming: first call only sets the mark (incident
+    # monitor's first poll is absent)...
+    w = HistogramWindow(hist)
+    assert w.advance() is None
+    hist.observe(0.5)
+    assert w.advance() == [0.0, 1.0, 0.0]
+    # ...prime_zero reads everything so far (feedback's first burn).
+    wz = HistogramWindow(hist, prime_zero=True)
+    assert wz.advance() == [1.0, 1.0, 0.0]
+    # min_obs gating does NOT advance the mark: sparse observations
+    # accumulate until the window carries enough.
+    hist.observe(0.05)
+    assert wz.advance(min_obs=2) is None
+    hist.observe(0.05)
+    assert wz.advance(min_obs=2) == [2.0, 0.0, 0.0]
+    # A reset under the window resyncs and yields None once.
+    hist.reset()
+    hist.observe(2.0)
+    assert wz.advance() is None
+    hist.observe(0.05)
+    # One observation in bucket (0, 0.1] → interpolation lands on the
+    # bucket's upper bound.
+    assert wz.percentile(50) == pytest.approx(0.1)
+
+
+def test_query_quantile_matches_live_histogram_window():
+    """The evaluator's histogram_quantile over stored bucket snapshots
+    equals HistogramWindow.percentile over the live histogram for the
+    same window — detection and /debug/query cannot disagree."""
+    reg = metrics_mod.MetricsRegistry()
+    hist = reg.histogram("runbook_q_seconds", "t", buckets=(0.1, 1.0, 5.0))
+    store = MetricsTSDB(registry=reg)
+    store.sample_once(10.0)
+    window = HistogramWindow(hist)
+    window.advance()  # prime at the same point the store sampled
+    for v in (0.05, 0.3, 0.3, 2.0, 7.0):
+        hist.observe(v)
+    store.sample_once(20.0)
+    doc = evaluate(store,
+                   "histogram_quantile(0.95, runbook_q_seconds_bucket[15s])",
+                   now=20.0)
+    [row] = doc["result"]
+    assert row["value"] == pytest.approx(window.percentile(95))
+
+
+# --------------------------------------------------------- config gating
+
+
+def test_from_config_gating():
+    from runbookai_tpu.utils.config import LLMConfig
+
+    assert MetricsTSDB.from_config(LLMConfig(obs={"enabled": False})) \
+        is None
+    assert MetricsTSDB.from_config(
+        LLMConfig(obs={"tsdb": {"enabled": False}})) is None
+    store = MetricsTSDB.from_config(
+        LLMConfig(obs={"tsdb": {"interval_s": 0.5, "retention_s": 120.0,
+                                "max_series": 32}}),
+        registry=metrics_mod.MetricsRegistry())
+    assert store is not None
+    assert store.interval_s == 0.5 and store.retention_s == 120.0
+    assert store.max_series == 32
+    # Defaults: the store is ON whenever the obs layer is.
+    assert MetricsTSDB.from_config(
+        LLMConfig(), registry=metrics_mod.MetricsRegistry()) is not None
+
+
+# ------------------------------------------------------- bundle history
+
+
+def _shed_policy():
+    return (SignalPolicy("router_shed", 2.0, 1.0, open_after_s=1.0,
+                         resolve_after_s=60.0, severity="major"),)
+
+
+def test_bundle_embeds_hash_verified_history(tmp_path):
+    """A monitor with a store derives router_shed from stored counter
+    increases, ingests every reading as SIGNAL_SERIES, and the bundle
+    captured at open embeds the pre-open lookback INSIDE the content
+    hash — tampering with a history point fails verification."""
+    store = MetricsTSDB(interval_s=1.0, retention_s=600.0,
+                        registry=metrics_mod.MetricsRegistry(),
+                        clock=lambda: 0.0)
+    for ts, v in ((0.0, 0.0), (0.5, 0.0), (1.0, 5.0), (1.5, 5.0),
+                  (2.0, 7.0), (2.5, 9.0)):
+        store.ingest(ts, "runbook_router_shed_total", (), v)
+    monitor = IncidentMonitor(
+        [], detector=IncidentDetector(_shed_policy()),
+        bundle_dir=tmp_path, tsdb=store, history_lookback_s=30.0,
+        clock=lambda: 2.6, registry=metrics_mod.MetricsRegistry())
+    assert monitor.poll_once(0.2) == []      # first poll: no window yet
+    assert monitor.poll_once(1.6) == []      # [0.2,1.6] → +5, breach #1
+    events = monitor.poll_once(2.6)          # sustained ≥ open_after_s
+    assert [k for k, _ in events] == ["open"]
+    inc = events[0][1]
+    assert inc["signal"] == "router_shed"
+    # The detector input history IS in the store (absence for signals
+    # that never read).
+    section = monitor.history_section(now=2.6)
+    assert section["schema_version"] == HISTORY_SCHEMA_VERSION
+    assert list(section["signals"]) == ["router_shed"]
+    assert [v for _, v in section["signals"]["router_shed"]] == [5.0, 2.0]
+    [path] = sorted(tmp_path.glob("*.json"))
+    ok, _, _ = verify_bundle(path)
+    assert ok
+    doc = json.loads(path.read_text())
+    hist = doc["history"]
+    assert hist["schema_version"] == HISTORY_SCHEMA_VERSION
+    assert hist["lookback_s"] == 30.0
+    assert hist["signals"]["router_shed"]  # the pre-open trend
+    # Tamper with ONE history value → the content hash catches it.
+    doc["history"]["signals"]["router_shed"][0][1] = 99.0
+    path.write_text(json.dumps(doc))
+    ok, _, _ = verify_bundle(path)
+    assert not ok
+    # SIGNAL_SERIES is store-only: registering it as a metric would
+    # materialize absent signals at 0.
+    assert metrics_mod.get_registry().get(SIGNAL_SERIES) is None
+
+
+def test_bundle_without_store_has_no_history_key(tmp_path):
+    monitor = IncidentMonitor(
+        [], detector=IncidentDetector(_shed_policy()),
+        bundle_dir=tmp_path, clock=lambda: 99.0,
+        registry=metrics_mod.MetricsRegistry())
+    assert monitor.history_section() is None
+    monitor.capture_bundle({"id": "inc-0001", "signal": "router_shed",
+                            "severity": "major", "status": "open",
+                            "opened_ts": 1.0})
+    [path] = sorted(tmp_path.glob("*.json"))
+    doc = json.loads(path.read_text())
+    assert "history" not in doc
+    ok, _, _ = verify_bundle(path)
+    assert ok
+
+
+# ------------------------------------------------------------- e2e dp=2
+
+
+async def test_server_cli_query_e2e_dp2(capsys):
+    from runbookai_tpu.cli.main import main as cli_main
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.server.openai_api import OpenAIServer
+
+    client = JaxTpuClient.for_testing(max_new_tokens=4, dp_replicas=2)
+    store = MetricsTSDB(interval_s=0.5, retention_s=600.0)
+    client.tsdb = store
+    try:
+        # Two deterministic sweeps around live traffic — no thread.
+        store.sample_once()
+        await client.engine.generate([7] * 16, client._sampling())
+        store.sample_once()
+        srv = OpenAIServer(client, "llama3-test", port=0)
+        srv.start_background()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            qs = urllib.parse.urlencode(
+                {"expr": "increase(runbook_ttft_seconds_count[10m])"})
+            body = urllib.request.urlopen(
+                f"{base}/debug/query?{qs}", timeout=30).read().decode()
+            doc = json.loads(body)
+            assert sum(r["value"] for r in doc["result"]) >= 1.0
+            # The HTTP bytes ARE the evaluator's canonical bytes.
+            assert body == result_json(doc)
+            # A parse error surfaces as 400, not 500.
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"{base}/debug/query?expr=bogus(runbook_x[1m])",
+                    timeout=30)
+            assert err.value.code == 400
+            # /healthz carries the store's accounting block.
+            health = json.loads(urllib.request.urlopen(
+                base + "/healthz", timeout=30).read())
+            assert health["history"]["enabled"] is True
+            assert health["history"]["series"] > 0
+            # The CLI renders the same result through the same route.
+            rc = cli_main(["query",
+                           "runbook_tsdb_series",
+                           "--url", base])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert "runbook_tsdb_series" in out
+            rc = cli_main(["query", "runbook_no_such_series",
+                           "--url", base, "--json"])
+            out = capsys.readouterr().out
+            assert rc == 0 and json.loads(out)["result"] == []
+        finally:
+            srv.shutdown()
+    finally:
+        await client.engine.stop()
+
+
+def test_server_without_store_reports_disabled(capsys):
+    from runbookai_tpu.cli.main import main as cli_main
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.server.openai_api import OpenAIServer
+
+    client = JaxTpuClient.for_testing(max_new_tokens=4)
+    assert client.tsdb is None
+    srv = OpenAIServer(client, "llama3-test", port=0)
+    srv.start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/debug/query?expr=runbook_x", timeout=30).read())
+        assert doc == {"enabled": False, "expr": "runbook_x",
+                       "result": []}
+        health = json.loads(urllib.request.urlopen(
+            base + "/healthz", timeout=30).read())
+        assert "history" not in health  # absent surface, not a zero one
+        rc = cli_main(["query", "runbook_x", "--url", base])
+        err = capsys.readouterr().err
+        assert rc == 1 and "disabled" in err
+    finally:
+        srv.shutdown()
+
+
+def test_client_from_config_wires_and_gates_store(tmp_path):
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+    from runbookai_tpu.utils.config import LLMConfig
+
+    base_kw = dict(provider="jax-tpu", model="llama3-test",
+                   dtype="float32", page_size=4, num_pages=256,
+                   max_batch_slots=4, prefill_chunk=32, max_seq_len=256,
+                   max_new_tokens=8)
+    on = LLMConfig(**base_kw, obs={"tsdb": {"interval_s": 0.2},
+                                   "incident_dir": str(tmp_path)})
+    client = JaxTpuClient.from_config(on)
+    try:
+        assert client.tsdb is not None
+        assert client.tsdb.interval_s == 0.2
+        # The incident monitor rides the SAME store (trend readings +
+        # bundle lookback come from one history).
+        assert client.incident_monitor is not None
+        assert client.incident_monitor.tsdb is client.tsdb
+    finally:
+        if client.incident_monitor is not None:
+            client.incident_monitor.stop()
+        client.tsdb.stop()
+    off = LLMConfig(**base_kw, obs={"tsdb": {"enabled": False},
+                                    "incident_dir": str(tmp_path)})
+    client = JaxTpuClient.from_config(off)
+    try:
+        assert client.tsdb is None  # every surface reports absent
+        assert client.incident_monitor is not None
+        assert client.incident_monitor.tsdb is None
+        assert client.incident_monitor.history_section() is None
+    finally:
+        if client.incident_monitor is not None:
+            client.incident_monitor.stop()
+
+
+async def test_tokens_byte_identical_with_store_on_vs_off():
+    """The read-only claim: a fleet sampled by a live tsdb thread
+    generates byte-identical tokens to an unsampled one (identical
+    seeds, identical prompts)."""
+    from runbookai_tpu.model.jax_tpu import JaxTpuClient
+
+    prompts = [[7] * 24, [9] * 40]
+    outs = {}
+    for sampled in (False, True):
+        client = JaxTpuClient.for_testing(max_new_tokens=8)
+        store = None
+        if sampled:
+            store = MetricsTSDB(interval_s=0.01, retention_s=60.0).start()
+        got = []
+        for p in prompts:
+            out = await client.engine.generate(p, client._sampling())
+            got.append(out.token_ids)
+        outs[sampled] = got
+        if store is not None:
+            assert store.snapshot()["samples"] > 0  # it really sampled
+            store.stop()
+        await client.engine.stop()
+    assert outs[False] == outs[True]
